@@ -1,0 +1,25 @@
+"""arrow_matrix_tpu — a TPU-native framework for communication-efficient
+distributed sparse matrix multiplication by arrow matrix decomposition.
+
+Re-designed from scratch for TPU (JAX / XLA / pjit / shard_map / Pallas)
+with the capabilities of the reference implementation of
+"Arrow Matrix Decomposition" (Gianinazzi et al., PPoPP 2024,
+spcl/arrow-matrix).  The reference is an MPI + scipy/cupy runtime; this
+framework instead expresses the distributed SpMM as a single SPMD program
+over a `jax.sharding.Mesh`, with XLA collectives (`psum`, `ppermute`,
+`all_to_all`) replacing MPI primitives and static routing-index arrays
+replacing Alltoallv tables.
+
+Layout (mirrors SURVEY.md layer map of the reference):
+  decomposition/  offline arrow decomposition (host, numpy/scipy + C++)
+  io/             on-disk artifact format (npy CSR triplets, memmap)
+  ops/            device kernels: ELL SpMM (jnp + Pallas), BCOO fallback
+  parallel/       mesh layouts: slim/banded arrow, multi-level
+                  orchestrator, 1.5D and 1D baselines, permutation routing
+  models/         iterated-propagation model families built on the SpMM
+  utils/          logging, timing, config, synthetic graph generators
+  cli/            command line entry points (arrow_decompose, spmm_arrow,
+                  spmm_15d, spmm_petsc)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,119 @@
+"""Linear-arrangement heuristics for the arrow decomposition.
+
+Re-implementation of the reference's igraph-based linearization
+(reference arrow/decomposition.py:147-281) on scipy.sparse.csgraph:
+
+  * ``random_forest_order`` — draw random edge weights, take a minimum
+    spanning forest, DFS-linearize each tree with children visited in
+    increasing subtree-size order (minimizes expected linear-arrangement
+    cost; reference linearize_with_random_forest / linearize_tree,
+    decomposition.py:165-241).
+  * ``bfs_order`` — deterministic per-component BFS fallback
+    (reference linearize_with_ck, decomposition.py:147-162).
+
+All functions take the *symmetrized structural* adjacency of the subgraph
+to linearize and return positions as indices into that subgraph; callers
+map back to original vertex ids.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import sparse
+from scipy.sparse import csgraph
+
+
+def _forest_children(order: np.ndarray, predecessors: np.ndarray):
+    """Children lists + subtree sizes for a DFS-rooted tree.
+
+    ``order`` is a DFS preorder, ``predecessors[v]`` the DFS parent
+    (-9999 for the root, scipy convention).  Subtree sizes are accumulated
+    in reverse preorder.
+    """
+    n = order.size
+    sizes = {}
+    children: dict[int, list[int]] = {int(v): [] for v in order}
+    for v in order:
+        sizes[int(v)] = 1
+    for v in order[::-1]:
+        p = predecessors[v]
+        if p >= 0:
+            sizes[int(p)] += sizes[int(v)]
+            children[int(p)].append(int(v))
+    return children, sizes
+
+
+def _linearize_tree(root: int, children: dict[int, list[int]],
+                    sizes: dict[int, int], out: list[int]) -> None:
+    """Append a subtree-size-ordered DFS of the rooted tree to ``out``.
+
+    Children with larger subtrees are visited last (pushed first on the
+    stack, popped last), matching the reference's cost heuristic
+    (decomposition.py:230-241).
+    """
+    stack = [root]
+    while stack:
+        v = stack.pop()
+        out.append(v)
+        kids = sorted(children[v], key=lambda u: sizes[u], reverse=True)
+        stack.extend(kids)
+
+
+def random_forest_order(adj_sym: sparse.csr_matrix, rng: np.random.Generator,
+                        base_size: int = 16) -> np.ndarray:
+    """Linearize via random minimum spanning forest + subtree-ordered DFS.
+
+    Components of size <= base_size are emitted as-is (their bandwidth is
+    bounded by their size, reference decomposition.py:185-189).
+    """
+    n = adj_sym.shape[0]
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+
+    n_comp, labels = csgraph.connected_components(adj_sym, directed=False)
+
+    # Random positive weights -> a uniformly random spanning forest flavor.
+    w = adj_sym.tocsr(copy=True).astype(np.float64)
+    w.data = rng.random(w.data.size) + 0.5
+    forest = csgraph.minimum_spanning_tree(w)
+    forest_sym = forest + forest.T  # undirected view for DFS
+
+    comp_members: list[list[int]] = [[] for _ in range(n_comp)]
+    for v, c in enumerate(labels):
+        comp_members[c].append(v)
+
+    order: list[int] = []
+    for members in comp_members:
+        if len(members) <= base_size:
+            order.extend(members)
+            continue
+        root = members[0]
+        dfs_order, preds = csgraph.depth_first_order(
+            forest_sym, root, directed=False, return_predecessors=True)
+        children, sizes = _forest_children(dfs_order, preds)
+        _linearize_tree(int(root), children, sizes, order)
+
+    assert len(order) == n
+    return np.asarray(order, dtype=np.int64)
+
+
+def bfs_order(adj_sym: sparse.csr_matrix, base_size: int = 2) -> np.ndarray:
+    """Deterministic per-component BFS linearization."""
+    n = adj_sym.shape[0]
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    n_comp, labels = csgraph.connected_components(adj_sym, directed=False)
+    comp_members: list[list[int]] = [[] for _ in range(n_comp)]
+    for v, c in enumerate(labels):
+        comp_members[c].append(v)
+
+    order: list[int] = []
+    for members in comp_members:
+        if len(members) <= base_size:
+            order.extend(members)
+            continue
+        bfs = csgraph.breadth_first_order(adj_sym, members[0], directed=False,
+                                          return_predecessors=False)
+        order.extend(int(v) for v in bfs)
+    assert len(order) == n
+    return np.asarray(order, dtype=np.int64)

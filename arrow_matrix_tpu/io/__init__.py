@@ -1,0 +1,29 @@
+from arrow_matrix_tpu.io.graphio import (
+    FileKind,
+    arrow_block_coords,
+    as_levels,
+    format_path,
+    load_block,
+    load_decomposition,
+    load_level_widths,
+    nnz_per_row,
+    num_rows,
+    number_of_blocks,
+    save_decomposition,
+    save_decomposition_npz,
+)
+
+__all__ = [
+    "FileKind",
+    "arrow_block_coords",
+    "as_levels",
+    "format_path",
+    "load_block",
+    "load_decomposition",
+    "load_level_widths",
+    "nnz_per_row",
+    "num_rows",
+    "number_of_blocks",
+    "save_decomposition",
+    "save_decomposition_npz",
+]

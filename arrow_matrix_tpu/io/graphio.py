@@ -1,0 +1,266 @@
+"""Decomposition artifact I/O.
+
+File format is byte-compatible with the reference's "new" npy-triplet
+scheme (reference arrow/common/graphio.py:38-70,131-191,251-314) so that
+artifacts produced by either implementation load in both:
+
+    {base}_B_{width}_{i}[_bd]_indptr.npy
+    {base}_B_{width}_{i}[_bd]_indices.npy
+    {base}_B_{width}_{i}[_bd]_data.npy        (optional; absent => ones)
+    {base}_B_{width}_{i}[_bd]_permutation.npy
+    {base}_B_{width}_0[_bd]_nnzrows.npy       (convenience)
+
+plus the legacy single-file ``.npz`` scheme.  Memory-mapped loading keeps
+the host footprint at O(touched blocks) for 100M+-row matrices
+(reference graphio.py:283-294).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from typing import Any, List, Optional, Tuple, Union
+
+import numpy as np
+from scipy import sparse
+
+from arrow_matrix_tpu.decomposition.decompose import ArrowLevel
+
+
+class FileKind(enum.Enum):
+    npz = 1
+    indptr = 2
+    indices = 3
+    data = 4
+    permutation = 5
+    nnzrows = 6
+    widths = 7
+
+
+_SUFFIX = {
+    FileKind.npz: ".npz",
+    FileKind.indptr: "_indptr.npy",
+    FileKind.indices: "_indices.npy",
+    FileKind.data: "_data.npy",
+    FileKind.permutation: "_permutation.npy",
+    FileKind.nnzrows: "_nnzrows.npy",
+    FileKind.widths: "_widths.npy",
+}
+
+
+def format_path(base: str, width: Optional[int], index: Optional[int],
+                block_diagonal: bool, kind: FileKind) -> str:
+    """Reference-compatible path scheme (graphio.py:38-70)."""
+    path = f"{base}_B"
+    if width is not None:
+        path += f"_{width}"
+    if index is not None:
+        path += f"_{index}"
+    if block_diagonal:
+        path += "_bd"
+    return path + _SUFFIX[kind]
+
+
+# A loaded level matrix: either an in-memory CSR or a (data, indices,
+# indptr) triplet of (possibly memory-mapped) arrays.
+CsrLike = Union[sparse.csr_matrix, Tuple[np.ndarray, np.ndarray, np.ndarray]]
+
+
+def save_decomposition(levels: List[ArrowLevel], base: str,
+                       block_diagonal: bool = True,
+                       dtype=np.float32) -> None:
+    """Write npy CSR triplets + permutations for every level.
+
+    All files use the *level-0* width in their names so the loader can
+    enumerate levels with one width key.  (The reference names each level
+    by its own achieved width but loads with a single fixed width, which
+    silently drops a last level whose width grew — a latent reference bug
+    we do not replicate.)  True per-level widths are stored in the
+    ``_widths.npy`` metadata file.
+    """
+    os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+    width0 = levels[0].arrow_width if levels else 0
+    for i, lvl in enumerate(levels):
+        m = lvl.matrix.tocsr().astype(dtype)
+        m.sum_duplicates()
+        m.sort_indices()
+        np.save(format_path(base, width0, i, block_diagonal, FileKind.indptr), m.indptr)
+        np.save(format_path(base, width0, i, block_diagonal, FileKind.indices), m.indices)
+        np.save(format_path(base, width0, i, block_diagonal, FileKind.data), m.data)
+        np.save(format_path(base, width0, i, block_diagonal, FileKind.permutation),
+                np.asarray(lvl.permutation, dtype=np.int64))
+    nnz_rows = np.asarray([l.nonzero_rows for l in levels], dtype=np.int64)
+    np.save(format_path(base, width0, 0, block_diagonal, FileKind.nnzrows), nnz_rows)
+    widths = np.asarray([l.arrow_width for l in levels], dtype=np.int64)
+    np.save(format_path(base, width0, 0, block_diagonal, FileKind.widths), widths)
+
+
+def load_level_widths(base: str, width: Optional[int],
+                      block_diagonal: bool = True) -> Optional[np.ndarray]:
+    """Per-level achieved widths, or None for artifacts without the
+    metadata file (e.g. reference-produced ones)."""
+    p = format_path(base, width, 0, block_diagonal, FileKind.widths)
+    return np.load(p) if os.path.exists(p) else None
+
+
+def save_decomposition_npz(levels: List[ArrowLevel], base: str,
+                           block_diagonal: bool = True,
+                           dtype=np.float32) -> None:
+    """Legacy single-file npz scheme (reference graphio.py:73-117)."""
+    for i, lvl in enumerate(levels):
+        m = lvl.matrix.tocsr().astype(dtype)
+        w = lvl.arrow_width
+        sparse.save_npz(format_path(base, w, i, block_diagonal, FileKind.npz), m)
+        np.save(format_path(base, w, i, block_diagonal, FileKind.permutation),
+                np.asarray(lvl.permutation, dtype=np.int64))
+
+
+def load_decomposition(base: str, width: Optional[int] = None,
+                       block_diagonal: bool = True,
+                       mem_map: bool = False,
+                       with_permutation: bool = True,
+                       ) -> List[Tuple[CsrLike, Optional[np.ndarray]]]:
+    """Load all levels of a decomposition in the npy-triplet format.
+
+    With ``mem_map`` the CSR triplet stays on disk (``np.lib.format.
+    open_memmap``); blocks are materialized lazily by ``load_block``.
+    Missing ``_data`` files mean implicit unit values (reference
+    graphio.py:298).
+    """
+    out: List[Tuple[CsrLike, Optional[np.ndarray]]] = []
+    i = 0
+    while True:
+        p_indptr = format_path(base, width, i, block_diagonal, FileKind.indptr)
+        if not os.path.exists(p_indptr):
+            break
+        loader = (lambda f: np.lib.format.open_memmap(f, mode="r")) if mem_map else np.load
+        indptr = loader(p_indptr)
+        indices = loader(format_path(base, width, i, block_diagonal, FileKind.indices))
+        p_data = format_path(base, width, i, block_diagonal, FileKind.data)
+        if os.path.exists(p_data):
+            data = loader(p_data)
+        else:
+            data = np.ones(indices.size, dtype=np.float32)
+        n = indptr.size - 1  # square adjacency: column count not stored
+        matrix: CsrLike = ((data, indices, indptr) if mem_map
+                           else sparse.csr_matrix((data, indices, indptr),
+                                                  shape=(n, n)))
+        perm = None
+        if with_permutation:
+            perm = np.load(format_path(base, width, i, block_diagonal,
+                                       FileKind.permutation))
+        out.append((matrix, perm))
+        i += 1
+
+    if not out:
+        out = _load_decomposition_npz(base, width, block_diagonal, with_permutation)
+    return out
+
+
+def _load_decomposition_npz(base, width, block_diagonal, with_permutation):
+    out = []
+    i = 0
+    while True:
+        p = format_path(base, width, i, block_diagonal, FileKind.npz)
+        if not os.path.exists(p):
+            break
+        m = sparse.load_npz(p)
+        perm = None
+        if with_permutation:
+            perm = np.load(format_path(base, width, i, block_diagonal,
+                                       FileKind.permutation))
+        out.append((m, perm))
+        i += 1
+    return out
+
+
+def as_levels(loaded: List[Tuple[CsrLike, Optional[np.ndarray]]],
+              widths: Union[int, np.ndarray, List[int]]) -> List[ArrowLevel]:
+    """Wrap loader output (in-memory case) back into ArrowLevel objects.
+
+    ``widths`` is either one width for all levels or a per-level array
+    (see ``load_level_widths``).
+    """
+    if np.isscalar(widths):
+        widths = [int(widths)] * len(loaded)
+    levels = []
+    for (m, perm), w in zip(loaded, widths):
+        if not isinstance(m, sparse.csr_matrix):
+            n = m[2].size - 1
+            m = sparse.csr_matrix((np.asarray(m[0]), np.asarray(m[1]),
+                                   np.asarray(m[2])), shape=(n, n))
+        levels.append(ArrowLevel(m, perm, int(w)))
+    return levels
+
+
+def num_rows(matrix: CsrLike) -> int:
+    if isinstance(matrix, sparse.csr_matrix):
+        return matrix.shape[0]
+    return matrix[2].size - 1
+
+
+def nnz_per_row(matrix: CsrLike) -> np.ndarray:
+    if isinstance(matrix, sparse.csr_matrix):
+        return np.diff(matrix.indptr)
+    indptr = matrix[2]
+    return np.asarray(indptr[1:]) - np.asarray(indptr[:-1])
+
+
+def number_of_blocks(matrix: CsrLike, width: int) -> int:
+    """Blocks per side after truncating trailing all-zero rows (reference
+    arrow_dec_mpi.py:612-627; assumes symmetric structure)."""
+    counts = nnz_per_row(matrix)
+    nz = np.nonzero(counts)[0]
+    nonzero_rows = 0 if nz.size == 0 else int(nz[-1]) + 1
+    return max(1, int(np.ceil(nonzero_rows / width)))
+
+
+def load_block(matrix: CsrLike, row_start: int, row_stop: int,
+               col_start: int, col_stop: int, block_size: int,
+               dtype=np.float32) -> sparse.csr_matrix:
+    """Materialize one width-by-width block from a CSR (possibly
+    memmapped) matrix, padded with empty rows to ``block_size`` square
+    (reference graphio.py:449-495: only the touched row range is read)."""
+    n = num_rows(matrix)
+    row_stop = min(row_stop, n)
+    if isinstance(matrix, sparse.csr_matrix):
+        data, indices, indptr = matrix.data, matrix.indices, matrix.indptr
+    else:
+        data, indices, indptr = matrix
+
+    lo = int(indptr[row_start])
+    hi = int(indptr[row_stop])
+    sub_indptr = np.asarray(indptr[row_start:row_stop + 1], dtype=np.int64) - lo
+    sub_indices = np.asarray(indices[lo:hi])
+    sub_data = np.asarray(data[lo:hi])
+
+    rows = sparse.csr_matrix((sub_data, sub_indices, sub_indptr),
+                             shape=(row_stop - row_start, n), dtype=dtype)
+    block = rows[:, col_start:min(col_stop, n)]
+
+    pad_rows = block_size - block.shape[0]
+    pad_cols = block_size - block.shape[1]
+    if pad_rows > 0 or pad_cols > 0:
+        indptr_padded = np.pad(block.indptr, (0, max(pad_rows, 0)), mode="edge")
+        block = sparse.csr_matrix((block.data, block.indices, indptr_padded),
+                                  shape=(block_size, block_size), dtype=dtype)
+    block.sum_duplicates()
+    block.sort_indices()
+    return block
+
+
+def arrow_block_coords(n_blocks: int, banded: bool) -> List[Tuple[int, int]]:
+    """Coordinates of the structurally-nonzero blocks of an arrow matrix:
+    head row (0, j), head column (i, 0), diagonal (i, i) and, in banded
+    mode, the (i, i+-1) off-diagonals (reference graphio.py:382,438)."""
+    coords = [(0, j) for j in range(n_blocks)]
+    for i in range(1, n_blocks):
+        if (i, 0) not in coords:
+            coords.append((i, 0))
+        coords.append((i, i))
+        if banded:
+            if i - 1 >= 1:
+                coords.append((i, i - 1))
+            if i + 1 < n_blocks:
+                coords.append((i, i + 1))
+    return coords

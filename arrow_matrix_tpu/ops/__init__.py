@@ -1,0 +1,29 @@
+from arrow_matrix_tpu.ops.ell import (
+    csr_flat_pack,
+    csr_flat_spmm,
+    ell_pack,
+    ell_pack_stack,
+    ell_spmm,
+    ell_spmm_batched,
+)
+from arrow_matrix_tpu.ops.arrow_blocks import (
+    ArrowBlocks,
+    arrow_blocks_from_csr,
+    arrow_spmm,
+    block_features,
+    unblock_features,
+)
+
+__all__ = [
+    "csr_flat_pack",
+    "csr_flat_spmm",
+    "ell_pack",
+    "ell_pack_stack",
+    "ell_spmm",
+    "ell_spmm_batched",
+    "ArrowBlocks",
+    "arrow_blocks_from_csr",
+    "arrow_spmm",
+    "block_features",
+    "unblock_features",
+]

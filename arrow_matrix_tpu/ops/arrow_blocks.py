@@ -1,0 +1,189 @@
+"""Device-resident arrow matrix blocks and the single-device SpMM step.
+
+An arrow matrix of ``nb`` block-rows of width ``w`` has nonzero blocks
+only at (0, j), (i, 0), (i, i) and — in banded mode — (i, i+-1)
+(reference arrow/common/graphio.py:382,438).  On TPU the natural layout
+is *stacked ELL arrays with a leading block axis*:
+
+    head:  (nb, w, m_h)   block j holds A_{0j}  (the head row chunk)
+    diag:  (nb, w, m_d)   block i holds A_{ii}  (empty at i = 0)
+    col:   (nb, w, m_c)   block i holds A_{i0}  (empty at i = 0)
+    lo/hi: (nb, w, m_b)   banded only: A_{i,i-1} / A_{i,i+1}
+
+The leading axis is the unit of sharding: `shard_map` over a mesh axis
+gives each device a contiguous slice of block-rows, and the identical
+per-block compute below runs unchanged inside or outside the mesh.  The
+reference's two MPI layouts collapse onto this one representation: the
+"slim" layout (one rank per block-row, reference arrow/arrow_slim_mpi.py)
+is the sharding itself, and the "wide" layout's separate row-arm ranks
+(reference arrow/arrow_mpi.py:31-47) exist only to parallelize the
+head-row reduction, which `psum` over ICI already does.
+
+Semantics of one SpMM ``C = B @ X`` (X blocked like the rows):
+    C_0 = sum_j A_0j X_j                    (head row; psum / sum)
+    C_i = A_ii X_i + A_i0 X_0 [+ A_i,i-1 X_{i-1} + A_i,i+1 X_{i+1}]
+(reference arrow/arrow_slim_mpi.py:104-147, arrow/arrow_mpi.py:177-299.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from scipy import sparse
+
+from arrow_matrix_tpu.io.graphio import CsrLike, load_block, number_of_blocks
+from arrow_matrix_tpu.ops.ell import ell_pack_stack, ell_spmm, ell_spmm_batched
+
+
+@struct.dataclass
+class ArrowBlocks:
+    """Pytree of stacked ELL arrays for one arrow matrix (one level)."""
+
+    head_cols: jax.Array
+    head_data: jax.Array
+    diag_cols: jax.Array
+    diag_data: jax.Array
+    col_cols: jax.Array
+    col_data: jax.Array
+    lo_cols: Optional[jax.Array] = None
+    lo_data: Optional[jax.Array] = None
+    hi_cols: Optional[jax.Array] = None
+    hi_data: Optional[jax.Array] = None
+
+    width: int = struct.field(pytree_node=False, default=0)
+    n_blocks: int = struct.field(pytree_node=False, default=0)
+    banded: bool = struct.field(pytree_node=False, default=False)
+
+    @property
+    def n_rows(self) -> int:
+        return self.width * self.n_blocks
+
+    def device_nbytes(self) -> int:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self):
+            total += leaf.size * leaf.dtype.itemsize
+        return total
+
+
+def arrow_blocks_from_csr(matrix: CsrLike, width: int,
+                          n_blocks: Optional[int] = None,
+                          banded: bool = False,
+                          pad_blocks_to: Optional[int] = None,
+                          dtype=np.float32,
+                          check: bool = True) -> ArrowBlocks:
+    """Tile an arrow-shaped CSR (or memmapped triplet) into ArrowBlocks.
+
+    Trailing all-zero rows beyond ``n_blocks * width`` are truncated
+    (reference arrow_dec_mpi.py:612-627); ``pad_blocks_to`` appends empty
+    block-rows so every level of a decomposition can share one static
+    block count (needed for a uniform mesh sharding).
+
+    With ``check`` (default) the tiling verifies that the arrow-pattern
+    blocks capture *every* nonzero of the matrix: a matrix wider than
+    ``width`` (e.g. a decomposition's last level whose achieved width
+    grew) would otherwise be silently mangled — the reference drops such
+    nonzeros without any diagnostic.  Requires a canonical (duplicate-
+    free) input, which this framework's loaders guarantee.
+    """
+    nb = n_blocks if n_blocks is not None else number_of_blocks(matrix, width)
+    nb_padded = max(pad_blocks_to or nb, nb)
+    captured = 0
+
+    def blk(i, j):
+        nonlocal captured
+        b = load_block(matrix, i * width, (i + 1) * width,
+                       j * width, (j + 1) * width, width, dtype=dtype)
+        captured += b.nnz
+        return b
+
+    head = [blk(0, j) if j < nb else None for j in range(nb_padded)]
+    diag = [None] + [blk(i, i) if i < nb else None for i in range(1, nb_padded)]
+    col = [None] + [blk(i, 0) if i < nb else None for i in range(1, nb_padded)]
+
+    head_cols, head_data = ell_pack_stack(head, dtype=dtype, rows=width)
+    diag_cols, diag_data = ell_pack_stack(diag, dtype=dtype, rows=width)
+    col_cols, col_data = ell_pack_stack(col, dtype=dtype, rows=width)
+
+    kw = {}
+    if banded:
+        lo = [None, None] + [blk(i, i - 1) if i < nb else None
+                             for i in range(2, nb_padded)]
+        hi = [None] + [blk(i, i + 1) if i + 1 < nb else None
+                       for i in range(1, nb_padded)]
+        lo_cols, lo_data = ell_pack_stack(lo, dtype=dtype, rows=width)
+        hi_cols, hi_data = ell_pack_stack(hi, dtype=dtype, rows=width)
+        kw = dict(lo_cols=jnp.asarray(lo_cols), lo_data=jnp.asarray(lo_data),
+                  hi_cols=jnp.asarray(hi_cols), hi_data=jnp.asarray(hi_data))
+
+    if check:
+        if isinstance(matrix, sparse.csr_matrix):
+            total = matrix.nnz
+        else:
+            total = int(np.asarray(matrix[1]).size)
+        if captured != total:
+            raise ValueError(
+                f"arrow tiling captured {captured} of {total} nonzeros: the "
+                f"matrix has entries outside the {'banded' if banded else 'block-diagonal'} "
+                f"arrow pattern at width {width} / {nb} blocks (did the last "
+                f"level's achieved width exceed the requested width?)")
+
+    return ArrowBlocks(
+        head_cols=jnp.asarray(head_cols), head_data=jnp.asarray(head_data),
+        diag_cols=jnp.asarray(diag_cols), diag_data=jnp.asarray(diag_data),
+        col_cols=jnp.asarray(col_cols), col_data=jnp.asarray(col_data),
+        width=width, n_blocks=nb_padded, banded=banded, **kw)
+
+
+def arrow_spmm(blocks: ArrowBlocks, x: jax.Array,
+               chunk: Optional[int] = None) -> jax.Array:
+    """Single-device arrow SpMM: x is (nb, w, k) blocked like the rows.
+
+    Jittable; this is the whole per-iteration compute of the slim layout
+    on one chip.  The distributed version in
+    ``arrow_matrix_tpu.parallel.arrow_layout`` applies the same block
+    compute per shard with psum/ppermute supplying C_0 / X_0 / halos.
+    """
+    nb, w, k = x.shape
+    assert nb == blocks.n_blocks and w == blocks.width
+
+    head_partial = ell_spmm_batched(blocks.head_cols, blocks.head_data, x,
+                                    chunk=chunk)
+    c0 = head_partial.sum(axis=0)
+
+    x0 = x[0]
+    c = ell_spmm_batched(blocks.diag_cols, blocks.diag_data, x, chunk=chunk)
+    c = c + jax.vmap(lambda cc, dd: ell_spmm(cc, dd, x0, chunk=chunk))(
+        blocks.col_cols, blocks.col_data)
+
+    if blocks.banded:
+        zeros = jnp.zeros((1, w, k), dtype=x.dtype)
+        x_lo = jnp.concatenate([zeros, x[:-1]], axis=0)   # block i sees X_{i-1}
+        x_hi = jnp.concatenate([x[1:], zeros], axis=0)    # block i sees X_{i+1}
+        c = c + ell_spmm_batched(blocks.lo_cols, blocks.lo_data, x_lo,
+                                 chunk=chunk)
+        c = c + ell_spmm_batched(blocks.hi_cols, blocks.hi_data, x_hi,
+                                 chunk=chunk)
+
+    return c.at[0].set(c0)
+
+
+def block_features(x: np.ndarray, width: int, n_blocks: int) -> np.ndarray:
+    """Host helper: pad (n, k) features with zero rows and reshape to the
+    blocked (nb, w, k) device layout."""
+    n, k = x.shape
+    total = width * n_blocks
+    if n > total:
+        x = x[:total]
+    elif n < total:
+        x = np.pad(x, ((0, total - n), (0, 0)))
+    return x.reshape(n_blocks, width, k)
+
+
+def unblock_features(x: jax.Array | np.ndarray, n: int) -> np.ndarray:
+    """Inverse of block_features: (nb, w, k) -> (n, k)."""
+    arr = np.asarray(x)
+    return arr.reshape(-1, arr.shape[-1])[:n]

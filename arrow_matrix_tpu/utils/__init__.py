@@ -1,0 +1,21 @@
+from arrow_matrix_tpu.utils.graphs import (
+    barabasi_albert,
+    erdos_renyi,
+    random_csr,
+    random_dense,
+    symmetrize,
+)
+from arrow_matrix_tpu.utils.logging import SegmentLog, get_log, log, set_iteration_data, finish
+
+__all__ = [
+    "barabasi_albert",
+    "erdos_renyi",
+    "random_csr",
+    "random_dense",
+    "symmetrize",
+    "SegmentLog",
+    "get_log",
+    "log",
+    "set_iteration_data",
+    "finish",
+]

@@ -1,0 +1,119 @@
+"""Synthetic graph / matrix generators (host side, numpy/scipy).
+
+TPU-native replacement for the reference's igraph-based dataset factories
+(reference tests/test_arrowdecomposition.py:14-22 use igraph Barabasi /
+Erdos_Renyi; reference arrow/common/utils.py:63-99 provides random CSR and
+dense generators).  igraph is not a dependency here: generators are pure
+numpy and return scipy CSR matrices, the framework's host-side graph
+representation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import sparse
+
+
+def symmetrize(a: sparse.spmatrix) -> sparse.csr_matrix:
+    """Structural symmetrization: pattern of A + A^T with unit-ish data.
+
+    Used for linearization, which operates on the undirected structure of
+    (possibly directed) input graphs.
+    """
+    a = a.tocsr()
+    s = (a + a.T).tocsr()
+    s.sum_duplicates()
+    s.sort_indices()
+    return s
+
+
+def barabasi_albert(n: int, m: int, seed: int | None = None,
+                    directed: bool = False) -> sparse.csr_matrix:
+    """Barabasi-Albert preferential-attachment graph as a CSR adjacency.
+
+    Each new vertex attaches to ``m`` distinct existing vertices chosen
+    proportionally to their current degree (the classic repeated-nodes
+    construction).  Undirected graphs get both edge directions.
+    """
+    if n < m + 1:
+        raise ValueError(f"need n > m (got n={n}, m={m})")
+    rng = np.random.default_rng(seed)
+
+    # Start from a star over the first m+1 vertices so every vertex has
+    # degree >= 1 from the outset.
+    sources = [np.arange(m), ]
+    targets = [np.full(m, m), ]
+    repeated = [np.arange(m), np.full(m, m)]
+
+    for v in range(m + 1, n):
+        pool = np.concatenate(repeated) if len(repeated) > 1 else repeated[0]
+        repeated = [pool]
+        chosen: set[int] = set()
+        # Rejection-sample m distinct targets by degree-proportional choice.
+        while len(chosen) < m:
+            picks = pool[rng.integers(0, pool.size, size=m)]
+            for p in picks:
+                if len(chosen) < m:
+                    chosen.add(int(p))
+        tgt = np.fromiter(chosen, dtype=np.int64, count=m)
+        sources.append(np.full(m, v))
+        targets.append(tgt)
+        repeated.append(np.full(m, v))
+        repeated.append(tgt)
+
+    row = np.concatenate(sources)
+    col = np.concatenate(targets)
+    data = np.ones(row.size, dtype=np.float32)
+    a = sparse.csr_matrix((data, (row, col)), shape=(n, n))
+    if not directed:
+        a = a + a.T
+    a = a.tocsr()
+    a.data[:] = 1.0
+    a.sum_duplicates()
+    a.sort_indices()
+    return a
+
+
+def erdos_renyi(n: int, p: float, seed: int | None = None,
+                directed: bool = False) -> sparse.csr_matrix:
+    """G(n, p) random graph as CSR adjacency (no self loops)."""
+    rng = np.random.default_rng(seed)
+    a = sparse.random(n, n, density=p, format="coo", random_state=rng,
+                      data_rvs=lambda k: np.ones(k, dtype=np.float32))
+    mask = a.row != a.col
+    a = sparse.csr_matrix((a.data[mask], (a.row[mask], a.col[mask])), shape=(n, n))
+    if not directed:
+        a = a + a.T
+        a = a.tocsr()
+        a.data[:] = 1.0
+    a.sum_duplicates()
+    a.sort_indices()
+    return a
+
+
+def random_csr(rows: int, cols: int, nnz_per_row: int,
+               seed: int | None = None, dtype=np.float32) -> sparse.csr_matrix:
+    """Random CSR with a fixed number of nonzeros per row.
+
+    Mirrors the reference generator's shape contract
+    (reference arrow/common/utils.py:63-87): fixed nnz/row keeps index
+    arithmetic small and the distribution balanced.
+    """
+    rng = np.random.default_rng(seed)
+    nnz_per_row = min(nnz_per_row, cols)
+    indices = np.empty((rows, nnz_per_row), dtype=np.int64)
+    for r in range(rows):
+        indices[r] = rng.choice(cols, size=nnz_per_row, replace=False)
+    indptr = np.arange(rows + 1, dtype=np.int64) * nnz_per_row
+    data = rng.uniform(-1.0, 1.0, size=rows * nnz_per_row).astype(dtype)
+    a = sparse.csr_matrix((data, indices.ravel(), indptr), shape=(rows, cols))
+    a.sum_duplicates()
+    a.sort_indices()
+    return a
+
+
+def random_dense(rows: int, cols: int, seed: int | None = None,
+                 dtype=np.float32) -> np.ndarray:
+    """Uniform [-1, 1) dense matrix (reference arrow/common/utils.py:90-99)."""
+    rng = np.random.default_rng(seed)
+    return rng.uniform(-1.0, 1.0, size=(rows, cols)).astype(dtype)

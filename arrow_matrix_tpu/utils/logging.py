@@ -1,0 +1,126 @@
+"""Named-segment timing log with a file sink.
+
+TPU-native counterpart of the reference's wandb logging module
+(reference arrow/common/wb_logging.py): every runtime layer appends
+named-segment wall-clock measurements via ``log({...})``; ``finish()``
+flushes everything to ``./logs/{algorithm}.{dataset}.{uuid}.{json,txt}``.
+
+Differences from the reference by design:
+  * single-process SPMD — there is no per-rank gather step (the reference
+    gathers per-rank logs over MPI, wb_logging.py:67-69); device-side
+    timing comes from `jax.profiler` traces instead.
+  * JSON sink instead of pickle (inspectable, no code dependency).
+  * wandb streaming is optional and lazy; absent wandb degrades to files
+    (the reference's wandb path is effectively dead code — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class SegmentLog:
+    """In-memory list of measurement dicts merged with iteration context."""
+
+    algorithm: str = "arrow_tpu"
+    dataset: str = "unknown"
+    config: dict = field(default_factory=dict)
+    entries: list = field(default_factory=list)
+    _iteration_data: dict = field(default_factory=dict)
+
+    def set_iteration_data(self, data: dict) -> None:
+        self._iteration_data = dict(data)
+
+    def log(self, measurements: dict) -> None:
+        entry = dict(self._iteration_data)
+        entry.update(measurements)
+        self.entries.append(entry)
+
+    @contextlib.contextmanager
+    def segment(self, name: str):
+        """Context manager timing a named host-side segment in seconds."""
+        tic = time.perf_counter()
+        yield
+        self.log({name: time.perf_counter() - tic})
+
+    def finish(self, log_dir: str = "./logs") -> str | None:
+        if not self.entries and not self.config:
+            return None
+        os.makedirs(log_dir, exist_ok=True)
+        run_id = uuid.uuid4().hex[:12]
+        base = os.path.join(log_dir, f"{self.algorithm}.{self.dataset}.{run_id}")
+        with open(base + ".json", "w") as f:
+            json.dump({"algorithm": self.algorithm, "dataset": self.dataset,
+                       "config": self.config, "entries": self.entries}, f, indent=1)
+        with open(base + ".txt", "w") as f:
+            f.write(f"{self.algorithm} {self.dataset}\n{self.config}\n")
+            for e in self.entries:
+                f.write(f"{e}\n")
+        return base
+
+    def summarize(self) -> dict[str, dict[str, float]]:
+        """Per-segment mean/min/max/count over all entries."""
+        stats: dict[str, list[float]] = {}
+        for e in self.entries:
+            for k, v in e.items():
+                if isinstance(v, (int, float)) and k != "iteration":
+                    stats.setdefault(k, []).append(float(v))
+        return {
+            k: {"mean": sum(v) / len(v), "min": min(v), "max": max(v),
+                "count": len(v)}
+            for k, v in stats.items()
+        }
+
+
+_GLOBAL = SegmentLog()
+
+
+def get_log() -> SegmentLog:
+    return _GLOBAL
+
+
+def init(algorithm: str, dataset: str, config: dict | None = None) -> SegmentLog:
+    """Reset the global log for a new run (reference wandb_init analog)."""
+    global _GLOBAL
+    _GLOBAL = SegmentLog(algorithm=algorithm, dataset=dataset,
+                         config=dict(config or {}))
+    return _GLOBAL
+
+
+def log(measurements: dict) -> None:
+    _GLOBAL.log(measurements)
+
+
+def set_iteration_data(data: dict) -> None:
+    _GLOBAL.set_iteration_data(data)
+
+
+def finish(log_dir: str = "./logs") -> str | None:
+    return _GLOBAL.finish(log_dir)
+
+
+def segment(name: str):
+    return _GLOBAL.segment(name)
+
+
+def block_until_ready(x: Any) -> Any:
+    """Convenience: jax.block_until_ready that tolerates non-jax values.
+
+    Only import/type failures are swallowed — device-side errors (e.g.
+    a failed async computation surfacing in block_until_ready) propagate.
+    """
+    try:
+        import jax
+    except ImportError:
+        return x
+    try:
+        return jax.block_until_ready(x)
+    except TypeError:
+        return x

@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU platform so the
+multi-chip sharding paths are exercised without TPU hardware (the TPU
+analog of the reference's ``mpiexec --oversubscribe`` many-rank fixture,
+reference scripts/run_tests.sh)."""
+
+import os
+
+# Force CPU even when the environment selects a TPU platform: the test
+# suite must be hermetic and must exercise the virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Some environments (axon TPU tunnels) register an out-of-tree PJRT
+# plugin for every interpreter via sitecustomize; initializing it can
+# block on a remote service.  Tests never want it — drop the factory and
+# repin the platform config (the env var was already latched at the
+# sitecustomize-time jax import) before the first backend init.
+try:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax internals moved; harmless
+    pass

@@ -1,0 +1,118 @@
+"""Decomposition invariant tests.
+
+Port of the reference's property suite
+(reference tests/test_arrowdecomposition.py:24-156) to the numpy/scipy
+decomposer: permutation validity, edge-disjointness and union coverage,
+the band/block width bound, exact reconstruction A = sum_i P_i^T B_i P_i,
+and the golden SpMM identity.
+"""
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.decomposition import (
+    arrow_decomposition,
+    decomposition_spmm,
+    reconstruct,
+)
+from arrow_matrix_tpu.utils import barabasi_albert, erdos_renyi, random_dense
+
+
+def datasets():
+    out = [barabasi_albert(2 ** i, 4, seed=503) for i in range(4, 8)]
+    out += [barabasi_albert(2 ** i, 8, seed=3434) for i in range(5, 8)]
+    out += [erdos_renyi(2 ** i, 0.1, seed=7) for i in range(5, 8)]
+    out += [barabasi_albert(2 ** i, 3, seed=11, directed=True) for i in range(9, 11)]
+    return out
+
+
+WIDTH_DIVISORS = [4, 8, 10]
+
+
+@pytest.mark.parametrize("g_index", range(len(datasets())))
+def test_invariants(g_index):
+    a = datasets()[g_index]
+    n = a.shape[0]
+    rng = np.random.default_rng(42)
+    x = rng.random((n, 16), dtype=np.float32)
+
+    for width_c in WIDTH_DIVISORS:
+        width = n // width_c + 1
+        levels = arrow_decomposition(a, width, max_levels=100,
+                                     block_diagonal=True, seed=width_c)
+
+        # Permutations are actual permutations.
+        for lvl in levels:
+            assert lvl.permutation.size == n
+            assert np.array_equal(np.sort(lvl.permutation), np.arange(n))
+
+        # Un-permuted levels are edge-disjoint and union to A's pattern.
+        total_nnz = 0
+        patterns = []
+        for lvl in levels:
+            p = lvl.permutation
+            coo = lvl.matrix.tocoo()
+            keys = set(zip(p[coo.row].tolist(), p[coo.col].tolist()))
+            assert len(keys) == coo.nnz
+            patterns.append(keys)
+            total_nnz += coo.nnz
+        union = set().union(*patterns)
+        assert len(union) == total_nnz  # pairwise disjoint
+        a_coo = a.tocoo()
+        a_keys = set(zip(a_coo.row.tolist(), a_coo.col.tolist()))
+        assert union == a_keys
+
+        # Width bound holds edge-by-edge.
+        for lvl in levels:
+            w = lvl.arrow_width
+            coo = lvl.matrix.tocoo()
+            ok = (np.abs(coo.row - coo.col) <= w) | (coo.row < w) | (coo.col < w)
+            assert bool(np.all(ok))
+
+        # Exact reconstruction and golden SpMM.
+        diff = (reconstruct(levels) - a).tocsr()
+        assert diff.nnz == 0 or np.max(np.abs(diff.data)) < 1e-6
+        ref_c = (a.astype(np.float32) @ x).astype(np.float32)
+        val_c = decomposition_spmm(levels, x)
+        np.testing.assert_allclose(val_c, ref_c, rtol=1e-4, atol=1e-4)
+
+
+def test_band_mode_width_bound():
+    a = barabasi_albert(256, 4, seed=1)
+    levels = arrow_decomposition(a, 40, max_levels=100, block_diagonal=False,
+                                 seed=0)
+    for lvl in levels:
+        w = lvl.arrow_width
+        coo = lvl.matrix.tocoo()
+        ok = (np.abs(coo.row - coo.col) <= w) | (coo.row < w) | (coo.col < w)
+        assert bool(np.all(ok))
+
+
+def test_last_level_keeps_everything():
+    a = erdos_renyi(128, 0.2, seed=3)
+    levels = arrow_decomposition(a, 16, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    assert len(levels) <= 2
+    assert sum(l.matrix.nnz for l in levels) == a.nnz
+
+
+def test_values_preserved():
+    rng = np.random.default_rng(0)
+    a = sparse.random(100, 100, density=0.05, format="csr", random_state=rng,
+                      dtype=np.float64)
+    a = a + a.T
+    levels = arrow_decomposition(a, 20, max_levels=10, block_diagonal=True,
+                                 seed=5)
+    diff = (reconstruct(levels) - a).tocsr()
+    assert diff.nnz == 0 or np.max(np.abs(diff.data)) < 1e-12
+
+
+def test_deterministic_fallback():
+    a = barabasi_albert(200, 3, seed=9)
+    l1 = arrow_decomposition(a, 30, max_levels=1, block_diagonal=True)
+    l2 = arrow_decomposition(a, 30, max_levels=1, block_diagonal=True)
+    assert np.array_equal(l1[0].permutation, l2[0].permutation)
+    x = random_dense(200, 8, seed=1)
+    np.testing.assert_allclose(decomposition_spmm(l1, x), a @ x, rtol=1e-4,
+                               atol=1e-4)

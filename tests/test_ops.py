@@ -1,0 +1,135 @@
+"""Device-kernel tests: ELL/flat SpMM vs scipy, and the single-device
+arrow SpMM vs the dense golden product (the reference gates its kernels
+the same way: distributed result vs ``A @ X``,
+reference tests/test_arrowmpi.py:342-398)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.decomposition import arrow_decomposition
+from arrow_matrix_tpu.ops import (
+    ArrowBlocks,
+    arrow_blocks_from_csr,
+    arrow_spmm,
+    block_features,
+    csr_flat_pack,
+    csr_flat_spmm,
+    ell_pack,
+    ell_spmm,
+    unblock_features,
+)
+from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+
+@pytest.mark.parametrize("chunk", [None, 4])
+@pytest.mark.parametrize("density", [0.02, 0.2])
+def test_ell_spmm_matches_scipy(chunk, density):
+    rng = np.random.default_rng(0)
+    a = sparse.random(100, 80, density=density, format="csr", random_state=rng,
+                      dtype=np.float32)
+    x = random_dense(80, 16, seed=1)
+    cols, data = ell_pack(a)
+    out = ell_spmm(jnp.asarray(cols), jnp.asarray(data), jnp.asarray(x),
+                   chunk=chunk)
+    np.testing.assert_allclose(np.asarray(out), a @ x, rtol=1e-4, atol=1e-5)
+
+
+def test_ell_spmm_empty():
+    cols = jnp.zeros((5, 0), dtype=jnp.int32)
+    data = jnp.zeros((5, 0), dtype=jnp.float32)
+    out = ell_spmm(cols, data, jnp.ones((7, 3)))
+    assert out.shape == (5, 3)
+    assert np.all(np.asarray(out) == 0)
+
+
+def test_csr_flat_spmm_matches_scipy():
+    rng = np.random.default_rng(3)
+    a = sparse.random(64, 64, density=0.1, format="csr", random_state=rng,
+                      dtype=np.float32)
+    x = random_dense(64, 8, seed=2)
+    rows, cols, data = csr_flat_pack(a, pad_to=a.nnz + 13)
+    out = csr_flat_spmm(jnp.asarray(rows), jnp.asarray(cols),
+                        jnp.asarray(data), jnp.asarray(x), 64)
+    np.testing.assert_allclose(np.asarray(out), a @ x, rtol=1e-4, atol=1e-5)
+
+
+def _dense_padded(m: sparse.csr_matrix, total: int) -> np.ndarray:
+    d = np.zeros((total, total), dtype=np.float32)
+    arr = m.toarray()
+    n = min(total, arr.shape[0])
+    d[:n, :n] = arr[:n, :n]
+    return d
+
+
+@pytest.mark.parametrize("banded", [False, True])
+def test_arrow_spmm_matches_dense(banded):
+    a = barabasi_albert(400, 4, seed=13)
+    width = 80
+    levels = arrow_decomposition(a, width, max_levels=100,
+                                 block_diagonal=not banded, seed=3)
+    for lvl in levels:
+        blocks = arrow_blocks_from_csr(lvl.matrix.astype(np.float32), width,
+                                       banded=banded)
+        nb = blocks.n_blocks
+        x_host = random_dense(400, 16, seed=7)
+        xb = block_features(x_host, width, nb)
+
+        total = nb * width  # zero-row truncation can make this < n
+        m = min(total, 400)
+        out = jax.jit(arrow_spmm)(blocks, jnp.asarray(xb))
+        got = unblock_features(out, m)
+
+        b_dense = _dense_padded(lvl.matrix.astype(np.float32), total)
+        x_pad = np.zeros((total, 16), dtype=np.float32)
+        x_pad[:m] = x_host[:m]
+        want = (b_dense @ x_pad)[:m]
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_arrow_spmm_padded_blocks():
+    # Padding the block count with empty block-rows must not change results.
+    a = barabasi_albert(256, 4, seed=5)
+    width = 64
+    levels = arrow_decomposition(a, width, max_levels=100, block_diagonal=True)
+    lvl = levels[0]
+    x_host = random_dense(256, 8, seed=9)
+
+    b1 = arrow_blocks_from_csr(lvl.matrix, width)
+    out1 = unblock_features(arrow_spmm(b1, jnp.asarray(
+        block_features(x_host, width, b1.n_blocks))), 256)
+
+    b2 = arrow_blocks_from_csr(lvl.matrix, width, pad_blocks_to=b1.n_blocks + 3)
+    out2 = unblock_features(arrow_spmm(b2, jnp.asarray(
+        block_features(x_host, width, b2.n_blocks))), 256)
+    np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_arrow_blocks_is_pytree():
+    a = barabasi_albert(128, 3, seed=8)
+    blocks = arrow_blocks_from_csr(
+        arrow_decomposition(a, 32, max_levels=100, block_diagonal=True)[0].matrix,
+        32)
+    leaves = jax.tree_util.tree_leaves(blocks)
+    assert len(leaves) >= 6
+    rebuilt = jax.tree_util.tree_map(lambda v: v, blocks)
+    assert isinstance(rebuilt, ArrowBlocks)
+    assert rebuilt.width == blocks.width
+
+
+def test_arrow_blocks_rejects_out_of_pattern():
+    # A matrix wider than the requested width must raise, not silently
+    # drop nonzeros (reference behavior: silent drop).
+    a = barabasi_albert(300, 6, seed=0)
+    levels = arrow_decomposition(a, 32, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    last = levels[-1]
+    if last.arrow_width > 32:
+        with pytest.raises(ValueError, match="captured"):
+            arrow_blocks_from_csr(last.matrix, 32)
+        # With its own achieved width it tiles fine in banded mode only if
+        # within band; block-diagonal needs the block criterion, so use
+        # the banded layout which covers |i-j|<=1 blocks.
+        arrow_blocks_from_csr(last.matrix, last.arrow_width, banded=True)
