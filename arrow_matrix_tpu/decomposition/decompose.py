@@ -162,7 +162,11 @@ def _decompose(a: sparse.csr_matrix, width: int, levels: list[ArrowLevel],
                               shape=(n, n))
         b.sum_duplicates()
         b.sort_indices()
-        levels.append(ArrowLevel(b, order, width))
+        # The all-False fallback above keeps every edge, so the level's
+        # width bound is whatever those edges achieve, not the request.
+        levels.append(ArrowLevel(b, order,
+                                 achieved_width(r[in_level], c[in_level],
+                                                width)))
 
         if np.any(rest):
             # Remainder keeps original indexing; recursion re-linearizes.

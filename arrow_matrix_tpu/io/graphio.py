@@ -62,8 +62,11 @@ def format_path(base: str, width: Optional[int], index: Optional[int],
 
 
 # A loaded level matrix: either an in-memory CSR or a (data, indices,
-# indptr) triplet of (possibly memory-mapped) arrays.
-CsrLike = Union[sparse.csr_matrix, Tuple[np.ndarray, np.ndarray, np.ndarray]]
+# indptr) triplet of (possibly memory-mapped) arrays.  A triplet's data
+# may be None, meaning implicit unit values (generated per-slice on
+# access, never materialized at full nnz size).
+CsrLike = Union[sparse.csr_matrix,
+                Tuple[Optional[np.ndarray], np.ndarray, np.ndarray]]
 
 
 def save_decomposition(levels: List[ArrowLevel], base: str,
@@ -106,12 +109,19 @@ def load_level_widths(base: str, width: Optional[int],
 def save_decomposition_npz(levels: List[ArrowLevel], base: str,
                            block_diagonal: bool = True,
                            dtype=np.float32) -> None:
-    """Legacy single-file npz scheme (reference graphio.py:73-117)."""
+    """Legacy single-file npz scheme (reference graphio.py:73-117).
+
+    Like ``save_decomposition``, all levels are named by the *level-0*
+    width so the loader's single-width enumeration finds every level
+    (naming each level by its own achieved width — the reference scheme —
+    silently drops a grown last level on reload)."""
+    width0 = levels[0].arrow_width if levels else 0
     for i, lvl in enumerate(levels):
         m = lvl.matrix.tocsr().astype(dtype)
-        w = lvl.arrow_width
-        sparse.save_npz(format_path(base, w, i, block_diagonal, FileKind.npz), m)
-        np.save(format_path(base, w, i, block_diagonal, FileKind.permutation),
+        sparse.save_npz(format_path(base, width0, i, block_diagonal,
+                                    FileKind.npz), m)
+        np.save(format_path(base, width0, i, block_diagonal,
+                            FileKind.permutation),
                 np.asarray(lvl.permutation, dtype=np.int64))
 
 
@@ -139,6 +149,11 @@ def load_decomposition(base: str, width: Optional[int] = None,
         p_data = format_path(base, width, i, block_diagonal, FileKind.data)
         if os.path.exists(p_data):
             data = loader(p_data)
+        elif mem_map:
+            # Implicit unit values: keep the O(touched-blocks) footprint —
+            # ones are generated per-slice by load_block, never as a full
+            # nnz-sized array.
+            data = None
         else:
             data = np.ones(indices.size, dtype=np.float32)
         n = indptr.size - 1  # square adjacency: column count not stored
@@ -154,6 +169,13 @@ def load_decomposition(base: str, width: Optional[int] = None,
 
     if not out:
         out = _load_decomposition_npz(base, width, block_diagonal, with_permutation)
+    if not out:
+        raise FileNotFoundError(
+            f"no decomposition artifacts found for base={base!r} "
+            f"width={width} block_diagonal={block_diagonal} (checked npy "
+            f"triplets and legacy npz; note levels are saved under the "
+            f"level-0 width, which for max_levels=1 is the *achieved* "
+            f"width, not the requested one)")
     return out
 
 
@@ -187,8 +209,10 @@ def as_levels(loaded: List[Tuple[CsrLike, Optional[np.ndarray]]],
     for (m, perm), w in zip(loaded, widths):
         if not isinstance(m, sparse.csr_matrix):
             n = m[2].size - 1
-            m = sparse.csr_matrix((np.asarray(m[0]), np.asarray(m[1]),
-                                   np.asarray(m[2])), shape=(n, n))
+            data = (np.ones(np.asarray(m[1]).size, dtype=np.float32)
+                    if m[0] is None else np.asarray(m[0]))
+            m = sparse.csr_matrix((data, np.asarray(m[1]), np.asarray(m[2])),
+                                  shape=(n, n))
         levels.append(ArrowLevel(m, perm, int(w)))
     return levels
 
@@ -207,12 +231,27 @@ def nnz_per_row(matrix: CsrLike) -> np.ndarray:
 
 
 def number_of_blocks(matrix: CsrLike, width: int) -> int:
-    """Blocks per side after truncating trailing all-zero rows (reference
-    arrow_dec_mpi.py:612-627; assumes symmetric structure)."""
+    """Blocks per side after truncating trailing all-zero rows *and*
+    columns.
+
+    The reference truncates by rows only (arrow_dec_mpi.py:612-627),
+    which for asymmetric (directed-graph) level matrices drops head-row
+    nonzeros sitting in columns beyond the last nonzero row; the column
+    extent is scanned here too (chunked, so memmapped index arrays are
+    streamed rather than materialized)."""
     counts = nnz_per_row(matrix)
     nz = np.nonzero(counts)[0]
-    nonzero_rows = 0 if nz.size == 0 else int(nz[-1]) + 1
-    return max(1, int(np.ceil(nonzero_rows / width)))
+    extent = 0 if nz.size == 0 else int(nz[-1]) + 1
+
+    indices = (matrix.indices if isinstance(matrix, sparse.csr_matrix)
+               else matrix[1])
+    nnz = int(indices.shape[0])
+    step = 1 << 24
+    for lo in range(0, nnz, step):
+        chunk = np.asarray(indices[lo:lo + step])
+        if chunk.size:
+            extent = max(extent, int(chunk.max()) + 1)
+    return max(1, -(-extent // width))
 
 
 def load_block(matrix: CsrLike, row_start: int, row_stop: int,
@@ -232,7 +271,8 @@ def load_block(matrix: CsrLike, row_start: int, row_stop: int,
     hi = int(indptr[row_stop])
     sub_indptr = np.asarray(indptr[row_start:row_stop + 1], dtype=np.int64) - lo
     sub_indices = np.asarray(indices[lo:hi])
-    sub_data = np.asarray(data[lo:hi])
+    sub_data = (np.ones(hi - lo, dtype=dtype) if data is None
+                else np.asarray(data[lo:hi]))
 
     rows = sparse.csr_matrix((sub_data, sub_indices, sub_indptr),
                              shape=(row_stop - row_start, n), dtype=dtype)

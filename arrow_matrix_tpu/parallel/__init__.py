@@ -1,0 +1,35 @@
+"""Distributed execution layer: mesh layouts and collective SpMM steps.
+
+TPU-native counterpart of the reference's MPI runtime (reference
+arrow/arrow_mpi.py, arrow/arrow_slim_mpi.py, arrow/arrow_dec_mpi.py and
+the two baselines).  Instead of per-rank Python objects mutating buffers
+and calling MPI primitives, every layout here is one SPMD program over a
+`jax.sharding.Mesh`:
+
+  * communicators        -> mesh axes
+  * Bcast of X_0         -> masked `psum` (or GSPMD broadcast)
+  * Reduce of C_0        -> `psum`
+  * banded halo Isend    -> `lax.ppermute`
+  * Alltoallv routing    -> static gather index arrays (+ `all_to_all`
+                            under `shard_map`)
+  * load-time Send/Recv  -> sharded array construction
+                            (`jax.device_put` with `NamedSharding`)
+
+Modules:
+  mesh           mesh construction helpers, sharding utilities
+  arrow_layout   slim / banded single-matrix distributed SpMM
+  multi_level    K-matrix orchestration with permutation routing
+  spmm_15d       1.5D A-stationary replication baseline
+  spmm_1d        PETSc-style 1D row partition with exact halo exchange
+"""
+
+from arrow_matrix_tpu.parallel.mesh import (
+    make_mesh,
+    shard_blocked,
+    blocks_sharding,
+)
+from arrow_matrix_tpu.parallel.arrow_layout import (
+    make_slim_spmm,
+    distributed_arrow_spmm,
+)
+from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow

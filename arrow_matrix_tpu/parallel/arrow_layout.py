@@ -1,0 +1,151 @@
+"""Distributed single-matrix arrow SpMM layouts.
+
+One arrow matrix, block-rows sharded over a 1-D mesh axis.  This single
+layout subsumes both of the reference's MPI layouts:
+
+  * the **slim** layout (one rank per block-row,
+    reference arrow/arrow_slim_mpi.py:246-280) *is* the sharding;
+  * the **wide** layout's separate row-arm ranks
+    (reference arrow/arrow_mpi.py:31-47,338-406) exist only to
+    parallelize the head-row reduction ``C_0 = sum_j A_0j X_j`` — which
+    on TPU is a single `psum` over ICI, already parallel across chips.
+    The wide layout's *banded* variant (±1 neighbor halo exchange,
+    reference arrow/arrow_mpi.py:123-175) is supported directly via
+    `lax.ppermute`.
+
+Collective mapping (reference MPI call -> here):
+  Bcast X_0 (arrow_slim_mpi.py:273)      -> masked psum broadcast
+  Reduce C_0 (arrow_slim_mpi.py:104-119) -> psum
+  Isend/Irecv halos (arrow_mpi.py:123-175) -> ppermute
+  Gather result (arrow_slim_mpi.py:423)  -> the output *is* a sharded
+                                            global array; no gather
+
+Two execution paths, same numerics:
+  * `distributed_arrow_spmm` — the single-device `arrow_spmm` jitted
+    with sharded inputs; GSPMD inserts the collectives.  Zero extra
+    code; the baseline path.
+  * `make_slim_spmm` — explicit `shard_map` with hand-placed psum /
+    ppermute; full control over collective placement for performance
+    work (e.g. overlapping the head reduction with the diagonal matmul,
+    the optimization the reference scaffolded but never enabled —
+    arrow_mpi.py:371, SURVEY.md §7 "known bugs").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from arrow_matrix_tpu.ops.arrow_blocks import ArrowBlocks, arrow_spmm
+from arrow_matrix_tpu.ops.ell import ell_spmm, ell_spmm_batched
+from arrow_matrix_tpu.parallel.mesh import blocks_sharding, shard_arrow_blocks
+
+
+@functools.lru_cache(maxsize=None)
+def _gspmd_spmm(chunk: Optional[int]):
+    # One jitted callable per chunk setting: jax.jit caches traces by
+    # function identity, so the wrapper must be stable across calls.
+    return jax.jit(functools.partial(arrow_spmm, chunk=chunk))
+
+
+def distributed_arrow_spmm(blocks: ArrowBlocks, x: jax.Array,
+                           mesh: Mesh, axis: str = "blocks",
+                           chunk: Optional[int] = None) -> jax.Array:
+    """GSPMD path: jit the single-device step over sharded operands.
+
+    `arrow_spmm`'s head-row sum, X_0 indexing and banded shifts lower to
+    an all-reduce, a broadcast and collective-permutes respectively when
+    the block axis is sharded — the same collectives `make_slim_spmm`
+    places by hand.  Sharding propagates from the operands (place them
+    with `shard_arrow_blocks` / `shard_blocked`); the jitted callable is
+    cached, so calling this per iteration does not re-trace.
+    """
+    del mesh, axis  # shardings are carried by the operands
+    return _gspmd_spmm(chunk)(blocks, x)
+
+
+def shard_arrow_blocks_spec(blocks: ArrowBlocks, mesh: Mesh, axis: str):
+    """NamedSharding pytree for an ArrowBlocks: leading axis over ``axis``."""
+    s = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda _: s, blocks)
+
+
+def _local_slim_step(blocks: ArrowBlocks, x: jax.Array, axis: str,
+                     n_dev: int, chunk: Optional[int]) -> jax.Array:
+    """Per-shard body of the slim SpMM under shard_map.
+
+    blocks/x hold this device's contiguous slice of block-rows;
+    the device holding global block 0 is mesh position 0.
+    """
+    nb_local, w, k = x.shape
+    idx = lax.axis_index(axis)
+    is_dev0 = (idx == 0)
+
+    # --- Broadcast X_0 from the head device (reference Bcast,
+    # arrow_slim_mpi.py:273).  Masked psum = broadcast over ICI.
+    x0 = lax.psum(jnp.where(is_dev0, x[0], jnp.zeros_like(x[0])), axis)
+
+    # --- Head row: C_0 = sum_j A_0j X_j, reduced over all devices
+    # (reference Reduce, arrow_slim_mpi.py:104-119).
+    head_partial = ell_spmm_batched(blocks.head_cols, blocks.head_data, x,
+                                    chunk=chunk).sum(axis=0)
+    c0 = lax.psum(head_partial, axis)
+
+    # --- Local blocks: C_i = A_ii X_i + A_i0 X_0 (arrow_slim_mpi.py:121-147).
+    c = ell_spmm_batched(blocks.diag_cols, blocks.diag_data, x, chunk=chunk)
+    c = c + jax.vmap(lambda cc, dd: ell_spmm(cc, dd, x0, chunk=chunk))(
+        blocks.col_cols, blocks.col_data)
+
+    # --- Banded halo exchange: block i needs X_{i±1}.  Within the shard
+    # a shift; across shard boundaries a ppermute of the edge block
+    # (reference nonblocking Isend/Irecv, arrow_mpi.py:123-175).
+    # ppermute leaves non-receiving devices with zeros — exactly the
+    # boundary condition at the first/last block.
+    if blocks.banded:
+        fwd = [(i, i + 1) for i in range(n_dev - 1)]
+        bwd = [(i + 1, i) for i in range(n_dev - 1)]
+        prev_tail = lax.ppermute(x[-1], axis, perm=fwd)   # from device idx-1
+        next_head = lax.ppermute(x[0], axis, perm=bwd)    # from device idx+1
+        x_lo = jnp.concatenate([prev_tail[None], x[:-1]], axis=0)
+        x_hi = jnp.concatenate([x[1:], next_head[None]], axis=0)
+        c = c + ell_spmm_batched(blocks.lo_cols, blocks.lo_data, x_lo,
+                                 chunk=chunk)
+        c = c + ell_spmm_batched(blocks.hi_cols, blocks.hi_data, x_hi,
+                                 chunk=chunk)
+
+    # --- The head device's local block 0 is global block 0: its result
+    # is the reduced C_0 (reference rank-0 buffer swap,
+    # arrow_slim_mpi.py:152-155).
+    c = c.at[0].set(jnp.where(is_dev0, c0, c[0]))
+    return c
+
+
+def make_slim_spmm(blocks: ArrowBlocks, mesh: Mesh, axis: str = "blocks",
+                   chunk: Optional[int] = None):
+    """Build the jitted shard_map slim SpMM step for one arrow matrix.
+
+    Returns ``step(blocks, x) -> c`` operating on globally-shaped arrays
+    whose block axis is sharded over ``axis``.  ``blocks`` is passed at
+    call time (it is donated to HBM once and reused across iterations —
+    unlike the reference GPU path's per-call host->device uploads,
+    arrow_mpi.py:314).
+    """
+    spec_blocks = jax.tree_util.tree_map(lambda _: P(axis), blocks)
+    step = shard_map(
+        functools.partial(_local_slim_step, axis=axis,
+                          n_dev=mesh.shape[axis], chunk=chunk),
+        mesh=mesh,
+        in_specs=(spec_blocks, P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(step)

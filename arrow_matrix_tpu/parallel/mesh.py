@@ -1,0 +1,67 @@
+"""Mesh construction and sharding helpers.
+
+The mesh replaces the reference's MPI communicators and rank groups
+(reference arrow/arrow_mpi.py:74-81,501-525, arrow/arrow_dec_mpi.py:140-165):
+rank arithmetic becomes named mesh axes, and sub-communicators become
+collectives over a subset of axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("blocks",),
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Default: a 1-D mesh named ``blocks`` over all devices — the slim
+    arrow layout's block-row axis (the TPU analog of the reference's
+    one-rank-per-block-row slim communicator,
+    reference arrow/arrow_slim_mpi.py:298-326).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devs),)
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError(f"mesh shape {tuple(shape)} does not cover "
+                         f"{len(devs)} devices")
+    arr = np.asarray(devs, dtype=object).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def blocks_sharding(mesh: Mesh, axis: str = "blocks") -> NamedSharding:
+    """Sharding for a (nb, w, k) blocked array: block axis over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_blocked(x, mesh: Mesh, axis: str = "blocks") -> jax.Array:
+    """Place a blocked (nb, ...) array with its leading axis sharded.
+
+    The load-time equivalent of the reference's rank-by-rank tagged
+    Send/Recv block distribution (reference arrow_dec_mpi.py:894-924) —
+    on TPU a single `device_put` with a NamedSharding.
+    """
+    nb = x.shape[0]
+    n_dev = mesh.shape[axis]
+    if nb % n_dev != 0:
+        raise ValueError(f"{nb} blocks not divisible by {n_dev} devices "
+                         f"on axis {axis!r}; pad with pad_blocks_to")
+    return jax.device_put(x, blocks_sharding(mesh, axis))
+
+
+def shard_arrow_blocks(blocks, mesh: Mesh, axis: str = "blocks"):
+    """Shard every array leaf of an ArrowBlocks pytree on its leading
+    (block) axis."""
+    return jax.tree_util.tree_map(lambda a: shard_blocked(a, mesh, axis),
+                                  blocks)
+
+
+def pad_to_multiple(nb: int, n_dev: int) -> int:
+    """Smallest block count >= nb divisible by the device count."""
+    return -(-nb // n_dev) * n_dev

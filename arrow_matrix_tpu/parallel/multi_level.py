@@ -1,0 +1,256 @@
+"""Multi-level orchestration: iterated SpMM through a whole decomposition.
+
+TPU-native counterpart of the reference's ``ArrowDecompositionMPI``
+(reference arrow/arrow_dec_mpi.py).  The reference runs the K arrow
+matrices *concurrently on disjoint MPI rank groups*, moving features
+forward and partial results backward every iteration through
+permutation-routed ``Alltoallv`` exchanges whose counts/displacements are
+precomputed into routing tables at init
+(arrow_dec_mpi.py:210-281,404-550).
+
+Here the design is deliberately different (SURVEY.md §7 layer 5): all K
+levels run **back-to-back on the full mesh**.  With fast ICI, time-sharing
+all chips over the levels beats space-sharing them (each level's SpMM
+gets the whole machine; no level sits idle waiting for its neighbors),
+and the permutation routing collapses to *composed static gather index
+arrays* applied to the sharded feature array — XLA lowers a sharded
+gather-by-permutation to exactly the all-to-all the routing tables
+hand-build in the reference.
+
+Semantics per ``step()`` (matches arrow_dec_mpi.py:283-307):
+
+    X held in level-0 order.                    x_0 = X
+    forward:   x_i = x_{i-1}[fwd_i]             (fwd_i = σ_{i-1}^{-1}∘σ_i)
+    compute:   c_i = B_i @ x_i                  (slim arrow SpMM)
+    backward:  agg_{K-1} = c_{K-1};
+               agg_{i-1} = c_{i-1} + agg_i[bwd_i]  (bwd_i = σ_i^{-1}∘σ_{i-1})
+    X := agg_0  — the result *in level-0 order* becomes the next
+    iteration's features (reference set_features, arrow_dec_mpi.py:438,545).
+
+The result in original row order is ``agg_0[σ_0^{-1}]`` — materialized
+only on demand by ``gather_result`` (reference allgather_result analog).
+
+Permutations are padded to the blocked row count with identity tails, and
+every level is padded to one shared block count, so all shapes are static
+and uniform across the mesh (the reference's dummy-row overflow mapping,
+arrow_dec_mpi.py:703-749, becomes plain zero-row padding here).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from arrow_matrix_tpu.decomposition.decompose import ArrowLevel
+from arrow_matrix_tpu.io.graphio import number_of_blocks
+from arrow_matrix_tpu.ops.arrow_blocks import (
+    ArrowBlocks,
+    arrow_blocks_from_csr,
+    arrow_spmm,
+)
+from arrow_matrix_tpu.parallel.mesh import (
+    pad_to_multiple,
+    shard_arrow_blocks,
+)
+
+
+def pad_permutation(perm: np.ndarray, total: int) -> np.ndarray:
+    """Extend a permutation of [0, n) to [0, total) with an identity tail
+    (padding rows are zero and permute among themselves)."""
+    n = perm.size
+    if n > total:
+        raise ValueError(f"permutation length {n} exceeds padded rows {total}")
+    return np.concatenate([perm.astype(np.int64),
+                           np.arange(n, total, dtype=np.int64)])
+
+
+def compose_routing(perms: Sequence[np.ndarray], total: int
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Static routing index arrays replacing the reference's Alltoallv
+    tables (arrow_dec_mpi.py:210-281).
+
+    Returns (fwd, bwd), each (K-1, total) int32:
+      fwd[i-1] maps level-(i-1)-ordered rows to level-i order:
+          x_i = x_{i-1}[fwd[i-1]],  fwd[i-1] = inv(σ_{i-1})[σ_i]
+      bwd[i-1] maps level-i-ordered rows to level-(i-1) order:
+          agg_{i-1} += agg_i[bwd[i-1]],  bwd[i-1] = inv(σ_i)[σ_{i-1}]
+    """
+    padded = [pad_permutation(np.asarray(p), total) for p in perms]
+    fwd, bwd = [], []
+    for i in range(1, len(padded)):
+        inv_prev = np.argsort(padded[i - 1])
+        inv_cur = np.argsort(padded[i])
+        fwd.append(inv_prev[padded[i]])
+        bwd.append(inv_cur[padded[i - 1]])
+    if not fwd:
+        return (np.zeros((0, total), np.int32),) * 2
+    return (np.stack(fwd).astype(np.int32), np.stack(bwd).astype(np.int32))
+
+
+class MultiLevelArrow:
+    """Device-resident multi-level arrow decomposition + jitted step.
+
+    Construction tiles every level's CSR into ArrowBlocks padded to one
+    shared flat row count (divisible by the mesh block axis), builds the
+    composed routing tables, and places everything on the mesh.  This
+    replaces the reference's entire distributed-load machinery
+    (arrow_dec_mpi.py:629-887: root-reads-and-ships-blocks) with sharded
+    `device_put`.
+
+    A last level whose *achieved* width exceeds the requested width (the
+    decomposition keeps all remaining edges there) is tiled at its own
+    block width — the achieved width rounded up to a multiple of the base
+    width — in banded mode, which provably covers every |r-c| <= W entry.
+    The reference instead loads every level at the fixed width and
+    silently drops out-of-pattern nonzeros (SURVEY.md §7 known bugs); we
+    stay exact.
+
+    ``step(x)`` runs one full iteration; iterate by feeding the result
+    back (the reference's benchmark loop, arrow_bench.py:111-134).
+    Features are carried as flat (total_rows, k) arrays sharded on the
+    row axis; each level reshapes to its own (nb_i, w_i, k) blocking.
+    """
+
+    def __init__(self, levels: List[ArrowLevel], width: int,
+                 mesh: Optional[Mesh] = None, axis: str = "blocks",
+                 banded: bool = False, dtype=np.float32,
+                 chunk: Optional[int] = None):
+        if not levels:
+            raise ValueError("empty decomposition")
+        self.width = width
+        self.mesh = mesh
+        self.axis = axis
+        self.banded = banded
+        self.n = levels[0].matrix.shape[0]
+
+        n_dev = mesh.shape[axis] if mesh is not None else 1
+
+        # Per-level block widths.  A level whose achieved width exceeds
+        # the base width (always possible for the last level, which keeps
+        # *all* remaining edges under a band bound; also the decomposer's
+        # keep-everything fallback) is tiled at its achieved width rounded
+        # up to a multiple of the base width, in banded mode — banded
+        # tiling at block width W covers every |r-c| <= W entry.  The
+        # last level's structure is a band even in block-diagonal mode,
+        # so it is always banded.
+        widths, bandeds = [], []
+        for i, lvl in enumerate(levels):
+            is_last = i == len(levels) - 1
+            if lvl.arrow_width > width or is_last:
+                widths.append(-(-lvl.arrow_width // width) * width)
+                bandeds.append(True)
+            else:
+                widths.append(width)
+                bandeds.append(banded)
+        self.widths = widths
+
+        # One shared flat row count, a multiple of every level's block
+        # width times the device count (widths[-1] is the only non-base
+        # width and is itself a multiple of the base width).
+        unit = n_dev * max(widths)
+        max_rows = max(number_of_blocks(lvl.matrix, w) * w
+                       for lvl, w in zip(levels, widths))
+        self.total_rows = pad_to_multiple(max_rows, unit)
+
+        self.blocks: List[ArrowBlocks] = [
+            arrow_blocks_from_csr(lvl.matrix.astype(dtype), w,
+                                  pad_blocks_to=self.total_rows // w,
+                                  banded=bd, dtype=dtype)
+            for lvl, w, bd in zip(levels, widths, bandeds)
+        ]
+        fwd, bwd = compose_routing([lvl.permutation for lvl in levels],
+                                   self.total_rows)
+        self.perm0 = pad_permutation(np.asarray(levels[0].permutation),
+                                     self.total_rows)
+        self.inv_perm0 = np.argsort(self.perm0)
+
+        if mesh is not None:
+            self.blocks = [shard_arrow_blocks(b, mesh, axis)
+                           for b in self.blocks]
+            # Routing tables are replicated (they index global rows).
+            repl = NamedSharding(mesh, P())
+            self.fwd = jax.device_put(fwd, repl)
+            self.bwd = jax.device_put(bwd, repl)
+        else:
+            self.fwd = jnp.asarray(fwd)
+            self.bwd = jnp.asarray(bwd)
+
+        self._step = jax.jit(functools.partial(
+            _multi_level_step, blocks=self.blocks, widths=tuple(widths),
+            chunk=chunk))
+
+    # -- feature placement -------------------------------------------------
+
+    def _rows_sharding(self):
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def place_features(self, x_level0: np.ndarray) -> jax.Array:
+        """Host (total_rows, k) features *already in level-0 order* ->
+        flat sharded device array."""
+        if self.mesh is None:
+            return jnp.asarray(x_level0)
+        return jax.device_put(x_level0, self._rows_sharding())
+
+    def set_features(self, x_original: np.ndarray) -> jax.Array:
+        """Host (n, k) features in *original* row order -> device array in
+        level-0 order (reference set_features on matrix 0,
+        arrow_bench.py:114-116)."""
+        n, k = x_original.shape
+        if n != self.n:
+            raise ValueError(f"expected {self.n} rows, got {n}")
+        padded = np.zeros((self.total_rows, k), dtype=x_original.dtype)
+        padded[:n] = x_original
+        return self.place_features(padded[self.perm0])
+
+    def gather_result(self, c: jax.Array) -> np.ndarray:
+        """Device result (level-0 order, flat) -> host (n, k) array in
+        original row order (reference allgather_result analog)."""
+        return np.asarray(c)[self.inv_perm0][:self.n]
+
+    # -- iteration ---------------------------------------------------------
+
+    def step(self, x: jax.Array) -> jax.Array:
+        """One iteration ``X := A @ X`` through all levels; input and
+        output are flat (total_rows, k) arrays in level-0 order."""
+        return self._step(x, fwd=self.fwd, bwd=self.bwd)
+
+    def run(self, x: jax.Array, iterations: int) -> jax.Array:
+        for _ in range(iterations):
+            x = self.step(x)
+        return x
+
+
+def _multi_level_step(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
+                      blocks: List[ArrowBlocks], widths: tuple,
+                      chunk: Optional[int]) -> jax.Array:
+    """One decomposition-wide SpMM (jitted; K unrolled — K is small).
+
+    Forward feature propagation (reference
+    _propagate_features_forwards, arrow_dec_mpi.py:507-550), per-level
+    arrow SpMM, backward aggregation (reference
+    _aggregate_features_backwards, arrow_dec_mpi.py:404-440).
+    ``x`` is flat (total_rows, k); each level reshapes to its own
+    blocking (nb_i, w_i, k).
+    """
+    total, k = x.shape
+    k_levels = len(blocks)
+    partials = []
+    x_cur = x
+    for i in range(k_levels):
+        if i > 0:
+            x_cur = jnp.take(x_cur, fwd[i - 1], axis=0)
+        w = widths[i]
+        c = arrow_spmm(blocks[i], x_cur.reshape(total // w, w, k),
+                       chunk=chunk)
+        partials.append(c.reshape(total, k))
+
+    agg = partials[-1]
+    for i in range(k_levels - 1, 0, -1):
+        agg = partials[i - 1] + jnp.take(agg, bwd[i - 1], axis=0)
+    return agg

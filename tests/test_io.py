@@ -123,3 +123,54 @@ def test_grown_last_level_roundtrips(tmp_path):
     relevels = as_levels(loaded, widths)
     diff = (reconstruct(relevels) - a).tocsr()
     assert diff.nnz == 0 or np.max(np.abs(diff.data)) < 1e-5
+
+
+def test_npz_grown_last_level_roundtrips(tmp_path):
+    # The legacy npz scheme must also name all levels by the level-0
+    # width so a grown last level is found on reload (code-review fix).
+    from arrow_matrix_tpu.io.graphio import save_decomposition_npz
+    a = barabasi_albert(300, 6, seed=0)
+    levels = arrow_decomposition(a, 32, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    assert levels[-1].arrow_width > 32  # the scenario under test
+    base = str(tmp_path / "g")
+    save_decomposition_npz(levels, base, block_diagonal=True)
+    loaded = load_decomposition(base, levels[0].arrow_width,
+                                block_diagonal=True)
+    assert len(loaded) == len(levels)
+
+
+def test_load_missing_artifacts_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no decomposition"):
+        load_decomposition(str(tmp_path / "nothing"), 32)
+
+
+def test_number_of_blocks_asymmetric_columns():
+    # Directed-graph level matrix: head row reaches a column beyond the
+    # last nonzero row; truncation must keep that column's block.
+    n, w = 60, 10
+    m = sparse.lil_matrix((n, n), dtype=np.float32)
+    m[0, 55] = 1.0   # head-row entry in the last block
+    m[5, 3] = 1.0    # rows end early
+    a = m.tocsr()
+    assert number_of_blocks(a, w) == 6
+
+
+def test_memmap_missing_data_stays_lazy(tmp_path):
+    # mem_map + absent _data file: loader returns data=None (implicit
+    # ones) instead of materializing an nnz-sized array.
+    import os
+    a = barabasi_albert(100, 3, seed=4)
+    levels = arrow_decomposition(a, 20, max_levels=4, block_diagonal=True,
+                                 seed=0)
+    base = str(tmp_path / "g")
+    save_decomposition(levels, base, block_diagonal=True)
+    os.remove(format_path(base, 20, 0, True, FileKind.data))
+    loaded = load_decomposition(base, 20, block_diagonal=True, mem_map=True)
+    data, indices, indptr = loaded[0][0]
+    assert data is None
+    blk = load_block(loaded[0][0], 0, 20, 0, 20, 20)
+    assert np.all(blk.data == 1.0)
+    # as_levels also materializes ones.
+    lvls = as_levels(loaded, 20)
+    assert np.all(lvls[0].matrix.data == 1.0)
