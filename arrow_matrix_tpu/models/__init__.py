@@ -1,0 +1,38 @@
+"""Model families built on the distributed arrow SpMM.
+
+The reference is a pure SpMM library — it has **no** model layer
+(SURVEY.md §2b "Absent": no models, no training, no attention).  Its
+stated workload is GNN-style iterated propagation ``X := A @ X``
+(reference README.md:3, arrow/arrow_bench.py:111-134).  This package
+turns that workload into first-class model families, all running on the
+same jitted multi-level arrow SpMM:
+
+  * :class:`~arrow_matrix_tpu.models.propagation.SGCModel` — simplified
+    graph convolution: K propagation hops + a dense readout head on the
+    MXU; the framework's flagship model (differentiable, trainable with
+    optax).
+  * :func:`~arrow_matrix_tpu.models.propagation.power_iteration` —
+    dominant-eigenvector solver by normalized iterated SpMM.
+  * :func:`~arrow_matrix_tpu.models.propagation.pagerank` — damped
+    propagation on the same operator.
+  * :func:`~arrow_matrix_tpu.models.propagation.label_propagation` —
+    masked seed-clamped propagation for semi-supervised labeling.
+"""
+
+from arrow_matrix_tpu.models.propagation import (
+    SGCModel,
+    SGCParams,
+    label_propagation,
+    make_train_step,
+    pagerank,
+    power_iteration,
+)
+
+__all__ = [
+    "SGCModel",
+    "SGCParams",
+    "label_propagation",
+    "make_train_step",
+    "pagerank",
+    "power_iteration",
+]

@@ -1,0 +1,215 @@
+"""Iterated-propagation models on the multi-level arrow SpMM.
+
+Everything here consumes the *pure* jitted SpMM
+:func:`arrow_matrix_tpu.parallel.multi_level.multi_level_spmm` — the
+same function the distributed runtime runs — so a model trained on one
+chip runs unchanged over a mesh (operands carry the shardings; GSPMD
+inserts the collectives).
+
+All feature arrays are flat ``(total_rows, k)`` in level-0 order (see
+``MultiLevelArrow``); ``SGCModel.from_multi`` handles padding and
+permutation from original row order.
+
+The flagship model is SGC (simplified graph convolution): ``K`` hops of
+``X := A @ X`` followed by one dense layer — exactly the reference's
+benchmark workload (reference arrow/arrow_bench.py:111-134: iterated
+``arrow.step()``) with a trainable MXU head on top.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from arrow_matrix_tpu.ops.arrow_blocks import ArrowBlocks
+from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow, multi_level_spmm
+
+
+@struct.dataclass
+class SGCParams:
+    """Dense readout head: logits = X_prop @ w + b."""
+
+    w: jax.Array
+    b: jax.Array
+
+
+def sgc_init(rng: jax.Array, k_in: int, k_out: int,
+             dtype=jnp.float32) -> SGCParams:
+    """LeCun-normal head init."""
+    w = jax.random.normal(rng, (k_in, k_out), dtype) / jnp.sqrt(
+        jnp.asarray(k_in, dtype))
+    return SGCParams(w=w, b=jnp.zeros((k_out,), dtype))
+
+
+def sgc_forward(params: SGCParams, x: jax.Array, fwd: jax.Array,
+                bwd: jax.Array, blocks: Sequence[ArrowBlocks],
+                widths: tuple, hops: int,
+                chunk: Optional[int] = None) -> jax.Array:
+    """K propagation hops through the decomposition, then the dense head.
+
+    Pure and jittable; ``blocks`` is a pytree argument, so the one trace
+    serves any decomposition with the same shapes, and shardings
+    propagate from the operands under a mesh.
+    """
+    for _ in range(hops):
+        x = multi_level_spmm(x, fwd, bwd, blocks, widths, chunk=chunk)
+    return x @ params.w + params.b[None, :]
+
+
+class SGCModel:
+    """Simplified graph convolution over an arrow decomposition.
+
+    Construction wires a :class:`MultiLevelArrow` (which owns the
+    device-resident blocks, routing tables and mesh placement) to a
+    jitted forward/loss/train-step.  The adjacency is fixed (it is the
+    decomposed graph); only the head parameters train — the defining
+    property of SGC.
+    """
+
+    def __init__(self, multi: MultiLevelArrow, k_in: int, k_out: int,
+                 hops: int = 2, seed: int = 0,
+                 chunk: Optional[int] = None):
+        self.multi = multi
+        self.hops = hops
+        self.params = sgc_init(jax.random.key(seed), k_in, k_out)
+        self._forward = jax.jit(functools.partial(
+            sgc_forward, widths=tuple(multi.widths), hops=hops, chunk=chunk))
+
+    @classmethod
+    def from_multi(cls, multi: MultiLevelArrow, k_in: int, k_out: int,
+                   **kw) -> "SGCModel":
+        return cls(multi, k_in, k_out, **kw)
+
+    def forward(self, x: jax.Array) -> jax.Array:
+        """x: flat (total_rows, k_in) in level-0 order -> logits
+        (total_rows, k_out)."""
+        m = self.multi
+        return self._forward(self.params, x, m.fwd, m.bwd, m.blocks)
+
+    def predict(self, x_original: np.ndarray) -> np.ndarray:
+        """Host (n, k_in) features in original row order -> host logits."""
+        m = self.multi
+        out = self.forward(m.set_features(x_original))
+        return m.gather_result(out)
+
+
+def make_train_step(widths: tuple, hops: int,
+                    optimizer: optax.GradientTransformation,
+                    chunk: Optional[int] = None) -> Callable:
+    """Jitted SGD/Adam training step for the SGC head.
+
+    Returns ``train_step(params, opt_state, x, y, mask, fwd, bwd, blocks)
+    -> (params, opt_state, loss)``.  ``mask`` is a per-row weight (zero
+    for padding rows — the blocked layout pads to the mesh-uniform row
+    count, and those rows must not contribute to the loss).
+    """
+
+    def loss_fn(params, x, y, mask, fwd, bwd, blocks):
+        logits = sgc_forward(params, x, fwd, bwd, blocks, widths, hops,
+                             chunk=chunk)
+        per_row = jnp.sum((logits - y) ** 2, axis=-1)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(per_row * mask) / denom
+
+    @jax.jit
+    def train_step(params, opt_state, x, y, mask, fwd, bwd, blocks):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, mask,
+                                                  fwd, bwd, blocks)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+# ---------------------------------------------------------------------------
+# Solver-style model families on the same operator.  Bodies are
+# module-level jitted functions (widths/chunk static) so repeated solver
+# calls on the same decomposition shapes hit the jit cache instead of
+# re-tracing the K-level SpMM.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("widths", "chunk"))
+def _power_body(x, fwd, bwd, blocks, widths, chunk):
+    y = multi_level_spmm(x, fwd, bwd, blocks, widths, chunk=chunk)
+    return y / jnp.maximum(jnp.linalg.norm(y), 1e-30)
+
+
+def power_iteration(multi: MultiLevelArrow, x0: np.ndarray,
+                    iterations: int = 50) -> tuple[np.ndarray, float]:
+    """Dominant eigenpair by normalized iterated SpMM.
+
+    Returns (eigenvector in original row order, Rayleigh-quotient
+    eigenvalue estimate).  ``x0``: host (n, 1) start vector.
+    """
+    x = multi.set_features(x0.astype(np.float32))
+    for _ in range(iterations):
+        x = _power_body(x, multi.fwd, multi.bwd, multi.blocks,
+                        tuple(multi.widths), multi.chunk)
+    # One more multiply for the Rayleigh quotient x^T A x / x^T x.
+    y = multi.step(x)
+    lam = float(jnp.vdot(x, y) / jnp.maximum(jnp.vdot(x, x), 1e-30))
+    return multi.gather_result(x), lam
+
+
+@functools.partial(jax.jit, static_argnames=("widths", "chunk"))
+def _pagerank_body(r, mask, damping, teleport, fwd, bwd, blocks, widths,
+                   chunk):
+    y = multi_level_spmm(r, fwd, bwd, blocks, widths, chunk=chunk)
+    return damping * y + teleport * mask
+
+
+def pagerank(multi: MultiLevelArrow, damping: float = 0.85,
+             iterations: int = 50) -> np.ndarray:
+    """PageRank by damped iterated SpMM: r := d * A_norm r + (1-d)/n.
+
+    ``multi`` must hold the *column-normalized* adjacency (build the
+    decomposition from ``A @ D^{-1}``); this function runs the iteration,
+    it does not normalize.
+    """
+    n = multi.n
+    r = multi.set_features(np.full((n, 1), 1.0 / n, dtype=np.float32))
+    # Padding rows stay zero: the teleport mass is masked to real rows.
+    # Row r of the level-0 layout is real iff its original index
+    # perm0[r] < n (perm0 pads with an identity tail).
+    mask = multi.place_features((multi.perm0 < n).astype(np.float32)[:, None])
+    damping_arr = jnp.float32(damping)
+    teleport = jnp.float32((1.0 - damping) / n)
+    for _ in range(iterations):
+        r = _pagerank_body(r, mask, damping_arr, teleport, multi.fwd,
+                           multi.bwd, multi.blocks, tuple(multi.widths),
+                           multi.chunk)
+    return multi.gather_result(r)
+
+
+@functools.partial(jax.jit, static_argnames=("widths", "chunk"))
+def _label_prop_body(y, seeds, clamp, fwd, bwd, blocks, widths, chunk):
+    prop = multi_level_spmm(y, fwd, bwd, blocks, widths, chunk=chunk)
+    return clamp * seeds + (1.0 - clamp) * prop
+
+
+def label_propagation(multi: MultiLevelArrow, labels: np.ndarray,
+                      seed_mask: np.ndarray,
+                      iterations: int = 20) -> np.ndarray:
+    """Semi-supervised label propagation with clamped seeds.
+
+    labels: host (n, c) one-hot (or soft) labels; seed_mask: (n,) bool —
+    True rows are clamped to their labels every iteration.
+    ``multi`` should hold a row-normalized adjacency for convergence.
+    """
+    y = multi.set_features(labels.astype(np.float32))
+    seeds = multi.set_features(
+        (labels * seed_mask[:, None]).astype(np.float32))
+    clamp = multi.set_features(seed_mask.astype(np.float32)[:, None])
+
+    for _ in range(iterations):
+        y = _label_prop_body(y, seeds, clamp, multi.fwd, multi.bwd,
+                             multi.blocks, tuple(multi.widths), multi.chunk)
+    return multi.gather_result(y)

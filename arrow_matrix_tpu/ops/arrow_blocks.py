@@ -36,7 +36,13 @@ from flax import struct
 from scipy import sparse
 
 from arrow_matrix_tpu.io.graphio import CsrLike, load_block, number_of_blocks
-from arrow_matrix_tpu.ops.ell import ell_pack_stack, ell_spmm, ell_spmm_batched
+from arrow_matrix_tpu.ops.ell import (
+    dense_pack_stack,
+    dense_spmm_batched,
+    ell_pack_stack,
+    ell_spmm,
+    ell_spmm_batched,
+)
 
 
 @struct.dataclass
@@ -57,6 +63,11 @@ class ArrowBlocks:
     width: int = struct.field(pytree_node=False, default=0)
     n_blocks: int = struct.field(pytree_node=False, default=0)
     banded: bool = struct.field(pytree_node=False, default=False)
+    # Block storage format: "ell" (gather-based, for widths too large to
+    # densify) or "dense" ((nb, w, w) blocks -> batched MXU matmuls; the
+    # *_cols arrays are empty).  An arrow matrix has ~3 structural blocks
+    # per block-row, so dense costs 3·n·w memory at n rows / width w.
+    fmt: str = struct.field(pytree_node=False, default="ell")
 
     @property
     def n_rows(self) -> int:
@@ -74,7 +85,8 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                           banded: bool = False,
                           pad_blocks_to: Optional[int] = None,
                           dtype=np.float32,
-                          check: bool = True) -> ArrowBlocks:
+                          check: bool = True,
+                          fmt: str = "ell") -> ArrowBlocks:
     """Tile an arrow-shaped CSR (or memmapped triplet) into ArrowBlocks.
 
     Trailing all-zero rows beyond ``n_blocks * width`` are truncated
@@ -100,13 +112,22 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
         captured += b.nnz
         return b
 
+    if fmt not in ("ell", "dense"):
+        raise ValueError(f"unknown block format {fmt!r}")
+
+    def pack(mats):
+        if fmt == "dense":
+            no_cols = np.zeros((len(mats), 0, 0), dtype=np.int32)
+            return no_cols, dense_pack_stack(mats, dtype=dtype, rows=width)
+        return ell_pack_stack(mats, dtype=dtype, rows=width)
+
     head = [blk(0, j) if j < nb else None for j in range(nb_padded)]
     diag = [None] + [blk(i, i) if i < nb else None for i in range(1, nb_padded)]
     col = [None] + [blk(i, 0) if i < nb else None for i in range(1, nb_padded)]
 
-    head_cols, head_data = ell_pack_stack(head, dtype=dtype, rows=width)
-    diag_cols, diag_data = ell_pack_stack(diag, dtype=dtype, rows=width)
-    col_cols, col_data = ell_pack_stack(col, dtype=dtype, rows=width)
+    head_cols, head_data = pack(head)
+    diag_cols, diag_data = pack(diag)
+    col_cols, col_data = pack(col)
 
     kw = {}
     if banded:
@@ -114,8 +135,8 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                              for i in range(2, nb_padded)]
         hi = [None] + [blk(i, i + 1) if i + 1 < nb else None
                        for i in range(1, nb_padded)]
-        lo_cols, lo_data = ell_pack_stack(lo, dtype=dtype, rows=width)
-        hi_cols, hi_data = ell_pack_stack(hi, dtype=dtype, rows=width)
+        lo_cols, lo_data = pack(lo)
+        hi_cols, hi_data = pack(hi)
         kw = dict(lo_cols=jnp.asarray(lo_cols), lo_data=jnp.asarray(lo_data),
                   hi_cols=jnp.asarray(hi_cols), hi_data=jnp.asarray(hi_data))
 
@@ -135,7 +156,29 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
         head_cols=jnp.asarray(head_cols), head_data=jnp.asarray(head_data),
         diag_cols=jnp.asarray(diag_cols), diag_data=jnp.asarray(diag_data),
         col_cols=jnp.asarray(col_cols), col_data=jnp.asarray(col_data),
-        width=width, n_blocks=nb_padded, banded=banded, **kw)
+        width=width, n_blocks=nb_padded, banded=banded, fmt=fmt, **kw)
+
+
+def block_spmm(fmt: str, cols: jax.Array, data: jax.Array, x: jax.Array,
+               chunk: Optional[int] = None) -> jax.Array:
+    """Batched per-block SpMM dispatching on the block format.
+
+    cols/data: stacked blocks (b, ...); x: (b, w, k) -> (b, w, k).
+    """
+    if fmt == "dense":
+        return dense_spmm_batched(data, x)
+    return ell_spmm_batched(cols, data, x, chunk=chunk)
+
+
+def block_spmm_shared(fmt: str, cols: jax.Array, data: jax.Array,
+                      x0: jax.Array, chunk: Optional[int] = None) -> jax.Array:
+    """Batched per-block SpMM against one shared operand (X_0):
+    (b, ...) blocks x (w, k) -> (b, w, k)."""
+    if fmt == "dense":
+        return jnp.einsum("bri,ik->brk", data, x0,
+                          preferred_element_type=jnp.float32).astype(x0.dtype)
+    return jax.vmap(lambda cc, dd: ell_spmm(cc, dd, x0, chunk=chunk))(
+        cols, data)
 
 
 def arrow_spmm(blocks: ArrowBlocks, x: jax.Array,
@@ -150,23 +193,23 @@ def arrow_spmm(blocks: ArrowBlocks, x: jax.Array,
     nb, w, k = x.shape
     assert nb == blocks.n_blocks and w == blocks.width
 
-    head_partial = ell_spmm_batched(blocks.head_cols, blocks.head_data, x,
-                                    chunk=chunk)
+    head_partial = block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data,
+                              x, chunk=chunk)
     c0 = head_partial.sum(axis=0)
 
-    x0 = x[0]
-    c = ell_spmm_batched(blocks.diag_cols, blocks.diag_data, x, chunk=chunk)
-    c = c + jax.vmap(lambda cc, dd: ell_spmm(cc, dd, x0, chunk=chunk))(
-        blocks.col_cols, blocks.col_data)
+    c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
+                   chunk=chunk)
+    c = c + block_spmm_shared(blocks.fmt, blocks.col_cols, blocks.col_data,
+                              x[0], chunk=chunk)
 
     if blocks.banded:
         zeros = jnp.zeros((1, w, k), dtype=x.dtype)
         x_lo = jnp.concatenate([zeros, x[:-1]], axis=0)   # block i sees X_{i-1}
         x_hi = jnp.concatenate([x[1:], zeros], axis=0)    # block i sees X_{i+1}
-        c = c + ell_spmm_batched(blocks.lo_cols, blocks.lo_data, x_lo,
-                                 chunk=chunk)
-        c = c + ell_spmm_batched(blocks.hi_cols, blocks.hi_data, x_hi,
-                                 chunk=chunk)
+        c = c + block_spmm(blocks.fmt, blocks.lo_cols, blocks.lo_data, x_lo,
+                           chunk=chunk)
+        c = c + block_spmm(blocks.fmt, blocks.hi_cols, blocks.hi_data, x_hi,
+                           chunk=chunk)
 
     return c.at[0].set(c0)
 

@@ -19,8 +19,6 @@ Modules:
   mesh           mesh construction helpers, sharding utilities
   arrow_layout   slim / banded single-matrix distributed SpMM
   multi_level    K-matrix orchestration with permutation routing
-  spmm_15d       1.5D A-stationary replication baseline
-  spmm_1d        PETSc-style 1D row partition with exact halo exchange
 """
 
 from arrow_matrix_tpu.parallel.mesh import (

@@ -45,8 +45,12 @@ try:  # jax >= 0.8 promotes shard_map out of experimental
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from arrow_matrix_tpu.ops.arrow_blocks import ArrowBlocks, arrow_spmm
-from arrow_matrix_tpu.ops.ell import ell_spmm, ell_spmm_batched
+from arrow_matrix_tpu.ops.arrow_blocks import (
+    ArrowBlocks,
+    arrow_spmm,
+    block_spmm,
+    block_spmm_shared,
+)
 from arrow_matrix_tpu.parallel.mesh import blocks_sharding, shard_arrow_blocks
 
 
@@ -96,14 +100,15 @@ def _local_slim_step(blocks: ArrowBlocks, x: jax.Array, axis: str,
 
     # --- Head row: C_0 = sum_j A_0j X_j, reduced over all devices
     # (reference Reduce, arrow_slim_mpi.py:104-119).
-    head_partial = ell_spmm_batched(blocks.head_cols, blocks.head_data, x,
-                                    chunk=chunk).sum(axis=0)
+    head_partial = block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data,
+                              x, chunk=chunk).sum(axis=0)
     c0 = lax.psum(head_partial, axis)
 
     # --- Local blocks: C_i = A_ii X_i + A_i0 X_0 (arrow_slim_mpi.py:121-147).
-    c = ell_spmm_batched(blocks.diag_cols, blocks.diag_data, x, chunk=chunk)
-    c = c + jax.vmap(lambda cc, dd: ell_spmm(cc, dd, x0, chunk=chunk))(
-        blocks.col_cols, blocks.col_data)
+    c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
+                   chunk=chunk)
+    c = c + block_spmm_shared(blocks.fmt, blocks.col_cols, blocks.col_data,
+                              x0, chunk=chunk)
 
     # --- Banded halo exchange: block i needs X_{i±1}.  Within the shard
     # a shift; across shard boundaries a ppermute of the edge block
@@ -117,10 +122,10 @@ def _local_slim_step(blocks: ArrowBlocks, x: jax.Array, axis: str,
         next_head = lax.ppermute(x[0], axis, perm=bwd)    # from device idx+1
         x_lo = jnp.concatenate([prev_tail[None], x[:-1]], axis=0)
         x_hi = jnp.concatenate([x[1:], next_head[None]], axis=0)
-        c = c + ell_spmm_batched(blocks.lo_cols, blocks.lo_data, x_lo,
-                                 chunk=chunk)
-        c = c + ell_spmm_batched(blocks.hi_cols, blocks.hi_data, x_hi,
-                                 chunk=chunk)
+        c = c + block_spmm(blocks.fmt, blocks.lo_cols, blocks.lo_data, x_lo,
+                           chunk=chunk)
+        c = c + block_spmm(blocks.fmt, blocks.hi_cols, blocks.hi_data, x_hi,
+                           chunk=chunk)
 
     # --- The head device's local block 0 is global block 0: its result
     # is the reduced C_0 (reference rank-0 buffer swap,

@@ -120,13 +120,15 @@ class MultiLevelArrow:
     def __init__(self, levels: List[ArrowLevel], width: int,
                  mesh: Optional[Mesh] = None, axis: str = "blocks",
                  banded: bool = False, dtype=np.float32,
-                 chunk: Optional[int] = None):
+                 chunk: Optional[int] = None, fmt: str = "auto",
+                 dense_budget: int = 4 << 30):
         if not levels:
             raise ValueError("empty decomposition")
         self.width = width
         self.mesh = mesh
         self.axis = axis
         self.banded = banded
+        self.chunk = chunk
         self.n = levels[0].matrix.shape[0]
 
         n_dev = mesh.shape[axis] if mesh is not None else 1
@@ -158,11 +160,32 @@ class MultiLevelArrow:
                        for lvl, w in zip(levels, widths))
         self.total_rows = pad_to_multiple(max_rows, unit)
 
+        # Per-level block format.  "auto" densifies levels as long as the
+        # *cumulative* dense footprint (total_rows · w · n_stacks ·
+        # itemsize per level — an arrow matrix has 3 structural block
+        # stacks, 5 banded) stays inside the budget: dense blocks run as
+        # batched MXU matmuls, the ELL gather path is the fallback for
+        # widths too large to densify.
+        itemsize = np.dtype(dtype).itemsize
+        budget_left = dense_budget
+        self.fmts = []
+        for w, bd in zip(widths, bandeds):
+            if fmt == "auto":
+                stacks = 5 if bd else 3
+                dense_bytes = self.total_rows * w * stacks * itemsize
+                if dense_bytes <= budget_left:
+                    budget_left -= dense_bytes
+                    self.fmts.append("dense")
+                else:
+                    self.fmts.append("ell")
+            else:
+                self.fmts.append(fmt)
+
         self.blocks: List[ArrowBlocks] = [
             arrow_blocks_from_csr(lvl.matrix.astype(dtype), w,
                                   pad_blocks_to=self.total_rows // w,
-                                  banded=bd, dtype=dtype)
-            for lvl, w, bd in zip(levels, widths, bandeds)
+                                  banded=bd, dtype=dtype, fmt=f)
+            for lvl, w, bd, f in zip(levels, widths, bandeds, self.fmts)
         ]
         fwd, bwd = compose_routing([lvl.permutation for lvl in levels],
                                    self.total_rows)
@@ -181,9 +204,11 @@ class MultiLevelArrow:
             self.fwd = jnp.asarray(fwd)
             self.bwd = jnp.asarray(bwd)
 
+        # Blocks are explicit jit arguments, not closure captures: captured
+        # arrays are inlined into the HLO as literal constants, which
+        # bloats the program (and breaks remote-compile size limits).
         self._step = jax.jit(functools.partial(
-            _multi_level_step, blocks=self.blocks, widths=tuple(widths),
-            chunk=chunk))
+            multi_level_spmm, widths=tuple(widths), chunk=chunk))
 
     # -- feature placement -------------------------------------------------
 
@@ -218,7 +243,7 @@ class MultiLevelArrow:
     def step(self, x: jax.Array) -> jax.Array:
         """One iteration ``X := A @ X`` through all levels; input and
         output are flat (total_rows, k) arrays in level-0 order."""
-        return self._step(x, fwd=self.fwd, bwd=self.bwd)
+        return self._step(x, self.fwd, self.bwd, self.blocks)
 
     def run(self, x: jax.Array, iterations: int) -> jax.Array:
         for _ in range(iterations):
@@ -226,9 +251,9 @@ class MultiLevelArrow:
         return x
 
 
-def _multi_level_step(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
-                      blocks: List[ArrowBlocks], widths: tuple,
-                      chunk: Optional[int]) -> jax.Array:
+def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
+                     blocks: Sequence[ArrowBlocks], widths: tuple,
+                     chunk: Optional[int] = None) -> jax.Array:
     """One decomposition-wide SpMM (jitted; K unrolled — K is small).
 
     Forward feature propagation (reference

@@ -39,30 +39,42 @@ def barabasi_albert(n: int, m: int, seed: int | None = None,
         raise ValueError(f"need n > m (got n={n}, m={m})")
     rng = np.random.default_rng(seed)
 
-    # Start from a star over the first m+1 vertices so every vertex has
-    # degree >= 1 from the outset.
-    sources = [np.arange(m), ]
-    targets = [np.full(m, m), ]
-    repeated = [np.arange(m), np.full(m, m)]
+    # Preallocated endpoint pool: every accepted edge contributes both of
+    # its endpoints, so uniform sampling from the filled prefix is
+    # degree-proportional sampling.  O(n·m) total work (the naive
+    # concatenate-per-vertex variant is O(n²·m) memory traffic).
+    pool = np.empty(2 * m * n, dtype=np.int64)
+    # Seed star over the first m+1 vertices: every vertex starts with
+    # degree >= 1.
+    pool[0:2 * m:2] = np.arange(m)
+    pool[1:2 * m:2] = m
+    fill = 2 * m
+
+    row = np.empty(m * n, dtype=np.int64)
+    col = np.empty(m * n, dtype=np.int64)
+    row[:m] = np.arange(m)
+    col[:m] = m
+    e = m
 
     for v in range(m + 1, n):
-        pool = np.concatenate(repeated) if len(repeated) > 1 else repeated[0]
-        repeated = [pool]
-        chosen: set[int] = set()
-        # Rejection-sample m distinct targets by degree-proportional choice.
-        while len(chosen) < m:
-            picks = pool[rng.integers(0, pool.size, size=m)]
-            for p in picks:
-                if len(chosen) < m:
-                    chosen.add(int(p))
-        tgt = np.fromiter(chosen, dtype=np.int64, count=m)
-        sources.append(np.full(m, v))
-        targets.append(tgt)
-        repeated.append(np.full(m, v))
-        repeated.append(tgt)
+        # Rejection-sample m *distinct* degree-proportional targets;
+        # dedup keeps first-seen order (sorted-unique truncation would
+        # bias toward low vertex ids).
+        picks = pool[rng.integers(0, fill, size=2 * m)]
+        while np.unique(picks).size < m:
+            picks = np.concatenate(
+                [picks, pool[rng.integers(0, fill, size=2 * m)]])
+        _, first = np.unique(picks, return_index=True)
+        tgt = picks[np.sort(first)][:m]
+        row[e:e + m] = v
+        col[e:e + m] = tgt
+        e += m
+        pool[fill:fill + m] = v
+        pool[fill + m:fill + 2 * m] = tgt
+        fill += 2 * m
 
-    row = np.concatenate(sources)
-    col = np.concatenate(targets)
+    row = row[:e]
+    col = col[:e]
     data = np.ones(row.size, dtype=np.float32)
     a = sparse.csr_matrix((data, (row, col)), shape=(n, n))
     if not directed:

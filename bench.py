@@ -1,0 +1,96 @@
+"""End-of-round benchmark: multi-level arrow SpMM iteration time.
+
+Measures the reference's headline quantity — wall-clock `spmm_time` per
+iteration of ``X := A @ X`` through a full arrow decomposition
+(reference arrow/arrow_bench.py:111-134, protocol in BASELINE.md) — on
+the available accelerator, and compares against the same iterated SpMM
+via scipy CSR on the host CPU (the reference's CPU kernel,
+SURVEY.md §2 "Device kernel bridge").
+
+Prints ONE JSON line:
+  {"metric": "spmm_iter_ms", "value": <tpu ms/iter>, "unit": "ms",
+   "vs_baseline": <scipy_ms / tpu_ms>, ...extra diagnostics}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    # Full-f32 matmul passes: the correctness gate is parity with the
+    # host CPU result (BASELINE.md north star); the default TPU bf16-pass
+    # matmul costs ~1e-3 relative error for ~10% speed.
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from arrow_matrix_tpu.decomposition.decompose import (
+        arrow_decomposition,
+        decomposition_spmm,
+    )
+    from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense
+
+    n, m, width, k, iters = 65536, 8, 2048, 16, 10
+
+    t0 = time.perf_counter()
+    a = barabasi_albert(n, m, seed=7)
+    levels = arrow_decomposition(a, arrow_width=width, max_levels=2,
+                                 block_diagonal=True, seed=7)
+    t_decomp = time.perf_counter() - t0
+
+    multi = MultiLevelArrow(levels, width, mesh=None)
+    x_host = random_dense(n, k, seed=3)
+
+    # --- Host CPU baseline: scipy CSR through the decomposition (the
+    # reference's CPU path: per-level CSRMM + permutations).
+    xb = x_host.copy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        xb = decomposition_spmm(levels, xb)
+    scipy_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    # --- Device path.
+    x = multi.set_features(x_host)
+    y = multi.step(x)  # compile + warmup
+    jax.block_until_ready(y)
+    y = multi.step(x)
+    jax.block_until_ready(y)
+
+    t0 = time.perf_counter()
+    xd = x
+    for _ in range(iters):
+        xd = multi.step(xd)
+    jax.block_until_ready(xd)
+    tpu_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    # --- Correctness gate: one device step vs the scipy golden.
+    got = multi.gather_result(multi.step(x))
+    want = decomposition_spmm(levels, x_host)
+    err = float(np.linalg.norm(got - want) /
+                max(np.linalg.norm(want), 1e-30))
+
+    nnz = sum(int(l.matrix.nnz) for l in levels)
+    gflops = 2.0 * nnz * k / (tpu_ms * 1e-3) / 1e9
+
+    print(json.dumps({
+        "metric": "spmm_iter_ms",
+        "value": round(tpu_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(scipy_ms / tpu_ms, 3),
+        "scipy_cpu_ms": round(scipy_ms, 3),
+        "gflops": round(gflops, 2),
+        "frobenius_err_vs_cpu": err,
+        "platform": jax.devices()[0].platform,
+        "config": {"n": n, "edges_nnz": nnz, "width": width, "features": k,
+                   "iterations": iters, "levels": len(levels),
+                   "decompose_s": round(t_decomp, 2)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

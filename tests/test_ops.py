@@ -133,3 +133,29 @@ def test_arrow_blocks_rejects_out_of_pattern():
         # within band; block-diagonal needs the block criterion, so use
         # the banded layout which covers |i-j|<=1 blocks.
         arrow_blocks_from_csr(last.matrix, last.arrow_width, banded=True)
+
+
+def test_dense_format_matches_ell():
+    """Dense (MXU) block format computes the same SpMM as ELL."""
+    import numpy as np
+    from arrow_matrix_tpu.ops.arrow_blocks import (
+        arrow_blocks_from_csr, arrow_spmm, block_features)
+    from arrow_matrix_tpu.utils.graphs import random_dense
+    from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert
+
+    w, n = 8, 96
+    a = barabasi_albert(n, 3, seed=11)
+    # Level 0 of a block-diagonal decomposition fits both the block and
+    # the (superset) banded tiling patterns.
+    lvl = arrow_decomposition(a, arrow_width=w, max_levels=2,
+                              block_diagonal=True, seed=11)[0]
+    for banded in (False, True):
+        ell = arrow_blocks_from_csr(lvl.matrix, w, banded=banded, fmt="ell")
+        dense = arrow_blocks_from_csr(lvl.matrix, w, banded=banded,
+                                      fmt="dense")
+        x = block_features(random_dense(n, 4, seed=1), ell.width,
+                           ell.n_blocks)
+        np.testing.assert_allclose(np.asarray(arrow_spmm(dense, x)),
+                                   np.asarray(arrow_spmm(ell, x)),
+                                   rtol=1e-5, atol=1e-5)
