@@ -7,8 +7,10 @@ chip runs unchanged over a mesh (operands carry the shardings; GSPMD
 inserts the collectives).
 
 All feature arrays are flat ``(total_rows, k)`` in level-0 order (see
-``MultiLevelArrow``); ``SGCModel.from_multi`` handles padding and
-permutation from original row order.
+``MultiLevelArrow``); ``SGCModel.predict`` / ``set_features`` handle
+padding and permutation from original row order, and
+``MultiLevelArrow.real_row_mask`` marks the non-padding rows for
+losses and per-row reductions.
 
 The flagship model is SGC (simplified graph convolution): ``K`` hops of
 ``X := A @ X`` followed by one dense layer — exactly the reference's
@@ -80,11 +82,6 @@ class SGCModel:
         self.params = sgc_init(jax.random.key(seed), k_in, k_out)
         self._forward = jax.jit(functools.partial(
             sgc_forward, widths=tuple(multi.widths), hops=hops, chunk=chunk))
-
-    @classmethod
-    def from_multi(cls, multi: MultiLevelArrow, k_in: int, k_out: int,
-                   **kw) -> "SGCModel":
-        return cls(multi, k_in, k_out, **kw)
 
     def forward(self, x: jax.Array) -> jax.Array:
         """x: flat (total_rows, k_in) in level-0 order -> logits
@@ -177,9 +174,7 @@ def pagerank(multi: MultiLevelArrow, damping: float = 0.85,
     n = multi.n
     r = multi.set_features(np.full((n, 1), 1.0 / n, dtype=np.float32))
     # Padding rows stay zero: the teleport mass is masked to real rows.
-    # Row r of the level-0 layout is real iff its original index
-    # perm0[r] < n (perm0 pads with an identity tail).
-    mask = multi.place_features((multi.perm0 < n).astype(np.float32)[:, None])
+    mask = multi.real_row_mask()
     damping_arr = jnp.float32(damping)
     teleport = jnp.float32((1.0 - damping) / n)
     for _ in range(iterations):

@@ -154,3 +154,116 @@ def make_slim_spmm(blocks: ArrowBlocks, mesh: Mesh, axis: str = "blocks",
         check_vma=False,
     )
     return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
+# Wide layout: disjoint row-arm / column-arm device groups.
+# ---------------------------------------------------------------------------
+
+def _local_wide_step(blocks: ArrowBlocks, x: jax.Array, arm_axis: str,
+                     block_axis: str, n_block_dev: int,
+                     chunk: Optional[int]) -> jax.Array:
+    """Per-shard body of the wide SpMM on a (2, t)-mesh.
+
+    Arm 0 devices are the reference's *column ranks* (diag/col/banded
+    blocks, reference arrow/arrow_mpi.py:399-406), arm 1 devices its *row
+    ranks* (head blocks + reduce, arrow_mpi.py:387-393).  Block arrays
+    are replicated over the arm axis; each arm computes only its own
+    matmuls (real `lax.cond` on the runtime arm index — uniform within
+    each arm, so the branch is SPMD-safe).  Collectives (x0 broadcast,
+    halos, head reduce) stay *outside* the conditionals so every group
+    member participates.
+    """
+    nb_local, w, k = x.shape
+    arm = lax.axis_index(arm_axis)
+    bidx = lax.axis_index(block_axis)
+    is_dev0 = (bidx == 0)
+
+    # X_0 broadcast within each arm row (reference column-comm Bcast,
+    # arrow_mpi.py:372-385; x is arm-replicated so block-axis psum
+    # suffices).
+    x0 = lax.psum(jnp.where(is_dev0, x[0], jnp.zeros_like(x[0])),
+                  block_axis)
+
+    # Row arm: C_0 = sum_j A_0j X_j, reduced over both axes (reference
+    # _ad_spmm_row_tile + Reduce, arrow_mpi.py:274-299).
+    def head_fn():
+        return block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data,
+                          x, chunk=chunk).sum(axis=0)
+
+    head_partial = lax.cond(arm == 1, head_fn,
+                            lambda: jnp.zeros((w, k), dtype=x.dtype))
+    c0 = lax.psum(head_partial, (arm_axis, block_axis))
+
+    # Banded halos: exchanged unconditionally (both arm rows run the
+    # same ppermute schedule; the row arm's result is unused).
+    x_lo = x_hi = None
+    if blocks.banded:
+        fwd = [(i, i + 1) for i in range(n_block_dev - 1)]
+        bwd = [(i + 1, i) for i in range(n_block_dev - 1)]
+        prev_tail = lax.ppermute(x[-1], block_axis, perm=fwd)
+        next_head = lax.ppermute(x[0], block_axis, perm=bwd)
+        x_lo = jnp.concatenate([prev_tail[None], x[:-1]], axis=0)
+        x_hi = jnp.concatenate([x[1:], next_head[None]], axis=0)
+
+    # Column arm: C_i = A_ii X_i + A_i0 X_0 [+ banded neighbors]
+    # (reference _ad_spmm_column_tile, arrow_mpi.py:177-222).
+    def col_fn():
+        c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
+                       chunk=chunk)
+        c = c + block_spmm_shared(blocks.fmt, blocks.col_cols,
+                                  blocks.col_data, x0, chunk=chunk)
+        if blocks.banded:
+            c = c + block_spmm(blocks.fmt, blocks.lo_cols, blocks.lo_data,
+                               x_lo, chunk=chunk)
+            c = c + block_spmm(blocks.fmt, blocks.hi_cols, blocks.hi_data,
+                               x_hi, chunk=chunk)
+        return c
+
+    c = lax.cond(arm == 0, col_fn, lambda: jnp.zeros_like(x))
+    # Only the column arm's device 0 stores C_0: the row arm's output
+    # slice stays all-zero (the documented output contract; a caller
+    # reducing over the arm axis must not double-count C_0).
+    c = c.at[0].set(jnp.where(is_dev0 & (arm == 0), c0, c[0]))
+    return c[None]
+
+
+def make_wide_spmm(blocks: ArrowBlocks, mesh: Mesh, arm_axis: str = "arm",
+                   block_axis: str = "blocks",
+                   chunk: Optional[int] = None):
+    """Build the jitted wide-layout SpMM over a (2, t) mesh.
+
+    TPU counterpart of the reference's wide layout (one arrow matrix on
+    ``2t-1`` ranks: ``t`` column ranks + ``t-1`` row ranks,
+    reference arrow/arrow_mpi.py:31-69): here a 2-D mesh with an ``arm``
+    axis of size 2 — arm 0 computes the column blocks, arm 1 the head
+    row — so the head reduction runs on devices *disjoint* from the
+    column compute, overlapping the two in space exactly as the
+    reference's rank split does.  (The slim layout instead overlaps them
+    in time on every chip; it is the default for the same reason the
+    reference defaults to slim, scripts/spmm_arrow_main.py:25-26.)
+
+    Returns ``step(blocks, x) -> c`` on globally-shaped arrays: blocks
+    and x carry the block axis over ``block_axis`` and are replicated
+    over ``arm_axis``; the result has a leading arm axis of size 2 whose
+    slice 0 holds the product (slice 1 is zero filler from the row arm).
+    """
+    if mesh.shape[arm_axis] != 2:
+        raise ValueError(
+            f"wide layout needs arm axis of size 2, got "
+            f"{mesh.shape[arm_axis]} (the reference's row/column rank "
+            f"split, arrow_mpi.py:31-47)")
+    # Leaf axis 0 is the block axis; the arm axis is simply absent from
+    # the spec (= replicated over it, the reference's A_0j copies on the
+    # row arm).
+    spec_blocks = jax.tree_util.tree_map(lambda _: P(block_axis), blocks)
+    step = shard_map(
+        functools.partial(_local_wide_step, arm_axis=arm_axis,
+                          block_axis=block_axis,
+                          n_block_dev=mesh.shape[block_axis], chunk=chunk),
+        mesh=mesh,
+        in_specs=(spec_blocks, P(block_axis)),
+        out_specs=P(arm_axis, block_axis),
+        check_vma=False,
+    )
+    return jax.jit(step)

@@ -233,6 +233,15 @@ class MultiLevelArrow:
         padded[:n] = x_original
         return self.place_features(padded[self.perm0])
 
+    def real_row_mask(self, dtype=np.float32) -> jax.Array:
+        """(total_rows, 1) device mask: 1 for rows backed by an original
+        matrix row, 0 for padding.  Row r of the level-0 layout is real
+        iff its original index ``perm0[r] < n`` (perm0 pads with an
+        identity tail).  Use this to keep padding rows out of losses,
+        teleport mass, and other per-row reductions."""
+        return self.place_features(
+            (self.perm0 < self.n).astype(dtype)[:, None])
+
     def gather_result(self, c: jax.Array) -> np.ndarray:
         """Device result (level-0 order, flat) -> host (n, k) array in
         original row order (reference allgather_result analog)."""

@@ -1,0 +1,205 @@
+"""1.5D A-stationary distributed SpMM baseline.
+
+TPU-native counterpart of the reference's 1.5D baseline
+(reference arrow/baseline/spmm_15d.py).  The reference runs P MPI ranks
+on a ``P/c x c`` cartesian grid (``Create_cart``, spmm_15d.py:43-64):
+rank (i, j) statically owns the sparse block ``A[i-th row slab, j-th
+column slab]``, further split into ``rounds = P/c**2`` column chunks;
+X is row-partitioned over the grid rows and replicated across the ``c``
+grid columns.  Each round broadcasts one X chunk down the grid column
+that owns it and accumulates ``Y += A[r] @ chunk``; a final Allreduce
+over the replication axis combines the partial Y's
+(spmm_15d.py:312-368).
+
+Here the grid is a 2-D ``jax.sharding.Mesh`` with axes ``("rows",
+"repl")`` and the whole iteration is one jitted `shard_map` program:
+
+  MPI primitive (reference)             this module
+  ------------------------------------  --------------------------------
+  Create_cart((P/c, c))  :43-46         Mesh(shape (P/c, c))
+  bcast_comm.Bcast(X, root=q) :335-343  masked `psum` over "rows"
+  Y += A[r] @ buf        :349           ELL SpMM (ops.ell)
+  reduce_comm.Allreduce  :354-361       `psum` over "repl"
+  >2**30-element chunking :339-343      unnecessary (XLA collectives)
+
+The replication factor ``c`` trades memory for bandwidth exactly as in
+the reference: each device receives ``rounds`` chunks of ``N/(P/c)``
+rows per SpMM — total ``N/c`` rows — instead of the full ``N`` an
+all-gather formulation would move.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from scipy import sparse
+
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from arrow_matrix_tpu.ops.ell import align_up, ell_pack, ell_spmm
+
+
+def largest_replication(n_dev: int) -> int:
+    """Largest power-of-two c with c**2 <= n_dev that yields a valid
+    grid, i.e. n_dev divisible by c**2 (reference auto-replication rule
+    plus its runtime divisibility requirement,
+    scripts/spmm_15d_main.py:87-96, spmm_15d.py:34-40)."""
+    c = 1
+    while (2 * c) ** 2 <= n_dev and n_dev % ((2 * c) ** 2) == 0:
+        c *= 2
+    return c
+
+
+class SpMM15D:
+    """A-stationary 1.5D partition of one sparse matrix on a 2-D mesh.
+
+    Construction tiles ``a`` into the static per-device ELL blocks
+    (replacing the reference's root-rank tagged Send/Recv distribution,
+    spmm_15d.py:86-119, with a single sharded `device_put`) and jits the
+    SpMM step.  ``spmm(x)`` maps a blocked feature array to the blocked
+    product; for square matrices the output blocking equals the input
+    blocking, so iterating ``x = spmm(x)`` runs the reference benchmark
+    loop (scripts/spmm_15d_main.py:237-269).
+    """
+
+    def __init__(self, a: sparse.spmatrix, mesh: Mesh,
+                 rows_axis: str = "rows", repl_axis: str = "repl",
+                 dtype=np.float32, chunk: Optional[int] = None):
+        self.mesh = mesh
+        self.rows_axis = rows_axis
+        self.repl_axis = repl_axis
+        p_div_c = mesh.shape[rows_axis]
+        c = mesh.shape[repl_axis]
+        if p_div_c % c != 0:
+            raise ValueError(
+                f"grid rows {p_div_c} not divisible by replication {c} "
+                f"(the reference requires P divisible by c**2, "
+                f"spmm_15d.py:38-40)")
+        self.rounds = p_div_c // c
+        self.p_div_c = p_div_c
+        self.c = c
+
+        a = a.tocsr().astype(dtype)
+        ni, nk = a.shape
+        self.shape = (ni, nk)
+        # Row-slab height == X-chunk height for square inputs; both are
+        # padded to one shared size (the reference rounds up and allows
+        # ragged/empty tail blocks, spmm_15d.py:80,139-141 — static
+        # shapes make the padding explicit instead).
+        self.l_ni = -(-ni // p_div_c)
+        self.l_nkb = -(-nk // p_div_c)
+        l_nk = self.l_nkb * self.rounds  # column-slab width per device
+
+        # Pack every (grid row i, grid col j, round r) block as ELL with
+        # one shared slot count: global arrays (p/c, c, rounds, l_ni, m)
+        # whose leading two axes shard over the mesh.
+        blocks = []
+        need = 0
+        for i in range(p_div_c):
+            row_slab = a[i * self.l_ni: min(ni, (i + 1) * self.l_ni)]
+            for j in range(c):
+                for r in range(self.rounds):
+                    q = j * self.rounds + r
+                    blk = row_slab[:, q * self.l_nkb:
+                                   min(nk, (q + 1) * self.l_nkb)]
+                    blk.sum_duplicates()
+                    counts = np.diff(blk.indptr)
+                    if counts.size:
+                        need = max(need, int(counts.max()))
+                    blocks.append(blk)
+        m_slots = align_up(need, 8) if need else 0
+        cols = np.zeros((p_div_c, c, self.rounds, self.l_ni, m_slots),
+                        dtype=np.int32)
+        data = np.zeros((p_div_c, c, self.rounds, self.l_ni, m_slots),
+                        dtype=dtype)
+        it = iter(blocks)
+        for i in range(p_div_c):
+            for j in range(c):
+                for r in range(self.rounds):
+                    blk = next(it)
+                    bc, bd = ell_pack(blk, max_nnz=m_slots, dtype=dtype)
+                    cols[i, j, r, :bc.shape[0]] = bc
+                    data[i, j, r, :bd.shape[0]] = bd
+
+        spec_a = NamedSharding(mesh, P(rows_axis, repl_axis))
+        self.a_cols = jax.device_put(cols, spec_a)
+        self.a_data = jax.device_put(data, spec_a)
+        del cols, data, blocks
+
+        rounds = self.rounds
+        l_nkb = self.l_nkb
+
+        def local_step(a_cols, a_data, x):
+            # a_cols/a_data: (1, 1, rounds, l_ni, m); x: (1, l_nkb, k).
+            # One grid cell of the reference's round loop
+            # (spmm_15d.py:332-351).
+            my_row = lax.axis_index(rows_axis)
+            j = lax.axis_index(repl_axis)
+            x_loc = x[0]
+            k = x_loc.shape[-1]
+
+            def round_body(y, r):
+                q = j * rounds + r
+                # Bcast root q over the grid column = masked psum.
+                buf = lax.psum(
+                    jnp.where(my_row == q, x_loc,
+                              jnp.zeros_like(x_loc)), rows_axis)
+                y = y + ell_spmm(a_cols[0, 0, r], a_data[0, 0, r], buf,
+                                 chunk=chunk).astype(jnp.float32)
+                return y, None
+
+            y0 = jnp.zeros((a_cols.shape[3], k), dtype=jnp.float32)
+            y, _ = lax.scan(round_body, y0, jnp.arange(rounds))
+            # Allreduce over the replication axis (spmm_15d.py:354-361).
+            y = lax.psum(y, repl_axis)
+            return y[None, None].astype(x.dtype)
+
+        self._step = jax.jit(shard_map(
+            local_step, mesh=mesh,
+            in_specs=(P(rows_axis, repl_axis), P(rows_axis, repl_axis),
+                      P(rows_axis)),
+            out_specs=P(rows_axis, repl_axis),
+            check_vma=False,
+        ))
+
+    # -- feature placement -------------------------------------------------
+
+    def set_features(self, x: np.ndarray) -> jax.Array:
+        """Host (nk, k) dense features -> blocked sharded (p/c, l_nkb, k)
+        device array (the reference generates X on reduce-rank 0 and
+        Bcasts it, spmm_15d.py:137-151; here one sharded device_put)."""
+        nk, k = x.shape
+        if nk != self.shape[1]:
+            raise ValueError(f"expected {self.shape[1]} rows, got {nk}")
+        total = self.p_div_c * self.l_nkb
+        padded = np.zeros((total, k), dtype=x.dtype)
+        padded[:nk] = x
+        blocked = padded.reshape(self.p_div_c, self.l_nkb, k)
+        return jax.device_put(blocked,
+                              NamedSharding(self.mesh, P(self.rows_axis)))
+
+    def spmm(self, x: jax.Array) -> jax.Array:
+        """One distributed SpMM: blocked X (p/c, l_nkb, k) ->
+        blocked Y (p/c, c, l_ni, k); the c replica copies are identical."""
+        return self._step(self.a_cols, self.a_data, x)
+
+    def as_features(self, y: jax.Array) -> jax.Array:
+        """Reuse a blocked result as the next iteration's features
+        (square matrices only: l_ni == l_nkb)."""
+        if self.l_ni != self.l_nkb:
+            raise ValueError("iterated SpMM needs a square matrix")
+        return y[:, 0]
+
+    def gather_result(self, y: jax.Array) -> np.ndarray:
+        """Blocked (p/c, c, l_ni, k) device result -> host (ni, k)."""
+        arr = np.asarray(y[:, 0])
+        return arr.reshape(-1, arr.shape[-1])[:self.shape[0]]

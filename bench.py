@@ -91,6 +91,13 @@ def main() -> None:
                    "decompose_s": round(t_decomp, 2)},
     }))
 
+    # Enforce the correctness gate: a fast-but-wrong kernel must fail the
+    # bench, not report a headline speedup (the JSON line above is still
+    # emitted so the failure is diagnosable from the recorded output).
+    if not np.isfinite(err) or err > 1e-5:
+        raise SystemExit(f"correctness gate failed: frobenius err {err:.3e} "
+                         f"vs host CPU exceeds 1e-5")
+
 
 if __name__ == "__main__":
     main()

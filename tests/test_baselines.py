@@ -1,0 +1,140 @@
+"""Tests for the two distributed SpMM baselines (1.5D and PETSc-style
+1-D), mirroring the reference's baseline test strategy: results compared
+against ``A @ X`` computed redundantly on the host
+(reference tests/test_spmmPETSc.py:11-42, scripts/spmm_15d_main.py
+--validate, :156-223), including unequal slices and zero-row slices
+(test_spmmPETSc.py:44-71)."""
+
+import jax
+import numpy as np
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.parallel.mesh import make_mesh
+from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D, largest_replication
+from arrow_matrix_tpu.parallel.spmm_1d import MatrixSlice1D, equal_slices
+from arrow_matrix_tpu.utils.graphs import random_csr, random_dense
+
+
+def _random_square(n, nnz_per_row, seed):
+    a = random_csr(n, n, nnz_per_row, seed=seed)
+    return a.astype(np.float32)
+
+
+class TestSpMM15D:
+    @pytest.mark.parametrize("c", [1, 2])
+    @pytest.mark.parametrize("n,k", [(64, 8), (97, 5)])
+    def test_matches_host(self, c, n, k):
+        n_dev = 8
+        mesh = make_mesh((n_dev // c, c), ("rows", "repl"))
+        a = _random_square(n, 4, seed=n + c)
+        x = random_dense(n, k, seed=1)
+
+        dist = SpMM15D(a, mesh)
+        y = dist.spmm(dist.set_features(x))
+        got = dist.gather_result(y)
+        want = a @ x
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_replicas_identical(self):
+        mesh = make_mesh((4, 2), ("rows", "repl"))
+        a = _random_square(64, 3, seed=3)
+        x = random_dense(64, 4, seed=2)
+        dist = SpMM15D(a, mesh)
+        y = np.asarray(dist.spmm(dist.set_features(x)))
+        for j in range(1, dist.c):
+            np.testing.assert_array_equal(y[:, 0], y[:, j])
+
+    def test_iterated(self):
+        mesh = make_mesh((4, 2), ("rows", "repl"))
+        a = _random_square(48, 3, seed=5)
+        # Scale to keep iterates bounded.
+        a = (a / max(abs(a).sum(axis=1).max(), 1.0)).tocsr().astype(np.float32)
+        x = random_dense(48, 4, seed=4)
+        dist = SpMM15D(a, mesh)
+        xd = dist.set_features(x)
+        want = x
+        for _ in range(3):
+            xd = dist.as_features(dist.spmm(xd))
+            want = a @ want
+        got = dist.gather_result(dist.spmm(xd))
+        np.testing.assert_allclose(got, a @ want, rtol=1e-4, atol=1e-5)
+
+    def test_replication_validation(self):
+        mesh = make_mesh((8,), ("rows",))
+        mesh2 = make_mesh((2, 4), ("rows", "repl"))
+        a = _random_square(32, 3, seed=1)
+        # rows=2 not divisible by repl=4: the reference's P % c**2 rule
+        # (spmm_15d.py:38-40).
+        with pytest.raises(ValueError):
+            SpMM15D(a, mesh2)
+
+    def test_largest_replication(self):
+        assert largest_replication(1) == 1
+        assert largest_replication(4) == 2
+        assert largest_replication(8) == 2
+        assert largest_replication(16) == 4
+        assert largest_replication(6) == 1
+
+
+class TestMatrixSlice1D:
+    @pytest.mark.parametrize("n,k,seed", [(64, 8, 0), (97, 5, 1), (33, 3, 2)])
+    def test_matches_host(self, n, k, seed):
+        mesh = make_mesh((8,), ("slices",))
+        a = _random_square(n, 4, seed=seed)
+        x = random_dense(n, k, seed=seed)
+        dist = MatrixSlice1D(a, mesh)
+        got = dist.gather_result(dist.spmm(dist.set_features(x)))
+        np.testing.assert_allclose(got, a @ x, rtol=1e-5, atol=1e-5)
+
+    def test_identity(self):
+        # Identity result == X (reference test_spmmPETSc.py:95-121).
+        mesh = make_mesh((8,), ("slices",))
+        n, k = 40, 6
+        a = sparse.identity(n, format="csr", dtype=np.float32)
+        x = random_dense(n, k, seed=3)
+        dist = MatrixSlice1D(a, mesh)
+        got = dist.gather_result(dist.spmm(dist.set_features(x)))
+        np.testing.assert_allclose(got, x, rtol=1e-6, atol=1e-6)
+        # Identity has no off-slice columns: no exchange slots at all.
+        assert dist.slot == 0
+
+    def test_unequal_slices_with_empty(self):
+        # Unequal slice sizes incl. zero-row slices stress the exchange
+        # tables (reference test_spmmPETSc.py:44-71).
+        mesh = make_mesh((8,), ("slices",))
+        n, k = 33, 4
+        bounds = [0, 0, 5, 5, 20, 21, 33, 33, 33]
+        slices = [(bounds[i], bounds[i + 1]) for i in range(8)]
+        a = _random_square(n, 5, seed=7)
+        x = random_dense(n, k, seed=7)
+        dist = MatrixSlice1D(a, mesh, slices=slices)
+        got = dist.gather_result(dist.spmm(dist.set_features(x)))
+        np.testing.assert_allclose(got, a @ x, rtol=1e-5, atol=1e-5)
+
+    def test_density_sweep(self):
+        # Seeds x densities sweep (reference test_spmmPETSc.py:74-92).
+        mesh = make_mesh((8,), ("slices",))
+        n, k = 56, 4
+        for seed in range(2):
+            for nnz_per_row in (1, 3, 8):
+                a = _random_square(n, nnz_per_row, seed=seed)
+                x = random_dense(n, k, seed=seed)
+                dist = MatrixSlice1D(a, mesh)
+                got = dist.gather_result(dist.spmm(dist.set_features(x)))
+                np.testing.assert_allclose(got, a @ x, rtol=1e-5, atol=1e-5)
+
+    def test_iterated(self):
+        mesh = make_mesh((8,), ("slices",))
+        n, k = 64, 4
+        a = _random_square(n, 3, seed=9)
+        a = (a / max(abs(a).sum(axis=1).max(), 1.0)).tocsr().astype(np.float32)
+        x = random_dense(n, k, seed=9)
+        dist = MatrixSlice1D(a, mesh)
+        xd = dist.set_features(x)
+        want = x
+        for _ in range(3):
+            xd = dist.spmm(xd)
+            want = a @ want
+        np.testing.assert_allclose(dist.gather_result(xd), want,
+                                   rtol=1e-4, atol=1e-5)

@@ -83,8 +83,7 @@ def test_sgc_training_decreases_loss():
     y_pad = np.zeros((multi.total_rows, k_out), np.float32)
     y_pad[:n] = y_host
     y = multi.place_features(y_pad[multi.perm0])
-    mask = multi.place_features(
-        (multi.perm0 < n).astype(np.float32)[:, None])[:, 0]
+    mask = multi.real_row_mask()[:, 0]
 
     params = sgc_init(jax.random.key(0), k_in, k_out)
     optimizer = optax.adam(5e-2)

@@ -203,3 +203,53 @@ def test_pad_to_multiple():
     assert pad_to_multiple(8, 8) == 8
     assert pad_to_multiple(9, 8) == 16
     assert pad_to_multiple(1, 8) == 8
+
+
+# ---------------------------------------------------------------------------
+# Wide layout (disjoint row-arm / column-arm device groups).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("banded", [False, True])
+def test_wide_spmm_matches_dense(banded):
+    """Wide layout on a (2, 4) mesh == A @ X (reference wide-mode
+    test_spmm, tests/test_arrowmpi.py:342-398 at 2t-1 ranks)."""
+    from arrow_matrix_tpu.parallel.arrow_layout import make_wide_spmm
+
+    wide_mesh = make_mesh((2, 4), ("arm", "blocks"))
+    width, n_blocks = 16, 8
+    a = _arrow_csr(n_blocks, width, banded, seed=11)
+    blocks = arrow_blocks_from_csr(a, width, banded=banded)
+    x_host = random_dense(n_blocks * width, 8, seed=5)
+    xb = jnp.asarray(block_features(x_host, width, n_blocks))
+
+    step = make_wide_spmm(blocks, wide_mesh)
+    out = step(blocks, xb)
+    got = unblock_features(np.asarray(out)[0], n_blocks * width)
+    np.testing.assert_allclose(got, a @ x_host, rtol=1e-4, atol=1e-4)
+
+
+def test_wide_matches_slim():
+    from arrow_matrix_tpu.parallel.arrow_layout import make_wide_spmm
+
+    wide_mesh = make_mesh((2, 4), ("arm", "blocks"))
+    slim_mesh = make_mesh((8,), ("blocks",))
+    width, n_blocks = 16, 8
+    a = _arrow_csr(n_blocks, width, banded=True, seed=13)
+    blocks = arrow_blocks_from_csr(a, width, banded=True)
+    x = jnp.asarray(block_features(random_dense(n_blocks * width, 4, seed=6),
+                                   width, n_blocks))
+
+    slim = make_slim_spmm(blocks, slim_mesh)(
+        shard_arrow_blocks(blocks, slim_mesh), shard_blocked(x, slim_mesh))
+    wide = make_wide_spmm(blocks, wide_mesh)(blocks, x)
+    np.testing.assert_allclose(np.asarray(wide)[0], np.asarray(slim),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_wide_requires_two_arms():
+    from arrow_matrix_tpu.parallel.arrow_layout import make_wide_spmm
+
+    bad_mesh = make_mesh((4, 2), ("arm", "blocks"))
+    blocks = arrow_blocks_from_csr(_arrow_csr(4, 8, False, seed=1), 8)
+    with pytest.raises(ValueError):
+        make_wide_spmm(blocks, bad_mesh)
