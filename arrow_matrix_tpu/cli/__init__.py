@@ -1,0 +1,16 @@
+"""Command-line entry points.
+
+Counterparts of the reference's console scripts (reference setup.py:17-24):
+
+  arrow_decompose   offline decomposition        (scripts/decomposition_main.py)
+  spmm_arrow        arrow SpMM benchmark         (scripts/spmm_arrow_main.py)
+  spmm_15d          1.5D baseline benchmark      (scripts/spmm_15d_main.py)
+  spmm_petsc        1D PETSc-style benchmark     (scripts/spmm_petsc_main.py)
+
+Each is runnable as ``python -m arrow_matrix_tpu.cli.<name>`` or via the
+installed console script.  One deliberate difference from the reference:
+there is no ``mpiexec`` — every command is a single SPMD process driving
+all local devices through one `jax.sharding.Mesh`; ``--devices N``
+requests an N-device *virtual CPU* mesh for testing multi-chip layouts
+without hardware (the analog of ``mpiexec --oversubscribe``).
+"""

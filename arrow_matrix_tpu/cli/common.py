@@ -1,0 +1,109 @@
+"""Shared CLI plumbing: flag parsing helpers, platform selection, and
+matrix loading.
+
+The reference's per-entry-point argparse + ``str2bool`` + device-string
+convention (reference arrow/common/utils.py:9-17, scripts/*_main.py) —
+plus the one genuinely TPU-specific concern: the JAX platform must be
+pinned *before* the first backend initialization.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import numpy as np
+from scipy import sparse
+
+
+def str2bool(v) -> bool:
+    """Reference-compatible boolean flag parser (utils.py:9-17)."""
+    if isinstance(v, bool):
+        return v
+    if v.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if v.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError("Boolean value expected.")
+
+
+def add_device_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "-i", "--device", type=str, default="auto",
+        choices=["auto", "cpu", "tpu"],
+        help="Compute platform (the reference's cpu/gpu gate, "
+             "spmm_arrow_main.py:18; 'auto' uses the default backend).")
+    parser.add_argument(
+        "--devices", type=int, default=0,
+        help="Force an N-device virtual CPU platform (multi-chip layouts "
+             "without hardware; the analog of mpiexec --oversubscribe). "
+             "Implies --device cpu.")
+
+
+def setup_platform(args: argparse.Namespace) -> None:
+    """Pin the JAX platform per --device/--devices (must run before
+    anything initializes a JAX backend)."""
+    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+    if args.device == "cpu" or args.devices > 0:
+        force_cpu_devices(args.devices if args.devices > 0 else None)
+    elif args.device == "tpu":
+        os.environ.setdefault("JAX_PLATFORMS", "tpu")
+
+
+def load_sparse_matrix(path: str, dtype=np.float32) -> sparse.csr_matrix:
+    """Load a sparse matrix from .npz (scipy), .mtx (matrix market), or
+    .mat (matlab; the reference's primary input format,
+    decomposition_main.py:18-34) — dispatch on extension."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npz":
+        m = sparse.load_npz(path)
+    elif ext in (".mtx", ".mm"):
+        from scipy.io import mmread
+
+        m = mmread(path)
+    elif ext == ".mat":
+        m = _load_matlab(path)
+    else:
+        raise ValueError(f"unsupported matrix format {ext!r} "
+                         f"(expected .npz, .mtx, or .mat)")
+    m = sparse.csr_matrix(m).astype(dtype)
+    m.sum_duplicates()
+    m.sort_indices()
+    return m
+
+
+def _load_matlab(path: str) -> sparse.spmatrix:
+    from scipy.io import loadmat
+
+    try:
+        contents = loadmat(path)
+    except NotImplementedError:
+        # v7.3 files are HDF5; mat73 handles them in the reference
+        # (decomposition_main.py:18-34).  Not baked into this image —
+        # re-save as npz/mtx or scipy-compatible .mat instead.
+        raise ValueError(
+            f"{path} is a MATLAB v7.3 (HDF5) file; convert it to .npz or "
+            f".mtx first (mat73 is not available in this environment)")
+    for v in contents.values():
+        if sparse.issparse(v):
+            return v
+    raise ValueError(f"no sparse matrix found in {path}")
+
+
+def random_adjacency(vertices: int, edges: int, seed: int,
+                     dtype=np.float32) -> sparse.csr_matrix:
+    """Random graph with ~edges nonzeros (the reference's random dataset
+    path, spmm_15d_main.py:100-110 via utils.generate_sparse_matrix)."""
+    from arrow_matrix_tpu.utils.graphs import random_csr
+
+    nnz_per_row = max(1, edges // max(vertices, 1))
+    return random_csr(vertices, vertices, nnz_per_row, seed=seed).astype(dtype)
+
+
+def normalize_scale(a: sparse.csr_matrix) -> sparse.csr_matrix:
+    """Scale so iterated SpMM stays bounded (benchmark loops reuse the
+    output as the next input)."""
+    s = max(abs(a).sum(axis=1).max(), 1.0)
+    return (a / s).tocsr().astype(a.dtype)

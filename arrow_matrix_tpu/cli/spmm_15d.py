@@ -1,0 +1,118 @@
+"""``spmm_15d`` — 1.5D A-stationary baseline benchmark.
+
+Counterpart of the reference's 1.5D entry point
+(reference scripts/spmm_15d_main.py:20-276): random or file matrix,
+auto replication factor, optional result validation against ``A @ X``
+on the host, timed iteration loop.  (The reference's benchmark loop
+as written raises NameError — SURVEY.md §7 known bugs — so the timing
+protocol here follows its ``--validate`` path's working kernel calls.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+from arrow_matrix_tpu.cli.common import (
+    add_device_args,
+    load_sparse_matrix,
+    normalize_scale,
+    random_adjacency,
+    setup_platform,
+    str2bool,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="SpMM 1.5D benchmark.")
+    parser.add_argument("-d", "--dataset", nargs="?",
+                        choices=["random", "file"], default="random")
+    parser.add_argument("-s", "--seed", type=int, default=42)
+    parser.add_argument("-v", "--vertices", type=int, default=100_000)
+    parser.add_argument("-e", "--edges", type=int, default=1_000_000)
+    parser.add_argument("-f", "--file", type=str, default=None,
+                        help="Sparse matrix file (.npz/.mtx/.mat).")
+    parser.add_argument("-c", "--columns", type=int, default=128,
+                        help="Feature columns of X.")
+    parser.add_argument("-r", "--replication", type=int, default=0,
+                        help="Replication factor c; 0 = largest valid "
+                             "power of two (spmm_15d_main.py:87-96).")
+    parser.add_argument("--validate", type=str2bool, nargs="?", default=True)
+    parser.add_argument("-z", "--iterations", type=int, default=10)
+    parser.add_argument("--logdir", type=str, default="./logs")
+    add_device_args(parser)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_platform(args)
+
+    import jax
+
+    from arrow_matrix_tpu.parallel.mesh import make_mesh
+    from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D, largest_replication
+    from arrow_matrix_tpu.utils import logging as wb
+    from arrow_matrix_tpu.utils.graphs import random_dense
+
+    if args.dataset == "file" or args.file:
+        if not args.file:
+            raise SystemExit("--dataset file requires --file")
+        a = load_sparse_matrix(args.file)
+        name = args.file
+    else:
+        a = random_adjacency(args.vertices, args.edges, args.seed)
+        name = f"random_{args.vertices}_{args.edges}"
+    a = normalize_scale(a)
+
+    n_dev = len(jax.devices())
+    c = args.replication or largest_replication(n_dev)
+    if n_dev % (c * c) != 0:
+        raise SystemExit(
+            f"device count {n_dev} not divisible by c^2 = {c * c} "
+            f"(reference divisibility rule, spmm_15d.py:34-40)")
+    mesh = make_mesh((n_dev // c, c), ("rows", "repl"))
+    print(f"grid {n_dev // c} x {c} on {n_dev} "
+          f"{jax.devices()[0].platform} device(s)")
+
+    wb.init(f"15D_TPU_c_{c}", name, config=vars(args))
+    with wb.segment("build_time"):
+        dist = SpMM15D(a, mesh)
+
+    x_host = random_dense(a.shape[1], args.columns, seed=args.seed)
+    x = dist.set_features(x_host)
+
+    if args.validate:
+        got = dist.gather_result(dist.spmm(x))
+        want = np.asarray(a @ x_host)
+        err = np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-30)
+        ok = np.allclose(got, want, rtol=1e-4, atol=1e-4)
+        print(f"validation: allclose={ok} rel frobenius err={err:.3e} "
+              f"(spmm_15d_main.py:195-197 protocol)")
+        wb.log({"frobenius_err": float(err)})
+        if not ok:
+            wb.finish(args.logdir)
+            return 1
+
+    y = dist.spmm(x)  # compile + warmup
+    jax.block_until_ready(y)
+    for it in range(args.iterations):
+        wb.set_iteration_data({"iteration": it})
+        tic = time.perf_counter()
+        y = dist.spmm(x)
+        jax.block_until_ready(y)
+        wb.log({"spmm_time": time.perf_counter() - tic})
+
+    s = wb.get_log().summarize()["spmm_time"]
+    print(f"spmm_time mean {s['mean'] * 1e3:.3f} ms over {s['count']} "
+          f"iterations (min {s['min'] * 1e3:.3f})")
+    out = wb.finish(args.logdir)
+    if out:
+        print(f"log written to {out}.json")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,189 @@
+"""``spmm_arrow`` — the distributed arrow SpMM benchmark.
+
+Counterpart of the reference's main benchmark entry point
+(reference scripts/spmm_arrow_main.py + arrow/arrow_bench.py:12-137):
+with no ``--path``, generate a Barabasi-Albert graph, decompose and save
+it; load the decomposition, build the distributed runtime, run the
+iteration loop with per-segment timing and failure detection, flush the
+log.
+
+Differences by design (single SPMD process instead of mpiexec ranks):
+``--ranksperside`` becomes the mesh size (``--devices``); rank-budget
+validation (arrow_bench.py:64-78) becomes block-count/mesh divisibility
+handled by padding; the per-iteration collective failure allreduce
+(arrow_bench.py:128-134) becomes a host-side try/except around the step
+— device errors surface synchronously at block_until_ready, and there
+is exactly one host to abort.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+from arrow_matrix_tpu.cli.common import (
+    add_device_args,
+    setup_platform,
+    str2bool,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="Arrow SpMM benchmark.")
+    parser.add_argument("-f", "--path", type=str, default=None,
+                        help="Decomposition artifact base path (no "
+                             "extension).  Default: generate a random "
+                             "graph, decompose, and benchmark that "
+                             "(arrow_bench.py:28-41).")
+    parser.add_argument("-w", "--width", type=int, default=0,
+                        help="Width of the decomposition / block height.")
+    parser.add_argument("-c", "--features", type=int, default=16,
+                        help="Number of feature columns of X.")
+    parser.add_argument("-z", "--iterations", type=int, default=1,
+                        help="Number of SpMM iterations.")
+    parser.add_argument("-v", "--vertices", type=int, default=10_000,
+                        help="Vertices of the generated graph (no --path).")
+    parser.add_argument("-m", "--ba_neighbors", type=int, default=3,
+                        help="Barabasi-Albert attachment count "
+                             "(spmm_arrow_main.py:22).")
+    parser.add_argument("-s", "--slim", type=str2bool, nargs="?",
+                        default=True,
+                        help="Accepted for reference flag parity "
+                             "(spmm_arrow_main.py:25-26).  The multi-"
+                             "level runtime always shards slim-style "
+                             "(one block-row group per device); the "
+                             "explicit wide layout is available via "
+                             "parallel.arrow_layout.make_wide_spmm.  "
+                             "slim=True requires --blocked (the "
+                             "reference's constraint, "
+                             "arrow_dec_mpi.py:131).")
+    parser.add_argument("-b", "--blocked", type=str2bool, nargs="?",
+                        default=True,
+                        help="Block-diagonal decomposition (required for "
+                             "slim, arrow_dec_mpi.py:131).")
+    parser.add_argument("--fmt", type=str, default="auto",
+                        choices=["auto", "dense", "ell"],
+                        help="Device block format (TPU-specific: dense = "
+                             "MXU batched matmuls, ell = gather path).")
+    parser.add_argument("--validate", type=str2bool, nargs="?",
+                        default=False,
+                        help="Compare each iteration against the host "
+                             "scipy golden (spmm_15d_main.py --validate "
+                             "analog).")
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--logdir", type=str, default="./logs")
+    add_device_args(parser)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.slim and not args.blocked:
+        raise SystemExit("--slim requires a block-diagonal decomposition "
+                         "(--blocked true); the reference enforces the "
+                         "same (arrow_dec_mpi.py:131)")
+    setup_platform(args)
+
+    import jax
+
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.io import (
+        as_levels,
+        load_decomposition,
+        load_level_widths,
+        save_decomposition,
+    )
+    from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+    from arrow_matrix_tpu.utils import graphs
+    from arrow_matrix_tpu.utils import logging as wb
+
+    width = args.width
+    if args.path is None:
+        # Generate + decompose + save (reference arrow_bench.py:28-41).
+        width = width or 512
+        n = args.vertices
+        print(f"generating Barabasi-Albert graph n={n} "
+              f"m={args.ba_neighbors}")
+        a = graphs.barabasi_albert(n, args.ba_neighbors, seed=args.seed)
+        levels = arrow_decomposition(a, arrow_width=width, max_levels=10,
+                                     block_diagonal=args.blocked,
+                                     seed=args.seed)
+        base = os.path.join(".", f"ba_{n}_{args.ba_neighbors}")
+        save_decomposition(levels, base, block_diagonal=args.blocked)
+        path = base
+    else:
+        path = args.path
+        if not width:
+            raise SystemExit("--width is required with --path "
+                             "(it names the artifact files)")
+
+    # Both branches above guarantee a nonzero width (it names the
+    # artifact files).
+    loaded = load_decomposition(path, width, block_diagonal=args.blocked)
+    widths = load_level_widths(path, width, block_diagonal=args.blocked)
+    if widths is None:
+        widths = width
+    levels = as_levels(loaded, widths)
+    n = levels[0].matrix.shape[0]
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh((n_dev,), ("blocks",)) if n_dev > 1 else None
+    # Version-string run name (reference arrow_bench.py:43-47 pattern),
+    # derived from what actually runs: slim-style sharding, banded or
+    # block-diagonal tiling.
+    algo = f"ArrowTPU_v{'BlockDiagonal' if args.blocked else 'Banded'}_Slim"
+    wb.init(algo, os.path.basename(path), config=vars(args))
+
+    with wb.segment("build_time"):
+        multi = MultiLevelArrow(levels, width, mesh=mesh,
+                                banded=not args.blocked, fmt=args.fmt)
+
+    # Untimed warmup: trace + compile must not pollute iteration 0's
+    # spmm_time (the sibling baseline CLIs warm up the same way).
+    warm = multi.set_features(
+        graphs.random_dense(n, args.features, seed=args.seed))
+    jax.block_until_ready(multi.step(warm))
+
+    rng = np.random.default_rng(args.seed)
+    fail = False
+    for it in range(args.iterations):
+        wb.set_iteration_data({"iteration": it})
+        # Fresh random X every iteration (arrow_bench.py:114-116).
+        x_host = graphs.random_dense(n, args.features, seed=int(rng.integers(2**31)))
+        x = multi.set_features(x_host)
+        try:
+            tic = time.perf_counter()
+            y = multi.step(x)
+            jax.block_until_ready(y)
+            wb.log({"spmm_time": time.perf_counter() - tic})
+        except Exception as e:  # abort like the collective LOR flag
+            print(f"iteration {it} failed: {e}")
+            fail = True
+            break
+        if args.validate:
+            got = multi.gather_result(y)
+            want = decomposition_spmm(levels, x_host)
+            err = np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-30)
+            wb.log({"frobenius_err": float(err)})
+            print(f"iteration {it}: rel err vs host {err:.3e}")
+            if not np.isfinite(err) or err > 1e-4:
+                fail = True
+                break
+
+    summary = wb.get_log().summarize()
+    if "spmm_time" in summary:
+        s = summary["spmm_time"]
+        print(f"spmm_time mean {s['mean'] * 1e3:.3f} ms over "
+              f"{s['count']} iterations (min {s['min'] * 1e3:.3f})")
+    out = wb.finish(args.logdir)
+    if out:
+        print(f"log written to {out}.json")
+    return 1 if fail else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,134 @@
+"""``spmm_petsc`` — 1-D row-partition (PETSc-style) baseline benchmark.
+
+Counterpart of the reference's PETSc baseline entry point
+(reference scripts/spmm_petsc_main.py + arrow/baseline/spmm_petsc.py:
+398-495).  The reference loads pre-partitioned per-rank slice files
+(``{name}.part.{P}.slice.{r}.npz``); here there is one SPMD process, so
+``--file`` takes the whole matrix (or a ``.part.`` slice-scheme prefix,
+reassembled) and the partition is computed at load.  ``--dryrun`` builds
+the exchange tables and exits without benchmarking
+(spmm_petsc_main.py:40).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import time
+
+import numpy as np
+from scipy import sparse
+
+from arrow_matrix_tpu.cli.common import (
+    add_device_args,
+    load_sparse_matrix,
+    normalize_scale,
+    random_adjacency,
+    setup_platform,
+    str2bool,
+)
+
+
+def load_slices_or_matrix(path: str) -> sparse.csr_matrix:
+    """Accept either one matrix file or any slice of the reference's
+    ``{name}.part.{P}.slice.{r}.npz`` scheme (all slices are then
+    reassembled; the partition itself is recomputed)."""
+    m = re.match(r"(.*)\.part\.(\d+)\.slice\.(\d+)\.npz$", path)
+    if not m:
+        return load_sparse_matrix(path)
+    base, p = m.group(1), int(m.group(2))
+    paths = sorted(
+        glob.glob(f"{base}.part.{p}.slice.*.npz"),
+        key=lambda s: int(re.search(r"slice\.(\d+)\.npz$", s).group(1)))
+    if len(paths) != p:
+        raise SystemExit(f"found {len(paths)} of {p} slice files for {base}")
+    return sparse.vstack([sparse.load_npz(f) for f in paths]).tocsr()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="SpMM PETSc benchmark.")
+    parser.add_argument("-s", "--seed", type=int, default=42)
+    parser.add_argument("-f", "--file", type=str, default=None,
+                        help="Matrix file, or one slice of the "
+                             "reference's .part.P.slice.r.npz scheme.")
+    parser.add_argument("-v", "--vertices", type=int, default=100_000,
+                        help="Vertices of the random matrix (no --file).")
+    parser.add_argument("-e", "--edges", type=int, default=1_000_000)
+    parser.add_argument("-c", "--columns", type=int, default=32)
+    parser.add_argument("-z", "--iterations", type=int, default=3)
+    parser.add_argument("--validate", type=str2bool, nargs="?", default=True)
+    parser.add_argument("--dryrun", type=str2bool, nargs="?", default=False,
+                        help="Build the exchange tables, print their "
+                             "stats, skip the benchmark.")
+    parser.add_argument("--logdir", type=str, default="./logs")
+    add_device_args(parser)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_platform(args)
+
+    import jax
+
+    from arrow_matrix_tpu.parallel.mesh import make_mesh
+    from arrow_matrix_tpu.parallel.spmm_1d import MatrixSlice1D
+    from arrow_matrix_tpu.utils import logging as wb
+    from arrow_matrix_tpu.utils.graphs import random_dense
+
+    if args.file:
+        a = load_slices_or_matrix(args.file)
+        name = os.path.basename(args.file)
+    else:
+        a = random_adjacency(args.vertices, args.edges, args.seed)
+        name = f"random_{args.vertices}_{args.edges}"
+    a = normalize_scale(a)
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh((n_dev,), ("slices",))
+    wb.init("PETSc_TPU_v1", name, config=vars(args))
+
+    with wb.segment("build_time"):
+        dist = MatrixSlice1D(a, mesh)
+    print(f"{n_dev} slices of <= {dist.l_rows} rows; exchange slot "
+          f"{dist.slot} rows/pair")
+    if args.dryrun:
+        wb.finish(args.logdir)
+        return 0
+
+    x_host = random_dense(a.shape[1], args.columns, seed=args.seed)
+    x = dist.set_features(x_host)
+
+    if args.validate:
+        got = dist.gather_result(dist.spmm(x))
+        want = np.asarray(a @ x_host)
+        err = np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-30)
+        ok = np.allclose(got, want, rtol=1e-4, atol=1e-4)
+        print(f"validation: allclose={ok} rel frobenius err={err:.3e}")
+        wb.log({"frobenius_err": float(err)})
+        if not ok:
+            wb.finish(args.logdir)
+            return 1
+
+    y = dist.spmm(x)  # compile + warmup
+    jax.block_until_ready(y)
+    for it in range(args.iterations):
+        wb.set_iteration_data({"iteration": it})
+        tic = time.perf_counter()
+        y = dist.spmm(x)
+        jax.block_until_ready(y)
+        wb.log({"spmm_time": time.perf_counter() - tic})
+
+    s = wb.get_log().summarize()["spmm_time"]
+    print(f"spmm_time mean {s['mean'] * 1e3:.3f} ms over {s['count']} "
+          f"iterations (min {s['min'] * 1e3:.3f})")
+    out = wb.finish(args.logdir)
+    if out:
+        print(f"log written to {out}.json")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,40 @@
+"""JAX platform pinning shared by the CLI, tests, and entry points.
+
+One place for the two environment quirks every host-side launcher hits:
+the device-count flag must be set before the first backend
+initialization, and sitecustomize-registered out-of-tree PJRT plugins
+(e.g. a TPU tunnel) latch a platform before ``main()`` runs and must be
+dropped when CPU is requested.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n_devices: int | None = None) -> None:
+    """Pin JAX to the host CPU platform, optionally with ``n_devices``
+    virtual devices (the multi-chip-without-hardware fixture; the analog
+    of the reference's ``mpiexec --oversubscribe`` many-rank testing,
+    reference scripts/run_tests.sh).
+
+    Must run before anything initializes a JAX backend.  Safe to call
+    when jax is already imported, as long as no backend exists yet.
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:  # pragma: no cover - jax internals moved; harmless
+        pass
+    jax.config.update("jax_platforms", "cpu")

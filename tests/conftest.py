@@ -14,14 +14,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 # Some environments (axon TPU tunnels) register an out-of-tree PJRT
 # plugin for every interpreter via sitecustomize; initializing it can
-# block on a remote service.  Tests never want it — drop the factory and
-# repin the platform config (the env var was already latched at the
-# sitecustomize-time jax import) before the first backend init.
-try:
-    import jax
-    from jax._src import xla_bridge as _xb
+# block on a remote service.  Tests never want it — the shared helper
+# drops the factory and repins the platform before the first backend
+# init.
+from arrow_matrix_tpu.utils.platform import force_cpu_devices
 
-    _xb._backend_factories.pop("axon", None)
-    jax.config.update("jax_platforms", "cpu")
-except Exception:  # pragma: no cover - jax internals moved; harmless
-    pass
+force_cpu_devices()

@@ -1,0 +1,92 @@
+"""CLI smoke tests (in-process; the conftest's 8-device CPU platform is
+already pinned, so setup_platform's env pinning is a no-op here).
+
+Mirrors the reference's end-to-end bench test
+(reference tests/test_arrowmpi.py:423-436 test_larger_ranks runs
+bench_spmm at several widths/features)."""
+
+import os
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.cli import arrow_decompose, spmm_15d, spmm_arrow, spmm_petsc
+from arrow_matrix_tpu.cli.common import str2bool
+from arrow_matrix_tpu.utils.graphs import barabasi_albert
+
+
+def test_str2bool():
+    assert str2bool("yes") and str2bool("True") and str2bool(True)
+    assert not str2bool("no") and not str2bool("0")
+    with pytest.raises(Exception):
+        str2bool("maybe")
+
+
+def test_arrow_decompose_then_spmm_arrow(tmp_path, monkeypatch):
+    a = barabasi_albert(300, 3, seed=1)
+    sparse.save_npz(tmp_path / "tiny.npz", a)
+
+    arrow_decompose.main([
+        "--dataset_dir", str(tmp_path), "--dataset_name", "tiny.npz",
+        "--width", "32", "--levels", "4", "--seed", "0",
+    ])
+    produced = sorted(os.listdir(tmp_path))
+    assert any("_indptr.npy" in p for p in produced)
+    assert any("_permutation.npy" in p for p in produced)
+
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_arrow.main([
+        "--path", str(tmp_path / "tiny"), "--width", "32",
+        "--features", "4", "--iterations", "2", "--validate", "true",
+        "--device", "cpu", "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    assert os.path.isdir(tmp_path / "logs")
+
+
+def test_spmm_arrow_generated_graph(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_arrow.main([
+        "--vertices", "300", "--width", "32", "--features", "4",
+        "--iterations", "1", "--validate", "true", "--device", "cpu",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+
+
+def test_spmm_15d_random_validates(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_15d.main([
+        "--vertices", "256", "--edges", "1024", "--columns", "4",
+        "--iterations", "2", "--validate", "true", "--device", "cpu",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+
+
+def test_spmm_petsc_random_validates(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_petsc.main([
+        "--vertices", "256", "--edges", "1024", "--columns", "4",
+        "--iterations", "2", "--validate", "true", "--device", "cpu",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+
+
+def test_spmm_petsc_dryrun_and_slices(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    # Reference slice-file scheme: {name}.part.P.slice.r.npz.
+    a = barabasi_albert(64, 2, seed=3).astype(np.float32)
+    p = 4
+    bounds = np.linspace(0, 64, p + 1).astype(int)
+    for r in range(p):
+        sparse.save_npz(tmp_path / f"g.part.{p}.slice.{r}.npz",
+                        a[bounds[r]:bounds[r + 1]])
+    rc = spmm_petsc.main([
+        "--file", str(tmp_path / f"g.part.{p}.slice.0.npz"),
+        "--dryrun", "true", "--device", "cpu",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
