@@ -54,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "(decomposition_main.py:157-162).")
     parser.add_argument("--out_dir", type=str, default=None,
                         help="Output directory (default: dataset_dir).")
+    parser.add_argument("--backend", type=str, default="auto",
+                        choices=["auto", "native", "numpy"],
+                        help="Linearization backend: native C++ kernels "
+                             "(the reference's fast Julia decomposer "
+                             "role) or the scipy/csgraph implementation. "
+                             "Backends use different RNG streams: pin "
+                             "one for seed-reproducible results across "
+                             "machines.")
     return parser
 
 
@@ -87,7 +95,8 @@ def decompose_one(path: str, args: argparse.Namespace) -> None:
     # while the level matrices keep the asymmetric values.
     levels = arrow_decomposition(
         a, arrow_width=args.width, max_levels=args.levels,
-        block_diagonal=args.block_diagonal, seed=args.seed)
+        block_diagonal=args.block_diagonal, seed=args.seed,
+        backend=args.backend)
     print(f"decomposed into {len(levels)} levels in "
           f"{time.perf_counter() - tic:.1f}s; achieved widths "
           f"{[l.arrow_width for l in levels]}")

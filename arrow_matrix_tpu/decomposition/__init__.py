@@ -6,6 +6,7 @@ from arrow_matrix_tpu.decomposition.decompose import (
     reconstruct,
 )
 from arrow_matrix_tpu.decomposition.linearize import bfs_order, random_forest_order
+from arrow_matrix_tpu.decomposition import native
 
 __all__ = [
     "ArrowLevel",

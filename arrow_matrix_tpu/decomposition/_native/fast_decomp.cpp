@@ -1,0 +1,252 @@
+// Native linearization kernels for the arrow decomposition.
+//
+// Role: the compiled-performance decomposer layer — the counterpart of
+// the reference's Julia module (reference julia/arrow/
+// GraphAlgorithms.jl: union-find :7-41, Kruskal MSF :45-80, masked BFS
+// :83-195; ArrowDecomposition.jl:_arrow_linear_order :102-135), which
+// exists because the per-vertex bookkeeping of linearization is the only
+// super-linear-constant hot spot of the offline pipeline at 10^8 rows.
+//
+// Operates directly on symmetrized CSR arrays (int64 indptr/indices),
+// no graph library.  Exposed via ctypes (this environment has no
+// pybind11); see ../native.py.
+//
+// Algorithms (matching arrow_matrix_tpu/decomposition/linearize.py):
+//   amt_random_forest_order: uniformly random spanning forest by
+//     shuffled-edge Kruskal + union-find, then per-component DFS with
+//     children visited in increasing subtree-size order.  Components of
+//     size <= base_size are emitted as-is (ascending vertex id).
+//   amt_bfs_order: deterministic per-component BFS.
+//
+// Both write a permutation of [0, n) to `out` and return 0 on success.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+// SplitMix64: tiny, high-quality, seedable — the RNG for edge shuffling.
+inline uint64_t splitmix64(uint64_t &state) {
+  uint64_t z = (state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// Union-find with path halving + union by size (reference
+// GraphAlgorithms.jl:7-41 uses path compression + rank; size works the
+// same and doubles as the component-size lookup).
+struct UnionFind {
+  std::vector<int64_t> parent;
+  std::vector<int64_t> size;
+
+  explicit UnionFind(int64_t n) : parent(n), size(n, 1) {
+    for (int64_t i = 0; i < n; ++i) parent[i] = i;
+  }
+
+  int64_t find(int64_t x) {
+    while (parent[x] != x) {
+      parent[x] = parent[parent[x]];
+      x = parent[x];
+    }
+    return x;
+  }
+
+  bool unite(int64_t a, int64_t b) {
+    a = find(a);
+    b = find(b);
+    if (a == b) return false;
+    if (size[a] < size[b]) std::swap(a, b);
+    parent[b] = a;
+    size[a] += size[b];
+    return true;
+  }
+};
+
+// Linearize one rooted forest tree: DFS preorder + parents, subtree
+// sizes in reverse preorder, then a second DFS visiting children in
+// increasing subtree-size order (larger subtrees last — the linear-
+// arrangement cost heuristic, reference
+// ArrowDecomposition.jl/_linearize_tree, linearize.py:_linearize_tree).
+void linearize_tree(int64_t root, const std::vector<int64_t> &adj_ptr,
+                    const std::vector<int64_t> &adj,
+                    std::vector<int64_t> &parent,
+                    std::vector<int64_t> &subtree,
+                    std::vector<int64_t> &preorder,
+                    std::vector<int64_t> &stack, int64_t *out,
+                    int64_t &out_pos) {
+  // Pass 1: DFS preorder, recording parents.
+  preorder.clear();
+  stack.clear();
+  stack.push_back(root);
+  parent[root] = -1;
+  while (!stack.empty()) {
+    int64_t v = stack.back();
+    stack.pop_back();
+    preorder.push_back(v);
+    for (int64_t e = adj_ptr[v]; e < adj_ptr[v + 1]; ++e) {
+      int64_t u = adj[e];
+      if (u != parent[v] && parent[u] == -2) {
+        parent[u] = v;
+        stack.push_back(u);
+      }
+    }
+  }
+  // Pass 2: subtree sizes in reverse preorder.
+  for (auto it = preorder.rbegin(); it != preorder.rend(); ++it) {
+    subtree[*it] = 1;
+  }
+  for (auto it = preorder.rbegin(); it != preorder.rend(); ++it) {
+    int64_t v = *it;
+    if (parent[v] >= 0) subtree[parent[v]] += subtree[v];
+  }
+  // Pass 3: DFS emitting children by increasing subtree size (push
+  // descending so the smallest pops first).
+  std::vector<std::pair<int64_t, int64_t>> kids;  // (size, child)
+  stack.clear();
+  stack.push_back(root);
+  while (!stack.empty()) {
+    int64_t v = stack.back();
+    stack.pop_back();
+    out[out_pos++] = v;
+    kids.clear();
+    for (int64_t e = adj_ptr[v]; e < adj_ptr[v + 1]; ++e) {
+      int64_t u = adj[e];
+      if (parent[u] == v) kids.emplace_back(subtree[u], u);
+    }
+    std::sort(kids.begin(), kids.end());
+    for (auto it = kids.rbegin(); it != kids.rend(); ++it) {
+      stack.push_back(it->second);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int amt_random_forest_order(int64_t n, const int64_t *indptr,
+                            const int64_t *indices, uint64_t seed,
+                            int64_t base_size, int64_t *out) {
+  if (n == 0) return 0;
+
+  // Unique undirected edges u < v from the symmetrized CSR.
+  std::vector<int64_t> eu, ev;
+  eu.reserve(indptr[n] / 2);
+  ev.reserve(indptr[n] / 2);
+  for (int64_t u = 0; u < n; ++u) {
+    for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+      int64_t v = indices[e];
+      if (u < v) {
+        eu.push_back(u);
+        ev.push_back(v);
+      }
+    }
+  }
+  const int64_t m = static_cast<int64_t>(eu.size());
+
+  // Shuffled-edge Kruskal == Kruskal on iid random weights == a random
+  // spanning forest (reference GraphAlgorithms.jl:45-80 sorts random
+  // weights; a Fisher-Yates shuffle of edge ids is the same ordering).
+  std::vector<int64_t> edge_order(m);
+  for (int64_t i = 0; i < m; ++i) edge_order[i] = i;
+  uint64_t state = seed ^ 0xdeadbeefcafef00dULL;
+  for (int64_t i = m - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(splitmix64(state) % (i + 1));
+    std::swap(edge_order[i], edge_order[j]);
+  }
+
+  UnionFind uf(n);
+  std::vector<int64_t> tu, tv;
+  tu.reserve(n);
+  tv.reserve(n);
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t a = eu[edge_order[i]], b = ev[edge_order[i]];
+    if (uf.unite(a, b)) {
+      tu.push_back(a);
+      tv.push_back(b);
+    }
+  }
+
+  // Forest adjacency (CSR, both directions).
+  std::vector<int64_t> adj_ptr(n + 1, 0);
+  for (size_t i = 0; i < tu.size(); ++i) {
+    ++adj_ptr[tu[i] + 1];
+    ++adj_ptr[tv[i] + 1];
+  }
+  for (int64_t v = 0; v < n; ++v) adj_ptr[v + 1] += adj_ptr[v];
+  std::vector<int64_t> adj(adj_ptr[n]);
+  std::vector<int64_t> fill(adj_ptr.begin(), adj_ptr.end() - 1);
+  for (size_t i = 0; i < tu.size(); ++i) {
+    adj[fill[tu[i]]++] = tv[i];
+    adj[fill[tv[i]]++] = tu[i];
+  }
+
+  // Emit components in order of smallest member (scipy's label order in
+  // linearize.py).  parent doubles as the visited marker: -2 unvisited.
+  std::vector<int64_t> parent(n, -2), subtree(n, 0), preorder, stack;
+  std::vector<int64_t> members;
+  int64_t out_pos = 0;
+  for (int64_t v = 0; v < n; ++v) {
+    if (parent[v] != -2) continue;
+    int64_t root = uf.find(v);
+    int64_t comp_size = uf.size[root];
+    if (comp_size <= base_size) {
+      // Small component: ascending vertex ids.  Collect by BFS over the
+      // forest (spanning: reaches every member), then sort.
+      members.clear();
+      members.push_back(v);
+      parent[v] = -1;
+      for (size_t h = 0; h < members.size(); ++h) {
+        int64_t w = members[h];
+        for (int64_t e = adj_ptr[w]; e < adj_ptr[w + 1]; ++e) {
+          int64_t u = adj[e];
+          if (parent[u] == -2) {
+            parent[u] = w;
+            members.push_back(u);
+          }
+        }
+      }
+      std::sort(members.begin(), members.end());
+      for (int64_t w : members) out[out_pos++] = w;
+    } else {
+      linearize_tree(v, adj_ptr, adj, parent, subtree, preorder, stack,
+                     out, out_pos);
+    }
+  }
+  return out_pos == n ? 0 : 1;
+}
+
+int amt_bfs_order(int64_t n, const int64_t *indptr, const int64_t *indices,
+                  int64_t base_size, int64_t *out) {
+  if (n == 0) return 0;
+  std::vector<int64_t> queue;
+  std::vector<char> visited(n, 0);
+  int64_t out_pos = 0;
+  for (int64_t v = 0; v < n; ++v) {
+    if (visited[v]) continue;
+    // BFS the component (reference masked BFS,
+    // GraphAlgorithms.jl:83-195).
+    queue.clear();
+    queue.push_back(v);
+    visited[v] = 1;
+    for (size_t h = 0; h < queue.size(); ++h) {
+      int64_t w = queue[h];
+      for (int64_t e = indptr[w]; e < indptr[w + 1]; ++e) {
+        int64_t u = indices[e];
+        if (!visited[u]) {
+          visited[u] = 1;
+          queue.push_back(u);
+        }
+      }
+    }
+    if (static_cast<int64_t>(queue.size()) <= base_size) {
+      std::sort(queue.begin(), queue.end());
+    }
+    for (int64_t w : queue) out[out_pos++] = w;
+  }
+  return out_pos == n ? 0 : 1;
+}
+
+}  // extern "C"

@@ -70,8 +70,33 @@ def achieved_width(coo_rows: np.ndarray, coo_cols: np.ndarray, width: int) -> in
     return max(width, int(np.max(np.abs(coo_rows[outside] - coo_cols[outside]))))
 
 
+def _resolve_backend(backend: str):
+    """Pick the linearization implementation.
+
+    ``numpy``: the scipy/csgraph implementation in ``linearize.py``.
+    ``native``: the C++ kernels (``native.py``; error if unavailable) —
+        the compiled-performance layer, the reference's Julia-module
+        role (julia/arrow/*.jl).
+    ``auto``: native when it loads, numpy otherwise.
+    """
+    if backend not in ("auto", "native", "numpy"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend == "numpy":
+        return bfs_order, random_forest_order
+    from arrow_matrix_tpu.decomposition import native
+
+    if native.available():
+        return native.bfs_order, native.random_forest_order
+    if backend == "native":
+        raise RuntimeError(
+            f"backend='native' requested but the native decomposer "
+            f"failed to build/load: {native.load_error()}")
+    return bfs_order, random_forest_order
+
+
 def _linear_order(a: sparse.csr_matrix, width: int, deterministic: bool,
-                  rng: np.random.Generator) -> np.ndarray:
+                  rng: np.random.Generator,
+                  backend: str = "auto") -> np.ndarray:
     """Level ordering: width highest-degree vertices first, then the
     forest-linearized middle, then zero-degree singletons."""
     n = a.shape[0]
@@ -86,12 +111,13 @@ def _linear_order(a: sparse.csr_matrix, width: int, deterministic: bool,
     singletons = tail[tail_deg == 0]
 
     if middle.size:
+        bfs_fn, forest_fn = _resolve_backend(backend)
         sub = sym[middle][:, middle]
         if deterministic:
-            sub_order = bfs_order(sub)
+            sub_order = bfs_fn(sub)
         else:
-            sub_order = random_forest_order(sub, rng,
-                                            base_size=min(width - 1, 16))
+            sub_order = forest_fn(sub, rng,
+                                  base_size=min(width - 1, 16))
         middle_order = middle[sub_order]
     else:
         middle_order = middle
@@ -106,7 +132,8 @@ def arrow_decomposition(a: sparse.spmatrix,
                         max_levels: int = 2,
                         block_diagonal: bool = False,
                         prune: bool = True,
-                        seed: int | None = None) -> list[ArrowLevel]:
+                        seed: int | None = None,
+                        backend: str = "auto") -> list[ArrowLevel]:
     """Compute an arrow decomposition of a square sparse matrix.
 
     :param a: square sparse matrix (any scipy format; values preserved).
@@ -120,6 +147,13 @@ def arrow_decomposition(a: sparse.spmatrix,
     :param prune: place the ``arrow_width`` highest-degree vertices first;
         their rows/columns always belong to the level (the arrow head).
     :param seed: RNG seed for the random-spanning-forest linearization.
+    :param backend: linearization implementation — "numpy" (scipy/
+        csgraph), "native" (C++ kernels, the reference's Julia-layer
+        role), or "auto" (native when available).  The two backends use
+        different RNG streams, so for a fixed seed the level structure
+        depends on the backend; pin one explicitly when bit-reproducible
+        decompositions across machines matter (the reference has the
+        same property between its Python and Julia decomposers).
     """
     a = a.tocsr()
     if a.shape[0] != a.shape[1]:
@@ -127,19 +161,24 @@ def arrow_decomposition(a: sparse.spmatrix,
     if arrow_width > a.shape[0]:
         raise ValueError(f"arrow_width {arrow_width} exceeds matrix side {a.shape[0]}")
 
+    if backend not in ("auto", "native", "numpy"):
+        raise ValueError(f"unknown backend {backend!r}")
+
     rng = np.random.default_rng(seed)
     levels: list[ArrowLevel] = []
-    _decompose(a, arrow_width, levels, max_levels, block_diagonal, prune, rng)
+    _decompose(a, arrow_width, levels, max_levels, block_diagonal, prune, rng,
+               backend)
     return levels
 
 
 def _decompose(a: sparse.csr_matrix, width: int, levels: list[ArrowLevel],
                max_levels: int, block_diagonal: bool, prune: bool,
-               rng: np.random.Generator) -> None:
+               rng: np.random.Generator, backend: str = "auto") -> None:
     n = a.shape[0]
     last = len(levels) + 1 >= max_levels
 
-    order = _linear_order(a, width, deterministic=last, rng=rng)
+    order = _linear_order(a, width, deterministic=last, rng=rng,
+                          backend=backend)
     inv = np.argsort(order)
 
     coo = a.tocoo()
@@ -173,7 +212,7 @@ def _decompose(a: sparse.csr_matrix, width: int, levels: list[ArrowLevel],
             a_rest = sparse.csr_matrix(
                 (coo.data[rest], (coo.row[rest], coo.col[rest])), shape=(n, n))
             _decompose(a_rest, width, levels, max_levels, block_diagonal,
-                       prune, rng)
+                       prune, rng, backend)
     else:
         # Last level: keep everything, report the width actually achieved.
         b = sparse.csr_matrix((coo.data, (r, c)), shape=(n, n))

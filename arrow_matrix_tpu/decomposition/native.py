@@ -1,0 +1,141 @@
+"""ctypes loader for the native linearization kernels.
+
+The compiled-performance decomposer layer (the reference's Julia module
+role, reference julia/arrow/*.jl — SURVEY.md §2a).  The shared library
+is built from ``_native/fast_decomp.cpp`` on first use with g++ (this
+environment has no pybind11; plain ``extern "C"`` + ctypes needs no
+build-time Python dependency at all) and cached next to the source.
+
+Public surface mirrors ``linearize.py``:
+
+    available() -> bool
+    random_forest_order(adj_sym, rng, base_size) -> order
+    bfs_order(adj_sym, base_size) -> order
+
+Callers should treat this as an *equivalent alternative* to the numpy
+implementation: both satisfy the decomposition invariants; the random
+orders differ (different RNG streams), exactly as the reference's Julia
+and Python decomposers differ.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+from scipy import sparse
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_native", "fast_decomp.cpp")
+_LIB_PATH = os.path.join(_DIR, "_native", "libfast_decomp.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_error: Exception | None = None
+
+
+def _build() -> None:
+    # Compile to a process-unique temp path and os.replace() into place:
+    # concurrent first-use builds (test workers, multi-host launchers on
+    # a shared filesystem) must never dlopen a partially-written .so.
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native decomposer build failed "
+                f"({' '.join(cmd)}):\n{proc.stderr}")
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        try:
+            stale = (not os.path.exists(_LIB_PATH)
+                     or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+            if stale:
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.amt_random_forest_order.argtypes = [
+                ctypes.c_int64, i64p, i64p, ctypes.c_uint64,
+                ctypes.c_int64, i64p]
+            lib.amt_random_forest_order.restype = ctypes.c_int
+            lib.amt_bfs_order.argtypes = [
+                ctypes.c_int64, i64p, i64p, ctypes.c_int64, i64p]
+            lib.amt_bfs_order.restype = ctypes.c_int
+            _lib = lib
+        except Exception as e:  # compiler missing, load failure, ...
+            _load_error = e
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is (or can be) loaded."""
+    return _load() is not None
+
+
+def load_error() -> Exception | None:
+    """The build/load failure, for error messages from backend='native'."""
+    _load()
+    return _load_error
+
+
+def _csr_int64(adj: sparse.csr_matrix) -> tuple[np.ndarray, np.ndarray]:
+    indptr = np.ascontiguousarray(adj.indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(adj.indices, dtype=np.int64)
+    return indptr, indices
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def random_forest_order(adj_sym: sparse.csr_matrix,
+                        rng: np.random.Generator,
+                        base_size: int = 16) -> np.ndarray:
+    """Native random-spanning-forest linearization (see linearize.py for
+    the algorithm contract)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decomposer unavailable: {_load_error}")
+    n = adj_sym.shape[0]
+    out = np.empty(n, dtype=np.int64)
+    if n == 0:
+        return out
+    indptr, indices = _csr_int64(adj_sym)
+    seed = int(rng.integers(0, 2**63 - 1))
+    rc = lib.amt_random_forest_order(n, _ptr(indptr), _ptr(indices),
+                                     seed, int(base_size), _ptr(out))
+    if rc != 0:
+        raise RuntimeError("native random_forest_order failed "
+                           "(emitted order is not a permutation)")
+    return out
+
+
+def bfs_order(adj_sym: sparse.csr_matrix, base_size: int = 2) -> np.ndarray:
+    """Native deterministic per-component BFS linearization."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decomposer unavailable: {_load_error}")
+    n = adj_sym.shape[0]
+    out = np.empty(n, dtype=np.int64)
+    if n == 0:
+        return out
+    indptr, indices = _csr_int64(adj_sym)
+    rc = lib.amt_bfs_order(n, _ptr(indptr), _ptr(indices), int(base_size),
+                           _ptr(out))
+    if rc != 0:
+        raise RuntimeError("native bfs_order failed "
+                           "(emitted order is not a permutation)")
+    return out

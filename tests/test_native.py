@@ -1,0 +1,104 @@
+"""Native (C++) decomposer backend tests.
+
+The native kernels are the compiled-performance layer (the reference's
+Julia-module role, reference julia/arrow/GraphAlgorithms.jl tested by
+julia/arrow/test/test_graph.jl: union-find semantics, MSF edge counts,
+degenerate graphs).  Tested here the same way the Python backend is:
+permutation validity, decomposition invariants, and cross-backend
+equivalence of the deterministic BFS path.
+"""
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.decomposition import arrow_decomposition, native
+from arrow_matrix_tpu.decomposition.decompose import (
+    decomposition_spmm,
+    reconstruct,
+)
+from arrow_matrix_tpu.decomposition.linearize import bfs_order as py_bfs
+from arrow_matrix_tpu.utils import barabasi_albert, erdos_renyi, random_dense
+from arrow_matrix_tpu.utils.graphs import symmetrize
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native decomposer unavailable: {native.load_error()}")
+
+
+def test_forest_order_is_permutation():
+    a = symmetrize(barabasi_albert(500, 3, seed=1))
+    rng = np.random.default_rng(0)
+    order = native.random_forest_order(a, rng)
+    assert np.array_equal(np.sort(order), np.arange(500))
+
+
+def test_bfs_order_is_permutation_and_component_contiguous():
+    # Two disjoint components: BFS must emit each contiguously, smaller
+    # component ids first (the MSF edge-count/degenerate-graph checks of
+    # the Julia tests, test_graph.jl:81-107).
+    a1 = symmetrize(barabasi_albert(40, 2, seed=2))
+    a2 = symmetrize(barabasi_albert(30, 2, seed=3))
+    a = sparse.block_diag([a1, a2], format="csr")
+    order = native.bfs_order(a)
+    assert np.array_equal(np.sort(order), np.arange(70))
+    first = order[:40]
+    assert np.all(first < 40), "component 0 must be emitted first"
+
+
+def test_bfs_matches_python_backend():
+    # BFS is deterministic: both backends must produce identical orders
+    # on a connected graph.
+    a = symmetrize(barabasi_albert(300, 3, seed=5))
+    np.testing.assert_array_equal(native.bfs_order(a), py_bfs(a))
+
+
+def test_degenerate_graphs():
+    # No edges at all: every component is a singleton.
+    empty = sparse.csr_matrix((16, 16), dtype=np.float32)
+    assert np.array_equal(native.bfs_order(empty), np.arange(16))
+    order = native.random_forest_order(empty, np.random.default_rng(0))
+    assert np.array_equal(np.sort(order), np.arange(16))
+    # Empty matrix.
+    zero = sparse.csr_matrix((0, 0), dtype=np.float32)
+    assert native.bfs_order(zero).size == 0
+
+
+@pytest.mark.parametrize("block_diagonal", [True, False])
+def test_native_backend_invariants(block_diagonal):
+    """Full decomposition invariant suite with backend='native'
+    (reference test_arrowdecomposition.py:24-112 protocol)."""
+    a = barabasi_albert(512, 4, seed=77)
+    n = a.shape[0]
+    width = 64
+    levels = arrow_decomposition(a, width, max_levels=100,
+                                 block_diagonal=block_diagonal, seed=3,
+                                 backend="native")
+    for lvl in levels:
+        assert np.array_equal(np.sort(lvl.permutation), np.arange(n))
+        w = lvl.arrow_width
+        coo = lvl.matrix.tocoo()
+        ok = (np.abs(coo.row - coo.col) <= w) | (coo.row < w) | (coo.col < w)
+        assert bool(np.all(ok))
+    diff = (reconstruct(levels) - a).tocsr()
+    assert diff.nnz == 0 or np.max(np.abs(diff.data)) < 1e-6
+    x = random_dense(n, 8, seed=1)
+    np.testing.assert_allclose(decomposition_spmm(levels, x), a @ x,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_backend_quality_parity():
+    """Native linearization must not degrade arrangement quality: the
+    number of levels produced at a fixed width stays comparable."""
+    a = erdos_renyi(512, 0.05, seed=9)
+    ln = arrow_decomposition(a, 80, max_levels=100, block_diagonal=True,
+                             seed=1, backend="native")
+    lp = arrow_decomposition(a, 80, max_levels=100, block_diagonal=True,
+                             seed=1, backend="numpy")
+    assert len(ln) <= len(lp) + 2
+
+
+def test_backend_validation():
+    a = barabasi_albert(64, 2, seed=1)
+    with pytest.raises(ValueError):
+        arrow_decomposition(a, 8, backend="julia")
