@@ -133,7 +133,7 @@ def arrow_decomposition(a: sparse.spmatrix,
                         block_diagonal: bool = False,
                         prune: bool = True,
                         seed: int | None = None,
-                        backend: str = "auto") -> list[ArrowLevel]:
+                        backend: str = "numpy") -> list[ArrowLevel]:
     """Compute an arrow decomposition of a square sparse matrix.
 
     :param a: square sparse matrix (any scipy format; values preserved).
@@ -148,12 +148,14 @@ def arrow_decomposition(a: sparse.spmatrix,
         their rows/columns always belong to the level (the arrow head).
     :param seed: RNG seed for the random-spanning-forest linearization.
     :param backend: linearization implementation — "numpy" (scipy/
-        csgraph), "native" (C++ kernels, the reference's Julia-layer
-        role), or "auto" (native when available).  The two backends use
-        different RNG streams, so for a fixed seed the level structure
-        depends on the backend; pin one explicitly when bit-reproducible
-        decompositions across machines matter (the reference has the
-        same property between its Python and Julia decomposers).
+        csgraph; the default), "native" (C++ kernels, the reference's
+        Julia-layer role; ~10x faster on large graphs), or "auto"
+        (native when available).  The two backends use different RNG
+        streams, so for a fixed seed the level structure depends on the
+        backend; the default is "numpy" so seeded results never depend
+        on toolchain presence — opt into "native"/"auto" for large
+        graphs (the reference has the same split between its Python and
+        Julia decomposers).
     """
     a = a.tocsr()
     if a.shape[0] != a.shape[1]:

@@ -38,10 +38,16 @@ _load_error: Exception | None = None
 
 
 def _build() -> None:
-    # Compile to a process-unique temp path and os.replace() into place:
-    # concurrent first-use builds (test workers, multi-host launchers on
-    # a shared filesystem) must never dlopen a partially-written .so.
-    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    # Compile to a unique temp path in the target directory and
+    # os.replace() into place: concurrent first-use builds (test
+    # workers, multi-host launchers on a shared filesystem — where pids
+    # can collide across hosts) must never dlopen a partially-written
+    # .so.  mkstemp gives per-open uniqueness on the shared directory.
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(suffix=".so.tmp",
+                               dir=os.path.dirname(_LIB_PATH))
+    os.close(fd)
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)

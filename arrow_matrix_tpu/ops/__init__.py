@@ -13,6 +13,21 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
     block_features,
     unblock_features,
 )
+# Pallas is optional: JAX builds without pallas/tpu support must still
+# import the (default, XLA-path) ops package.
+try:
+    from arrow_matrix_tpu.ops.pallas_blocks import (
+        arrow_spmm_pallas,
+        column_spmm_pallas,
+        head_spmm_pallas,
+    )
+except ImportError as _pallas_err:  # pragma: no cover - env dependent
+    _msg = f"pallas kernels unavailable: {_pallas_err}"
+
+    def _unavailable(*_a, **_k):
+        raise RuntimeError(_msg)
+
+    arrow_spmm_pallas = column_spmm_pallas = head_spmm_pallas = _unavailable
 
 __all__ = [
     "csr_flat_pack",
@@ -24,6 +39,9 @@ __all__ = [
     "ArrowBlocks",
     "arrow_blocks_from_csr",
     "arrow_spmm",
+    "arrow_spmm_pallas",
+    "column_spmm_pallas",
+    "head_spmm_pallas",
     "block_features",
     "unblock_features",
 ]

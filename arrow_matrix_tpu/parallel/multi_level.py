@@ -121,9 +121,17 @@ class MultiLevelArrow:
                  mesh: Optional[Mesh] = None, axis: str = "blocks",
                  banded: bool = False, dtype=np.float32,
                  chunk: Optional[int] = None, fmt: str = "auto",
-                 dense_budget: int = 4 << 30):
+                 dense_budget: int = 4 << 30, kernel: str = "xla"):
         if not levels:
             raise ValueError("empty decomposition")
+        if kernel not in ("xla", "pallas"):
+            raise ValueError(f"unknown kernel {kernel!r}")
+        if kernel == "pallas" and mesh is not None:
+            # Pallas custom calls do not partition under GSPMD; the
+            # fused kernels are a single-chip path (per-shard use under
+            # shard_map is future work).
+            raise ValueError("kernel='pallas' requires mesh=None")
+        self.kernel = kernel
         self.width = width
         self.mesh = mesh
         self.axis = axis
@@ -181,6 +189,12 @@ class MultiLevelArrow:
             else:
                 self.fmts.append(fmt)
 
+        if kernel == "pallas" and "dense" not in self.fmts:
+            raise ValueError(
+                "kernel='pallas' but no level resolved to the dense block "
+                "format (the pallas kernels cover dense only; raise "
+                "dense_budget or pass fmt='dense')")
+
         self.blocks: List[ArrowBlocks] = [
             arrow_blocks_from_csr(lvl.matrix.astype(dtype), w,
                                   pad_blocks_to=self.total_rows // w,
@@ -208,7 +222,8 @@ class MultiLevelArrow:
         # arrays are inlined into the HLO as literal constants, which
         # bloats the program (and breaks remote-compile size limits).
         self._step = jax.jit(functools.partial(
-            multi_level_spmm, widths=tuple(widths), chunk=chunk))
+            multi_level_spmm, widths=tuple(widths), chunk=chunk,
+            kernel=kernel))
 
     # -- feature placement -------------------------------------------------
 
@@ -262,7 +277,8 @@ class MultiLevelArrow:
 
 def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
                      blocks: Sequence[ArrowBlocks], widths: tuple,
-                     chunk: Optional[int] = None) -> jax.Array:
+                     chunk: Optional[int] = None,
+                     kernel: str = "xla") -> jax.Array:
     """One decomposition-wide SpMM (jitted; K unrolled — K is small).
 
     Forward feature propagation (reference
@@ -270,7 +286,8 @@ def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
     arrow SpMM, backward aggregation (reference
     _aggregate_features_backwards, arrow_dec_mpi.py:404-440).
     ``x`` is flat (total_rows, k); each level reshapes to its own
-    blocking (nb_i, w_i, k).
+    blocking (nb_i, w_i, k).  ``kernel="pallas"`` routes dense-format
+    levels through the fused Pallas kernels (single chip only).
     """
     total, k = x.shape
     k_levels = len(blocks)
@@ -280,8 +297,18 @@ def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
         if i > 0:
             x_cur = jnp.take(x_cur, fwd[i - 1], axis=0)
         w = widths[i]
-        c = arrow_spmm(blocks[i], x_cur.reshape(total // w, w, k),
-                       chunk=chunk)
+        xb = x_cur.reshape(total // w, w, k)
+        use_pallas = False
+        if kernel == "pallas" and blocks[i].fmt == "dense":
+            from arrow_matrix_tpu.ops import pallas_blocks
+
+            # Oversized levels (grown last-level width) whose feature
+            # operands exceed VMEM fall back to XLA per level.
+            use_pallas = pallas_blocks.feasible(w, k, blocks[i].banded)
+        if use_pallas:
+            c = pallas_blocks.arrow_spmm_pallas(blocks[i], xb)
+        else:
+            c = arrow_spmm(blocks[i], xb, chunk=chunk)
         partials.append(c.reshape(total, k))
 
     agg = partials[-1]

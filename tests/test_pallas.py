@@ -1,0 +1,119 @@
+"""Pallas kernel tests (interpret mode on the CPU fixture; the same
+code compiles via Mosaic on TPU — verified on hardware, see
+ops/pallas_blocks.py).
+
+Gate: exact agreement with the XLA dense path — the same cpu-vs-device
+numerics gate the reference applies between its scipy and cuSPARSE
+kernels (reference tests/test_arrowmpi.py:342-398 runs both devices)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.ops import arrow_blocks_from_csr, arrow_spmm
+from arrow_matrix_tpu.ops.pallas_blocks import (
+    _row_tile,
+    arrow_spmm_pallas,
+    column_spmm_pallas,
+    head_spmm_pallas,
+)
+from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
+from arrow_matrix_tpu.decomposition import arrow_decomposition
+from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+
+def _arrow_csr(nb, w, banded, seed, density=0.25):
+    rng = np.random.default_rng(seed)
+
+    def blk():
+        return sparse.random(w, w, density=density, random_state=rng,
+                             dtype=np.float32)
+
+    grid = [[None] * nb for _ in range(nb)]
+    for j in range(nb):
+        grid[0][j] = blk()
+    for i in range(1, nb):
+        grid[i][0] = blk()
+        grid[i][i] = blk()
+        if banded:
+            if i - 1 >= 1:
+                grid[i][i - 1] = blk()
+            if i + 1 < nb:
+                grid[i][i + 1] = blk()
+    return sparse.bmat(grid, format="csr").astype(np.float32)
+
+
+@pytest.mark.parametrize("banded", [False, True])
+def test_arrow_spmm_pallas_matches_xla(banded):
+    nb, w, k = 6, 32, 8
+    a = _arrow_csr(nb, w, banded, seed=1)
+    blocks = arrow_blocks_from_csr(a, w, banded=banded, fmt="dense")
+    x = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal((nb, w, k)).astype(np.float32))
+    want = np.asarray(arrow_spmm(blocks, x))
+    got = np.asarray(arrow_spmm_pallas(blocks, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_head_kernel_accumulates_all_blocks():
+    nb, w, k = 5, 16, 4
+    rng = np.random.default_rng(3)
+    head = rng.standard_normal((nb, w, w)).astype(np.float32)
+    x = rng.standard_normal((nb, w, k)).astype(np.float32)
+    got = np.asarray(head_spmm_pallas(jnp.asarray(head), jnp.asarray(x)))
+    want = sum(head[b] @ x[b] for b in range(nb))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_column_kernel_block_diagonal():
+    nb, w, k = 4, 24, 4
+    rng = np.random.default_rng(5)
+    diag = rng.standard_normal((nb, w, w)).astype(np.float32)
+    col = rng.standard_normal((nb, w, w)).astype(np.float32)
+    x = rng.standard_normal((nb, w, k)).astype(np.float32)
+    got = np.asarray(column_spmm_pallas(jnp.asarray(diag), jnp.asarray(col),
+                                        jnp.asarray(x), jnp.asarray(x[0])))
+    want = np.stack([diag[b] @ x[b] + col[b] @ x[0] for b in range(nb)])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_row_tile_divides_and_budgets():
+    for w in (16, 200, 512, 2000, 2048):
+        for stacks in (1, 2, 4):
+            t = _row_tile(w, stacks)
+            assert w % t == 0
+            assert stacks * t * w * 4 * 2 <= max(8 << 20, stacks * 8 * w * 8)
+
+
+def test_pallas_rejects_ell_format():
+    a = _arrow_csr(4, 16, False, seed=2)
+    blocks = arrow_blocks_from_csr(a, 16, fmt="ell")
+    x = jnp.zeros((4, 16, 4), dtype=jnp.float32)
+    with pytest.raises(ValueError):
+        arrow_spmm_pallas(blocks, x)
+
+
+def test_multi_level_pallas_kernel_end_to_end():
+    n, width = 512, 64
+    a = barabasi_albert(n, 3, seed=4)
+    levels = arrow_decomposition(a, width, max_levels=4,
+                                 block_diagonal=True, seed=0)
+    x = random_dense(n, 8, seed=1)
+    ml_x = MultiLevelArrow(levels, width, mesh=None, fmt="dense")
+    ml_p = MultiLevelArrow(levels, width, mesh=None, fmt="dense",
+                           kernel="pallas")
+    want = ml_x.gather_result(ml_x.step(ml_x.set_features(x)))
+    got = ml_p.gather_result(ml_p.step(ml_p.set_features(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_kernel_requires_single_chip():
+    from arrow_matrix_tpu.parallel.mesh import make_mesh
+
+    a = barabasi_albert(128, 3, seed=4)
+    levels = arrow_decomposition(a, 16, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    mesh = make_mesh((8,), ("blocks",))
+    with pytest.raises(ValueError):
+        MultiLevelArrow(levels, 16, mesh=mesh, kernel="pallas")
