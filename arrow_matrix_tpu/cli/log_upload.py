@@ -1,0 +1,33 @@
+"""``log_upload`` — deferred upload of offline benchmark logs.
+
+Counterpart of the reference's wandb upload tool (reference
+scripts/wb_log_main.py + arrow/common/wb_logging.py:135-160): scan a log
+directory for runs written by the benchmark CLIs, stream each to wandb,
+and mark it with a ``.logged`` indicator file.  Without wandb installed
+it lists the pending runs (file logs remain the source of truth).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Upload offline run logs to W&B.")
+    parser.add_argument("-f", "--path", type=str, default="./logs",
+                        help="Directory containing run logs.")
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.path):
+        raise SystemExit(f"{args.path} is not a directory")
+
+    from arrow_matrix_tpu.utils.logging import log_local_runs
+
+    handled = log_local_runs(args.path)
+    print(f"{len(handled)} run(s) handled")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

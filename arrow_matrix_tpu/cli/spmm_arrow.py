@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Compare each iteration against the host "
                              "scipy golden (spmm_15d_main.py --validate "
                              "analog).")
+    parser.add_argument("--backend", type=str, default="auto",
+                        choices=["auto", "native", "numpy"],
+                        help="Decomposer linearization backend for the "
+                             "generated-graph path (native C++ when "
+                             "available; see arrow_decompose --backend).")
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
@@ -111,7 +116,7 @@ def main(argv=None) -> int:
         a = graphs.barabasi_albert(n, args.ba_neighbors, seed=args.seed)
         levels = arrow_decomposition(a, arrow_width=width, max_levels=10,
                                      block_diagonal=args.blocked,
-                                     seed=args.seed)
+                                     seed=args.seed, backend=args.backend)
         base = os.path.join(".", f"ba_{n}_{args.ba_neighbors}")
         save_decomposition(levels, base, block_diagonal=args.blocked)
         path = base

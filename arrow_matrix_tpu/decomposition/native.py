@@ -55,6 +55,9 @@ def _build() -> None:
             raise RuntimeError(
                 f"native decomposer build failed "
                 f"({' '.join(cmd)}):\n{proc.stderr}")
+        # mkstemp creates 0600; restore umask-default perms so other
+        # users of a shared install can dlopen the library.
+        os.chmod(tmp, 0o644)
         os.replace(tmp, _LIB_PATH)
     finally:
         if os.path.exists(tmp):

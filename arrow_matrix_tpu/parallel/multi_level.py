@@ -131,6 +131,13 @@ class MultiLevelArrow:
             # fused kernels are a single-chip path (per-shard use under
             # shard_map is future work).
             raise ValueError("kernel='pallas' requires mesh=None")
+        if kernel == "pallas":
+            try:
+                from arrow_matrix_tpu.ops import pallas_blocks  # noqa: F401
+            except ImportError as e:
+                raise ValueError(
+                    f"kernel='pallas' but pallas is unavailable in this "
+                    f"JAX build: {e}") from e
         self.kernel = kernel
         self.width = width
         self.mesh = mesh

@@ -110,6 +110,99 @@ def segment(name: str):
     return _GLOBAL.segment(name)
 
 
+def trace(log_dir: str = "./traces"):
+    """Device-side profiling: `jax.profiler.trace` context writing a
+    TensorBoard-loadable trace.
+
+    The TPU counterpart of the reference's manual GPU-side timing gap
+    (reference has no GPU-event timing, SURVEY.md §5 tracing): host
+    segments come from :func:`segment`, device timelines from here.
+
+    Usage: ``with wb.trace("./traces"): multi.step(x)``.
+    """
+    import jax
+
+    return jax.profiler.trace(log_dir)
+
+
+def _acquire_lock(lock_path: str, attempts: int = 20,
+                  stale_s: float = 600.0) -> bool:
+    """Exclusive-create lockfile with randomized exponential backoff
+    (reference wb_logging.py:21-46: serializes uploads across
+    concurrent jobs sharing a filesystem).  A lock older than
+    ``stale_s`` is treated as abandoned (holder killed before its
+    cleanup ran) and broken."""
+    import random
+
+    delay = 0.1
+    for _ in range(attempts):
+        try:
+            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return True
+        except FileExistsError:
+            try:
+                if time.time() - os.path.getmtime(lock_path) > stale_s:
+                    os.unlink(lock_path)
+                    continue
+            except OSError:
+                continue  # holder released it between the checks
+            time.sleep(delay * (1.0 + random.random()))
+            delay = min(delay * 2, 5.0)
+    return False
+
+
+def log_local_runs(log_dir: str = "./logs") -> list[str]:
+    """Upload offline run files to wandb, marking each with a
+    ``.logged`` indicator so reruns skip it (reference
+    wb_logging.py:135-160, scripts/wb_log_main.py).
+
+    Without wandb installed, lists the pending runs and uploads
+    nothing (the reference's wandb path is effectively dead code —
+    SURVEY.md §5; files are the source of truth either way).
+    Returns the list of run base paths uploaded (or pending, when
+    wandb is absent).
+    """
+    try:
+        import wandb
+    except ImportError:
+        wandb = None
+
+    handled = []
+    for name in sorted(os.listdir(log_dir)):
+        if not name.endswith(".json"):
+            continue
+        base = os.path.join(log_dir, name[:-len(".json")])
+        indicator = base + ".logged"
+        if os.path.exists(indicator):
+            continue
+        with open(base + ".json") as f:
+            run = json.load(f)
+        if not run.get("entries"):
+            continue
+        if wandb is None:
+            print(f"pending (wandb not installed): {base}")
+            handled.append(base)
+            continue
+        lock = os.path.join(log_dir, ".wandb.lock")
+        if not _acquire_lock(lock):
+            print(f"could not acquire wandb lock for {base}; retry later")
+            continue
+        handled.append(base)
+        try:
+            wandb.init(project="spmm-tpu", name=run["algorithm"],
+                       config=run.get("config", {}),
+                       tags=[run["algorithm"], run["dataset"]])
+            for item in run["entries"]:
+                wandb.log(item)
+            wandb.finish()
+            with open(indicator, "w"):
+                pass
+        finally:
+            os.unlink(lock)
+    return handled
+
+
 def block_until_ready(x: Any) -> Any:
     """Convenience: jax.block_until_ready that tolerates non-jax values.
 

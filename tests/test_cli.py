@@ -90,3 +90,48 @@ def test_spmm_petsc_dryrun_and_slices(tmp_path, monkeypatch):
         "--logdir", str(tmp_path / "logs"),
     ])
     assert rc == 0
+
+
+def test_log_upload_marks_and_lists(tmp_path):
+    # A run written by the benchmark CLIs is discovered; without wandb
+    # it stays pending (no .logged marker), and empty runs are skipped
+    # (reference wb_logging.py:135-160 semantics).
+    import json
+
+    from arrow_matrix_tpu.cli import log_upload
+    from arrow_matrix_tpu.utils.logging import log_local_runs
+
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    run = {"algorithm": "ArrowTPU_test", "dataset": "tiny",
+           "config": {"width": 4}, "entries": [{"spmm_time": 0.1}]}
+    (logdir / "ArrowTPU_test.tiny.abc.json").write_text(json.dumps(run))
+    empty = dict(run, entries=[])
+    (logdir / "ArrowTPU_test.tiny.def.json").write_text(json.dumps(empty))
+
+    handled = log_local_runs(str(logdir))
+    assert len(handled) == 1 and handled[0].endswith(".abc")
+
+    assert log_upload.main(["--path", str(logdir)]) == 0
+    with pytest.raises(SystemExit):
+        log_upload.main(["--path", str(logdir / "nope")])
+
+
+def test_segment_log_and_trace(tmp_path):
+    import jax.numpy as jnp
+
+    from arrow_matrix_tpu.utils import logging as wb
+
+    wb.init("algo", "ds", {"k": 1})
+    with wb.segment("phase_a"):
+        pass
+    wb.set_iteration_data({"iteration": 3})
+    wb.log({"spmm_time": 0.5})
+    s = wb.get_log().summarize()
+    assert "phase_a" in s and s["spmm_time"]["count"] == 1
+    base = wb.finish(str(tmp_path / "logs"))
+    assert base and os.path.exists(base + ".json")
+
+    with wb.trace(str(tmp_path / "traces")):
+        jnp.ones(8).sum().block_until_ready()
+    assert os.path.isdir(tmp_path / "traces")
