@@ -232,6 +232,18 @@ class MultiLevelArrow:
             multi_level_spmm, widths=tuple(widths), chunk=chunk,
             kernel=kernel))
 
+        def scan_steps(x, fwd, bwd, blocks, n):
+            def body(xc, _):
+                xc = multi_level_spmm(xc, fwd, bwd, blocks,
+                                      widths=tuple(widths), chunk=chunk,
+                                      kernel=kernel)
+                return xc, None
+
+            out, _ = jax.lax.scan(body, x, None, length=n)
+            return out
+
+        self._scan_steps = jax.jit(scan_steps, static_argnames=("n",))
+
     # -- feature placement -------------------------------------------------
 
     def _rows_sharding(self):
@@ -277,9 +289,14 @@ class MultiLevelArrow:
         return self._step(x, self.fwd, self.bwd, self.blocks)
 
     def run(self, x: jax.Array, iterations: int) -> jax.Array:
-        for _ in range(iterations):
-            x = self.step(x)
-        return x
+        """``iterations`` steps as ONE device program (`lax.scan` over
+        the jitted step): a single dispatch regardless of iteration
+        count — the iteration loop itself is compiler-friendly control
+        flow on device, not a host loop of dispatches (which pays
+        dispatch latency per step, badly over remote/tunneled devices).
+        """
+        return self._scan_steps(x, self.fwd, self.bwd, self.blocks,
+                                n=iterations)
 
 
 def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,

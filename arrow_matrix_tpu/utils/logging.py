@@ -143,10 +143,20 @@ def _acquire_lock(lock_path: str, attempts: int = 20,
         except FileExistsError:
             try:
                 if time.time() - os.path.getmtime(lock_path) > stale_s:
-                    os.unlink(lock_path)
-                    continue
+                    # Claim the stale lock by atomic rename: exactly one
+                    # contender wins (unlinking in place would race —
+                    # a second contender could remove the winner's
+                    # *fresh* lock).  Losers fall through to backoff.
+                    claimed = f"{lock_path}.stale.{uuid.uuid4().hex}"
+                    try:
+                        os.rename(lock_path, claimed)
+                    except OSError:
+                        pass
+                    else:
+                        os.unlink(claimed)
+                        continue
             except OSError:
-                continue  # holder released it between the checks
+                pass  # holder released it between the checks
             time.sleep(delay * (1.0 + random.random()))
             delay = min(delay * 2, 5.0)
     return False

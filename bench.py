@@ -40,7 +40,8 @@ def main() -> None:
     t0 = time.perf_counter()
     a = barabasi_albert(n, m, seed=7)
     levels = arrow_decomposition(a, arrow_width=width, max_levels=2,
-                                 block_diagonal=True, seed=7)
+                                 block_diagonal=True, seed=7,
+                                 backend="auto")
     t_decomp = time.perf_counter() - t0
 
     multi = MultiLevelArrow(levels, width, mesh=None)
@@ -54,19 +55,23 @@ def main() -> None:
         xb = decomposition_spmm(levels, xb)
     scipy_ms = (time.perf_counter() - t0) / iters * 1e3
 
-    # --- Device path.
+    # --- Device path.  Timing protocol for remote/tunneled devices
+    # (e.g. the axon TPU relay): block_until_ready without a host fetch
+    # can return before the work is actually done, so each measurement
+    # chains the iterations and ends with a scalar host fetch (which
+    # cannot complete early), and the dispatch+fetch round-trip is
+    # measured separately and subtracted.
     x = multi.set_features(x_host)
-    y = multi.step(x)  # compile + warmup
-    jax.block_until_ready(y)
-    y = multi.step(x)
-    jax.block_until_ready(y)
 
-    t0 = time.perf_counter()
-    xd = x
-    for _ in range(iters):
-        xd = multi.step(xd)
-    jax.block_until_ready(xd)
-    tpu_ms = (time.perf_counter() - t0) / iters * 1e3
+    def chain(n: int) -> float:
+        t0 = time.perf_counter()
+        xd = multi.run(x, n) if n else x
+        float(np.asarray(xd[0, 0]))  # forced host fetch
+        return time.perf_counter() - t0
+
+    chain(iters)  # compile + warmup at the benchmark length
+    rtt = min(chain(0) for _ in range(3))  # dispatch+fetch round-trip
+    tpu_ms = max((chain(iters) - rtt) / iters, 1e-9) * 1e3
 
     # --- Correctness gate: one device step vs the scipy golden.
     got = multi.gather_result(multi.step(x))

@@ -92,11 +92,15 @@ def test_spmm_petsc_dryrun_and_slices(tmp_path, monkeypatch):
     assert rc == 0
 
 
-def test_log_upload_marks_and_lists(tmp_path):
+def test_log_upload_marks_and_lists(tmp_path, monkeypatch):
     # A run written by the benchmark CLIs is discovered; without wandb
     # it stays pending (no .logged marker), and empty runs are skipped
-    # (reference wb_logging.py:135-160 semantics).
+    # (reference wb_logging.py:135-160 semantics).  wandb is forced
+    # absent so the test never performs real uploads.
     import json
+    import sys
+
+    monkeypatch.setitem(sys.modules, "wandb", None)
 
     from arrow_matrix_tpu.cli import log_upload
     from arrow_matrix_tpu.utils.logging import log_local_runs
