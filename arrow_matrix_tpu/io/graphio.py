@@ -217,6 +217,62 @@ def as_levels(loaded: List[Tuple[CsrLike, Optional[np.ndarray]]],
     return levels
 
 
+def convert_decomposition(base: str, width: Optional[int] = None,
+                          block_diagonal: bool = True,
+                          to: str = "npy") -> int:
+    """Convert a stored decomposition between the legacy single-file
+    ``.npz`` scheme and the npy-triplet scheme (reference
+    convert_decomposition, graphio.py:317-358).
+
+    ``to="npy"`` reads npz levels and writes triplets; ``to="npz"`` the
+    reverse.  Returns the number of levels converted.  Conversion is
+    per-level streaming (one level resident at a time), matching the
+    reference's memory behavior.
+    """
+    if to not in ("npy", "npz"):
+        raise ValueError(f"unknown target format {to!r}")
+    n_levels = 0
+    i = 0
+    while True:
+        src_kind = FileKind.npz if to == "npy" else FileKind.indptr
+        if not os.path.exists(format_path(base, width, i, block_diagonal,
+                                          src_kind)):
+            break
+        if to == "npy":
+            m = sparse.load_npz(format_path(base, width, i, block_diagonal,
+                                            FileKind.npz)).tocsr()
+            m.sum_duplicates()
+            m.sort_indices()
+            np.save(format_path(base, width, i, block_diagonal,
+                                FileKind.indptr), m.indptr)
+            np.save(format_path(base, width, i, block_diagonal,
+                                FileKind.indices), m.indices)
+            np.save(format_path(base, width, i, block_diagonal,
+                                FileKind.data), m.data)
+        else:
+            indptr = np.load(format_path(base, width, i, block_diagonal,
+                                         FileKind.indptr))
+            indices = np.load(format_path(base, width, i, block_diagonal,
+                                          FileKind.indices))
+            p_data = format_path(base, width, i, block_diagonal,
+                                 FileKind.data)
+            data = (np.load(p_data) if os.path.exists(p_data)
+                    else np.ones(indices.size, dtype=np.float32))
+            n = indptr.size - 1
+            sparse.save_npz(format_path(base, width, i, block_diagonal,
+                                        FileKind.npz),
+                            sparse.csr_matrix((data, indices, indptr),
+                                              shape=(n, n)))
+        # Permutations share one file name across both schemes.
+        n_levels += 1
+        i += 1
+    if n_levels == 0:
+        raise FileNotFoundError(
+            f"no decomposition found for base={base!r} width={width} in "
+            f"the {'npz' if to == 'npy' else 'npy-triplet'} scheme")
+    return n_levels
+
+
 def num_rows(matrix: CsrLike) -> int:
     if isinstance(matrix, sparse.csr_matrix):
         return matrix.shape[0]

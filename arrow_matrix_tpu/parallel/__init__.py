@@ -25,6 +25,8 @@ Modules:
 """
 
 from arrow_matrix_tpu.parallel.mesh import (
+    initialize_multihost,
+    make_hybrid_mesh,
     make_mesh,
     shard_blocked,
     blocks_sharding,

@@ -65,3 +65,44 @@ def shard_arrow_blocks(blocks, mesh: Mesh, axis: str = "blocks"):
 def pad_to_multiple(nb: int, n_dev: int) -> int:
     """Smallest block count >= nb divisible by the device count."""
     return -(-nb // n_dev) * n_dev
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> int:
+    """Join a multi-host JAX runtime (the framework's scale-out story;
+    the counterpart of the reference's MPI launch across nodes,
+    reference README.md:10 Cray-MPICH).
+
+    After this, `jax.devices()` spans every host's chips and the same
+    single-SPMD-program code runs unchanged — collectives ride ICI
+    within a slice and DCN across slices.  On TPU pods the arguments
+    are auto-detected from the environment; pass them explicitly for
+    CPU/GPU clusters.  Returns this process's index.
+    """
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_index()
+
+
+def make_hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
+                     axis_names: Sequence[str]) -> Mesh:
+    """Mesh whose leading axes span slices over DCN and trailing axes
+    span chips over ICI (via `mesh_utils.create_hybrid_device_mesh`).
+
+    Lay out shardings so the high-volume exchanges (block axis psum /
+    ppermute) map to ICI axes and only the low-volume ones cross DCN —
+    the mesh-axis analog of the reference's node-local vs inter-node
+    communicator split.  Falls back to a plain mesh when there is a
+    single granule (e.g. single-host testing).
+    """
+    from jax.experimental import mesh_utils
+
+    if int(np.prod(dcn_shape)) == 1:
+        return make_mesh(tuple(ici_shape), tuple(axis_names))
+    devs = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape), tuple(dcn_shape))
+    return Mesh(devs, tuple(axis_names))

@@ -174,3 +174,42 @@ def test_memmap_missing_data_stays_lazy(tmp_path):
     # as_levels also materializes ones.
     lvls = as_levels(loaded, 20)
     assert np.all(lvls[0].matrix.data == 1.0)
+
+
+def test_convert_decomposition_roundtrip(tmp_path):
+    """npz -> npy triplet -> npz round trip (reference
+    convert_decomposition, graphio.py:317-358)."""
+    from arrow_matrix_tpu.io import (
+        as_levels,
+        convert_decomposition,
+        load_decomposition,
+        save_decomposition_npz,
+    )
+    from arrow_matrix_tpu.decomposition import (
+        arrow_decomposition,
+        decomposition_spmm,
+    )
+    from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+    a = barabasi_albert(200, 3, seed=2)
+    levels = arrow_decomposition(a, 32, max_levels=3, block_diagonal=True,
+                                 seed=0)
+    base = str(tmp_path / "conv")
+    width0 = levels[0].arrow_width
+    save_decomposition_npz(levels, base)
+
+    n = convert_decomposition(base, width0, to="npy")
+    assert n == len(levels)
+    loaded = as_levels(load_decomposition(base, width0), width0)
+    x = random_dense(200, 4, seed=1)
+    np.testing.assert_allclose(decomposition_spmm(loaded, x),
+                               decomposition_spmm(levels, x),
+                               rtol=1e-5, atol=1e-5)
+
+    # Reverse direction rewrites identical npz levels.
+    assert convert_decomposition(base, width0, to="npz") == n
+
+    with pytest.raises(FileNotFoundError):
+        convert_decomposition(str(tmp_path / "missing"), 32, to="npy")
+    with pytest.raises(ValueError):
+        convert_decomposition(base, width0, to="parquet")

@@ -253,3 +253,10 @@ def test_wide_requires_two_arms():
     blocks = arrow_blocks_from_csr(_arrow_csr(4, 8, False, seed=1), 8)
     with pytest.raises(ValueError):
         make_wide_spmm(blocks, bad_mesh)
+
+
+def test_hybrid_mesh_single_granule_fallback():
+    from arrow_matrix_tpu.parallel.mesh import make_hybrid_mesh
+
+    m = make_hybrid_mesh((8,), (1,), ("blocks",))
+    assert m.shape["blocks"] == 8
