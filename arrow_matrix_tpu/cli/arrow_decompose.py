@@ -74,8 +74,16 @@ def decompose_one(path: str, args: argparse.Namespace) -> None:
     os.makedirs(out_dir, exist_ok=True)
     base = os.path.join(out_dir, base_name)
 
+    # The cache is only honored when --save_input_graph opted into it,
+    # and only while it is at least as new as the source file (a stale
+    # pickle must never silently replace an updated input graph; pickle
+    # is also an arbitrary-code-execution format, so loading one the
+    # user never asked to create is not acceptable).
     cache = base + ".pickle"
-    if os.path.exists(cache):
+    cache_fresh = (args.save_input_graph and os.path.exists(cache)
+                   and (not os.path.exists(path)
+                        or os.path.getmtime(cache) >= os.path.getmtime(path)))
+    if cache_fresh:
         print(f"loading cached graph {cache}")
         with open(cache, "rb") as f:
             a = pickle.load(f)

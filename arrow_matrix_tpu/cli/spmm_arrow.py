@@ -170,12 +170,20 @@ def main(argv=None) -> int:
             fail = True
             break
         if args.validate:
+            from arrow_matrix_tpu.utils import numerics
+
             got = multi.gather_result(y)
             want = decomposition_spmm(levels, x_host)
-            err = np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-30)
+            err = numerics.relative_error(got, want)
+            # One step separates the compared states (X is fresh per
+            # iteration); tolerance per the documented accumulation-
+            # order policy (utils/numerics.py).
+            tol = numerics.relative_tolerance(
+                sum(l.matrix.nnz for l in levels) / max(n, 1), iters=1)
             wb.log({"frobenius_err": float(err)})
-            print(f"iteration {it}: rel err vs host {err:.3e}")
-            if not np.isfinite(err) or err > 1e-4:
+            print(f"iteration {it}: rel err vs host {err:.3e} "
+                  f"(gate {tol:.1e})")
+            if not np.isfinite(err) or err > tol:
                 fail = True
                 break
 

@@ -70,8 +70,12 @@ def _load() -> ctypes.CDLL | None:
         if _lib is not None or _load_error is not None:
             return _lib
         try:
+            # <= not <: a source edit landing within the filesystem's
+            # timestamp granularity of the build must still trigger a
+            # rebuild (a fresh build always stamps the library strictly
+            # newer than the source it came from).
             stale = (not os.path.exists(_LIB_PATH)
-                     or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+                     or os.path.getmtime(_LIB_PATH) <= os.path.getmtime(_SRC))
             if stale:
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)

@@ -97,6 +97,29 @@ def ell_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
     return cols, data
 
 
+def auto_chunk(rows: int, k: int, m: int, budget_bytes: int,
+               itemsize: int = 4) -> Optional[int]:
+    """Slot-chunk size bounding the ELL gather intermediate
+    (``rows × chunk × k`` elements) to ``budget_bytes``; ``None`` when
+    the whole slot axis fits.  The auto-sizing counterpart of the
+    reference's OOM-model GPU tiling
+    (reference arrow/baseline/spmm_petsc.py:323-395) — derive
+    ``budget_bytes`` from the live chip via
+    ``utils.platform.device_memory_budget``.
+    """
+    if m == 0 or rows <= 0 or k <= 0:
+        return None
+    if rows * m * k * itemsize <= budget_bytes:
+        return None
+    per_slot = rows * k * itemsize
+    # Align DOWN so the chunked intermediate stays under budget; the
+    # SLOT_ALIGN floor is the one allowed overshoot (a narrower chunk
+    # cannot be tiled).
+    c = int(budget_bytes // per_slot)
+    c = max(c - c % SLOT_ALIGN, SLOT_ALIGN)
+    return None if c >= m else c
+
+
 def ell_spmm(cols: jax.Array, data: jax.Array, x: jax.Array,
              chunk: Optional[int] = None) -> jax.Array:
     """out[r] = sum_j data[r, j] * x[cols[r, j], :].

@@ -1,0 +1,255 @@
+"""Space-shared multi-matrix execution: K levels on disjoint device groups.
+
+The TPU-native counterpart of the reference's signature runtime
+structure — the K arrow matrices of one decomposition running
+*concurrently* on disjoint MPI rank groups, exchanging features forward
+and partial results backward through permutation-routed Alltoallv
+exchanges every iteration (reference arrow/arrow_dec_mpi.py:106-177,
+210-281, 404-550).  The sibling ``MultiLevelArrow`` implements the
+time-shared alternative (all devices sweep the levels sequentially);
+this module implements the space-shared one so the two can be raced
+(SURVEY.md §7.5 asked for both).
+
+Mapping to SPMD:
+
+* the disjoint rank groups become a 2-D mesh ``("lvl", "blocks")`` —
+  ``lvl`` has one slice per level (the reference's per-matrix
+  ``Comm.Create`` groups, arrow_dec_mpi.py:140-165), ``blocks`` is the
+  slim block-row axis within each group;
+* every per-level array gains a leading level axis sharded over
+  ``lvl``; the per-level SpMM is *batched* over that axis, so XLA
+  executes all levels concurrently, each on its own device group —
+  space sharing without any rank-state machine;
+* the reference's K-1 step *chain* of backward aggregation hops
+  (matrix i ships C_i to matrix i-1, arrow_dec_mpi.py:404-440) is
+  algebraically collapsed: gathers compose, so level g's contribution
+  to the level-0 aggregate is one directly-composed static table
+  ``bwd0[g] = inv(sigma_g)[sigma_0]`` and the whole backward pass is a
+  single per-level gather + one sum over the ``lvl`` axis (an ICI
+  reduce across groups).  The forward propagation chain
+  (arrow_dec_mpi.py:507-550) likewise collapses to
+  ``fwd0[g] = inv(sigma_0)[sigma_g]`` applied to the aggregate.  K-1
+  sequential inter-group exchanges become 2 table-driven collective
+  rounds regardless of K.
+
+Uniform tiling: all levels are tiled at ONE shared block width (the
+largest level width, rounded up to a multiple of the base width) in
+banded mode — banded tiling at width W covers every entry with
+|r-c| <= w_i <= W plus the head/column arms, so every level fits the
+same (K, nb, w, ...) stacked layout (verified structurally by the
+nnz-capture check at construction).  The cost is extra ELL padding for
+narrow levels; the benefit is one static SPMD program over the whole
+decomposition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from arrow_matrix_tpu.decomposition.decompose import ArrowLevel
+from arrow_matrix_tpu.io.graphio import number_of_blocks, num_rows
+from arrow_matrix_tpu.ops.arrow_blocks import (
+    ArrowBlocks,
+    arrow_blocks_from_csr,
+    arrow_spmm,
+)
+from arrow_matrix_tpu.parallel.mesh import make_mesh, pad_to_multiple
+from arrow_matrix_tpu.parallel.multi_level import pad_permutation
+
+
+def stack_arrow_blocks(blocks_list: List[ArrowBlocks]) -> ArrowBlocks:
+    """Stack per-level ArrowBlocks into one pytree with a leading level
+    axis, padding each ELL slot axis to the max across levels (levels
+    have independent slot budgets; the stacked layout needs one)."""
+    first = blocks_list[0]
+    out = {}
+    for f in dataclasses.fields(first):
+        vals = [getattr(b, f.name) for b in blocks_list]
+        if not isinstance(vals[0], (jax.Array, np.ndarray)):
+            if any(v != vals[0] for v in vals):
+                raise ValueError(
+                    f"levels disagree on static field {f.name!r}: {vals}")
+            out[f.name] = vals[0]
+            continue
+        m = max(v.shape[-1] for v in vals)
+        padded = [np.pad(np.asarray(v),
+                         [(0, 0)] * (v.ndim - 1) + [(0, m - v.shape[-1])])
+                  for v in vals]
+        out[f.name] = jnp.asarray(np.stack(padded))
+    return ArrowBlocks(**out)
+
+
+class SpaceSharedArrow:
+    """K decomposition levels running concurrently on disjoint device
+    groups of a ("lvl", "blocks") mesh.
+
+    Same iteration semantics and feature API as ``MultiLevelArrow``
+    (X held in level-0 order between steps; ``step`` = forward
+    propagate, concurrent per-level SpMM, backward aggregate).
+    """
+
+    def __init__(self, levels: List[ArrowLevel], width: int,
+                 mesh: Optional[Mesh] = None,
+                 lvl_axis: str = "lvl", axis: str = "blocks",
+                 dtype=np.float32, fmt: str = "auto",
+                 dense_budget: Optional[int] = None,
+                 chunk="auto"):
+        if not levels:
+            raise ValueError("empty decomposition")
+        k_levels = len(levels)
+        if mesh is None:
+            # Default: one device group per level, all remaining
+            # parallelism on the block axis.
+            n_dev = len(jax.devices())
+            if n_dev % k_levels != 0:
+                raise ValueError(
+                    f"{n_dev} devices not divisible by {k_levels} levels; "
+                    f"pass an explicit mesh")
+            mesh = make_mesh((k_levels, n_dev // k_levels),
+                             (lvl_axis, axis))
+        if mesh.shape[lvl_axis] != k_levels:
+            raise ValueError(
+                f"mesh axis {lvl_axis!r} has size {mesh.shape[lvl_axis]}, "
+                f"need one slice per level ({k_levels})")
+        self.mesh = mesh
+        self.lvl_axis = lvl_axis
+        self.axis = axis
+        self.k_levels = k_levels
+        self.n = num_rows(levels[0].matrix)
+
+        # One uniform banded block width >= every level's achieved width
+        # (see module docstring).
+        w = max(width, *(lvl.arrow_width for lvl in levels))
+        w = -(-w // width) * width
+        self.width = w
+
+        n_dev_blocks = mesh.shape[axis]
+        unit = n_dev_blocks * w
+        max_rows = max(number_of_blocks(lvl.matrix, w) * w
+                       for lvl in levels)
+        self.total_rows = pad_to_multiple(max_rows, unit)
+        nb = self.total_rows // w
+
+        if dense_budget is None:
+            # One chip's budget per device: the stacked blocks shard
+            # over BOTH mesh axes (level groups x block rows).
+            from arrow_matrix_tpu.utils.platform import device_memory_budget
+
+            dense_budget = (device_memory_budget(mesh.devices.flat[0])
+                            * k_levels * n_dev_blocks)
+        if fmt == "auto":
+            # 5 stacked banded structural blocks per level, all levels
+            # resident simultaneously.
+            dense_bytes = (k_levels * self.total_rows * w * 5
+                           * np.dtype(dtype).itemsize)
+            fmt = "dense" if dense_bytes <= dense_budget else "ell"
+        self.fmt = fmt
+        self.chunk = chunk
+
+        per_level = [
+            arrow_blocks_from_csr(lvl.matrix, w, pad_blocks_to=nb,
+                                  banded=True, dtype=dtype, fmt=fmt)
+            for lvl in levels
+        ]
+        blocks = stack_arrow_blocks(per_level)
+
+        # Directly-composed routing tables (module docstring): row j of
+        # the level-0 layout carries original row sigma_0[j]; in level
+        # g's layout that row sits at position inv(sigma_g)[sigma_0[j]].
+        perms = [pad_permutation(np.asarray(lvl.permutation),
+                                 self.total_rows) for lvl in levels]
+        self.perm0 = perms[0]
+        self.inv_perm0 = np.argsort(self.perm0)
+        invs = [np.argsort(p) for p in perms]
+        bwd0 = np.stack([invs[g][perms[0]] for g in range(k_levels)])
+        fwd0 = np.stack([invs[0][perms[g]] for g in range(k_levels)])
+
+        lvl_rows = NamedSharding(mesh, P(lvl_axis, axis))
+        lvl_only = NamedSharding(mesh, P(lvl_axis))
+        self.blocks = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, lvl_rows), blocks)
+        self.bwd0 = jax.device_put(bwd0.astype(np.int32), lvl_only)
+        self.fwd0 = jax.device_put(fwd0.astype(np.int32), lvl_only)
+
+        gather_budget = max(dense_budget // 4, 1 << 27)
+        self._step = jax.jit(functools.partial(
+            space_shared_spmm, width=w, chunk=chunk,
+            gather_budget=gather_budget))
+
+        def scan_steps(x_all, bwd0, fwd0, blocks, n):
+            def body(xc, _):
+                return space_shared_spmm(xc, bwd0, fwd0, blocks,
+                                         width=w, chunk=chunk,
+                                         gather_budget=gather_budget), None
+
+            out, _ = jax.lax.scan(body, x_all, None, length=n)
+            return out
+
+        self._scan_steps = jax.jit(scan_steps, static_argnames=("n",))
+
+    # -- feature placement (MultiLevelArrow-compatible surface) ----------
+
+    def set_features(self, x_original: np.ndarray) -> jax.Array:
+        """Host (n, k) features in original row order -> (K, total, k)
+        device array, level g's slice in level-g order (the reference
+        forward-propagates X to every matrix before the first compute;
+        here each group materializes its own ordering up front)."""
+        n, k = x_original.shape
+        if n != self.n:
+            raise ValueError(f"expected {self.n} rows, got {n}")
+        padded = np.zeros((self.total_rows, k), dtype=x_original.dtype)
+        padded[:n] = x_original
+        x0 = padded[self.perm0]
+        x_all = x0[np.asarray(self.fwd0)]          # (K, total, k)
+        return jax.device_put(
+            x_all, NamedSharding(self.mesh, P(self.lvl_axis, self.axis)))
+
+    def gather_result(self, x_all: jax.Array) -> np.ndarray:
+        """(K, total, k) device result -> host (n, k) in original row
+        order (level 0's slice IS the canonical aggregate)."""
+        return np.asarray(x_all[0])[self.inv_perm0][:self.n]
+
+    def step(self, x_all: jax.Array) -> jax.Array:
+        return self._step(x_all, self.bwd0, self.fwd0, self.blocks)
+
+    def run(self, x_all: jax.Array, iterations: int) -> jax.Array:
+        return self._scan_steps(x_all, self.bwd0, self.fwd0, self.blocks,
+                                n=iterations)
+
+
+def space_shared_spmm(x_all: jax.Array, bwd0: jax.Array, fwd0: jax.Array,
+                      blocks: ArrowBlocks, width: int,
+                      chunk="auto",
+                      gather_budget: int = 1 << 30) -> jax.Array:
+    """One space-shared iteration ``X := A @ X`` (jitted).
+
+    x_all: (K, total, k), level g's features in level-g order.
+    Compute is batched over the level axis (each mesh group runs its
+    own level); the backward chain is one composed gather per level +
+    a sum over the level axis; the forward chain is one gather of the
+    aggregate per level.
+    """
+    from arrow_matrix_tpu.parallel.multi_level import resolve_chunk
+
+    k_lvls, total, k = x_all.shape
+    # The stacked blocks share one slot budget (slot axis is last, so
+    # the leading level axis doesn't change the static computation).
+    chunk = resolve_chunk(chunk, blocks, total, k, gather_budget)
+    xb = x_all.reshape(k_lvls, total // width, width, k)
+    c = jax.vmap(lambda b, x: arrow_spmm(b, x, chunk=chunk))(blocks, xb)
+    c = c.reshape(k_lvls, total, k)
+    # Each level reorders its partial into level-0 order (all_to_all
+    # within the group), then the aggregate is a reduce across groups
+    # (the collapsed backward-aggregation chain).
+    c0 = jnp.take_along_axis(c, bwd0[:, :, None], axis=1)
+    agg = c0.sum(axis=0)                            # (total, k)
+    # Forward propagation for the next iteration: every level gathers
+    # the aggregate into its own ordering.
+    return jnp.take(agg, fwd0, axis=0)              # (K, total, k)

@@ -198,16 +198,26 @@ def log_local_runs(log_dir: str = "./logs") -> list[str]:
         if not _acquire_lock(lock):
             print(f"could not acquire wandb lock for {base}; retry later")
             continue
-        handled.append(base)
         try:
-            wandb.init(project="spmm-tpu", name=run["algorithm"],
-                       config=run.get("config", {}),
-                       tags=[run["algorithm"], run["dataset"]])
-            for item in run["entries"]:
-                wandb.log(item)
-            wandb.finish()
+            # One run's upload failure must not abort the remaining
+            # runs; it stays un-marked so the next invocation retries.
+            try:
+                wandb.init(project="spmm-tpu", name=run["algorithm"],
+                           config=run.get("config", {}),
+                           tags=[run["algorithm"], run["dataset"]])
+                for item in run["entries"]:
+                    wandb.log(item)
+            except Exception as e:
+                print(f"upload failed for {base}: {e}")
+                continue
+            finally:
+                try:
+                    wandb.finish()
+                except Exception:
+                    pass
             with open(indicator, "w"):
                 pass
+            handled.append(base)
         finally:
             os.unlink(lock)
     return handled

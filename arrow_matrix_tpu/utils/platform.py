@@ -10,6 +10,21 @@ dropped when CPU is requested.
 from __future__ import annotations
 
 import os
+import re
+import warnings
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def backend_initialized() -> bool:
+    """True once any JAX backend has been created (after which platform
+    pinning is a no-op and device counts are fixed)."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        return bool(_xb._backends)
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
 
 
 def force_cpu_devices(n_devices: int | None = None) -> None:
@@ -23,10 +38,18 @@ def force_cpu_devices(n_devices: int | None = None) -> None:
     """
     if n_devices is not None:
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
+        m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+        if m is None:
             os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n_devices}"
-            ).strip()
+                flags + f" {_COUNT_FLAG}={n_devices}").strip()
+        elif int(m.group(1)) != n_devices:
+            # An inherited flag must not silently override the requested
+            # count (a CLI asked for N devices and should get N).
+            warnings.warn(
+                f"XLA_FLAGS already pins {m.group(1)} host devices; "
+                f"replacing with the requested {n_devices}")
+            os.environ["XLA_FLAGS"] = re.sub(
+                rf"{_COUNT_FLAG}=\d+", f"{_COUNT_FLAG}={n_devices}", flags)
     os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -38,3 +61,36 @@ def force_cpu_devices(n_devices: int | None = None) -> None:
     except Exception:  # pragma: no cover - jax internals moved; harmless
         pass
     jax.config.update("jax_platforms", "cpu")
+
+
+def device_memory_budget(device=None, fraction: float = 0.5,
+                         default: int = 4 << 30) -> int:
+    """Bytes available for resident block storage on ``device``, derived
+    from the live chip instead of a constant (a v5e has 16G HBM, a v5p
+    95G — one hardcoded budget misformats on both).
+
+    Uses PJRT ``memory_stats`` (free = limit − in_use) when the backend
+    reports it; on CPU falls back to available host RAM; ``default``
+    only when neither is known.  ``fraction`` leaves headroom for
+    features, collectives buffers, and XLA scratch.
+    """
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    try:
+        stats = dev.memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit")
+        if limit:
+            free = int(limit) - int(stats.get("bytes_in_use", 0))
+            return max(int(free * fraction), 0)
+    except Exception:
+        pass
+    if dev.platform == "cpu":
+        try:
+            free = (os.sysconf("SC_AVPHYS_PAGES")
+                    * os.sysconf("SC_PAGE_SIZE"))
+            return max(int(free * fraction), 0)
+        except (ValueError, OSError, AttributeError):
+            pass
+    return default

@@ -3,29 +3,111 @@
 Measures the reference's headline quantity — wall-clock `spmm_time` per
 iteration of ``X := A @ X`` through a full arrow decomposition
 (reference arrow/arrow_bench.py:111-134, protocol in BASELINE.md) — on
-the available accelerator, and compares against the same iterated SpMM
-via scipy CSR on the host CPU (the reference's CPU kernel,
-SURVEY.md §2 "Device kernel bridge").
+the available accelerator at protocol scale (>=1M rows, BASELINE.md
+configs), and compares against the same iterated SpMM via scipy CSR on
+the host CPU (the reference's CPU kernel, SURVEY.md §2 "Device kernel
+bridge").
 
-Prints ONE JSON line:
-  {"metric": "spmm_iter_ms", "value": <tpu ms/iter>, "unit": "ms",
-   "vs_baseline": <scipy_ms / tpu_ms>, ...extra diagnostics}
+Robustness contract (round-1 postmortem): the accelerator backend is
+probed in a *subprocess with a timeout* — a hung PJRT plugin (e.g. an
+unreachable TPU tunnel) must degrade to a diagnosable CPU run, not hang
+or crash the bench — and exactly ONE JSON line is always printed, with
+an "error" field when anything failed:
+
+  {"metric": "spmm_iter_ms", "value": N, "unit": "ms",
+   "vs_baseline": scipy_ms / device_ms, ...diagnostics}
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+# Peak HBM bandwidth (GB/s) by TPU generation, for the bandwidth
+# roofline (public figures; the iterated SpMM is bandwidth-bound: each
+# iteration streams the resident blocks once).
+PEAK_HBM_GBPS = {
+    "v6": 1640.0,
+    "v5p": 2765.0,
+    "v5e": 819.0,
+    "v5lite": 819.0,   # v5e reports device_kind "TPU v5 lite"
+    "v4": 1228.0,
+    "v3": 900.0,
+    "v2": 700.0,
+}
 
-def main() -> None:
+
+def _peak_bw(device_kind: str) -> float | None:
+    kind = device_kind.lower().replace(" ", "")
+    for key, bw in PEAK_HBM_GBPS.items():
+        if key in kind:
+            return bw
+    return None
+
+
+def probe_backend(timeout_s: float = 60.0, retries: int = 2
+                  ) -> tuple[str, str | None]:
+    """Initialize-check the default JAX backend in a subprocess.
+
+    Returns (platform, error).  On repeated failure (nonzero rc *or
+    hang* — the round-1 failure mode was `jax.devices()` hanging inside
+    the site-registered TPU tunnel plugin) pins ``JAX_PLATFORMS=cpu``
+    in this process and reports the last error so the bench still
+    produces a measurement, flagged as degraded.
+    """
+    code = "import jax; print(jax.devices()[0].platform)"
+    err = None
+    for attempt in range(retries):
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=timeout_s)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return proc.stdout.split()[-1], None
+            err = (f"backend probe rc={proc.returncode}: "
+                   f"{proc.stderr.strip()[-400:]}")
+        except subprocess.TimeoutExpired:
+            err = (f"backend probe timed out after {timeout_s:.0f}s "
+                   f"(PJRT plugin init hang)")
+        if attempt < retries - 1:
+            time.sleep(min(5.0 * 2 ** attempt, 30.0))
+    # JAX_PLATFORMS=cpu alone does NOT stop a site-registered plugin
+    # from initializing (and hanging) at the first backend access —
+    # force_cpu_devices also drops the plugin's backend factory.
+    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices()
+    return "cpu", err
+
+
+def _measure(multi, x, iters: int) -> float:
+    """ms/iter via chained on-device iteration (`lax.scan`) ending in a
+    scalar host fetch, with the dispatch+fetch round-trip subtracted —
+    block_until_ready alone can return early over remote/tunneled
+    devices, a host fetch cannot."""
+    def chain(n: int) -> float:
+        t0 = time.perf_counter()
+        xd = multi.run(x, n) if n else x
+        float(np.asarray(xd[0, 0]))
+        return time.perf_counter() - t0
+
+    chain(iters)  # compile + warmup at the benchmark length
+    rtt = min(chain(0) for _ in range(3))
+    return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
+
+
+def run_bench(result: dict) -> None:
     import jax
 
     # Full-f32 matmul passes: the correctness gate is parity with the
-    # host CPU result (BASELINE.md north star); the default TPU bf16-pass
-    # matmul costs ~1e-3 relative error for ~10% speed.
+    # host CPU result (BASELINE.md north star + the accumulation-order
+    # policy in utils/numerics.py); the default TPU bf16-pass matmul
+    # costs ~1e-3 relative error for ~10% speed.
     jax.config.update("jax_default_matmul_precision", "highest")
 
     from arrow_matrix_tpu.decomposition.decompose import (
@@ -33,75 +115,156 @@ def main() -> None:
         decomposition_spmm,
     )
     from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
+    from arrow_matrix_tpu.utils import numerics
     from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense
+    from arrow_matrix_tpu.utils.platform import device_memory_budget
 
-    n, m, width, k, iters = 65536, 8, 2048, 16, 10
+    dev = jax.devices()[0]
+    # On a CPU fallback (accelerator unreachable or absent) the point is
+    # a diagnosable measurement, not protocol numbers: drop to smoke
+    # scale with the cheap-to-pack ELL format so the bench finishes in
+    # seconds on one host core.  AMT_BENCH_FULL=1 overrides.
+    degraded = (dev.platform == "cpu"
+                and os.environ.get("AMT_BENCH_FULL") != "1")
+    small = degraded or os.environ.get("AMT_BENCH_SMALL") == "1"
+    # Protocol scale (BASELINE.md: >=1M rows, features 16, 10 iters).
+    if small:
+        n, m, width, k, iters = 32768, 8, 1024, 16, 5
+        fmt = "ell"
+    else:
+        n, m, width, k, iters = 1 << 20, 8, 2048, 16, 10
+        fmt = "auto"
+    n = int(os.environ.get("AMT_BENCH_N", n))
+
+    budget = device_memory_budget(dev)
+    result["config"] = {"n": n, "width": width, "features": k,
+                        "iterations": iters, "ba_neighbors": m,
+                        "dense_budget_gb": round(budget / 2**30, 2)}
+    result["platform"] = dev.platform
+    result["device_kind"] = dev.device_kind
+    if degraded:
+        result["degraded"] = True
 
     t0 = time.perf_counter()
     a = barabasi_albert(n, m, seed=7)
-    levels = arrow_decomposition(a, arrow_width=width, max_levels=2,
+    levels = arrow_decomposition(a, arrow_width=width, max_levels=4,
                                  block_diagonal=True, seed=7,
                                  backend="auto")
-    t_decomp = time.perf_counter() - t0
+    result["config"]["decompose_s"] = round(time.perf_counter() - t0, 2)
 
-    multi = MultiLevelArrow(levels, width, mesh=None)
+    t0 = time.perf_counter()
+    multi = MultiLevelArrow(levels, width, mesh=None, fmt=fmt,
+                            dense_budget=budget)
+    result["config"]["build_s"] = round(time.perf_counter() - t0, 2)
+    result["config"]["levels"] = len(levels)
+    result["config"]["fmts"] = list(multi.fmts)
+    nnz = sum(int(l.matrix.nnz) for l in levels)
+    result["config"]["edges_nnz"] = nnz
+
     x_host = random_dense(n, k, seed=3)
 
     # --- Host CPU baseline: scipy CSR through the decomposition (the
     # reference's CPU path: per-level CSRMM + permutations).
+    base_iters = 3 if n > (1 << 18) else iters
     xb = x_host.copy()
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for _ in range(base_iters):
         xb = decomposition_spmm(levels, xb)
-    scipy_ms = (time.perf_counter() - t0) / iters * 1e3
+    scipy_ms = (time.perf_counter() - t0) / base_iters * 1e3
 
-    # --- Device path.  Timing protocol for remote/tunneled devices
-    # (e.g. the axon TPU relay): block_until_ready without a host fetch
-    # can return before the work is actually done, so each measurement
-    # chains the iterations and ends with a scalar host fetch (which
-    # cannot complete early), and the dispatch+fetch round-trip is
-    # measured separately and subtracted.
+    # --- Device path.
     x = multi.set_features(x_host)
+    dev_ms = _measure(multi, x, iters)
 
-    def chain(n: int) -> float:
-        t0 = time.perf_counter()
-        xd = multi.run(x, n) if n else x
-        float(np.asarray(xd[0, 0]))  # forced host fetch
-        return time.perf_counter() - t0
-
-    chain(iters)  # compile + warmup at the benchmark length
-    rtt = min(chain(0) for _ in range(3))  # dispatch+fetch round-trip
-    tpu_ms = max((chain(iters) - rtt) / iters, 1e-9) * 1e3
-
-    # --- Correctness gate: one device step vs the scipy golden.
+    # --- Correctness gate: one device step vs the scipy golden, at the
+    # documented accumulation-order tolerance (utils/numerics.py).
     got = multi.gather_result(multi.step(x))
     want = decomposition_spmm(levels, x_host)
-    err = float(np.linalg.norm(got - want) /
-                max(np.linalg.norm(want), 1e-30))
+    err = numerics.relative_error(got, want)
+    tol = numerics.relative_tolerance(nnz / max(n, 1), iters=1)
 
-    nnz = sum(int(l.matrix.nnz) for l in levels)
-    gflops = 2.0 * nnz * k / (tpu_ms * 1e-3) / 1e9
+    flops = 2.0 * nnz * k
+    # Bandwidth roofline: one iteration streams every resident block
+    # array once and reads+writes the feature array once per level
+    # (+ the routing gathers, ~2 more feature passes per level beyond
+    # the first).  This is the memory floor; achieved/floor bandwidth
+    # against the chip's peak is the MFU analog for a bandwidth-bound
+    # kernel.
+    block_bytes = sum(b.device_nbytes() for b in multi.blocks)
+    feat_bytes = multi.total_rows * k * 4
+    n_lvl = len(levels)
+    bytes_per_iter = block_bytes + feat_bytes * (2 * n_lvl
+                                                 + 2 * (n_lvl - 1))
+    achieved_gbps = bytes_per_iter / (dev_ms * 1e-3) / 1e9
+    peak = _peak_bw(dev.device_kind)
 
-    print(json.dumps({
-        "metric": "spmm_iter_ms",
-        "value": round(tpu_ms, 3),
-        "unit": "ms",
-        "vs_baseline": round(scipy_ms / tpu_ms, 3),
+    result.update({
+        "value": round(dev_ms, 3),
+        "vs_baseline": round(scipy_ms / dev_ms, 3),
         "scipy_cpu_ms": round(scipy_ms, 3),
-        "gflops": round(gflops, 2),
+        "gflops": round(flops / (dev_ms * 1e-3) / 1e9, 2),
         "frobenius_err_vs_cpu": err,
-        "platform": jax.devices()[0].platform,
-        "config": {"n": n, "edges_nnz": nnz, "width": width, "features": k,
-                   "iterations": iters, "levels": len(levels),
-                   "decompose_s": round(t_decomp, 2)},
-    }))
+        "frobenius_gate": tol,
+        "bytes_per_iter_gb": round(bytes_per_iter / 2**30, 3),
+        "achieved_gbps": round(achieved_gbps, 1),
+        "roofline_frac": (round(achieved_gbps / peak, 3)
+                          if peak else None),
+    })
 
-    # Enforce the correctness gate: a fast-but-wrong kernel must fail the
-    # bench, not report a headline speedup (the JSON line above is still
-    # emitted so the failure is diagnosable from the recorded output).
-    if not np.isfinite(err) or err > 1e-5:
-        raise SystemExit(f"correctness gate failed: frobenius err {err:.3e} "
-                         f"vs host CPU exceeds 1e-5")
+    if not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1":
+        try:
+            result["kernel_compare"] = kernel_compare()
+        except Exception as e:  # comparison is diagnostics, not the gate
+            result["kernel_compare"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if not np.isfinite(err) or err > tol:
+        raise RuntimeError(f"correctness gate failed: frobenius err "
+                           f"{err:.3e} vs host CPU exceeds {tol:.1e}")
+
+
+def kernel_compare() -> dict:
+    """ms/iter of the ELL, dense and Pallas block kernels on one
+    mid-size config (dense must fit): the data for VERDICT r1 item 6
+    (integrate Pallas or retire it with numbers)."""
+    from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition
+    from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense
+
+    n, m, width, k, iters = 65536, 8, 2048, 16, 10
+    a = barabasi_albert(n, m, seed=7)
+    levels = arrow_decomposition(a, arrow_width=width, max_levels=2,
+                                 block_diagonal=True, seed=7,
+                                 backend="auto")
+    x_host = random_dense(n, k, seed=3)
+
+    out = {"config": {"n": n, "width": width, "features": k}}
+    variants = [("ell", dict(fmt="ell")),
+                ("dense", dict(fmt="dense")),
+                ("pallas", dict(fmt="dense", kernel="pallas"))]
+    for name, kw in variants:
+        try:
+            multi = MultiLevelArrow(levels, width, mesh=None, **kw)
+            x = multi.set_features(x_host)
+            out[name + "_ms"] = round(_measure(multi, x, iters), 3)
+        except Exception as e:
+            out[name + "_ms"] = None
+            out[name + "_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def main() -> None:
+    result = {"metric": "spmm_iter_ms", "value": None, "unit": "ms",
+              "vs_baseline": None}
+    platform, probe_err = probe_backend()
+    if probe_err:
+        result["backend_probe_error"] = probe_err
+    try:
+        run_bench(result)
+    except BaseException as e:
+        result["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
+        raise SystemExit(1)
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

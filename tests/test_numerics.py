@@ -1,0 +1,50 @@
+"""Numerics policy (utils/numerics.py) and hardware-derived budgets
+(utils/platform.py)."""
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu.utils import numerics
+from arrow_matrix_tpu.utils.platform import (
+    device_memory_budget,
+    force_cpu_devices,
+)
+
+
+def test_tolerance_scales_with_terms_and_iters():
+    t1 = numerics.relative_tolerance(16, 1)
+    assert t1 == pytest.approx(64 * numerics.EPS_F32 * 4.0)
+    assert numerics.relative_tolerance(64, 1) == pytest.approx(2 * t1)
+    assert numerics.relative_tolerance(16, 10) == pytest.approx(10 * t1)
+    # Degenerate inputs clamp instead of vanishing.
+    assert numerics.relative_tolerance(0) > 0
+    assert numerics.relative_tolerance(1, 0) > 0
+
+
+def test_relative_error():
+    a = np.ones((4, 4), np.float32)
+    assert numerics.relative_error(a, a) == 0.0
+    assert numerics.relative_error(2 * a, a) == pytest.approx(1.0)
+    # Zero reference does not divide by zero.
+    assert np.isfinite(numerics.relative_error(a, np.zeros_like(a)))
+
+
+def test_device_memory_budget_positive():
+    # On the virtual-CPU test fixture this resolves via host RAM (or the
+    # backend's memory_stats); either way it must be a usable number.
+    budget = device_memory_budget()
+    assert budget > 0
+
+
+def test_force_cpu_devices_replaces_existing_count(monkeypatch):
+    import os
+
+    # The request must win over an inherited flag value (ADVICE r1).
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    with pytest.warns(UserWarning, match="replacing"):
+        force_cpu_devices(8)
+    assert "--xla_force_host_platform_device_count=8" in os.environ["XLA_FLAGS"]
+    # Same count: no warning, value untouched.
+    force_cpu_devices(8)
+    assert os.environ["XLA_FLAGS"].count("device_count") == 1
