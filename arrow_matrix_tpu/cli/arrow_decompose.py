@@ -80,9 +80,12 @@ def decompose_one(path: str, args: argparse.Namespace) -> None:
     # is also an arbitrary-code-execution format, so loading one the
     # user never asked to create is not acceptable).
     cache = base + ".pickle"
+    # Strict >: a source rewrite landing within the filesystem's
+    # timestamp granularity of the cache write must invalidate (same
+    # tie-break direction as the native-library staleness check).
     cache_fresh = (args.save_input_graph and os.path.exists(cache)
                    and (not os.path.exists(path)
-                        or os.path.getmtime(cache) >= os.path.getmtime(path)))
+                        or os.path.getmtime(cache) > os.path.getmtime(path)))
     if cache_fresh:
         print(f"loading cached graph {cache}")
         with open(cache, "rb") as f:

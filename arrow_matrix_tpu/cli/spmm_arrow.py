@@ -68,6 +68,27 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["auto", "dense", "ell"],
                         help="Device block format (TPU-specific: dense = "
                              "MXU batched matmuls, ell = gather path).")
+    parser.add_argument("--mode", type=str, default="time",
+                        choices=["time", "space"],
+                        help="Multi-matrix execution mode: 'time' sweeps "
+                             "the levels sequentially on the full mesh "
+                             "(MultiLevelArrow); 'space' runs them "
+                             "concurrently on disjoint device groups "
+                             "(SpaceSharedArrow — the reference's "
+                             "per-matrix rank groups, "
+                             "arrow_dec_mpi.py:106-177; needs the "
+                             "device count divisible by the level "
+                             "count).")
+    parser.add_argument("--routing", type=str, default="gather",
+                        choices=["gather", "a2a"],
+                        help="Inter-level exchange lowering (time-shared "
+                             "mode): 'gather' lets GSPMD lower the "
+                             "permutation gathers (may all-gather), "
+                             "'a2a' uses explicit precomputed "
+                             "send/recv tables over all_to_all "
+                             "(O(moved rows) volume; the reference's "
+                             "Alltoallv tables, "
+                             "arrow_dec_mpi.py:210-281).")
     parser.add_argument("--validate", type=str2bool, nargs="?",
                         default=False,
                         help="Compare each iteration against the host "
@@ -136,16 +157,37 @@ def main(argv=None) -> int:
     n = levels[0].matrix.shape[0]
 
     n_dev = len(jax.devices())
-    mesh = make_mesh((n_dev,), ("blocks",)) if n_dev > 1 else None
     # Version-string run name (reference arrow_bench.py:43-47 pattern),
     # derived from what actually runs: slim-style sharding, banded or
-    # block-diagonal tiling.
-    algo = f"ArrowTPU_v{'BlockDiagonal' if args.blocked else 'Banded'}_Slim"
+    # block-diagonal tiling, time- or space-shared level execution.
+    algo = (f"ArrowTPU_v{'BlockDiagonal' if args.blocked else 'Banded'}"
+            f"_Slim_{args.mode.capitalize()}Shared")
     wb.init(algo, os.path.basename(path), config=vars(args))
 
     with wb.segment("build_time"):
-        multi = MultiLevelArrow(levels, width, mesh=mesh,
-                                banded=not args.blocked, fmt=args.fmt)
+        if args.mode == "space":
+            from arrow_matrix_tpu.parallel.space_shared import (
+                SpaceSharedArrow,
+            )
+
+            if n_dev % len(levels) != 0:
+                raise SystemExit(
+                    f"--mode space needs the device count ({n_dev}) "
+                    f"divisible by the level count ({len(levels)}); "
+                    f"rerun with --devices set accordingly (the "
+                    f"reference's rank-budget validation analog, "
+                    f"arrow_bench.py:64-78)")
+            if args.routing != "gather":
+                print(f"warning: --routing {args.routing} applies only "
+                      f"to --mode time; space-shared exchanges are the "
+                      f"composed-gather + cross-group reduce")
+            multi = SpaceSharedArrow(levels, width, fmt=args.fmt)
+        else:
+            mesh = make_mesh((n_dev,), ("blocks",)) if n_dev > 1 else None
+            multi = MultiLevelArrow(levels, width, mesh=mesh,
+                                    banded=not args.blocked, fmt=args.fmt,
+                                    routing=(args.routing if mesh is not None
+                                             else "gather"))
 
     # Untimed warmup: trace + compile must not pollute iteration 0's
     # spmm_time (the sibling baseline CLIs warm up the same way).

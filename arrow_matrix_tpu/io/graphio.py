@@ -197,17 +197,22 @@ def _load_decomposition_npz(base, width, block_diagonal, with_permutation):
 
 
 def as_levels(loaded: List[Tuple[CsrLike, Optional[np.ndarray]]],
-              widths: Union[int, np.ndarray, List[int]]) -> List[ArrowLevel]:
-    """Wrap loader output (in-memory case) back into ArrowLevel objects.
+              widths: Union[int, np.ndarray, List[int]],
+              materialize: bool = True) -> List[ArrowLevel]:
+    """Wrap loader output back into ArrowLevel objects.
 
     ``widths`` is either one width for all levels or a per-level array
-    (see ``load_level_widths``).
+    (see ``load_level_widths``).  With ``materialize=False`` memmapped
+    CsrLike triplets stay triplets (host RSS O(touched blocks)); the
+    device builders (``arrow_blocks_from_csr`` / ``MultiLevelArrow``)
+    consume them block-by-block — the streaming-ingestion path for
+    matrices larger than host RAM (reference arrow_dec_mpi.py:629-887).
     """
     if np.isscalar(widths):
         widths = [int(widths)] * len(loaded)
     levels = []
     for (m, perm), w in zip(loaded, widths):
-        if not isinstance(m, sparse.csr_matrix):
+        if materialize and not isinstance(m, sparse.csr_matrix):
             n = m[2].size - 1
             data = (np.ones(np.asarray(m[1]).size, dtype=np.float32)
                     if m[0] is None else np.asarray(m[0]))

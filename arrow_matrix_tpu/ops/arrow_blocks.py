@@ -59,6 +59,12 @@ class ArrowBlocks:
     lo_data: Optional[jax.Array] = None
     hi_cols: Optional[jax.Array] = None
     hi_data: Optional[jax.Array] = None
+    # Flat-COO head (head_flat=True): head_rows/head_cols/head_data are
+    # (nb, B) per-block entry lists and the head SpMM is a scatter-add.
+    # The arrow head's rows are the pruned high-degree vertices, so ELL
+    # row padding there can blow up by orders of magnitude (measured
+    # 150x on a 400k-row Barabasi graph); flat packing is O(nnz).
+    head_rows: Optional[jax.Array] = None
 
     width: int = struct.field(pytree_node=False, default=0)
     n_blocks: int = struct.field(pytree_node=False, default=0)
@@ -68,6 +74,7 @@ class ArrowBlocks:
     # *_cols arrays are empty).  An arrow matrix has ~3 structural blocks
     # per block-row, so dense costs 3·n·w memory at n rows / width w.
     fmt: str = struct.field(pytree_node=False, default="ell")
+    head_flat: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def n_rows(self) -> int:
@@ -86,7 +93,8 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                           pad_blocks_to: Optional[int] = None,
                           dtype=np.float32,
                           check: bool = True,
-                          fmt: str = "ell") -> ArrowBlocks:
+                          fmt: str = "ell",
+                          head_fmt: str = "auto") -> ArrowBlocks:
     """Tile an arrow-shaped CSR (or memmapped triplet) into ArrowBlocks.
 
     Trailing all-zero rows beyond ``n_blocks * width`` are truncated
@@ -100,6 +108,11 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
     grew) would otherwise be silently mangled — the reference drops such
     nonzeros without any diagnostic.  Requires a canonical (duplicate-
     free) input, which this framework's loaders guarantee.
+
+    ``head_fmt`` governs the head stack under ``fmt="ell"``: "flat"
+    packs the head blocks as per-block flat-COO entry lists (O(nnz) —
+    immune to the head's skewed row degrees), "ell" keeps the uniform
+    ELL layout, "auto" picks flat whenever it is at least 4x smaller.
     """
     nb = n_blocks if n_blocks is not None else number_of_blocks(matrix, width)
     nb_padded = max(pad_blocks_to or nb, nb)
@@ -125,7 +138,16 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
     diag = [None] + [blk(i, i) if i < nb else None for i in range(1, nb_padded)]
     col = [None] + [blk(i, 0) if i < nb else None for i in range(1, nb_padded)]
 
-    head_cols, head_data = pack(head)
+    head_flat = fmt == "ell" and _choose_flat_head(head, width, dtype,
+                                                   head_fmt)
+    head_rows = None
+    if head_flat:
+        from arrow_matrix_tpu.ops.ell import flat_pack_stack
+
+        head_rows, head_cols, head_data = flat_pack_stack(
+            head, dtype=dtype, rows=width)
+    else:
+        head_cols, head_data = pack(head)
     diag_cols, diag_data = pack(diag)
     col_cols, col_data = pack(col)
 
@@ -156,7 +178,212 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
         head_cols=jnp.asarray(head_cols), head_data=jnp.asarray(head_data),
         diag_cols=jnp.asarray(diag_cols), diag_data=jnp.asarray(diag_data),
         col_cols=jnp.asarray(col_cols), col_data=jnp.asarray(col_data),
-        width=width, n_blocks=nb_padded, banded=banded, fmt=fmt, **kw)
+        head_rows=(jnp.asarray(head_rows) if head_rows is not None
+                   else None),
+        width=width, n_blocks=nb_padded, banded=banded, fmt=fmt,
+        head_flat=head_flat, **kw)
+
+
+def choose_flat_head_from_stats(nb: int, width: int, max_row_nnz: int,
+                                max_block_nnz: int, dtype,
+                                head_fmt: str) -> bool:
+    """One flat-vs-ELL head decision shared by the eager and streamed
+    builders (they MUST agree: streamed promises bit-identical output).
+    "auto" picks flat when the flat footprint is at least 4x smaller."""
+    if head_fmt == "flat":
+        return True
+    if head_fmt == "ell":
+        return False
+    if head_fmt != "auto":
+        raise ValueError(f"unknown head format {head_fmt!r}")
+    from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up
+
+    itemsize = np.dtype(dtype).itemsize
+    ell = nb * width * align_up(max_row_nnz, SLOT_ALIGN) * (4 + itemsize)
+    flat = nb * align_up(max_block_nnz, SLOT_ALIGN) * (8 + itemsize)
+    return flat * 4 <= ell
+
+
+def _choose_flat_head(head, width: int, dtype, head_fmt: str) -> bool:
+    max_row = 0
+    max_nnz = 0
+    for m in head:
+        if m is None or m.nnz == 0:
+            continue
+        counts = np.diff(m.tocsr().indptr)
+        if counts.size:
+            max_row = max(max_row, int(counts.max()))
+        max_nnz = max(max_nnz, int(m.nnz))
+    return choose_flat_head_from_stats(len(head), width, max_row, max_nnz,
+                                       dtype, head_fmt)
+
+
+def _stack_coords(nb: int, nb_padded: int, banded: bool
+                  ) -> dict[str, list[Optional[tuple[int, int]]]]:
+    """Per-stack block coordinates, None for structurally-empty slots
+    (mirrors the list construction in ``arrow_blocks_from_csr``)."""
+    coords: dict[str, list[Optional[tuple[int, int]]]] = {
+        "head": [(0, j) if j < nb else None for j in range(nb_padded)],
+        "diag": [None] + [(i, i) if i < nb else None
+                          for i in range(1, nb_padded)],
+        "col": [None] + [(i, 0) if i < nb else None
+                         for i in range(1, nb_padded)],
+    }
+    if banded:
+        coords["lo"] = [None, None] + [(i, i - 1) if i < nb else None
+                                       for i in range(2, nb_padded)]
+        coords["hi"] = [None] + [(i, i + 1) if i + 1 < nb else None
+                                 for i in range(1, nb_padded)]
+    return coords
+
+
+def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
+                          axis: str = "blocks",
+                          n_blocks: Optional[int] = None,
+                          pad_blocks_to: Optional[int] = None,
+                          banded: bool = False,
+                          dtype=np.float32,
+                          check: bool = True,
+                          fmt: str = "ell",
+                          head_fmt: str = "auto") -> ArrowBlocks:
+    """Streaming twin of ``arrow_blocks_from_csr`` for >RAM matrices.
+
+    Never materializes a whole level on the host: a first streaming
+    pass over the (possibly memmapped) matrix sizes the shared ELL slot
+    budgets block by block; the device arrays are then created with
+    ``jax.make_array_from_callback``, whose callback packs only the
+    block-rows of one addressable shard — peak host RSS is
+    O(one shard) = O(level / n_devices) plus memmap page cache, the
+    TPU analog of the reference's root-reads-and-ships-per-rank loader
+    (reference arrow_dec_mpi.py:629-887, graphio.py:449-495).
+
+    Produces bit-identical arrays to the eager builder (tested).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up
+
+    if fmt not in ("ell", "dense"):
+        raise ValueError(f"unknown block format {fmt!r}")
+    nb = n_blocks if n_blocks is not None else number_of_blocks(matrix, width)
+    nb_padded = max(pad_blocks_to or nb, nb)
+    coords = _stack_coords(nb, nb_padded, banded)
+
+    def blk(ij):
+        i, j = ij
+        return load_block(matrix, i * width, (i + 1) * width,
+                          j * width, (j + 1) * width, width, dtype=dtype)
+
+    # Pass 1 — streaming slot sizing + nnz-capture check (each block is
+    # loaded, reduced to its max row count, and dropped).
+    slots: dict[str, int] = {}
+    captured = 0
+    head_nnz_max = 0
+    head_row_max = 0
+    for name, cs in coords.items():
+        need = 0
+        for ij in cs:
+            if ij is None:
+                continue
+            b = blk(ij)
+            captured += b.nnz
+            counts = np.diff(b.indptr)
+            if counts.size:
+                need = max(need, int(counts.max()))
+            if name == "head":
+                head_nnz_max = max(head_nnz_max, int(b.nnz))
+        if name == "head":
+            head_row_max = need
+        slots[name] = align_up(need, SLOT_ALIGN) if need else 0
+
+    # Flat-COO head decision (the SAME rule as the eager builder, via
+    # the shared helper — the builders must agree bit-for-bit).
+    head_flat = fmt == "ell" and choose_flat_head_from_stats(
+        nb_padded, width, head_row_max, head_nnz_max, dtype, head_fmt)
+    head_budget = align_up(head_nnz_max, SLOT_ALIGN) if head_nnz_max else 0
+
+    if check:
+        if isinstance(matrix, sparse.csr_matrix):
+            total = matrix.nnz
+        else:
+            total = int(np.asarray(matrix[1]).size)
+        if captured != total:
+            raise ValueError(
+                f"arrow tiling captured {captured} of {total} nonzeros: "
+                f"the matrix has entries outside the "
+                f"{'banded' if banded else 'block-diagonal'} arrow "
+                f"pattern at width {width} / {nb} blocks")
+
+    # Pass 2 — per-device-shard packing: pack one shard's block range,
+    # ship it to its device, free the host buffer, move on.  Peak host
+    # RSS is one shard's (cols, data) pair; the global arrays are then
+    # assembled from the per-device pieces without further host copies.
+    sharding = NamedSharding(mesh, P(axis))
+
+    def pack_shard(name: str, sl: slice):
+        cs = coords[name][sl]
+        m = slots[name]
+        if name == "head" and head_flat:
+            from arrow_matrix_tpu.ops.ell import csr_flat_pack
+
+            rows = np.full((len(cs), head_budget), width, dtype=np.int32)
+            cols = np.zeros((len(cs), head_budget), dtype=np.int32)
+            data = np.zeros((len(cs), head_budget), dtype=dtype)
+            for r_i, ij in enumerate(cs):
+                if ij is None:
+                    continue
+                b = blk(ij)
+                if b.nnz:
+                    rows[r_i], cols[r_i], data[r_i] = csr_flat_pack(
+                        b, pad_to=head_budget, dtype=dtype)
+            return rows, cols, data
+        if fmt == "dense":
+            cols = np.zeros((len(cs), 0, 0), dtype=np.int32)
+            data = np.zeros((len(cs), width, width), dtype=dtype)
+            for r, ij in enumerate(cs):
+                if ij is not None:
+                    data[r] = blk(ij).toarray()
+        else:
+            from arrow_matrix_tpu.ops.ell import ell_pack
+
+            cols = np.zeros((len(cs), width, m), dtype=np.int32)
+            data = np.zeros((len(cs), width, m), dtype=dtype)
+            for r, ij in enumerate(cs):
+                if ij is None:
+                    continue
+                b = blk(ij)
+                if b.nnz:
+                    cols[r], data[r] = ell_pack(b, max_nnz=m, dtype=dtype)
+        return cols, data
+
+    def make_stack(name: str):
+        m = slots[name]
+        if name == "head" and head_flat:
+            shapes = [(nb_padded, head_budget)] * 3
+        elif fmt == "dense":
+            shapes = [(nb_padded, 0, 0), (nb_padded, width, width)]
+        else:
+            shapes = [(nb_padded, width, m)] * 2
+        dev_map = sharding.addressable_devices_indices_map(shapes[-1])
+        parts: list[list] = [[] for _ in shapes]
+        for dev, idx in dev_map.items():
+            arrs = pack_shard(name, idx[0])
+            for p, a in zip(parts, arrs):
+                p.append(jax.device_put(a, dev))
+            del arrs  # host buffers freed before the next shard packs
+        return tuple(
+            jax.make_array_from_single_device_arrays(shape, sharding, p)
+            for shape, p in zip(shapes, parts))
+
+    kw = {}
+    for name in coords:
+        out = make_stack(name)
+        if name == "head" and head_flat:
+            kw["head_rows"], kw["head_cols"], kw["head_data"] = out
+        else:
+            kw[f"{name}_cols"], kw[f"{name}_data"] = out
+    return ArrowBlocks(width=width, n_blocks=nb_padded, banded=banded,
+                       fmt=fmt, head_flat=head_flat, **kw)
 
 
 def block_spmm(fmt: str, cols: jax.Array, data: jax.Array, x: jax.Array,
@@ -168,6 +395,27 @@ def block_spmm(fmt: str, cols: jax.Array, data: jax.Array, x: jax.Array,
     if fmt == "dense":
         return dense_spmm_batched(data, x)
     return ell_spmm_batched(cols, data, x, chunk=chunk)
+
+
+def head_block_spmm(blocks: ArrowBlocks, x: jax.Array,
+                    chunk: Optional[int] = None) -> jax.Array:
+    """Per-block head-row contributions: block j's A_0j @ X_j, shape
+    (nb, w, k).  Sum (or psum) over the block axis gives C_0.
+
+    Branches on the head storage: flat-COO heads (head_flat) scatter-add
+    per block — O(nnz) compute immune to the head rows' degree skew —
+    ELL/dense heads go through ``block_spmm``.  Works identically on
+    global arrays and on per-shard slices under shard_map.
+    """
+    if blocks.head_flat:
+        from arrow_matrix_tpu.ops.ell import csr_flat_spmm
+
+        w = blocks.width
+        return jax.vmap(
+            lambda r, c, d, xx: csr_flat_spmm(r, c, d, xx, w))(
+                blocks.head_rows, blocks.head_cols, blocks.head_data, x)
+    return block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data, x,
+                      chunk=chunk)
 
 
 def block_spmm_shared(fmt: str, cols: jax.Array, data: jax.Array,
@@ -193,9 +441,7 @@ def arrow_spmm(blocks: ArrowBlocks, x: jax.Array,
     nb, w, k = x.shape
     assert nb == blocks.n_blocks and w == blocks.width
 
-    head_partial = block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data,
-                              x, chunk=chunk)
-    c0 = head_partial.sum(axis=0)
+    c0 = head_block_spmm(blocks, x, chunk=chunk).sum(axis=0)
 
     c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
                    chunk=chunk)

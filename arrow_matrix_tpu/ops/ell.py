@@ -223,6 +223,30 @@ def csr_flat_pack(m: sparse.spmatrix, pad_to: Optional[int] = None,
     return rows_pad, cols_pad, data_pad
 
 
+def flat_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
+                    align: int = SLOT_ALIGN, rows: Optional[int] = None
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack equal-shaped sparse blocks into stacked flat-COO arrays
+    (b, B) with one shared per-block nnz budget B (max over blocks,
+    aligned).  Padding entries point at the dummy row ``rows`` (dropped
+    by the csr_flat_spmm scatter).  O(nnz) storage regardless of row
+    skew — the arrow-head companion of ``ell_pack_stack``."""
+    shapes = [m.shape for m in mats if m is not None]
+    if not shapes and rows is None:
+        raise ValueError("no non-empty blocks and no explicit row count")
+    n_rows = rows if rows is not None else shapes[0][0]
+    need = max((int(m.nnz) for m in mats if m is not None), default=0)
+    budget = align_up(need, align) if need else 0
+    r = np.full((len(mats), budget), n_rows, dtype=np.int32)
+    c = np.zeros((len(mats), budget), dtype=np.int32)
+    d = np.zeros((len(mats), budget), dtype=dtype)
+    for i, m in enumerate(mats):
+        if m is None or m.nnz == 0:
+            continue
+        r[i], c[i], d[i] = csr_flat_pack(m, pad_to=budget, dtype=dtype)
+    return r, c, d
+
+
 def csr_flat_spmm(rows: jax.Array, cols: jax.Array, data: jax.Array,
                   x: jax.Array, n_rows: int) -> jax.Array:
     """Scatter-add SpMM over a flat nonzero list: one extra dummy row

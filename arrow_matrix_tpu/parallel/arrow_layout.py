@@ -50,6 +50,7 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
     arrow_spmm,
     block_spmm,
     block_spmm_shared,
+    head_block_spmm,
 )
 from arrow_matrix_tpu.parallel.mesh import blocks_sharding, shard_arrow_blocks
 
@@ -100,8 +101,7 @@ def _local_slim_step(blocks: ArrowBlocks, x: jax.Array, axis: str,
 
     # --- Head row: C_0 = sum_j A_0j X_j, reduced over all devices
     # (reference Reduce, arrow_slim_mpi.py:104-119).
-    head_partial = block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data,
-                              x, chunk=chunk).sum(axis=0)
+    head_partial = head_block_spmm(blocks, x, chunk=chunk).sum(axis=0)
     c0 = lax.psum(head_partial, axis)
 
     # --- Local blocks: C_i = A_ii X_i + A_i0 X_0 (arrow_slim_mpi.py:121-147).
@@ -188,8 +188,7 @@ def _local_wide_step(blocks: ArrowBlocks, x: jax.Array, arm_axis: str,
     # Row arm: C_0 = sum_j A_0j X_j, reduced over both axes (reference
     # _ad_spmm_row_tile + Reduce, arrow_mpi.py:274-299).
     def head_fn():
-        return block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data,
-                          x, chunk=chunk).sum(axis=0)
+        return head_block_spmm(blocks, x, chunk=chunk).sum(axis=0)
 
     head_partial = lax.cond(arm == 1, head_fn,
                             lambda: jnp.zeros((w, k), dtype=x.dtype))

@@ -47,11 +47,14 @@ import numpy as np
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from scipy import sparse
+
 from arrow_matrix_tpu.decomposition.decompose import ArrowLevel
-from arrow_matrix_tpu.io.graphio import number_of_blocks
+from arrow_matrix_tpu.io.graphio import number_of_blocks, num_rows
 from arrow_matrix_tpu.ops.arrow_blocks import (
     ArrowBlocks,
     arrow_blocks_from_csr,
+    arrow_blocks_streamed,
     arrow_spmm,
 )
 from arrow_matrix_tpu.parallel.mesh import (
@@ -121,9 +124,22 @@ class MultiLevelArrow:
                  mesh: Optional[Mesh] = None, axis: str = "blocks",
                  banded: bool = False, dtype=np.float32,
                  chunk="auto", fmt: str = "auto",
-                 dense_budget: Optional[int] = None, kernel: str = "xla"):
+                 dense_budget: Optional[int] = None, kernel: str = "xla",
+                 routing: str = "gather"):
+        """``routing`` selects the inter-level exchange lowering:
+        "gather" leaves the permutation gathers to GSPMD (which may
+        all-gather the whole feature array per exchange), "a2a" compiles
+        them into explicit per-device send/recv tables over one
+        fixed-shape all_to_all (parallel/routing.py — O(moved rows)
+        volume, the reference's Alltoallv tables,
+        arrow_dec_mpi.py:210-281).  "a2a" requires a mesh and carries
+        the features sharded on rows only."""
         if not levels:
             raise ValueError("empty decomposition")
+        if routing not in ("gather", "a2a"):
+            raise ValueError(f"unknown routing {routing!r}")
+        if routing == "a2a" and mesh is None:
+            raise ValueError("routing='a2a' requires a mesh")
         if dense_budget is None:
             # Budget from the actual target chip's free memory, not a
             # constant (VERDICT r1: 4GiB misformats on both v5e and v5p).
@@ -156,7 +172,7 @@ class MultiLevelArrow:
         self.axis = axis
         self.banded = banded
         self.chunk = chunk
-        self.n = levels[0].matrix.shape[0]
+        self.n = num_rows(levels[0].matrix)
 
         n_dev = mesh.shape[axis] if mesh is not None else 1
 
@@ -214,10 +230,25 @@ class MultiLevelArrow:
                 "format (the pallas kernels cover dense only; raise "
                 "dense_budget or pass fmt='dense')")
 
+        # Level matrices pass through as-is: an in-memory CSR or a
+        # memmapped CsrLike triplet.  Triplet levels on a mesh take the
+        # streaming builder — per-device-shard packing bounds peak host
+        # RSS to O(level / n_devices) so >RAM artifacts ingest without
+        # ever materializing a level (the reference's
+        # root-reads-and-ships loader role, arrow_dec_mpi.py:629-887).
+        def build(lvl, w, bd, f) -> ArrowBlocks:
+            if mesh is not None and not isinstance(lvl.matrix,
+                                                   sparse.csr_matrix):
+                return arrow_blocks_streamed(
+                    lvl.matrix, w, mesh, axis,
+                    pad_blocks_to=self.total_rows // w,
+                    banded=bd, dtype=dtype, fmt=f)
+            return arrow_blocks_from_csr(lvl.matrix, w,
+                                         pad_blocks_to=self.total_rows // w,
+                                         banded=bd, dtype=dtype, fmt=f)
+
         self.blocks: List[ArrowBlocks] = [
-            arrow_blocks_from_csr(lvl.matrix.astype(dtype), w,
-                                  pad_blocks_to=self.total_rows // w,
-                                  banded=bd, dtype=dtype, fmt=f)
+            build(lvl, w, bd, f)
             for lvl, w, bd, f in zip(levels, widths, bandeds, self.fmts)
         ]
         fwd, bwd = compose_routing([lvl.permutation for lvl in levels],
@@ -226,13 +257,27 @@ class MultiLevelArrow:
                                      self.total_rows)
         self.inv_perm0 = np.argsort(self.perm0)
 
+        self.routing = routing
         if mesh is not None:
             self.blocks = [shard_arrow_blocks(b, mesh, axis)
                            for b in self.blocks]
-            # Routing tables are replicated (they index global rows).
-            repl = NamedSharding(mesh, P())
-            self.fwd = jax.device_put(fwd, repl)
-            self.bwd = jax.device_put(bwd, repl)
+            if routing == "a2a":
+                from arrow_matrix_tpu.parallel.routing import build_route
+
+                n_dev = mesh.shape[axis]
+                shard = NamedSharding(mesh, P(axis))
+
+                def put(rt):
+                    return jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, shard), rt)
+
+                self.fwd = [put(build_route(t, n_dev)) for t in fwd]
+                self.bwd = [put(build_route(t, n_dev)) for t in bwd]
+            else:
+                # Routing tables replicated (they index global rows).
+                repl = NamedSharding(mesh, P())
+                self.fwd = jax.device_put(fwd, repl)
+                self.bwd = jax.device_put(bwd, repl)
         else:
             self.fwd = jnp.asarray(fwd)
             self.bwd = jnp.asarray(bwd)
@@ -247,14 +292,16 @@ class MultiLevelArrow:
         # bloats the program (and breaks remote-compile size limits).
         self._step = jax.jit(functools.partial(
             multi_level_spmm, widths=tuple(widths), chunk=chunk,
-            kernel=kernel, gather_budget=gather_budget))
+            kernel=kernel, gather_budget=gather_budget,
+            mesh=mesh, axis=axis))
 
         def scan_steps(x, fwd, bwd, blocks, n):
             def body(xc, _):
                 xc = multi_level_spmm(xc, fwd, bwd, blocks,
                                       widths=tuple(widths), chunk=chunk,
                                       kernel=kernel,
-                                      gather_budget=gather_budget)
+                                      gather_budget=gather_budget,
+                                      mesh=mesh, axis=axis)
                 return xc, None
 
             out, _ = jax.lax.scan(body, x, None, length=n)
@@ -328,17 +375,20 @@ def resolve_chunk(chunk, blk: ArrowBlocks, total_rows: int, k: int,
         return None
     from arrow_matrix_tpu.ops.ell import auto_chunk
 
-    dims = [blk.head_cols.shape[-1], blk.diag_cols.shape[-1],
-            blk.col_cols.shape[-1]]
+    dims = [blk.diag_cols.shape[-1], blk.col_cols.shape[-1]]
+    if not blk.head_flat:   # flat head scatters; chunking is ELL-only
+        dims.append(blk.head_cols.shape[-1])
     if blk.banded:
         dims += [blk.lo_cols.shape[-1], blk.hi_cols.shape[-1]]
     return auto_chunk(total_rows, k, max(dims), gather_budget)
 
 
-def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
+def multi_level_spmm(x: jax.Array, fwd, bwd,
                      blocks: Sequence[ArrowBlocks], widths: tuple,
                      chunk="auto", kernel: str = "xla",
-                     gather_budget: int = 1 << 30) -> jax.Array:
+                     gather_budget: int = 1 << 30,
+                     mesh: Optional[Mesh] = None,
+                     axis: str = "blocks") -> jax.Array:
     """One decomposition-wide SpMM (jitted; K unrolled — K is small).
 
     Forward feature propagation (reference
@@ -349,13 +399,15 @@ def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
     blocking (nb_i, w_i, k).  ``kernel="pallas"`` routes dense-format
     levels through the fused Pallas kernels (single chip only).
     """
+    from arrow_matrix_tpu.parallel.routing import take as routed_or_take
+
     total, k = x.shape
     k_levels = len(blocks)
     partials = []
     x_cur = x
     for i in range(k_levels):
         if i > 0:
-            x_cur = jnp.take(x_cur, fwd[i - 1], axis=0)
+            x_cur = routed_or_take(x_cur, fwd[i - 1], mesh, axis)
         w = widths[i]
         xb = x_cur.reshape(total // w, w, k)
         use_pallas = False
@@ -375,5 +427,5 @@ def multi_level_spmm(x: jax.Array, fwd: jax.Array, bwd: jax.Array,
 
     agg = partials[-1]
     for i in range(k_levels - 1, 0, -1):
-        agg = partials[i - 1] + jnp.take(agg, bwd[i - 1], axis=0)
+        agg = partials[i - 1] + routed_or_take(agg, bwd[i - 1], mesh, axis)
     return agg

@@ -72,7 +72,15 @@ def stack_arrow_blocks(blocks_list: List[ArrowBlocks]) -> ArrowBlocks:
     out = {}
     for f in dataclasses.fields(first):
         vals = [getattr(b, f.name) for b in blocks_list]
-        if not isinstance(vals[0], (jax.Array, np.ndarray)):
+        is_arr = [isinstance(v, (jax.Array, np.ndarray)) for v in vals]
+        if any(is_arr) and not all(is_arr):
+            # e.g. head_rows/lo_cols None on some levels only — diagnose
+            # instead of crashing on None.shape below.
+            raise ValueError(
+                f"levels disagree on optional field {f.name!r} "
+                f"(present on some levels, absent on others — build all "
+                f"levels with the same banded/head_fmt settings)")
+        if not is_arr[0]:
             if any(v != vals[0] for v in vals):
                 raise ValueError(
                     f"levels disagree on static field {f.name!r}: {vals}")
@@ -158,6 +166,17 @@ class SpaceSharedArrow:
                                   banded=True, dtype=dtype, fmt=fmt)
             for lvl in levels
         ]
+        # The stacked layout needs one head storage across levels; if
+        # the per-level auto choices disagree, force flat everywhere
+        # (always correct, and the flat-preferring level is the one
+        # whose ELL padding would blow up).
+        if len({b.head_flat for b in per_level}) > 1:
+            per_level = [
+                b if b.head_flat else arrow_blocks_from_csr(
+                    lvl.matrix, w, pad_blocks_to=nb, banded=True,
+                    dtype=dtype, fmt=fmt, head_fmt="flat")
+                for b, lvl in zip(per_level, levels)
+            ]
         blocks = stack_arrow_blocks(per_level)
 
         # Directly-composed routing tables (module docstring): row j of
@@ -178,7 +197,11 @@ class SpaceSharedArrow:
         self.bwd0 = jax.device_put(bwd0.astype(np.int32), lvl_only)
         self.fwd0 = jax.device_put(fwd0.astype(np.int32), lvl_only)
 
-        gather_budget = max(dense_budget // 4, 1 << 27)
+        # The ELL gather intermediate of one level shards only over the
+        # block axis, and each device runs exactly one level (lvl axis
+        # sharded) — so the chunker's budget scales by n_dev_blocks, NOT
+        # by the k_levels factor dense_budget carries for block storage.
+        gather_budget = max(dense_budget // max(k_levels, 1) // 4, 1 << 27)
         self._step = jax.jit(functools.partial(
             space_shared_spmm, width=w, chunk=chunk,
             gather_budget=gather_budget))

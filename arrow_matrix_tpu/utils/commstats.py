@@ -1,0 +1,122 @@
+"""Per-iteration communication accounting from compiled HLO.
+
+Communication volume is the reference paper's headline metric
+(reference README.md:3: "communication-efficient ... polynomial
+reduction in communication volume"), but under GSPMD the collectives
+are *inserted by the compiler*, not written by hand — so the volume
+must be read back out of the compiled program.  This module parses the
+post-partitioning HLO of any jitted step and reports, per collective
+kind, the op count and the summed output bytes — the device-visible
+data volume of one execution.
+
+Use ``collective_stats(jitted, *args)`` for a dict, or
+``format_stats`` for a log-friendly table.  ``ideal_routing_bytes``
+computes the O(moved rows) lower bound the routing exchanges should
+approach (the reference's Alltoallv payload,
+arrow/arrow_dec_mpi.py:404-550).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+import numpy as np
+
+# HLO collective op mnemonics (post-SPMD-partitioning).
+COLLECTIVE_OPS = (
+    "all-to-all",
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+# e.g. "f32[16,2048,16]" or "(f32[8,16], s32[8,16])" pieces.
+_SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_stats(jitted_fn, *args, **kwargs) -> Dict[str, Any]:
+    """Compile ``jitted_fn(*args)`` and account its collectives.
+
+    Returns ``{kind: {"count": int, "bytes": int}, ...,
+    "total_bytes": int}`` where bytes are the summed *output* shapes of
+    the collective ops in the optimized (post-partitioning) HLO — the
+    per-device-visible volume of one call, summed over ops.
+    """
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    text = compiled.as_text()
+    stats: Dict[str, Any] = {k: {"count": 0, "bytes": 0}
+                             for k in COLLECTIVE_OPS}
+    for line in text.splitlines():
+        s = line.strip()
+        if "=" not in s:
+            continue
+        # "%name = SHAPE op-name(...)" where SHAPE may be a
+        # parenthesized tuple with spaces (e.g. sharded all-to-all
+        # emits one tuple element per participant).  "-start" covers
+        # async forms ("-done" carries no new bytes and is skipped; for
+        # async ops the start tuple includes aliased input shapes, so
+        # bytes are an upper estimate).
+        for kind in COLLECTIVE_OPS:
+            m = re.search(rf"=\s*(.+?)\s{re.escape(kind)}(?:-start)?\(", s)
+            if m:
+                stats[kind]["count"] += 1
+                stats[kind]["bytes"] += _shape_bytes(m.group(1))
+                break
+    stats["total_bytes"] = sum(v["bytes"] for k, v in stats.items()
+                               if isinstance(v, dict))
+    return stats
+
+
+def ideal_routing_bytes(perms, n_devices: int, k: int,
+                        itemsize: int = 4) -> int:
+    """O(moved rows) lower bound for one iteration's permutation
+    routing: a row contributes iff the forward (and backward) exchange
+    moves it to a *different device* than the one holding it, summed
+    over adjacent level pairs, for both directions.
+
+    ``perms`` are the padded level permutations over the shared row
+    count (level-i order), row-block-sharded over ``n_devices``.
+    """
+    perms = [np.asarray(p) for p in perms]
+    total = perms[0].size
+    rows_per_dev = -(-total // n_devices)
+    moved = 0
+    inv = [np.argsort(p) for p in perms]
+    for i in range(1, len(perms)):
+        # Position of each level-(i-1) row in level-i order.
+        pos = inv[i][perms[i - 1]]
+        here = np.arange(total) // rows_per_dev
+        there = pos // rows_per_dev
+        moved += int(np.count_nonzero(here != there))
+    return 2 * moved * k * itemsize  # forward + backward
+
+
+def format_stats(stats: Dict[str, Any]) -> str:
+    lines = [f"{'collective':20s} {'count':>6s} {'bytes':>14s}"]
+    for kind in COLLECTIVE_OPS:
+        v = stats[kind]
+        if v["count"]:
+            lines.append(f"{kind:20s} {v['count']:6d} {v['bytes']:14,d}")
+    lines.append(f"{'TOTAL':20s} {'':6s} {stats['total_bytes']:14,d}")
+    return "\n".join(lines)

@@ -1,0 +1,75 @@
+"""Race the time-shared vs space-shared multi-matrix runtimes.
+
+Produces the ms/iter table in README.md ("Time-sharing AND
+space-sharing, raced") on an 8-device virtual CPU mesh; run it on real
+TPU devices (unset JAX_PLATFORMS) before changing any mode default.
+
+Usage: python tools/race_modes.py [n_vertices]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from arrow_matrix_tpu.utils.platform import backend_initialized, force_cpu_devices  # noqa: E402
+
+if not backend_initialized() and os.environ.get("AMT_RACE_REAL") != "1":
+    force_cpu_devices(8)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition  # noqa: E402
+from arrow_matrix_tpu.parallel.mesh import make_mesh  # noqa: E402
+from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow  # noqa: E402
+from arrow_matrix_tpu.parallel.space_shared import SpaceSharedArrow  # noqa: E402
+from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense  # noqa: E402
+
+
+def ms_per_iter(obj, x, iters: int = 10) -> float:
+    def chain(n):
+        t0 = time.perf_counter()
+        xd = obj.run(x, n) if n else x
+        float(np.asarray(xd).ravel()[0])
+        return time.perf_counter() - t0
+
+    chain(iters)  # compile + warmup
+    rtt = min(chain(0) for _ in range(3))
+    return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    k = 16
+    n_dev = len(jax.devices())
+    a = barabasi_albert(n, 8, seed=7)
+    x_host = random_dense(n, k, seed=3)
+    print(f"n={n} nnz={a.nnz} k={k}, {n_dev} "
+          f"{jax.devices()[0].platform} devices")
+    for w, max_lvl in [(512, 2), (512, 4), (1024, 2)]:
+        levels = arrow_decomposition(a, arrow_width=w, max_levels=max_lvl,
+                                     block_diagonal=True, seed=7)
+        k_lvl = len(levels)
+        if n_dev % k_lvl:
+            print(f"w={w} K={k_lvl}: skip (K does not divide {n_dev})")
+            continue
+        for fmt in ("ell", "dense"):
+            mlm = MultiLevelArrow(levels, w,
+                                  mesh=make_mesh((n_dev,), ("blocks",)),
+                                  fmt=fmt)
+            ss = SpaceSharedArrow(levels, w, fmt=fmt)
+            t_ml = ms_per_iter(mlm, mlm.set_features(x_host))
+            t_ss = ms_per_iter(ss, ss.set_features(x_host))
+            print(f"w={w} K={k_lvl} fmt={fmt}: "
+                  f"time-shared {t_ml:8.2f} ms/iter   "
+                  f"space-shared {t_ss:8.2f} ms/iter   "
+                  f"ratio {t_ml / t_ss:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
