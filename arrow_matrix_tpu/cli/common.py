@@ -80,16 +80,54 @@ def _load_matlab(path: str) -> sparse.spmatrix:
     try:
         contents = loadmat(path)
     except NotImplementedError:
-        # v7.3 files are HDF5; mat73 handles them in the reference
-        # (decomposition_main.py:18-34).  Not baked into this image —
-        # re-save as npz/mtx or scipy-compatible .mat instead.
-        raise ValueError(
-            f"{path} is a MATLAB v7.3 (HDF5) file; convert it to .npz or "
-            f".mtx first (mat73 is not available in this environment)")
+        # v7.3 files are HDF5 (the reference reads them with mat73,
+        # decomposition_main.py:18-34; mat73 is not in this image).
+        return _load_matlab_hdf5(path)
     for v in contents.values():
         if sparse.issparse(v):
             return v
     raise ValueError(f"no sparse matrix found in {path}")
+
+
+def _load_matlab_hdf5(path: str) -> sparse.spmatrix:
+    """MATLAB v7.3 (HDF5) sparse loader via h5py.
+
+    MATLAB stores a sparse matrix as an HDF5 group with CSC component
+    datasets ``data``/``ir``/``jc`` and the row count in the group's
+    ``MATLAB_sparse`` attribute.  The SuiteSparse collection (the
+    reference's primary datasets) keeps the matrix at ``Problem/A``;
+    that location is probed first, then any sparse-tagged group.
+    """
+    try:
+        import h5py
+    except ImportError:
+        raise ValueError(
+            f"{path} is a MATLAB v7.3 (HDF5) file and h5py is not "
+            f"available; convert it to .npz or .mtx first")
+
+    def as_csc(node):
+        jc = np.asarray(node["jc"], dtype=np.int64)
+        ir = np.asarray(node["ir"], dtype=np.int64)
+        data = (np.asarray(node["data"]) if "data" in node
+                else np.ones(ir.size, dtype=np.float32))
+        n_rows = int(node.attrs["MATLAB_sparse"])
+        n_cols = jc.size - 1
+        return sparse.csc_matrix((data, ir, jc), shape=(n_rows, n_cols))
+
+    with h5py.File(path, "r") as f:
+        if "Problem" in f and "A" in f["Problem"] \
+                and "MATLAB_sparse" in f["Problem"]["A"].attrs:
+            return as_csc(f["Problem"]["A"])
+        found = []
+
+        def visit(name, node):
+            if isinstance(node, h5py.Group) and "MATLAB_sparse" in node.attrs:
+                found.append(name)
+
+        f.visititems(visit)
+        if found:
+            return as_csc(f[found[0]])
+    raise ValueError(f"no MATLAB sparse matrix found in HDF5 file {path}")
 
 
 def random_adjacency(vertices: int, edges: int, seed: int,

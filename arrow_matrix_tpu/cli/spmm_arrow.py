@@ -61,9 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "reference's constraint, "
                              "arrow_dec_mpi.py:131).")
     parser.add_argument("-b", "--blocked", type=str2bool, nargs="?",
-                        default=True,
+                        default=None, const=True,
                         help="Block-diagonal decomposition (required for "
-                             "slim, arrow_dec_mpi.py:131).")
+                             "slim, arrow_dec_mpi.py:131).  Default: "
+                             "true.")
     parser.add_argument("--fmt", type=str, default="auto",
                         choices=["auto", "dense", "ell"],
                         help="Device block format (TPU-specific: dense = "
@@ -107,6 +108,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    blocked_explicit = args.blocked is not None
+    args.blocked = True if args.blocked is None else args.blocked
     if args.slim and not args.blocked:
         raise SystemExit("--slim requires a block-diagonal decomposition "
                          "(--blocked true); the reference enforces the "
@@ -160,7 +163,12 @@ def main(argv=None) -> int:
     # Version-string run name (reference arrow_bench.py:43-47 pattern),
     # derived from what actually runs: slim-style sharding, banded or
     # block-diagonal tiling, time- or space-shared level execution.
-    algo = (f"ArrowTPU_v{'BlockDiagonal' if args.blocked else 'Banded'}"
+    # SpaceSharedArrow always tiles banded, whatever --blocked says.
+    banded_run = args.mode == "space" or not args.blocked
+    if args.mode == "space" and args.blocked and blocked_explicit:
+        print("warning: --mode space always uses banded tiling; "
+              "--blocked affects only the artifact naming")
+    algo = (f"ArrowTPU_v{'Banded' if banded_run else 'BlockDiagonal'}"
             f"_Slim_{args.mode.capitalize()}Shared")
     wb.init(algo, os.path.basename(path), config=vars(args))
 

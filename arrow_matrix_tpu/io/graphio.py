@@ -18,7 +18,9 @@ the host footprint at O(touched blocks) for 100M+-row matrices
 from __future__ import annotations
 
 import enum
+import glob as _glob
 import os
+import re
 from typing import Any, List, Optional, Tuple, Union
 
 import numpy as np
@@ -61,6 +63,47 @@ def format_path(base: str, width: Optional[int], index: Optional[int],
     return path + _SUFFIX[kind]
 
 
+def _discover_level_width(base: str, width: Optional[int], index: int,
+                          block_diagonal: bool) -> Optional[int]:
+    """Width under which level ``index``'s files exist on disk.
+
+    The reference writer names each level by its own *achieved* width
+    (reference graphio.py:173-186 uses ``arrow_m.arrow_width`` per
+    level) while its loader enumerates all levels under one fixed width
+    (graphio.py:251-314) — so a reference-written artifact whose last
+    level grew beyond the requested width is silently truncated on
+    reload there.  Here the exact width is probed first, then a glob
+    over any-width names recovers the level regardless of which width
+    its files carry.  Returns the width found, or None if the level
+    does not exist at all.
+    """
+    exact = format_path(base, width, index, block_diagonal, FileKind.indptr)
+    if os.path.exists(exact):
+        return width
+    if width is None:  # width not part of the name: nothing to discover
+        return None
+    bd = "_bd" if block_diagonal else ""
+    pattern = f"{_glob.escape(base)}_B_*_{index}{bd}_indptr.npy"
+    rx = re.compile(re.escape(base) + r"_B_(\d+)_" + re.escape(str(index))
+                    + bd + r"_indptr\.npy$")
+    # Only widths *greater* than the requested one qualify: a grown
+    # level is always wider (the decomposer widens, never narrows), and
+    # the restriction keeps a same-base artifact of a different
+    # (smaller) requested width from being spliced in as a fake level.
+    widths = sorted(int(m.group(1)) for p in _glob.glob(pattern)
+                    if (m := rx.match(p)) and int(m.group(1)) > width)
+    if widths:
+        import warnings
+
+        warnings.warn(
+            f"level {index} of {base!r} found under achieved width "
+            f"{widths[0]} (requested {width}): reference-writer naming "
+            f"(its own loader would silently drop this level)",
+            stacklevel=3)
+        return widths[0]
+    return None
+
+
 # A loaded level matrix: either an in-memory CSR or a (data, indices,
 # indptr) triplet of (possibly memory-mapped) arrays.  A triplet's data
 # may be None, meaning implicit unit values (generated per-slice on
@@ -100,10 +143,24 @@ def save_decomposition(levels: List[ArrowLevel], base: str,
 
 def load_level_widths(base: str, width: Optional[int],
                       block_diagonal: bool = True) -> Optional[np.ndarray]:
-    """Per-level achieved widths, or None for artifacts without the
-    metadata file (e.g. reference-produced ones)."""
+    """Per-level achieved widths.
+
+    Prefers the ``_widths.npy`` metadata file this framework writes;
+    for reference-produced artifacts (no metadata file) the achieved
+    widths are recovered from the per-level filenames the reference
+    writer embeds them in (reference graphio.py:173-186).  Returns None
+    only when neither source exists.
+    """
     p = format_path(base, width, 0, block_diagonal, FileKind.widths)
-    return np.load(p) if os.path.exists(p) else None
+    if os.path.exists(p):
+        return np.load(p)
+    if width is None:
+        return None
+    widths, i = [], 0
+    while (w := _discover_level_width(base, width, i, block_diagonal)) is not None:
+        widths.append(int(w))
+        i += 1
+    return np.asarray(widths, dtype=np.int64) if widths else None
 
 
 def save_decomposition_npz(levels: List[ArrowLevel], base: str,
@@ -140,13 +197,18 @@ def load_decomposition(base: str, width: Optional[int] = None,
     out: List[Tuple[CsrLike, Optional[np.ndarray]]] = []
     i = 0
     while True:
-        p_indptr = format_path(base, width, i, block_diagonal, FileKind.indptr)
+        # Per-level width discovery: reference-written artifacts name
+        # each level by its achieved width (see _discover_level_width).
+        w_i = _discover_level_width(base, width, i, block_diagonal)
+        if w_i is None and width is not None:
+            break
+        p_indptr = format_path(base, w_i, i, block_diagonal, FileKind.indptr)
         if not os.path.exists(p_indptr):
             break
         loader = (lambda f: np.lib.format.open_memmap(f, mode="r")) if mem_map else np.load
         indptr = loader(p_indptr)
-        indices = loader(format_path(base, width, i, block_diagonal, FileKind.indices))
-        p_data = format_path(base, width, i, block_diagonal, FileKind.data)
+        indices = loader(format_path(base, w_i, i, block_diagonal, FileKind.indices))
+        p_data = format_path(base, w_i, i, block_diagonal, FileKind.data)
         if os.path.exists(p_data):
             data = loader(p_data)
         elif mem_map:
@@ -162,7 +224,7 @@ def load_decomposition(base: str, width: Optional[int] = None,
                                                   shape=(n, n)))
         perm = None
         if with_permutation:
-            perm = np.load(format_path(base, width, i, block_diagonal,
+            perm = np.load(format_path(base, w_i, i, block_diagonal,
                                        FileKind.permutation))
         out.append((matrix, perm))
         i += 1

@@ -87,6 +87,17 @@ class ArrowBlocks:
         return total
 
 
+def scipy_safe_dtype(dtype):
+    """scipy.sparse cannot hold narrow dtypes like bf16; blocks pass
+    through scipy at f32 and are cast to the storage dtype only at the
+    numpy packing step (ell/dense/flat packers)."""
+    try:
+        sparse.csr_matrix((0, 0), dtype=dtype)
+        return dtype
+    except ValueError:
+        return np.float32
+
+
 def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                           n_blocks: Optional[int] = None,
                           banded: bool = False,
@@ -117,11 +128,12 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
     nb = n_blocks if n_blocks is not None else number_of_blocks(matrix, width)
     nb_padded = max(pad_blocks_to or nb, nb)
     captured = 0
+    host_dtype = scipy_safe_dtype(dtype)
 
     def blk(i, j):
         nonlocal captured
         b = load_block(matrix, i * width, (i + 1) * width,
-                       j * width, (j + 1) * width, width, dtype=dtype)
+                       j * width, (j + 1) * width, width, dtype=host_dtype)
         captured += b.nnz
         return b
 
@@ -269,10 +281,13 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
     nb_padded = max(pad_blocks_to or nb, nb)
     coords = _stack_coords(nb, nb_padded, banded)
 
+    host_dtype = scipy_safe_dtype(dtype)
+
     def blk(ij):
         i, j = ij
         return load_block(matrix, i * width, (i + 1) * width,
-                          j * width, (j + 1) * width, width, dtype=dtype)
+                          j * width, (j + 1) * width, width,
+                          dtype=host_dtype)
 
     # Pass 1 — streaming slot sizing + nnz-capture check (each block is
     # loaded, reduced to its max row count, and dropped).

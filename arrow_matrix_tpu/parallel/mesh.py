@@ -25,13 +25,27 @@ def make_mesh(shape: Optional[Sequence[int]] = None,
     one-rank-per-block-row slim communicator,
     reference arrow/arrow_slim_mpi.py:298-326).
     """
-    devs = list(devices if devices is not None else jax.devices())
+    explicit = devices is not None
+    devs = list(devices if explicit else jax.devices())
     if shape is None:
         shape = (len(devs),)
-    if int(np.prod(shape)) != len(devs):
-        raise ValueError(f"mesh shape {tuple(shape)} does not cover "
-                         f"{len(devs)} devices")
-    arr = np.asarray(devs, dtype=object).reshape(tuple(shape))
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh shape {tuple(shape)} needs {n} devices, "
+                         f"only {len(devs)} available")
+    # A smaller shape takes the first n devices: sub-meshes of any size
+    # (including non-power-of-two) from one device pool — the analog of
+    # the reference's many-rank test matrix on an oversubscribed host
+    # (reference tests/test_arrowmpi.py:11-17 runs at up to 30 ranks).
+    # Warn when the subset was not asked for explicitly: a stale shape
+    # silently idling part of the machine is a perf bug, not a choice.
+    if n < len(devs) and not explicit:
+        import warnings
+
+        warnings.warn(f"mesh shape {tuple(shape)} uses {n} of "
+                      f"{len(devs)} available devices; pass devices= to "
+                      f"silence", stacklevel=2)
+    arr = np.asarray(devs[:n], dtype=object).reshape(tuple(shape))
     return Mesh(arr, tuple(axis_names))
 
 

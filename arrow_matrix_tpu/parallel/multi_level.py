@@ -63,6 +63,27 @@ from arrow_matrix_tpu.parallel.mesh import (
 )
 
 
+def resolve_block_dtype(dtype):
+    """Block-storage dtype: numpy dtypes pass through; the strings
+    "f32"/"bf16" name the two supported storage modes.  bf16 halves the
+    HBM footprint and stream time of the resident blocks — the dominant
+    bytes in the bandwidth-bound iteration — while every kernel still
+    accumulates in f32 on the MXU (``preferred_element_type`` in
+    ops/ell.py and ops/pallas_blocks.py); features stay f32.
+    """
+    if isinstance(dtype, str):
+        import ml_dtypes
+
+        try:
+            return {"f32": np.float32, "float32": np.float32,
+                    "bf16": ml_dtypes.bfloat16,
+                    "bfloat16": ml_dtypes.bfloat16}[dtype]
+        except KeyError:
+            raise ValueError(f"unknown block dtype {dtype!r} "
+                             f"(expected 'f32' or 'bf16')") from None
+    return dtype
+
+
 def pad_permutation(perm: np.ndarray, total: int) -> np.ndarray:
     """Extend a permutation of [0, n) to [0, total) with an identity tail
     (padding rows are zero and permute among themselves)."""
@@ -136,6 +157,7 @@ class MultiLevelArrow:
         the features sharded on rows only."""
         if not levels:
             raise ValueError("empty decomposition")
+        dtype = resolve_block_dtype(dtype)
         if routing not in ("gather", "a2a"):
             raise ValueError(f"unknown routing {routing!r}")
         if routing == "a2a" and mesh is None:
@@ -154,11 +176,6 @@ class MultiLevelArrow:
         self.dense_budget = dense_budget
         if kernel not in ("xla", "pallas"):
             raise ValueError(f"unknown kernel {kernel!r}")
-        if kernel == "pallas" and mesh is not None:
-            # Pallas custom calls do not partition under GSPMD; the
-            # fused kernels are a single-chip path (per-shard use under
-            # shard_map is future work).
-            raise ValueError("kernel='pallas' requires mesh=None")
         if kernel == "pallas":
             try:
                 from arrow_matrix_tpu.ops import pallas_blocks  # noqa: F401
@@ -397,7 +414,8 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
     _aggregate_features_backwards, arrow_dec_mpi.py:404-440).
     ``x`` is flat (total_rows, k); each level reshapes to its own
     blocking (nb_i, w_i, k).  ``kernel="pallas"`` routes dense-format
-    levels through the fused Pallas kernels (single chip only).
+    levels through the fused Pallas kernels — directly on a single
+    chip, per shard under shard_map on a mesh.
     """
     from arrow_matrix_tpu.parallel.routing import take as routed_or_take
 
@@ -417,7 +435,19 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
             # Oversized levels (grown last-level width) whose feature
             # operands exceed VMEM fall back to XLA per level.
             use_pallas = pallas_blocks.feasible(w, k, blocks[i].banded)
-        if use_pallas:
+        if use_pallas and mesh is not None:
+            # Pallas custom calls do not partition under GSPMD, but the
+            # shard-local shapes under shard_map are static: run the
+            # slim step body per shard with the fused kernels inside
+            # and the usual psum/ppermute collectives around them.
+            from arrow_matrix_tpu.parallel.arrow_layout import (
+                slim_step_shard_map,
+            )
+
+            step = slim_step_shard_map(blocks[i], mesh, axis=axis,
+                                       kernel="pallas")
+            c = step(blocks[i], xb)
+        elif use_pallas:
             c = pallas_blocks.arrow_spmm_pallas(blocks[i], xb)
         else:
             c = arrow_spmm(blocks[i], xb,

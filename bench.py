@@ -101,6 +101,16 @@ def _measure(multi, x, iters: int) -> float:
     return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
 
 
+def _progress(msg: str) -> None:
+    """Stage markers on stderr (stdout carries only the JSON line): a
+    killed/timed-out run must be diagnosable from its partial output."""
+    print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
 def run_bench(result: dict) -> None:
     import jax
 
@@ -145,6 +155,7 @@ def run_bench(result: dict) -> None:
     if degraded:
         result["degraded"] = True
 
+    _progress(f"platform={dev.platform} kind={dev.device_kind} n={n} fmt={fmt}")
     t0 = time.perf_counter()
     a = barabasi_albert(n, m, seed=7)
     levels = arrow_decomposition(a, arrow_width=width, max_levels=4,
@@ -152,6 +163,7 @@ def run_bench(result: dict) -> None:
                                  backend="auto")
     result["config"]["decompose_s"] = round(time.perf_counter() - t0, 2)
 
+    _progress(f"decomposed in {result['config']['decompose_s']}s; building blocks")
     t0 = time.perf_counter()
     multi = MultiLevelArrow(levels, width, mesh=None, fmt=fmt,
                             dense_budget=budget)
@@ -166,15 +178,17 @@ def run_bench(result: dict) -> None:
     # --- Host CPU baseline: scipy CSR through the decomposition (the
     # reference's CPU path: per-level CSRMM + permutations).
     base_iters = 3 if n > (1 << 18) else iters
+    _progress(f"blocks built in {result['config']['build_s']}s; scipy baseline")
     xb = x_host.copy()
     t0 = time.perf_counter()
     for _ in range(base_iters):
         xb = decomposition_spmm(levels, xb)
     scipy_ms = (time.perf_counter() - t0) / base_iters * 1e3
 
-    # --- Device path.
+    _progress(f"scipy {scipy_ms:.1f} ms/iter; device path (compile+measure)")
     x = multi.set_features(x_host)
     dev_ms = _measure(multi, x, iters)
+    _progress(f"device {dev_ms:.2f} ms/iter; correctness gate")
 
     # --- Correctness gate: one device step vs the scipy golden, at the
     # documented accumulation-order tolerance (utils/numerics.py).
@@ -211,53 +225,93 @@ def run_bench(result: dict) -> None:
                           if peak else None),
     })
 
-    if not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1":
-        try:
-            result["kernel_compare"] = kernel_compare()
-        except Exception as e:  # comparison is diagnostics, not the gate
-            result["kernel_compare"] = {"error": f"{type(e).__name__}: {e}"}
-
     if not np.isfinite(err) or err > tol:
         raise RuntimeError(f"correctness gate failed: frobenius err "
                            f"{err:.3e} vs host CPU exceeds {tol:.1e}")
 
 
-def kernel_compare() -> dict:
-    """ms/iter of the ELL, dense and Pallas block kernels on one
-    mid-size config (dense must fit): the data for VERDICT r1 item 6
-    (integrate Pallas or retire it with numbers)."""
+COMPARE_VARIANTS = {
+    "ell": dict(fmt="ell"),
+    "dense": dict(fmt="dense"),
+    "pallas": dict(fmt="dense", kernel="pallas"),
+    "dense_bf16": dict(fmt="dense", dtype="bf16"),
+    "pallas_bf16": dict(fmt="dense", kernel="pallas", dtype="bf16"),
+}
+COMPARE_CONFIG = dict(n=65536, m=8, width=2048, k=16, iters=10)
+
+
+def run_one_variant(name: str) -> None:
+    """Build + measure ONE kernel variant; prints its ms as JSON.
+
+    Runs in a subprocess spawned by ``kernel_compare`` so that a
+    pathological kernel (e.g. a Mosaic compile that never returns — a
+    hang SIGALRM cannot interrupt inside native code) costs its own
+    timeout, not the whole bench."""
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "highest")
     from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition
     from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
     from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense
 
-    n, m, width, k, iters = 65536, 8, 2048, 16, 10
-    a = barabasi_albert(n, m, seed=7)
-    levels = arrow_decomposition(a, arrow_width=width, max_levels=2,
-                                 block_diagonal=True, seed=7,
-                                 backend="auto")
-    x_host = random_dense(n, k, seed=3)
+    c = COMPARE_CONFIG
+    a = barabasi_albert(c["n"], c["m"], seed=7)
+    levels = arrow_decomposition(a, arrow_width=c["width"], max_levels=2,
+                                 block_diagonal=True, seed=7, backend="auto")
+    x_host = random_dense(c["n"], c["k"], seed=3)
+    multi = MultiLevelArrow(levels, c["width"], mesh=None,
+                            **COMPARE_VARIANTS[name])
+    x = multi.set_features(x_host)
+    print(json.dumps({"ms": round(_measure(multi, x, c["iters"]), 3)}),
+          flush=True)
 
-    out = {"config": {"n": n, "width": width, "features": k}}
-    variants = [("ell", dict(fmt="ell")),
-                ("dense", dict(fmt="dense")),
-                ("pallas", dict(fmt="dense", kernel="pallas"))]
-    for name, kw in variants:
+
+def kernel_compare(timeout_s: float = 420.0) -> dict:
+    """ms/iter of the ELL / dense / Pallas / bf16 block kernels on one
+    mid-size config (dense must fit): the data for VERDICT r1 item 6
+    (integrate Pallas or retire it with numbers).  One subprocess per
+    variant, each with a hard timeout."""
+    out = {"config": dict(COMPARE_CONFIG)}
+    for name in COMPARE_VARIANTS:
+        _progress(f"kernel variant {name}")
         try:
-            multi = MultiLevelArrow(levels, width, mesh=None, **kw)
-            x = multi.set_features(x_host)
-            out[name + "_ms"] = round(_measure(multi, x, iters), 3)
-        except Exception as e:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--variant", name],
+                capture_output=True, text=True, timeout=timeout_s)
+            if proc.returncode == 0 and proc.stdout.strip():
+                out[name + "_ms"] = json.loads(
+                    proc.stdout.strip().splitlines()[-1])["ms"]
+            else:
+                out[name + "_ms"] = None
+                out[name + "_error"] = (f"rc={proc.returncode}: "
+                                        f"{proc.stderr.strip()[-300:]}")
+        except subprocess.TimeoutExpired:
             out[name + "_ms"] = None
-            out[name + "_error"] = f"{type(e).__name__}: {e}"
+            out[name + "_error"] = f"timed out after {timeout_s:.0f}s"
     return out
 
 
 def main() -> None:
+    if len(sys.argv) == 3 and sys.argv[1] == "--variant":
+        run_one_variant(sys.argv[2])
+        return
     result = {"metric": "spmm_iter_ms", "value": None, "unit": "ms",
               "vs_baseline": None}
     platform, probe_err = probe_backend()
     if probe_err:
         result["backend_probe_error"] = probe_err
+    # Kernel comparison runs FIRST, before this process initializes the
+    # accelerator backend: each variant subprocess needs the chip to
+    # itself (TPU ownership is exclusive per process), so the parent
+    # must not be holding it yet.
+    degraded = platform == "cpu" and os.environ.get("AMT_BENCH_FULL") != "1"
+    small = degraded or os.environ.get("AMT_BENCH_SMALL") == "1"
+    if not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1":
+        try:
+            result["kernel_compare"] = kernel_compare()
+        except Exception as e:  # comparison is diagnostics, not the gate
+            result["kernel_compare"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         run_bench(result)
     except BaseException as e:

@@ -1,16 +1,18 @@
-"""Test configuration: force an 8-device virtual CPU platform so the
+"""Test configuration: force a 16-device virtual CPU platform so the
 multi-chip sharding paths are exercised without TPU hardware (the TPU
 analog of the reference's ``mpiexec --oversubscribe`` many-rank fixture,
-reference scripts/run_tests.sh)."""
+reference scripts/run_tests.sh runs at up to 30 ranks).  Most tests use
+an 8-device sub-mesh; tests/test_mesh_sizes.py sweeps sub-meshes of
+2..16 devices including non-power-of-two sizes."""
 
 import os
 
 # Force CPU even when the environment selects a TPU platform: the test
-# suite must be hermetic and must exercise the virtual 8-device mesh.
+# suite must be hermetic and must exercise the virtual multi-device mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=16").strip()
 
 # Some environments (axon TPU tunnels) register an out-of-tree PJRT
 # plugin for every interpreter via sitecustomize; initializing it can

@@ -108,12 +108,73 @@ def test_multi_level_pallas_kernel_end_to_end():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
-def test_pallas_kernel_requires_single_chip():
+@pytest.mark.parametrize("n_dev", [4, 8])
+def test_multi_level_pallas_distributed_matches(n_dev):
+    """Per-shard Pallas under shard_map == XLA GSPMD path on a mesh
+    (VERDICT r1 item 6: the distributed Pallas integration)."""
     from arrow_matrix_tpu.parallel.mesh import make_mesh
 
-    a = barabasi_albert(128, 3, seed=4)
-    levels = arrow_decomposition(a, 16, max_levels=2, block_diagonal=True,
-                                 seed=0)
+    n, width = 512, 64
+    a = barabasi_albert(n, 3, seed=4)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=0)
+    x = random_dense(n, 8, seed=1)
+    mesh = make_mesh((n_dev,), ("blocks",))
+    ml_x = MultiLevelArrow(levels, width, mesh=mesh, fmt="dense")
+    ml_p = MultiLevelArrow(levels, width, mesh=mesh, fmt="dense",
+                           kernel="pallas")
+    want = ml_x.gather_result(ml_x.step(ml_x.set_features(x)))
+    got = ml_p.gather_result(ml_p.step(ml_p.set_features(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("banded", [False, True])
+def test_slim_spmm_pallas_kernel_matches(banded):
+    """make_slim_spmm(kernel='pallas') == kernel='xla' on a mesh,
+    including the banded ppermute halos feeding the fused kernel."""
+    import jax.numpy as jnp
+
+    from arrow_matrix_tpu.ops import block_features, unblock_features
+    from arrow_matrix_tpu.parallel import make_slim_spmm, shard_blocked
+    from arrow_matrix_tpu.parallel.mesh import make_mesh, shard_arrow_blocks
+
+    nb, w, k = 8, 32, 8
+    a = _arrow_csr(nb, w, banded, seed=9)
+    blocks = arrow_blocks_from_csr(a, w, banded=banded, fmt="dense")
     mesh = make_mesh((8,), ("blocks",))
-    with pytest.raises(ValueError):
-        MultiLevelArrow(levels, 16, mesh=mesh, kernel="pallas")
+    x_host = random_dense(nb * w, k, seed=2)
+    xb = shard_blocked(jnp.asarray(block_features(x_host, w, nb)), mesh)
+    bs = shard_arrow_blocks(blocks, mesh)
+
+    want = unblock_features(make_slim_spmm(blocks, mesh)(bs, xb), nb * w)
+    got = unblock_features(
+        make_slim_spmm(blocks, mesh, kernel="pallas")(bs, xb), nb * w)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got, a @ x_host, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_bf16_block_storage_matches_f32(kernel):
+    """bf16 block storage with f32 accumulation: halves resident-block
+    HBM bytes; result within bf16 rounding of the f32 path."""
+    n, width = 512, 64
+    a = barabasi_albert(n, 3, seed=6)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=0)
+    x = random_dense(n, 8, seed=2)
+    ml32 = MultiLevelArrow(levels, width, mesh=None, fmt="dense")
+    ml16 = MultiLevelArrow(levels, width, mesh=None, fmt="dense",
+                           dtype="bf16", kernel=kernel)
+    want = ml32.gather_result(ml32.step(ml32.set_features(x)))
+    got = ml16.gather_result(ml16.step(ml16.set_features(x)))
+    # bf16 has ~8 mantissa bits: 2^-8 per rounded operand.
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    blk = ml16.blocks[0]
+    assert blk.diag_data.dtype == jnp.bfloat16
+
+
+def test_unknown_dtype_rejected():
+    from arrow_matrix_tpu.parallel.multi_level import resolve_block_dtype
+
+    with pytest.raises(ValueError, match="unknown block dtype"):
+        resolve_block_dtype("fp8")

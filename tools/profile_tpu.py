@@ -1,0 +1,103 @@
+"""Per-component timing of the multi-level SpMM step on the live chip.
+
+Breaks the bench iteration into its constituent device programs — each
+level's full arrow SpMM, that level's head/diag/col stacks separately,
+and the inter-level routing gathers — so a slow iteration can be
+attributed to a specific kernel (the reference's per-segment timing
+philosophy, reference arrow/common/wb_logging.py, applied at kernel
+granularity).
+
+Usage:  python tools/profile_tpu.py [n] [width] [k]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=5) -> float:
+    """ms per call, host-fetch synced."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(leaf).ravel()[0])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+
+    from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition
+    from arrow_matrix_tpu.ops.arrow_blocks import (
+        arrow_spmm,
+        block_spmm,
+        block_spmm_shared,
+        head_block_spmm,
+    )
+    from arrow_matrix_tpu.parallel.multi_level import (
+        MultiLevelArrow,
+        resolve_chunk,
+    )
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense
+    from arrow_matrix_tpu.utils.platform import device_memory_budget
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+
+    t0 = time.perf_counter()
+    a = barabasi_albert(n, 8, seed=7)
+    levels = arrow_decomposition(a, arrow_width=width, max_levels=4,
+                                 block_diagonal=True, seed=7, backend="auto")
+    print(f"decomposed {n} rows -> {len(levels)} levels "
+          f"in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    budget = device_memory_budget(dev)
+    multi = MultiLevelArrow(levels, width, mesh=None, fmt="auto",
+                            dense_budget=budget)
+    print(f"fmts: {multi.fmts}  total_rows: {multi.total_rows}", flush=True)
+
+    x_host = random_dense(n, k, seed=3)
+    x = multi.set_features(x_host)
+
+    ms = timeit(multi.step, x)
+    print(f"full step: {ms:.1f} ms", flush=True)
+
+    total = multi.total_rows
+    gather_budget = max(multi.dense_budget // 4, 1 << 27)
+    for i, blk in enumerate(multi.blocks):
+        w = multi.widths[i]
+        xb = jnp.reshape(x, (total // w, w, k))
+        chunk = resolve_chunk("auto", blk, total, k, gather_budget)
+        lvl_ms = timeit(jax.jit(functools.partial(arrow_spmm, chunk=chunk)),
+                        blk, xb)
+        head_ms = timeit(
+            jax.jit(functools.partial(head_block_spmm, chunk=chunk)), blk, xb)
+        diag_ms = timeit(
+            jax.jit(lambda b, xx, c=chunk: block_spmm(
+                b.fmt, b.diag_cols, b.diag_data, xx, chunk=c)), blk, xb)
+        col_ms = timeit(
+            jax.jit(lambda b, xx, c=chunk: block_spmm_shared(
+                b.fmt, b.col_cols, b.col_data, xx[0], chunk=c)), blk, xb)
+        nnz = int(levels[i].matrix.nnz)
+        print(f"level {i}: fmt={blk.fmt} w={w} head_flat={blk.head_flat} "
+              f"nnz={nnz} full={lvl_ms:.1f}ms head={head_ms:.1f}ms "
+              f"diag={diag_ms:.1f}ms col={col_ms:.1f}ms", flush=True)
+
+    if len(multi.blocks) > 1:
+        fwd = multi.fwd
+        take_ms = timeit(jax.jit(lambda xx, t: jnp.take(xx, t, axis=0)),
+                         x, fwd[0])
+        print(f"routing gather (one exchange): {take_ms:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
