@@ -216,6 +216,21 @@ def choose_flat_head_from_stats(nb: int, width: int, max_row_nnz: int,
     return flat * 4 <= ell
 
 
+def head_stats(matrix: CsrLike, width: int, nb: int) -> tuple[int, int]:
+    """(max row nnz, max block nnz) over the head-row blocks A_0j —
+    the inputs of the flat-vs-ELL head decision, computed by loading
+    ONLY the head blocks (so callers can pre-agree a head format
+    across levels without building, then build once)."""
+    max_row = max_nnz = 0
+    for j in range(nb):
+        b = load_block(matrix, 0, width, j * width, (j + 1) * width, width)
+        counts = np.diff(b.indptr)
+        if counts.size:
+            max_row = max(max_row, int(counts.max()))
+        max_nnz = max(max_nnz, int(b.nnz))
+    return max_row, max_nnz
+
+
 def _choose_flat_head(head, width: int, dtype, head_fmt: str) -> bool:
     max_row = 0
     max_nnz = 0

@@ -146,7 +146,7 @@ class MultiLevelArrow:
                  banded: bool = False, dtype=np.float32,
                  chunk="auto", fmt: str = "auto",
                  dense_budget: Optional[int] = None, kernel: str = "xla",
-                 routing: str = "gather"):
+                 routing: str = "gather", head_fmt: str = "auto"):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -259,10 +259,11 @@ class MultiLevelArrow:
                 return arrow_blocks_streamed(
                     lvl.matrix, w, mesh, axis,
                     pad_blocks_to=self.total_rows // w,
-                    banded=bd, dtype=dtype, fmt=f)
+                    banded=bd, dtype=dtype, fmt=f, head_fmt=head_fmt)
             return arrow_blocks_from_csr(lvl.matrix, w,
                                          pad_blocks_to=self.total_rows // w,
-                                         banded=bd, dtype=dtype, fmt=f)
+                                         banded=bd, dtype=dtype, fmt=f,
+                                         head_fmt=head_fmt)
 
         self.blocks: List[ArrowBlocks] = [
             build(lvl, w, bd, f)

@@ -161,22 +161,34 @@ class SpaceSharedArrow:
         self.fmt = fmt
         self.chunk = chunk
 
+        # The stacked layout needs ONE head storage across levels.
+        # Pre-agree it from head-only stats (loads just the A_0j blocks,
+        # no full build), then build every level exactly once: flat if
+        # any level's auto choice would be flat (always correct, and the
+        # flat-preferring level is the one whose ELL padding would blow
+        # up).
+        from arrow_matrix_tpu.ops.arrow_blocks import (
+            choose_flat_head_from_stats,
+            head_stats,
+        )
+
+        if fmt == "ell":
+            decisions = [
+                choose_flat_head_from_stats(
+                    nb, w, *head_stats(lvl.matrix, w,
+                                       number_of_blocks(lvl.matrix, w)),
+                    dtype, "auto")
+                for lvl in levels
+            ]
+            head_fmt = "flat" if any(decisions) else "ell"
+        else:
+            head_fmt = "auto"  # dense blocks have no head variant
         per_level = [
             arrow_blocks_from_csr(lvl.matrix, w, pad_blocks_to=nb,
-                                  banded=True, dtype=dtype, fmt=fmt)
+                                  banded=True, dtype=dtype, fmt=fmt,
+                                  head_fmt=head_fmt)
             for lvl in levels
         ]
-        # The stacked layout needs one head storage across levels; if
-        # the per-level auto choices disagree, force flat everywhere
-        # (always correct, and the flat-preferring level is the one
-        # whose ELL padding would blow up).
-        if len({b.head_flat for b in per_level}) > 1:
-            per_level = [
-                b if b.head_flat else arrow_blocks_from_csr(
-                    lvl.matrix, w, pad_blocks_to=nb, banded=True,
-                    dtype=dtype, fmt=fmt, head_fmt="flat")
-                for b, lvl in zip(per_level, levels)
-            ]
         blocks = stack_arrow_blocks(per_level)
 
         # Directly-composed routing tables (module docstring): row j of

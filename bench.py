@@ -232,6 +232,11 @@ def run_bench(result: dict) -> None:
 
 COMPARE_VARIANTS = {
     "ell": dict(fmt="ell"),
+    # Head-stack kernel isolation: flat-COO head = scatter-add (TPU
+    # scatters serialize), ELL head = gather + reduce.  The spread
+    # between these two is the head-kernel cost.
+    "ell_headell": dict(fmt="ell", head_fmt="ell"),
+    "ell_headflat": dict(fmt="ell", head_fmt="flat"),
     "dense": dict(fmt="dense"),
     "pallas": dict(fmt="dense", kernel="pallas"),
     "dense_bf16": dict(fmt="dense", dtype="bf16"),

@@ -260,3 +260,19 @@ def test_hybrid_mesh_single_granule_fallback():
 
     m = make_hybrid_mesh((8,), (1,), ("blocks",))
     assert m.shape["blocks"] == 8
+
+
+@pytest.mark.parametrize("head_fmt", ["ell", "flat"])
+def test_multi_level_head_fmt_matches(mesh, head_fmt):
+    """Explicit head formats (gather-ELL vs scatter-flat) agree with the
+    golden — the two kernels bench.py races on the chip."""
+    n, width = 320, 32
+    a = barabasi_albert(n, 4, seed=61)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=5)
+    ml = MultiLevelArrow(levels, width, mesh=mesh, fmt="ell",
+                         head_fmt=head_fmt)
+    x_host = random_dense(n, 8, seed=13)
+    out = ml.gather_result(ml.step(ml.set_features(x_host)))
+    np.testing.assert_allclose(out, a @ x_host, rtol=1e-3, atol=1e-3)
+    assert all(b.head_flat == (head_fmt == "flat") for b in ml.blocks)

@@ -1,0 +1,90 @@
+"""Per-iteration communication-volume report on the virtual CPU mesh.
+
+Communication volume is the reference paper's headline metric
+(reference README.md:3); here the collectives are compiler-inserted, so
+the report reads them back out of the compiled HLO (utils/commstats)
+for every execution mode the framework offers, next to the O(moved
+rows) analytic lower bound:
+
+  * time-shared, routing="gather"  (GSPMD lowers the permutation
+    gathers itself — may all-gather whole feature arrays)
+  * time-shared, routing="a2a"     (explicit precomputed send/recv
+    tables over one fixed-shape all_to_all per exchange — the
+    reference's Alltoallv tables, arrow_dec_mpi.py:210-281)
+  * space-shared                   (composed-gather + cross-group
+    reduce, parallel/space_shared.py)
+
+Usage: python tools/comm_report.py [n] [width] [k] [n_dev]
+"""
+
+import sys
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+width = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+k = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+n_dev = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+from arrow_matrix_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(n_dev)
+
+import numpy as np  # noqa: E402
+
+from arrow_matrix_tpu.decomposition import arrow_decomposition  # noqa: E402
+from arrow_matrix_tpu.parallel import (  # noqa: E402
+    MultiLevelArrow,
+    make_mesh,
+)
+from arrow_matrix_tpu.parallel.multi_level import (  # noqa: E402
+    pad_permutation,
+)
+from arrow_matrix_tpu.parallel.space_shared import (  # noqa: E402
+    SpaceSharedArrow,
+)
+from arrow_matrix_tpu.utils import commstats  # noqa: E402
+from arrow_matrix_tpu.utils.graphs import (  # noqa: E402
+    barabasi_albert,
+    random_dense,
+)
+
+
+def main() -> None:
+    a = barabasi_albert(n, 4, seed=7)
+    levels = arrow_decomposition(a, arrow_width=width, max_levels=4,
+                                 block_diagonal=True, seed=7)
+    x_host = random_dense(n, k, seed=1)
+    print(f"n={n} width={width} k={k} devices={n_dev} "
+          f"levels={len(levels)}\n")
+
+    reports = {}
+    mesh = make_mesh((n_dev,), ("blocks",))
+    for routing in ("gather", "a2a"):
+        ml = MultiLevelArrow(levels, width, mesh=mesh, routing=routing)
+        x = ml.set_features(x_host)
+        reports[f"time-shared/{routing}"] = (
+            commstats.collective_stats(ml._step, x, ml.fwd, ml.bwd,
+                                       ml.blocks),
+            ml,
+        )
+
+    if n_dev % len(levels) == 0:
+        ss = SpaceSharedArrow(levels, width)
+        xs = ss.set_features(x_host)
+        reports["space-shared"] = (
+            commstats.collective_stats(ss._step, xs, ss.bwd0, ss.fwd0,
+                                       ss.blocks),
+            ss,
+        )
+
+    some_ml = next(iter(reports.values()))[1]
+    perms = [pad_permutation(np.asarray(l.permutation), some_ml.total_rows)
+             for l in levels]
+    ideal = commstats.ideal_routing_bytes(perms, n_dev, k)
+    for name, (stats, _) in reports.items():
+        print(f"== {name}")
+        print(commstats.format_stats(stats))
+        print(f"{'ideal routing':20s} {'':6s} {ideal:14,d}\n")
+
+
+if __name__ == "__main__":
+    main()
