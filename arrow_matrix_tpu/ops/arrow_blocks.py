@@ -35,7 +35,12 @@ import numpy as np
 from flax import struct
 from scipy import sparse
 
-from arrow_matrix_tpu.io.graphio import CsrLike, load_block, number_of_blocks
+from arrow_matrix_tpu.io.graphio import (
+    CsrLike,
+    load_block,
+    num_rows,
+    number_of_blocks,
+)
 from arrow_matrix_tpu.ops.ell import (
     dense_pack_stack,
     dense_spmm_batched,
@@ -75,6 +80,14 @@ class ArrowBlocks:
     # per block-row, so dense costs 3·n·w memory at n rows / width w.
     fmt: str = struct.field(pytree_node=False, default="ell")
     head_flat: bool = struct.field(pytree_node=False, default=False)
+    # Global-row ELL head (head_gell=True): head_cols/head_data are
+    # (w, m) over GLOBAL column indices — the head has only w rows, so
+    # one ELL over the whole row space is compact even when per-block
+    # ELL would degenerate to dense, and the compute is a chunked
+    # gather+reduce instead of the flat head's scatter-add (TPU
+    # scatters serialize; gathers stream).  Single-chip layout: the
+    # gather reads the whole feature array, so it does not shard.
+    head_gell: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def n_rows(self) -> int:
@@ -146,20 +159,26 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
             return no_cols, dense_pack_stack(mats, dtype=dtype, rows=width)
         return ell_pack_stack(mats, dtype=dtype, rows=width)
 
-    head = [blk(0, j) if j < nb else None for j in range(nb_padded)]
+    head_rows = None
+    head_flat = False
+    head_gell = fmt == "ell" and head_fmt == "gell"
+    if head_gell:
+        head_cols, head_data, head_nnz = _gell_head_pack(matrix, width,
+                                                         dtype=dtype)
+        captured += head_nnz
+    else:
+        head = [blk(0, j) if j < nb else None for j in range(nb_padded)]
+        head_flat = fmt == "ell" and _choose_flat_head(head, width, dtype,
+                                                       head_fmt)
+        if head_flat:
+            from arrow_matrix_tpu.ops.ell import flat_pack_stack
+
+            head_rows, head_cols, head_data = flat_pack_stack(
+                head, dtype=dtype, rows=width)
+        else:
+            head_cols, head_data = pack(head)
     diag = [None] + [blk(i, i) if i < nb else None for i in range(1, nb_padded)]
     col = [None] + [blk(i, 0) if i < nb else None for i in range(1, nb_padded)]
-
-    head_flat = fmt == "ell" and _choose_flat_head(head, width, dtype,
-                                                   head_fmt)
-    head_rows = None
-    if head_flat:
-        from arrow_matrix_tpu.ops.ell import flat_pack_stack
-
-        head_rows, head_cols, head_data = flat_pack_stack(
-            head, dtype=dtype, rows=width)
-    else:
-        head_cols, head_data = pack(head)
     diag_cols, diag_data = pack(diag)
     col_cols, col_data = pack(col)
 
@@ -193,7 +212,35 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
         head_rows=(jnp.asarray(head_rows) if head_rows is not None
                    else None),
         width=width, n_blocks=nb_padded, banded=banded, fmt=fmt,
-        head_flat=head_flat, **kw)
+        head_flat=head_flat, head_gell=head_gell, **kw)
+
+
+def _gell_head_pack(matrix: CsrLike, width: int, dtype=np.float32
+                    ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Head rows [0, width) packed as ONE (width, m) ELL over *global*
+    column indices (see ArrowBlocks.head_gell).  Returns
+    (cols, data, nnz); m is the max head-row degree, slot-aligned."""
+    from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up, ell_pack
+
+    n = num_rows(matrix)
+    if isinstance(matrix, sparse.csr_matrix):
+        data, indices, indptr = matrix.data, matrix.indices, matrix.indptr
+    else:
+        data, indices, indptr = matrix
+    w_eff = min(width, n)
+    hi = int(indptr[w_eff])
+    sub_indptr = np.asarray(indptr[:w_eff + 1], dtype=np.int64)
+    if w_eff < width:  # empty padding rows
+        sub_indptr = np.pad(sub_indptr, (0, width - w_eff), mode="edge")
+    sub_data = (np.ones(hi, dtype=np.float32) if data is None
+                else np.asarray(data[:hi]))
+    sub = sparse.csr_matrix((sub_data, np.asarray(indices[:hi]), sub_indptr),
+                            shape=(width, n))
+    counts = np.diff(sub.indptr)
+    need = int(counts.max()) if counts.size and counts.max() > 0 else 0
+    m = align_up(need, SLOT_ALIGN) if need else 0
+    cols, packed = ell_pack(sub, max_nnz=m, dtype=dtype)
+    return cols, packed, hi
 
 
 def choose_flat_head_from_stats(nb: int, width: int, max_row_nnz: int,
@@ -437,6 +484,11 @@ def head_block_spmm(blocks: ArrowBlocks, x: jax.Array,
     ELL/dense heads go through ``block_spmm``.  Works identically on
     global arrays and on per-shard slices under shard_map.
     """
+    if blocks.head_gell:
+        raise ValueError(
+            "gell heads gather from the whole feature array and have no "
+            "per-block form; they do not shard — use head_fmt='flat' or "
+            "'ell' on a mesh (arrow_spmm handles gell directly)")
     if blocks.head_flat:
         from arrow_matrix_tpu.ops.ell import csr_flat_spmm
 
@@ -471,7 +523,14 @@ def arrow_spmm(blocks: ArrowBlocks, x: jax.Array,
     nb, w, k = x.shape
     assert nb == blocks.n_blocks and w == blocks.width
 
-    c0 = head_block_spmm(blocks, x, chunk=chunk).sum(axis=0)
+    if blocks.head_gell:
+        # One gather+reduce over the flat feature array (w output rows
+        # only): the TPU-native head kernel — no scatter, MXU-friendly
+        # weighted reduction, chunked like every other ELL stack.
+        c0 = ell_spmm(blocks.head_cols, blocks.head_data,
+                      x.reshape(nb * w, k), chunk=chunk)
+    else:
+        c0 = head_block_spmm(blocks, x, chunk=chunk).sum(axis=0)
 
     c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
                    chunk=chunk)

@@ -160,6 +160,11 @@ class MultiLevelArrow:
         dtype = resolve_block_dtype(dtype)
         if routing not in ("gather", "a2a"):
             raise ValueError(f"unknown routing {routing!r}")
+        if head_fmt == "gell" and mesh is not None:
+            raise ValueError(
+                "head_fmt='gell' is the single-chip head layout (its "
+                "gather reads the whole feature array); use 'flat', "
+                "'ell' or 'auto' on a mesh")
         if routing == "a2a" and mesh is None:
             raise ValueError("routing='a2a' requires a mesh")
         if dense_budget is None:
@@ -253,17 +258,37 @@ class MultiLevelArrow:
         # RSS to O(level / n_devices) so >RAM artifacts ingest without
         # ever materializing a level (the reference's
         # root-reads-and-ships loader role, arrow_dec_mpi.py:629-887).
+        def resolve_head_fmt(lvl, w, f) -> str:
+            """Platform-aware "auto": on a single TPU chip an ELL
+            level's head goes gell when compact — the flat head's
+            scatter-add serializes on TPU, the gell gather streams —
+            falling back to the flat/ell size rule when one mega-degree
+            head row would blow the gell slot budget."""
+            if head_fmt != "auto" or mesh is not None or f != "ell":
+                return head_fmt
+            if jax.default_backend() != "tpu":
+                return head_fmt
+            indptr = (lvl.matrix.indptr
+                      if isinstance(lvl.matrix, sparse.csr_matrix)
+                      else lvl.matrix[2])
+            w_eff = min(w, indptr.shape[0] - 1)
+            counts = np.diff(np.asarray(indptr[:w_eff + 1]))
+            need = int(counts.max()) if counts.size else 0
+            gell_bytes = w * need * (4 + np.dtype(dtype).itemsize)
+            return "gell" if gell_bytes <= dense_budget // 8 else "auto"
+
         def build(lvl, w, bd, f) -> ArrowBlocks:
+            hf = resolve_head_fmt(lvl, w, f)
             if mesh is not None and not isinstance(lvl.matrix,
                                                    sparse.csr_matrix):
                 return arrow_blocks_streamed(
                     lvl.matrix, w, mesh, axis,
                     pad_blocks_to=self.total_rows // w,
-                    banded=bd, dtype=dtype, fmt=f, head_fmt=head_fmt)
+                    banded=bd, dtype=dtype, fmt=f, head_fmt=hf)
             return arrow_blocks_from_csr(lvl.matrix, w,
                                          pad_blocks_to=self.total_rows // w,
                                          banded=bd, dtype=dtype, fmt=f,
-                                         head_fmt=head_fmt)
+                                         head_fmt=hf)
 
         self.blocks: List[ArrowBlocks] = [
             build(lvl, w, bd, f)

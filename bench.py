@@ -237,6 +237,7 @@ COMPARE_VARIANTS = {
     # between these two is the head-kernel cost.
     "ell_headell": dict(fmt="ell", head_fmt="ell"),
     "ell_headflat": dict(fmt="ell", head_fmt="flat"),
+    "ell_headgell": dict(fmt="ell", head_fmt="gell"),
     "dense": dict(fmt="dense"),
     "pallas": dict(fmt="dense", kernel="pallas"),
     "dense_bf16": dict(fmt="dense", dtype="bf16"),

@@ -159,3 +159,95 @@ def test_dense_format_matches_ell():
         np.testing.assert_allclose(np.asarray(arrow_spmm(dense, x)),
                                    np.asarray(arrow_spmm(ell, x)),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_gell_head_matches_golden():
+    """Global-row ELL head (head_fmt='gell'): one gather+reduce over
+    the flat feature array replaces the flat head's scatter-add."""
+    import jax
+
+    from arrow_matrix_tpu.ops import arrow_blocks_from_csr, arrow_spmm
+    from arrow_matrix_tpu.ops.arrow_blocks import head_block_spmm
+
+    nb, w, k = 6, 32, 8
+    rng = np.random.default_rng(31)
+
+    def blk():
+        return sparse.random(w, w, density=0.3, random_state=rng,
+                             dtype=np.float32)
+
+    grid = [[None] * nb for _ in range(nb)]
+    for j in range(nb):
+        grid[0][j] = blk()
+    for i in range(1, nb):
+        grid[i][0] = blk()
+        grid[i][i] = blk()
+    a = sparse.bmat(grid, format="csr").astype(np.float32)
+    a.sum_duplicates()
+    a.sort_indices()
+    x_host = random_dense(nb * w, k, seed=5)
+    xb = jnp.asarray(x_host.reshape(nb, w, k))
+
+    g = arrow_blocks_from_csr(a, w, head_fmt="gell")
+    assert g.head_gell and g.head_cols.shape[0] == w
+    got = np.asarray(jax.jit(arrow_spmm)(g, xb)).reshape(nb * w, k)
+    np.testing.assert_allclose(got, a @ x_host, rtol=1e-5, atol=1e-5)
+
+    # Chunked slot axis agrees with unchunked.
+    got_c = np.asarray(arrow_spmm(g, xb, chunk=8)).reshape(nb * w, k)
+    np.testing.assert_allclose(got_c, got, rtol=1e-6, atol=1e-6)
+
+    # The per-block head API rejects gell blocks with a clear error.
+    with pytest.raises(ValueError, match="gell"):
+        head_block_spmm(g, xb)
+
+
+def test_gell_head_rejected_on_mesh():
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    a = barabasi_albert(128, 3, seed=1)
+    levels = arrow_decomposition(a, 16, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    with pytest.raises(ValueError, match="single-chip"):
+        MultiLevelArrow(levels, 16, mesh=make_mesh((8,), ("blocks",)),
+                        head_fmt="gell")
+
+
+def test_gell_head_multi_level_end_to_end():
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    n, width = 480, 32
+    a = barabasi_albert(n, 4, seed=17)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    ml = MultiLevelArrow(levels, width, mesh=None, fmt="ell",
+                        head_fmt="gell")
+    assert all(b.head_gell for b in ml.blocks)
+    x_host = random_dense(n, 8, seed=3)
+    out = ml.gather_result(ml.step(ml.set_features(x_host)))
+    np.testing.assert_allclose(out, decomposition_spmm(levels, x_host),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_head_auto_prefers_gell_on_tpu(monkeypatch):
+    """Platform-aware head auto-rule: single-chip TPU ELL levels pick
+    the gather-based gell head (scatter-adds serialize on TPU)."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    n, width = 480, 32
+    a = barabasi_albert(n, 4, seed=23)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    ml = MultiLevelArrow(levels, width, mesh=None, fmt="ell")
+    assert all(b.head_gell for b in ml.blocks)
+    x_host = random_dense(n, 8, seed=4)
+    out = ml.gather_result(ml.step(ml.set_features(x_host)))
+    np.testing.assert_allclose(out, a @ x_host, rtol=1e-3, atol=1e-3)
