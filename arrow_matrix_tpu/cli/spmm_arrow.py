@@ -69,6 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["auto", "dense", "ell"],
                         help="Device block format (TPU-specific: dense = "
                              "MXU batched matmuls, ell = gather path).")
+    parser.add_argument("--head_fmt", type=str, default="auto",
+                        choices=["auto", "flat", "ell", "gell"],
+                        help="Head-stack storage for ELL levels: flat "
+                             "(scatter-add, O(nnz)), ell (per-block "
+                             "gather), gell (global-row gather; "
+                             "single-chip only), auto (platform-aware).")
     parser.add_argument("--mode", type=str, default="time",
                         choices=["time", "space"],
                         help="Multi-matrix execution mode: 'time' sweeps "
@@ -194,6 +200,7 @@ def main(argv=None) -> int:
             mesh = make_mesh((n_dev,), ("blocks",)) if n_dev > 1 else None
             multi = MultiLevelArrow(levels, width, mesh=mesh,
                                     banded=not args.blocked, fmt=args.fmt,
+                                    head_fmt=args.head_fmt,
                                     routing=(args.routing if mesh is not None
                                              else "gather"))
 

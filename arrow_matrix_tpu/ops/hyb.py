@@ -1,0 +1,147 @@
+"""HYB (split-ELL) whole-level SpMM — the single-chip fast path.
+
+Within one device the arrow block structure buys nothing: the reference
+computes a rank's whole share with one general CSRMM (cuSPARSE via
+cupy, reference arrow/common/sp2cp.py:6-16); blocking only shapes the
+*communication*.  The TPU-native general SpMM is ELL (gathers stream,
+MXU does the weighted reduction) — but one power-law hub row would pad
+every row's slots to the hub degree.  So split by degree, the classic
+HYB layout re-derived for TPU:
+
+  * light rows (degree <= m0): one (rows, m0) row-ELL over global
+    columns — O(rows x m0) storage, pure chunked gather+reduce;
+  * heavy rows (the few hubs): their own compact (h, m_h) ELL plus a
+    row-index list; results are written back with one h-row scatter
+    (h ~ hundreds, negligible).
+
+m0 is chosen as the smallest aligned slot count that keeps the heavy
+list under a row-count cap, so light storage is bounded and the heavy
+ELL stays small.  An arrow decomposition's *levels* remain the unit of
+distribution; HYB replaces only the per-level device kernel when the
+level lives on one chip (``MultiLevelArrow(fmt="hyb")``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from scipy import sparse
+
+from arrow_matrix_tpu.io.graphio import CsrLike, num_rows
+from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up, ell_spmm
+
+
+@struct.dataclass
+class HybLevel:
+    """One level's matrix in split-ELL form (see module docstring)."""
+
+    light_cols: jax.Array    # (rows, m0) int32
+    light_data: jax.Array    # (rows, m0)
+    heavy_idx: jax.Array     # (h,) int32 row indices (h may be 0)
+    heavy_cols: jax.Array    # (h, m_h) int32
+    heavy_data: jax.Array    # (h, m_h)
+
+    n_rows: int = struct.field(pytree_node=False, default=0)
+
+    def device_nbytes(self) -> int:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self):
+            total += leaf.size * leaf.dtype.itemsize
+        return total
+
+
+def choose_light_slots(degrees: np.ndarray, heavy_cap: int,
+                       align: int = SLOT_ALIGN) -> int:
+    """Smallest aligned slot count m0 with at most ``heavy_cap`` rows
+    of degree > m0."""
+    if degrees.size == 0:
+        return 0
+    cap = min(max(heavy_cap, 0), degrees.size - 1)
+    kth = np.partition(degrees, degrees.size - 1 - cap)[
+        degrees.size - 1 - cap]
+    return align_up(max(int(kth), 1), align)
+
+
+def hyb_from_csr(matrix: CsrLike, pad_rows_to: Optional[int] = None,
+                 dtype=np.float32, heavy_cap: Optional[int] = None,
+                 ) -> HybLevel:
+    """Split a CSR (or memmapped triplet) level into a HybLevel.
+
+    ``pad_rows_to`` appends empty rows so all levels share one static
+    row count; ``heavy_cap`` bounds the heavy list (default: rows/256,
+    at least 512).
+    """
+    n = num_rows(matrix)
+    total = max(pad_rows_to or n, n)
+    if isinstance(matrix, sparse.csr_matrix):
+        data, indices, indptr = matrix.data, matrix.indices, matrix.indptr
+    else:
+        data, indices, indptr = matrix
+    indptr = np.asarray(indptr, dtype=np.int64)
+    degrees = np.diff(indptr)
+    if heavy_cap is None:
+        heavy_cap = max(512, total // 256)
+    m0 = choose_light_slots(degrees, heavy_cap)
+
+    heavy_mask = degrees > m0
+    heavy_rows = np.flatnonzero(heavy_mask)
+    h = heavy_rows.size
+
+    nnz = int(indptr[-1])
+    all_data = (np.ones(nnz, dtype=dtype) if data is None
+                else np.asarray(data[:nnz]).astype(dtype, copy=False))
+    all_cols = np.asarray(indices[:nnz])
+
+    light_cols = np.zeros((total, m0), dtype=np.int32)
+    light_data = np.zeros((total, m0), dtype=dtype)
+    light_counts = np.where(heavy_mask, 0, degrees)
+    if light_counts.sum():
+        starts = np.repeat(indptr[:-1][~heavy_mask],
+                           degrees[~heavy_mask])
+        slot = (np.arange(starts.size)
+                - np.repeat(np.cumsum(degrees[~heavy_mask])
+                            - degrees[~heavy_mask],
+                            degrees[~heavy_mask]))
+        flat = np.repeat(np.arange(n)[~heavy_mask], degrees[~heavy_mask])
+        src = starts + slot
+        light_cols[flat, slot] = all_cols[src]
+        light_data[flat, slot] = all_data[src]
+
+    if h:
+        m_h = align_up(int(degrees[heavy_rows].max()), SLOT_ALIGN)
+        heavy_cols = np.zeros((h, m_h), dtype=np.int32)
+        heavy_data = np.zeros((h, m_h), dtype=dtype)
+        for out_i, r in enumerate(heavy_rows):
+            lo, hi = int(indptr[r]), int(indptr[r + 1])
+            heavy_cols[out_i, :hi - lo] = all_cols[lo:hi]
+            heavy_data[out_i, :hi - lo] = all_data[lo:hi]
+    else:
+        heavy_cols = np.zeros((0, 0), dtype=np.int32)
+        heavy_data = np.zeros((0, 0), dtype=dtype)
+
+    return HybLevel(
+        light_cols=jnp.asarray(light_cols),
+        light_data=jnp.asarray(light_data),
+        heavy_idx=jnp.asarray(heavy_rows.astype(np.int32)),
+        heavy_cols=jnp.asarray(heavy_cols),
+        heavy_data=jnp.asarray(heavy_data),
+        n_rows=total)
+
+
+def hyb_spmm(level: HybLevel, x: jax.Array,
+             chunk: Optional[int] = None,
+             heavy_chunk: Optional[int] = None) -> jax.Array:
+    """``level @ x`` on flat (rows, k) features: light row-ELL gather +
+    compact heavy ELL, merged by one h-row scatter."""
+    out = ell_spmm(level.light_cols, level.light_data, x, chunk=chunk)
+    if level.heavy_idx.shape[0]:
+        heavy = ell_spmm(level.heavy_cols, level.heavy_data, x,
+                         chunk=heavy_chunk)
+        out = out.at[level.heavy_idx].set(heavy.astype(out.dtype),
+                                          unique_indices=True,
+                                          indices_are_sorted=True)
+    return out

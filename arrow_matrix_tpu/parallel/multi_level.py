@@ -57,6 +57,7 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
     arrow_blocks_streamed,
     arrow_spmm,
 )
+from arrow_matrix_tpu.ops.hyb import HybLevel
 from arrow_matrix_tpu.parallel.mesh import (
     pad_to_multiple,
     shard_arrow_blocks,
@@ -165,6 +166,13 @@ class MultiLevelArrow:
                 "head_fmt='gell' is the single-chip head layout (its "
                 "gather reads the whole feature array); use 'flat', "
                 "'ell' or 'auto' on a mesh")
+        if fmt == "hyb" and mesh is not None:
+            raise ValueError(
+                "fmt='hyb' is the single-chip whole-level kernel (the "
+                "arrow block structure exists to shape communication; "
+                "within one chip a general split-ELL SpMM replaces it, "
+                "the way the reference's per-rank cuSPARSE CSRMM does "
+                "— sp2cp.py:6-16); use 'auto'/'dense'/'ell' on a mesh")
         if routing == "a2a" and mesh is None:
             raise ValueError("routing='a2a' requires a mesh")
         if dense_budget is None:
@@ -277,7 +285,13 @@ class MultiLevelArrow:
             gell_bytes = w * need * (4 + np.dtype(dtype).itemsize)
             return "gell" if gell_bytes <= dense_budget // 8 else "auto"
 
-        def build(lvl, w, bd, f) -> ArrowBlocks:
+        def build(lvl, w, bd, f):
+            if f == "hyb":
+                from arrow_matrix_tpu.ops.hyb import hyb_from_csr
+
+                return hyb_from_csr(lvl.matrix,
+                                    pad_rows_to=self.total_rows,
+                                    dtype=dtype)
             hf = resolve_head_fmt(lvl, w, f)
             if mesh is not None and not isinstance(lvl.matrix,
                                                    sparse.csr_matrix):
@@ -452,6 +466,17 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
     for i in range(k_levels):
         if i > 0:
             x_cur = routed_or_take(x_cur, fwd[i - 1], mesh, axis)
+        if isinstance(blocks[i], HybLevel):
+            # Whole-level split-ELL on flat features (single chip; no
+            # blocking — see ops/hyb.py).
+            from arrow_matrix_tpu.ops.ell import auto_chunk
+            from arrow_matrix_tpu.ops.hyb import hyb_spmm
+
+            m0 = blocks[i].light_cols.shape[-1]
+            hyb_chunk = (auto_chunk(total, k, m0, gather_budget)
+                         if chunk == "auto" else chunk)
+            partials.append(hyb_spmm(blocks[i], x_cur, chunk=hyb_chunk))
+            continue
         w = widths[i]
         xb = x_cur.reshape(total // w, w, k)
         use_pallas = False

@@ -145,6 +145,7 @@ def run_bench(result: dict) -> None:
         n, m, width, k, iters = 1 << 20, 8, 2048, 16, 10
         fmt = "auto"
     n = int(os.environ.get("AMT_BENCH_N", n))
+    fmt = os.environ.get("AMT_BENCH_FMT", fmt)
 
     budget = device_memory_budget(dev)
     result["config"] = {"n": n, "width": width, "features": k,
@@ -238,6 +239,7 @@ COMPARE_VARIANTS = {
     "ell_headell": dict(fmt="ell", head_fmt="ell"),
     "ell_headflat": dict(fmt="ell", head_fmt="flat"),
     "ell_headgell": dict(fmt="ell", head_fmt="gell"),
+    "hyb": dict(fmt="hyb"),
     "dense": dict(fmt="dense"),
     "pallas": dict(fmt="dense", kernel="pallas"),
     "dense_bf16": dict(fmt="dense", dtype="bf16"),

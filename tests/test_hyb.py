@@ -1,0 +1,105 @@
+"""HYB (split-ELL) whole-level kernel tests (ops/hyb.py): the
+single-chip general SpMM replacing arrow blocking within one device
+(the role of the reference's per-rank cuSPARSE CSRMM, sp2cp.py:6-16)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.decomposition import arrow_decomposition
+from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+from arrow_matrix_tpu.ops.hyb import (
+    HybLevel,
+    choose_light_slots,
+    hyb_from_csr,
+    hyb_spmm,
+)
+from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+
+def test_choose_light_slots():
+    deg = np.array([1, 2, 3, 100, 200])
+    # cap=2 heavy rows: m0 covers the 3rd largest (3), aligned to 8.
+    assert choose_light_slots(deg, heavy_cap=2) == 8
+    assert choose_light_slots(deg, heavy_cap=0) == 200
+    assert choose_light_slots(np.array([], dtype=np.int64), 4) == 0
+
+
+@pytest.mark.parametrize("chunk", [None, 8])
+def test_hyb_spmm_matches_scipy(chunk):
+    rng = np.random.default_rng(0)
+    a = sparse.random(200, 200, density=0.05, format="csr",
+                      random_state=rng, dtype=np.float32)
+    # Inject two hub rows so the heavy path is exercised.
+    a = a.tolil()
+    a[7, :] = rng.standard_normal(200).astype(np.float32)
+    a[123, ::2] = 1.0
+    a = a.tocsr()
+    a.sum_duplicates()
+    a.sort_indices()
+
+    h = hyb_from_csr(a, heavy_cap=4)
+    assert h.heavy_idx.shape[0] >= 2
+    x = random_dense(200, 8, seed=1)
+    out = np.asarray(hyb_spmm(h, jnp.asarray(x), chunk=chunk))
+    np.testing.assert_allclose(out, a @ x, rtol=1e-4, atol=1e-5)
+
+
+def test_hyb_row_padding():
+    a = sparse.identity(10, format="csr", dtype=np.float32)
+    h = hyb_from_csr(a, pad_rows_to=16)
+    x = random_dense(16, 4, seed=2)
+    out = np.asarray(hyb_spmm(h, jnp.asarray(x)))
+    assert out.shape == (16, 4)
+    np.testing.assert_allclose(out[:10], x[:10], rtol=1e-6, atol=1e-6)
+    assert np.all(out[10:] == 0)
+
+
+def test_hyb_implicit_ones_triplet():
+    a = barabasi_albert(100, 3, seed=4)
+    trip = (None, a.indices, a.indptr)   # memmap-style implicit data
+    h = hyb_from_csr(trip)
+    x = random_dense(100, 4, seed=3)
+    out = np.asarray(hyb_spmm(h, jnp.asarray(x)))
+    np.testing.assert_allclose(out, a.astype(np.float32) @ x,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_multi_level_hyb_matches_golden():
+    """fmt='hyb' end-to-end, including a grown last level whose arrow
+    blocking would be pathological (the protocol-scale finding)."""
+    n, width = 480, 32
+    a = barabasi_albert(n, 6, seed=19)
+    levels = arrow_decomposition(a, width, max_levels=2,
+                                 block_diagonal=True, seed=2)
+    assert levels[-1].arrow_width > width  # grown last level
+    ml = MultiLevelArrow(levels, width, mesh=None, fmt="hyb")
+    assert all(isinstance(b, HybLevel) for b in ml.blocks)
+    x_host = random_dense(n, 8, seed=3)
+    out = ml.gather_result(ml.step(ml.set_features(x_host)))
+    np.testing.assert_allclose(out, decomposition_spmm(levels, x_host),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(out, a @ x_host, rtol=1e-3, atol=1e-3)
+
+    # Iterated run (lax.scan) works over HybLevel pytrees too.
+    a2 = (a / 8.0).tocsr().astype(np.float32)
+    levels2 = arrow_decomposition(a2, width, max_levels=2,
+                                  block_diagonal=True, seed=2)
+    ml2 = MultiLevelArrow(levels2, width, mesh=None, fmt="hyb")
+    xd = ml2.run(ml2.set_features(x_host), 3)
+    want = x_host
+    for _ in range(3):
+        want = a2 @ want
+    np.testing.assert_allclose(ml2.gather_result(xd), want,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_hyb_rejected_on_mesh():
+    a = barabasi_albert(128, 3, seed=1)
+    levels = arrow_decomposition(a, 16, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    with pytest.raises(ValueError, match="single-chip"):
+        MultiLevelArrow(levels, 16, mesh=make_mesh((8,), ("blocks",)),
+                        fmt="hyb")

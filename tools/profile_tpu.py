@@ -79,8 +79,17 @@ def main():
         chunk = resolve_chunk("auto", blk, total, k, gather_budget)
         lvl_ms = timeit(jax.jit(functools.partial(arrow_spmm, chunk=chunk)),
                         blk, xb)
-        head_ms = timeit(
-            jax.jit(functools.partial(head_block_spmm, chunk=chunk)), blk, xb)
+        if blk.head_gell:
+            from arrow_matrix_tpu.ops.ell import ell_spmm
+
+            head_ms = timeit(
+                jax.jit(lambda b, xx, c=chunk: ell_spmm(
+                    b.head_cols, b.head_data,
+                    xx.reshape(-1, xx.shape[-1]), chunk=c)), blk, xb)
+        else:
+            head_ms = timeit(
+                jax.jit(functools.partial(head_block_spmm, chunk=chunk)),
+                blk, xb)
         diag_ms = timeit(
             jax.jit(lambda b, xx, c=chunk: block_spmm(
                 b.fmt, b.diag_cols, b.diag_data, xx, chunk=c)), blk, xb)
@@ -88,7 +97,9 @@ def main():
             jax.jit(lambda b, xx, c=chunk: block_spmm_shared(
                 b.fmt, b.col_cols, b.col_data, xx[0], chunk=c)), blk, xb)
         nnz = int(levels[i].matrix.nnz)
-        print(f"level {i}: fmt={blk.fmt} w={w} head_flat={blk.head_flat} "
+        head_kind = ("gell" if blk.head_gell
+                     else "flat" if blk.head_flat else blk.fmt)
+        print(f"level {i}: fmt={blk.fmt} w={w} head={head_kind} "
               f"nnz={nnz} full={lvl_ms:.1f}ms head={head_ms:.1f}ms "
               f"diag={diag_ms:.1f}ms col={col_ms:.1f}ms", flush=True)
 
