@@ -193,8 +193,15 @@ def run_bench(result: dict) -> None:
         result["degraded"] = True
 
     _progress(f"platform={dev.platform} kind={dev.device_kind} n={n} fmt={fmt}")
+    # max_levels high enough to converge: a capped decomposition leaves
+    # a grown last level holding half the nonzeros at near-full-matrix
+    # width (measured 657k-wide at n=1M with the old cap of 4), which
+    # no kernel can tile well.  At 1M/BA-8 the recursion exhausts after
+    # 10 levels, all at the base width.
     t0 = time.perf_counter()
-    levels = _cached_levels(n, m, width, seed=7)
+    levels = _cached_levels(n, m, width, seed=7,
+                            max_levels=int(os.environ.get(
+                                "AMT_BENCH_LEVELS", 12)))
     result["config"]["decompose_s"] = round(time.perf_counter() - t0, 2)
 
     _progress(f"decomposed in {result['config']['decompose_s']}s; building blocks")
