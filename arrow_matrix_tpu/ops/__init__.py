@@ -13,6 +13,7 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
     block_features,
     unblock_features,
 )
+from arrow_matrix_tpu.ops.hyb import HybLevel, hyb_from_csr, hyb_spmm
 # Pallas is optional: JAX builds without pallas/tpu support must still
 # import the (default, XLA-path) ops package.
 try:
@@ -38,6 +39,9 @@ __all__ = [
     "ell_spmm_batched",
     "ArrowBlocks",
     "arrow_blocks_from_csr",
+    "HybLevel",
+    "hyb_from_csr",
+    "hyb_spmm",
     "arrow_spmm",
     "arrow_spmm_pallas",
     "column_spmm_pallas",

@@ -19,6 +19,9 @@ Modules:
   mesh           mesh construction helpers, sharding utilities
   arrow_layout   slim / banded single-matrix distributed SpMM
   multi_level    K-matrix orchestration with permutation routing
+                 (time-shared; space_shared runs levels concurrently on
+                 disjoint device groups)
+  routing        explicit all_to_all permutation tables
   spmm_15d       1.5D A-stationary baseline (2-D replication mesh)
   spmm_1d        PETSc-style 1-D row-partition baseline (exact-row
                  exchange via static tables + all_to_all)
@@ -36,5 +39,6 @@ from arrow_matrix_tpu.parallel.arrow_layout import (
     distributed_arrow_spmm,
 )
 from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
+from arrow_matrix_tpu.parallel.space_shared import SpaceSharedArrow
 from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D, largest_replication
 from arrow_matrix_tpu.parallel.spmm_1d import MatrixSlice1D, equal_slices
