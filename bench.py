@@ -312,13 +312,21 @@ def run_one_variant(name: str) -> None:
           flush=True)
 
 
-def kernel_compare(timeout_s: float = 420.0) -> dict:
+def kernel_compare(timeout_s: float = 420.0,
+                   total_budget_s: float = 1500.0) -> dict:
     """ms/iter of the ELL / dense / Pallas / bf16 block kernels on one
     mid-size config (dense must fit): the data for VERDICT r1 item 6
     (integrate Pallas or retire it with numbers).  One subprocess per
-    variant, each with a hard timeout."""
+    variant, each with a hard timeout; a total budget stops the sweep
+    early if the device starts wedging (comparison is diagnostics — it
+    must never eat the bench's own time)."""
     out = {"config": dict(COMPARE_CONFIG)}
+    t_start = time.perf_counter()
     for name in COMPARE_VARIANTS:
+        if time.perf_counter() - t_start > total_budget_s:
+            out[name + "_ms"] = None
+            out[name + "_error"] = "compare budget exhausted"
+            continue
         _progress(f"kernel variant {name}")
         try:
             proc = subprocess.run(

@@ -104,3 +104,19 @@ def test_ideal_routing_bytes():
     # A shift by one device's rows moves every row, both directions.
     perms = [np.arange(64), np.roll(np.arange(64), 8)]
     assert commstats.ideal_routing_bytes(perms, 8, 4) == 2 * 64 * 4 * 4
+
+
+def test_multi_level_a2a_iterated_scan(mesh):
+    # routing='a2a' under run() (lax.scan): RouteTables pytrees must
+    # thread through the scan carry machinery like plain arrays.
+    a, levels = _problem()
+    a = (a / 8.0).tocsr().astype(np.float32)
+    levels = arrow_decomposition(a, 64, max_levels=3, block_diagonal=True,
+                                 seed=1)
+    x_host = random_dense(a.shape[0], 4, seed=2)
+    ml = MultiLevelArrow(levels, 64, mesh=mesh, routing="a2a")
+    got = ml.gather_result(ml.run(ml.set_features(x_host), 3))
+    want = x_host
+    for _ in range(3):
+        want = a @ want
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
