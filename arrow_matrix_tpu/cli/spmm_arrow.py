@@ -66,9 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "slim, arrow_dec_mpi.py:131).  Default: "
                              "true.")
     parser.add_argument("--fmt", type=str, default="auto",
-                        choices=["auto", "dense", "ell"],
+                        choices=["auto", "dense", "ell", "hyb"],
                         help="Device block format (TPU-specific: dense = "
-                             "MXU batched matmuls, ell = gather path).")
+                             "MXU batched matmuls, ell = gather path, "
+                             "hyb = whole-level split-ELL; hyb is "
+                             "single-chip only).")
     parser.add_argument("--head_fmt", type=str, default="auto",
                         choices=["auto", "flat", "ell", "gell"],
                         help="Head-stack storage for ELL levels: flat "

@@ -296,3 +296,22 @@ def test_convert_decomposition_roundtrip(tmp_path):
         convert_decomposition(str(tmp_path / "missing"), 32, to="npy")
     with pytest.raises(ValueError):
         convert_decomposition(base, width0, to="parquet")
+
+
+@pytest.mark.parametrize("width", [5, 9, 13, 19])
+def test_save_load_width_sweep(tmp_path, width):
+    """Loader smoke across odd small widths (reference
+    test_load_graph_distributed, tests/test_arrowmpi.py:170-203 sweeps
+    widths 5-19)."""
+    a = barabasi_albert(150, 3, seed=width)
+    levels = arrow_decomposition(a, width, max_levels=6,
+                                 block_diagonal=True, seed=0)
+    base = str(tmp_path / f"w{width}")
+    save_decomposition(levels, base, block_diagonal=True)
+    loaded = load_decomposition(base, width, block_diagonal=True)
+    assert len(loaded) == len(levels)
+    from arrow_matrix_tpu.io import load_level_widths
+    widths = load_level_widths(base, width, block_diagonal=True)
+    relevels = as_levels(loaded, widths)
+    diff = (reconstruct(relevels) - a).tocsr()
+    assert diff.nnz == 0 or np.max(np.abs(diff.data)) < 1e-5
