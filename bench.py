@@ -204,13 +204,7 @@ def run_bench(result: dict) -> None:
                                 "AMT_BENCH_LEVELS", 12)))
     result["config"]["decompose_s"] = round(time.perf_counter() - t0, 2)
 
-    _progress(f"decomposed in {result['config']['decompose_s']}s; building blocks")
-    t0 = time.perf_counter()
-    multi = MultiLevelArrow(levels, width, mesh=None, fmt=fmt,
-                            dense_budget=budget)
-    result["config"]["build_s"] = round(time.perf_counter() - t0, 2)
     result["config"]["levels"] = len(levels)
-    result["config"]["fmts"] = list(multi.fmts)
     nnz = sum(int(l.matrix.nnz) for l in levels)
     result["config"]["edges_nnz"] = nnz
 
@@ -219,24 +213,58 @@ def run_bench(result: dict) -> None:
     # --- Host CPU baseline: scipy CSR through the decomposition (the
     # reference's CPU path: per-level CSRMM + permutations).
     base_iters = 3 if n > (1 << 18) else iters
-    _progress(f"blocks built in {result['config']['build_s']}s; scipy baseline")
+    _progress(f"decomposed in {result['config']['decompose_s']}s; "
+              f"scipy baseline")
     xb = x_host.copy()
     t0 = time.perf_counter()
     for _ in range(base_iters):
         xb = decomposition_spmm(levels, xb)
     scipy_ms = (time.perf_counter() - t0) / base_iters * 1e3
-
-    _progress(f"scipy {scipy_ms:.1f} ms/iter; device path (compile+measure)")
-    x = multi.set_features(x_host)
-    dev_ms = _measure(multi, x, iters)
-    _progress(f"device {dev_ms:.2f} ms/iter; correctness gate")
-
-    # --- Correctness gate: one device step vs the scipy golden, at the
-    # documented accumulation-order tolerance (utils/numerics.py).
-    got = multi.gather_result(multi.step(x))
     want = decomposition_spmm(levels, x_host)
-    err = numerics.relative_error(got, want)
     tol = numerics.relative_tolerance(nnz / max(n, 1), iters=1)
+
+    # --- Device path: race the candidate single-chip execution configs
+    # at full scale and report the best (each gated for correctness
+    # individually; losers are freed before the next builds).
+    candidates = ([("auto", fmt), ("hyb", "hyb")] if fmt == "auto"
+                  else [(fmt, fmt)])
+    runs = {}
+    best = None
+    for name, f in candidates:
+        _progress(f"building fmt={f}")
+        t0 = time.perf_counter()
+        multi = MultiLevelArrow(levels, width, mesh=None, fmt=f,
+                                dense_budget=budget)
+        build_s = time.perf_counter() - t0
+        x = multi.set_features(x_host)
+        _progress(f"fmt={f} built in {build_s:.0f}s; compile+measure")
+        dev_ms = _measure(multi, x, iters)
+        err = numerics.relative_error(
+            multi.gather_result(multi.step(x)), want)
+        block_bytes = sum(b.device_nbytes() for b in multi.blocks)
+        runs[name] = {"ms": round(dev_ms, 3), "err": err,
+                      "build_s": round(build_s, 2),
+                      "fmts": list(multi.fmts),
+                      "block_bytes": block_bytes,
+                      "total_rows": multi.total_rows}
+        _progress(f"fmt={f}: {dev_ms:.2f} ms/iter err={err:.2e}")
+        if (np.isfinite(err) and err <= tol
+                and (best is None or dev_ms < runs[best]["ms"])):
+            best = name
+        del multi, x
+
+    result["device_runs"] = {k: {kk: vv for kk, vv in v.items()
+                                 if kk != "block_bytes" and kk != "total_rows"}
+                             for k, v in runs.items()}
+    if best is None:
+        raise RuntimeError(
+            f"correctness gate failed for every config: "
+            f"{[(k, v['err']) for k, v in runs.items()]} vs {tol:.1e}")
+    win = runs[best]
+    dev_ms = win["ms"]
+    result["config"]["fmts"] = win["fmts"]
+    result["config"]["build_s"] = win["build_s"]
+    result["fmt_used"] = best
 
     flops = 2.0 * nnz * k
     # Bandwidth roofline: one iteration streams every resident block
@@ -245,30 +273,25 @@ def run_bench(result: dict) -> None:
     # the first).  This is the memory floor; achieved/floor bandwidth
     # against the chip's peak is the MFU analog for a bandwidth-bound
     # kernel.
-    block_bytes = sum(b.device_nbytes() for b in multi.blocks)
-    feat_bytes = multi.total_rows * k * 4
+    feat_bytes = win["total_rows"] * k * 4
     n_lvl = len(levels)
-    bytes_per_iter = block_bytes + feat_bytes * (2 * n_lvl
-                                                 + 2 * (n_lvl - 1))
+    bytes_per_iter = win["block_bytes"] + feat_bytes * (2 * n_lvl
+                                                        + 2 * (n_lvl - 1))
     achieved_gbps = bytes_per_iter / (dev_ms * 1e-3) / 1e9
     peak = _peak_bw(dev.device_kind)
 
     result.update({
-        "value": round(dev_ms, 3),
+        "value": dev_ms,
         "vs_baseline": round(scipy_ms / dev_ms, 3),
         "scipy_cpu_ms": round(scipy_ms, 3),
         "gflops": round(flops / (dev_ms * 1e-3) / 1e9, 2),
-        "frobenius_err_vs_cpu": err,
+        "frobenius_err_vs_cpu": win["err"],
         "frobenius_gate": tol,
         "bytes_per_iter_gb": round(bytes_per_iter / 2**30, 3),
         "achieved_gbps": round(achieved_gbps, 1),
         "roofline_frac": (round(achieved_gbps / peak, 3)
                           if peak else None),
     })
-
-    if not np.isfinite(err) or err > tol:
-        raise RuntimeError(f"correctness gate failed: frobenius err "
-                           f"{err:.3e} vs host CPU exceeds {tol:.1e}")
 
 
 COMPARE_VARIANTS = {
