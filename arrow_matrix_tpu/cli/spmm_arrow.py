@@ -122,6 +122,16 @@ def main(argv=None) -> int:
         raise SystemExit("--slim requires a block-diagonal decomposition "
                          "(--blocked true); the reference enforces the "
                          "same (arrow_dec_mpi.py:131)")
+    if args.mode == "space":
+        if args.fmt == "hyb":
+            raise SystemExit(
+                "--fmt hyb is the single-chip whole-level kernel; "
+                "--mode space runs levels on disjoint device groups — "
+                "use --fmt auto/dense/ell")
+        if args.head_fmt != "auto":
+            print(f"warning: --head_fmt {args.head_fmt} applies only to "
+                  f"--mode time; the space-shared runtime pre-agrees "
+                  f"one head format across levels")
     setup_platform(args)
 
     import jax

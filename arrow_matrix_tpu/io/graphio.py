@@ -93,15 +93,20 @@ def _discover_level_width(base: str, width: Optional[int], index: int,
     widths = sorted(int(m.group(1)) for p in _glob.glob(pattern)
                     if (m := rx.match(p)) and int(m.group(1)) > width)
     if widths:
-        import warnings
+        if (base, index) not in _DISCOVERY_WARNED:  # once per artifact
+            _DISCOVERY_WARNED.add((base, index))
+            import warnings
 
-        warnings.warn(
-            f"level {index} of {base!r} found under achieved width "
-            f"{widths[0]} (requested {width}): reference-writer naming "
-            f"(its own loader would silently drop this level)",
-            stacklevel=3)
+            warnings.warn(
+                f"level {index} of {base!r} found under achieved width "
+                f"{widths[0]} (requested {width}): reference-writer "
+                f"naming (its own loader would silently drop this "
+                f"level)", stacklevel=3)
         return widths[0]
     return None
+
+
+_DISCOVERY_WARNED: set = set()
 
 
 # A loaded level matrix: either an in-memory CSR or a (data, indices,
@@ -160,6 +165,8 @@ def load_level_widths(base: str, width: Optional[int],
     while (w := _discover_level_width(base, width, i, block_diagonal)) is not None:
         widths.append(int(w))
         i += 1
+        if w != width:
+            break  # a discovered width is the grown last level
     return np.asarray(widths, dtype=np.int64) if widths else None
 
 
@@ -195,8 +202,15 @@ def load_decomposition(base: str, width: Optional[int] = None,
     graphio.py:298).
     """
     out: List[Tuple[CsrLike, Optional[np.ndarray]]] = []
+    # When this framework's _widths.npy metadata exists it bounds the
+    # level count: without the bound, glob discovery could splice a
+    # trailing level from a coexisting same-base artifact of a larger
+    # requested width into this decomposition.
+    meta = format_path(base, width, 0, block_diagonal, FileKind.widths)
+    n_levels_bound = (int(np.load(meta).size) if os.path.exists(meta)
+                      else None)
     i = 0
-    while True:
+    while n_levels_bound is None or i < n_levels_bound:
         # Per-level width discovery: reference-written artifacts name
         # each level by its achieved width (see _discover_level_width).
         w_i = _discover_level_width(base, width, i, block_diagonal)
@@ -228,6 +242,13 @@ def load_decomposition(base: str, width: Optional[int] = None,
                                        FileKind.permutation))
         out.append((matrix, perm))
         i += 1
+        if w_i is not None and width is not None and w_i != width:
+            # A glob-discovered level is the grown LAST level (only the
+            # final level of a reference-written artifact carries a
+            # different width) — stop enumerating so a foreign
+            # larger-width artifact sharing the base cannot contribute
+            # further phantom levels.
+            break
 
     if not out:
         out = _load_decomposition_npz(base, width, block_diagonal, with_permutation)

@@ -64,6 +64,12 @@ from arrow_matrix_tpu.parallel.mesh import (
 )
 
 
+def gather_budget_for(dense_budget: int) -> int:
+    """Byte budget for the ELL gather intermediate, derived from the
+    dense-format budget (one rule shared with the profiling tools)."""
+    return max(dense_budget // 4, 1 << 27)
+
+
 def resolve_block_dtype(dtype):
     """Block-storage dtype: numpy dtypes pass through; the strings
     "f32"/"bf16" name the two supported storage modes.  bf16 halves the
@@ -342,7 +348,7 @@ class MultiLevelArrow:
         # chunk="auto" sizes the ELL gather intermediate from the same
         # hardware-derived budget as the format choice (resolved per
         # level at trace time — shapes are static under jit).
-        gather_budget = max(dense_budget // 4, 1 << 27)
+        gather_budget = gather_budget_for(dense_budget)
 
         # Blocks are explicit jit arguments, not closure captures: captured
         # arrays are inlined into the HLO as literal constants, which

@@ -101,6 +101,16 @@ def _measure(multi, x, iters: int) -> float:
     return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
 
 
+def _degraded_small(platform: str) -> tuple[bool, bool]:
+    """One derivation of the degraded/small mode from a platform string
+    (used by main() with the probe's answer and by run_bench with the
+    live backend's — they must agree on the rule)."""
+    degraded = (platform == "cpu"
+                and os.environ.get("AMT_BENCH_FULL") != "1")
+    small = degraded or os.environ.get("AMT_BENCH_SMALL") == "1"
+    return degraded, small
+
+
 def _cached_levels(n: int, m: int, width: int, seed: int,
                    max_levels: int = 4):
     """Generate+decompose once per (n, m, width, seed), then reload the
@@ -118,13 +128,19 @@ def _cached_levels(n: int, m: int, width: int, seed: int,
 
     base = os.path.join("bench_cache",
                         f"ba_{n}_{m}_w{width}_s{seed}_L{max_levels}")
-    try:
-        loaded = load_decomposition(base, width, block_diagonal=True)
-        widths = load_level_widths(base, width, block_diagonal=True)
-        _progress(f"loaded cached decomposition {base}")
-        return as_levels(loaded, widths if widths is not None else width)
-    except FileNotFoundError:
-        pass
+    # Completion sentinel: save_decomposition writes many files; a run
+    # killed mid-write (subprocess timeouts are SIGKILL) must not leave
+    # a loadable-but-truncated artifact that later runs silently
+    # benchmark as a smaller problem.
+    sentinel = base + ".complete"
+    if os.path.exists(sentinel):
+        try:
+            loaded = load_decomposition(base, width, block_diagonal=True)
+            widths = load_level_widths(base, width, block_diagonal=True)
+            _progress(f"loaded cached decomposition {base}")
+            return as_levels(loaded, widths if widths is not None else width)
+        except FileNotFoundError:
+            pass
     a = barabasi_albert(n, m, seed=seed)
     levels = arrow_decomposition(a, arrow_width=width,
                                  max_levels=max_levels,
@@ -132,6 +148,8 @@ def _cached_levels(n: int, m: int, width: int, seed: int,
                                  backend="auto")
     try:
         save_decomposition(levels, base, block_diagonal=True)
+        with open(sentinel, "w") as f:
+            f.write(f"{len(levels)} levels\n")
     except OSError as e:  # caching is best-effort (read-only dirs etc.)
         _progress(f"decomposition cache write failed: {e}")
     return levels
@@ -170,9 +188,7 @@ def run_bench(result: dict) -> None:
     # a diagnosable measurement, not protocol numbers: drop to smoke
     # scale with the cheap-to-pack ELL format so the bench finishes in
     # seconds on one host core.  AMT_BENCH_FULL=1 overrides.
-    degraded = (dev.platform == "cpu"
-                and os.environ.get("AMT_BENCH_FULL") != "1")
-    small = degraded or os.environ.get("AMT_BENCH_SMALL") == "1"
+    degraded, small = _degraded_small(dev.platform)
     # Protocol scale (BASELINE.md: >=1M rows, features 16, 10 iters).
     if small:
         n, m, width, k, iters = 32768, 8, 1024, 16, 5
@@ -382,8 +398,7 @@ def main() -> None:
     # accelerator backend: each variant subprocess needs the chip to
     # itself (TPU ownership is exclusive per process), so the parent
     # must not be holding it yet.
-    degraded = platform == "cpu" and os.environ.get("AMT_BENCH_FULL") != "1"
-    small = degraded or os.environ.get("AMT_BENCH_SMALL") == "1"
+    _, small = _degraded_small(platform)
     if not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1":
         try:
             result["kernel_compare"] = kernel_compare()

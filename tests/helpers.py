@@ -1,0 +1,31 @@
+"""Shared test fixtures (imported by the suites; not collected)."""
+
+import numpy as np
+from scipy import sparse
+
+
+def arrow_csr(n_blocks: int, width: int, banded: bool = False,
+              seed: int = 0, density: float = 0.25) -> sparse.csr_matrix:
+    """Random matrix with exact arrow block structure (the reference's
+    dense structured analog, tests/test_arrowmpi.py:407-421)."""
+    rng = np.random.default_rng(seed)
+
+    def blk():
+        return sparse.random(width, width, density=density,
+                             random_state=rng, dtype=np.float32)
+
+    grid = [[None] * n_blocks for _ in range(n_blocks)]
+    for j in range(n_blocks):
+        grid[0][j] = blk()
+    for i in range(1, n_blocks):
+        grid[i][0] = blk()
+        grid[i][i] = blk()
+        if banded:
+            if i - 1 >= 1:
+                grid[i][i - 1] = blk()
+            if i + 1 < n_blocks:
+                grid[i][i + 1] = blk()
+    a = sparse.bmat(grid, format="csr").astype(np.float32)
+    a.sum_duplicates()
+    a.sort_indices()
+    return a

@@ -315,3 +315,49 @@ def test_save_load_width_sweep(tmp_path, width):
     relevels = as_levels(loaded, widths)
     diff = (reconstruct(relevels) - a).tocsr()
     assert diff.nnz == 0 or np.max(np.abs(diff.data)) < 1e-5
+
+
+def test_coexisting_widths_do_not_splice(tmp_path):
+    """Two decompositions of different widths under ONE base path must
+    load independently — discovery must not splice a foreign trailing
+    level in (code-review r2 repro)."""
+    from arrow_matrix_tpu.decomposition import decomposition_spmm
+    from arrow_matrix_tpu.io import load_level_widths
+    from arrow_matrix_tpu.utils import random_dense
+
+    a = barabasi_albert(300, 5, seed=3)
+    base = str(tmp_path / "shared")
+    lv16 = arrow_decomposition(a, 16, max_levels=4, block_diagonal=True,
+                               seed=0)
+    lv32 = arrow_decomposition(a, 32, max_levels=6, block_diagonal=True,
+                               seed=0)
+    assert len(lv32) != len(lv16)
+    save_decomposition(lv16, base, block_diagonal=True)
+    save_decomposition(lv32, base, block_diagonal=True)
+
+    for width, lv in ((16, lv16), (32, lv32)):
+        loaded = load_decomposition(base, width, block_diagonal=True)
+        assert len(loaded) == len(lv)
+        widths = load_level_widths(base, width, block_diagonal=True)
+        x = random_dense(300, 4, seed=1)
+        np.testing.assert_allclose(
+            decomposition_spmm(as_levels(loaded, widths), x),
+            decomposition_spmm(lv, x), rtol=1e-4, atol=1e-4)
+
+
+def test_discovery_stops_after_grown_level(tmp_path):
+    # Reference-layout artifact (no metadata) with a grown last level,
+    # PLUS a foreign larger-width artifact sharing the base: the
+    # discovered grown level terminates enumeration.
+    a = barabasi_albert(300, 6, seed=0)
+    levels = arrow_decomposition(a, 32, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    assert levels[-1].arrow_width > 32
+    base = str(tmp_path / "g")
+    _write_reference_layout(levels, base)
+    # Foreign artifact at width 90 with MORE levels.
+    foreign = arrow_decomposition(a, 90, max_levels=4, block_diagonal=True,
+                                  seed=1)
+    _write_reference_layout(foreign, base)
+    loaded = load_decomposition(base, 32, block_diagonal=True)
+    assert len(loaded) == len(levels)

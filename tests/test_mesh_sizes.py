@@ -32,37 +32,20 @@ from arrow_matrix_tpu.parallel import (
 from arrow_matrix_tpu.parallel.mesh import shard_arrow_blocks
 from arrow_matrix_tpu.utils import barabasi_albert, random_dense
 from arrow_matrix_tpu.utils.graphs import random_csr
+from helpers import arrow_csr as _arrow_csr_shared
+
+
+def _arrow_csr(n_blocks, width, seed, banded=False, density=0.25):
+    return _arrow_csr_shared(n_blocks, width, banded=banded, seed=seed,
+                             density=density)
 
 # 2/4/8/16 mirror power-of-two pods; 3/5/6 are the non-power-of-two
 # sizes the reference's odd-rank wide tests exercise.
-SIZES = [2, 3, 5, 8, 16]
+SIZES = [2, 3, 4, 5, 6, 8, 16]
 
 
 def test_pool_is_large_enough():
     assert jax.device_count() >= 16, "conftest must provide 16 devices"
-
-
-def _arrow_csr(n_blocks, width, seed, banded=False, density=0.25):
-    rng = np.random.default_rng(seed)
-
-    def blk():
-        return sparse.random(width, width, density=density, random_state=rng,
-                             dtype=np.float32)
-
-    grid = [[None] * n_blocks for _ in range(n_blocks)]
-    for j in range(n_blocks):
-        grid[0][j] = blk()
-    for i in range(1, n_blocks):
-        grid[i][0] = blk()
-        grid[i][i] = blk()
-        if banded and i - 1 >= 1:
-            grid[i][i - 1] = blk()
-        if banded and i + 1 < n_blocks:
-            grid[i][i + 1] = blk()
-    a = sparse.bmat(grid, format="csr").astype(np.float32)
-    a.sum_duplicates()
-    a.sort_indices()
-    return a
 
 
 @pytest.mark.parametrize("n_dev", SIZES)

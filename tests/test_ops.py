@@ -169,22 +169,10 @@ def test_gell_head_matches_golden():
     from arrow_matrix_tpu.ops import arrow_blocks_from_csr, arrow_spmm
     from arrow_matrix_tpu.ops.arrow_blocks import head_block_spmm
 
+    from helpers import arrow_csr
+
     nb, w, k = 6, 32, 8
-    rng = np.random.default_rng(31)
-
-    def blk():
-        return sparse.random(w, w, density=0.3, random_state=rng,
-                             dtype=np.float32)
-
-    grid = [[None] * nb for _ in range(nb)]
-    for j in range(nb):
-        grid[0][j] = blk()
-    for i in range(1, nb):
-        grid[i][0] = blk()
-        grid[i][i] = blk()
-    a = sparse.bmat(grid, format="csr").astype(np.float32)
-    a.sum_duplicates()
-    a.sort_indices()
+    a = arrow_csr(nb, w, seed=31, density=0.3)
     x_host = random_dense(nb * w, k, seed=5)
     xb = jnp.asarray(x_host.reshape(nb, w, k))
 

@@ -23,25 +23,12 @@ from arrow_matrix_tpu.decomposition import arrow_decomposition
 from arrow_matrix_tpu.utils import barabasi_albert, random_dense
 
 
+from helpers import arrow_csr as _shared_arrow_csr
+
+
 def _arrow_csr(nb, w, banded, seed, density=0.25):
-    rng = np.random.default_rng(seed)
-
-    def blk():
-        return sparse.random(w, w, density=density, random_state=rng,
-                             dtype=np.float32)
-
-    grid = [[None] * nb for _ in range(nb)]
-    for j in range(nb):
-        grid[0][j] = blk()
-    for i in range(1, nb):
-        grid[i][0] = blk()
-        grid[i][i] = blk()
-        if banded:
-            if i - 1 >= 1:
-                grid[i][i - 1] = blk()
-            if i + 1 < nb:
-                grid[i][i + 1] = blk()
-    return sparse.bmat(grid, format="csr").astype(np.float32)
+    return _shared_arrow_csr(nb, w, banded=banded, seed=seed,
+                             density=density)
 
 
 @pytest.mark.parametrize("banded", [False, True])

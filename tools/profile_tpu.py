@@ -11,6 +11,7 @@ Usage:  python tools/profile_tpu.py [n] [width] [k]
 """
 
 import functools
+import os
 import sys
 import time
 
@@ -36,7 +37,6 @@ def main():
     width = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
     k = int(sys.argv[3]) if len(sys.argv) > 3 else 16
 
-    from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition
     from arrow_matrix_tpu.ops.arrow_blocks import (
         arrow_spmm,
         block_spmm,
@@ -45,19 +45,25 @@ def main():
     )
     from arrow_matrix_tpu.parallel.multi_level import (
         MultiLevelArrow,
+        gather_budget_for,
         resolve_chunk,
     )
-    from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense
+    from arrow_matrix_tpu.utils.graphs import random_dense
     from arrow_matrix_tpu.utils.platform import device_memory_budget
 
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {dev.device_kind}", flush=True)
 
+    # Cached, CONVERGED decomposition — the same problem bench.py runs
+    # (a max_levels cap would re-create the degenerate-last-level
+    # pathology the bench no longer executes; see PERFORMANCE.md).
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _cached_levels
+
     t0 = time.perf_counter()
-    a = barabasi_albert(n, 8, seed=7)
-    levels = arrow_decomposition(a, arrow_width=width, max_levels=4,
-                                 block_diagonal=True, seed=7, backend="auto")
-    print(f"decomposed {n} rows -> {len(levels)} levels "
+    levels = _cached_levels(n, 8, width, seed=7, max_levels=12)
+    print(f"{n} rows -> {len(levels)} levels "
           f"in {time.perf_counter() - t0:.1f}s", flush=True)
 
     budget = device_memory_budget(dev)
@@ -72,7 +78,7 @@ def main():
     print(f"full step: {ms:.1f} ms", flush=True)
 
     total = multi.total_rows
-    gather_budget = max(multi.dense_budget // 4, 1 << 27)
+    gather_budget = gather_budget_for(multi.dense_budget)
     for i, blk in enumerate(multi.blocks):
         w = multi.widths[i]
         xb = jnp.reshape(x, (total // w, w, k))
