@@ -310,18 +310,19 @@ def run_bench(result: dict) -> None:
     })
 
 
+# Ordered most-informative-first: the total budget may cut the tail.
 COMPARE_VARIANTS = {
-    "ell": dict(fmt="ell"),
-    # Head-stack kernel isolation: flat-COO head = scatter-add (TPU
-    # scatters serialize), ELL head = gather + reduce.  The spread
-    # between these two is the head-kernel cost.
-    "ell_headell": dict(fmt="ell", head_fmt="ell"),
-    "ell_headflat": dict(fmt="ell", head_fmt="flat"),
-    "ell_headgell": dict(fmt="ell", head_fmt="gell"),
     "hyb": dict(fmt="hyb"),
+    "ell": dict(fmt="ell"),               # platform-aware auto head
     "dense": dict(fmt="dense"),
     "pallas": dict(fmt="dense", kernel="pallas"),
     "dense_bf16": dict(fmt="dense", dtype="bf16"),
+    # Head-stack kernel isolation: flat-COO head = scatter-add (TPU
+    # scatters serialize), ELL/gell heads = gather + reduce.  The
+    # spread between these is the head-kernel cost.
+    "ell_headflat": dict(fmt="ell", head_fmt="flat"),
+    "ell_headgell": dict(fmt="ell", head_fmt="gell"),
+    "ell_headell": dict(fmt="ell", head_fmt="ell"),
     "pallas_bf16": dict(fmt="dense", kernel="pallas", dtype="bf16"),
 }
 COMPARE_CONFIG = dict(n=65536, m=8, width=2048, k=16, iters=10)
@@ -351,8 +352,8 @@ def run_one_variant(name: str) -> None:
           flush=True)
 
 
-def kernel_compare(timeout_s: float = 420.0,
-                   total_budget_s: float = 1500.0) -> dict:
+def kernel_compare(timeout_s: float = 300.0,
+                   total_budget_s: float = 900.0) -> dict:
     """ms/iter of the ELL / dense / Pallas / bf16 block kernels on one
     mid-size config (dense must fit): the data for VERDICT r1 item 6
     (integrate Pallas or retire it with numbers).  One subprocess per
