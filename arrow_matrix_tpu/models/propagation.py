@@ -41,6 +41,19 @@ class SGCParams:
     b: jax.Array
 
 
+def _check_not_folded(multi: MultiLevelArrow, what: str) -> None:
+    """The propagation drivers compose per-level SpMMs with masks and
+    head matmuls on flat (total_rows, k) features; the folded
+    single-chip mode carries feature-major arrays and a SellMatrix
+    operator instead — reject it up front rather than mis-broadcasting
+    downstream (fold is a ``step``/``run``-only execution mode)."""
+    if getattr(multi, "folded", False):
+        raise ValueError(
+            f"{what} does not support fmt='fold' (feature-major "
+            f"step/run-only execution); build the MultiLevelArrow with "
+            f"fmt='auto'/'hyb'/'ell'/'dense' instead")
+
+
 def sgc_init(rng: jax.Array, k_in: int, k_out: int,
              dtype=jnp.float32) -> SGCParams:
     """LeCun-normal head init."""
@@ -77,6 +90,7 @@ class SGCModel:
     def __init__(self, multi: MultiLevelArrow, k_in: int, k_out: int,
                  hops: int = 2, seed: int = 0,
                  chunk: Optional[int] = None):
+        _check_not_folded(multi, "SGCModel")
         self.multi = multi
         self.hops = hops
         self.params = sgc_init(jax.random.key(seed), k_in, k_out)
@@ -146,6 +160,7 @@ def power_iteration(multi: MultiLevelArrow, x0: np.ndarray,
     Returns (eigenvector in original row order, Rayleigh-quotient
     eigenvalue estimate).  ``x0``: host (n, 1) start vector.
     """
+    _check_not_folded(multi, "power_iteration")
     x = multi.set_features(x0.astype(np.float32))
     for _ in range(iterations):
         x = _power_body(x, multi.fwd, multi.bwd, multi.blocks,
@@ -171,6 +186,7 @@ def pagerank(multi: MultiLevelArrow, damping: float = 0.85,
     decomposition from ``A @ D^{-1}``); this function runs the iteration,
     it does not normalize.
     """
+    _check_not_folded(multi, "pagerank")
     n = multi.n
     r = multi.set_features(np.full((n, 1), 1.0 / n, dtype=np.float32))
     # Padding rows stay zero: the teleport mass is masked to real rows.
@@ -199,6 +215,7 @@ def label_propagation(multi: MultiLevelArrow, labels: np.ndarray,
     True rows are clamped to their labels every iteration.
     ``multi`` should hold a row-normalized adjacency for convergence.
     """
+    _check_not_folded(multi, "label_propagation")
     y = multi.set_features(labels.astype(np.float32))
     seeds = multi.set_features(
         (labels * seed_mask[:, None]).astype(np.float32))

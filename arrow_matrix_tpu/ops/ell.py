@@ -159,6 +159,85 @@ def ell_spmm(cols: jax.Array, data: jax.Array, x: jax.Array,
     return acc.astype(x.dtype)
 
 
+def ell_spmm_t(cols: jax.Array, x_t: jax.Array,
+               data: Optional[jax.Array] = None,
+               deg: Optional[jax.Array] = None,
+               chunk: Optional[int] = None) -> jax.Array:
+    """Slot-major, feature-major ELL SpMM (the padding-free layout):
+    ``out_t[:, r] = sum_j w[j, r] * x_t[:, cols[j, r]]``.
+
+    Motivation (measured, v5e): XLA's TPU layout tiles the last two
+    dims to (8, 128), so a row-major ELL array ``(rows, m)`` with
+    m = 8..24 slots is *physically* padded 5-16x in HBM, and a row-major
+    feature array ``(N, 16)`` 8x — a compile-time OOM at protocol scale
+    (28 GB program for 2.4 GB of logical data) and the same factor in
+    streamed bytes.  Storing slots major ``(m, rows)`` and features
+    major ``(k, N)`` puts the large dimension minor everywhere; no
+    hidden padding remains.
+
+    Weighted mode passes ``data`` (m, rows) with zeros in padding
+    slots.  Binary mode (implicit-ones matrices — graph adjacency)
+    passes ``data=None`` and ``deg`` (rows,) instead: the slot-validity
+    mask is an iota-vs-degree compare generated in registers, so the
+    value array's bytes vanish entirely.  Bit-identical to the weighted
+    kernel on 0/1 data (same addends, same slot order).
+
+    :param cols: (m, rows) int32 — column indices, 0 in padding slots.
+    :param x_t:  (k, n_cols) — dense operand, feature-major.
+    :param data: (m, rows) values, or None for binary.
+    :param deg:  (rows,) int32 valid-slot counts (binary mode only).
+    :param chunk: slot-axis chunk bounding the gather intermediate
+        (k * chunk * rows elements); None processes all slots at once.
+    :returns: (k, rows) result, feature-major.
+    """
+    m, rows = cols.shape
+    k = x_t.shape[0]
+    if data is None and deg is None and m > 0:
+        raise ValueError("binary ELL (data=None) requires deg")
+    if m == 0:
+        return jnp.zeros((k, rows), dtype=x_t.dtype)
+    c = m if chunk is None else min(chunk, m)
+    n_chunks = align_up(m, c) // c
+    pad = n_chunks * c - m
+    if pad:
+        cols = jnp.pad(cols, ((0, pad), (0, 0)))
+        if data is not None:
+            data = jnp.pad(data, ((0, pad), (0, 0)))
+
+    def contribution(cols_c, w_c):
+        g = jnp.take(x_t, cols_c.reshape(-1), axis=1)
+        g = g.reshape(k, c, rows)
+        return (g * w_c[None].astype(g.dtype)).sum(axis=1)
+
+    if n_chunks == 1:
+        if data is not None:
+            w = data
+        else:
+            w = (jnp.arange(m + pad, dtype=deg.dtype)[:, None]
+                 < deg[None, :])
+        return contribution(cols, w).astype(x_t.dtype)
+
+    cols_c = cols.reshape(n_chunks, c, rows)
+    if data is not None:
+        def body(acc, xs):
+            cc, dc = xs
+            return acc + contribution(cc, dc), None
+        xs = (cols_c, data.reshape(n_chunks, c, rows))
+    else:
+        offsets = jnp.arange(n_chunks, dtype=deg.dtype) * c
+
+        def body(acc, xs):
+            cc, off = xs
+            w = (off + jnp.arange(c, dtype=deg.dtype)[:, None]
+                 < deg[None, :])
+            return acc + contribution(cc, w), None
+        xs = (cols_c, offsets)
+
+    acc0 = jnp.zeros((k, rows), dtype=jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, xs)
+    return acc.astype(x_t.dtype)
+
+
 def ell_spmm_batched(cols: jax.Array, data: jax.Array, x: jax.Array,
                      chunk: Optional[int] = None) -> jax.Array:
     """Batched ELL SpMM over stacked blocks.

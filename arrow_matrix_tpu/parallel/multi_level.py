@@ -172,9 +172,9 @@ class MultiLevelArrow:
                 "head_fmt='gell' is the single-chip head layout (its "
                 "gather reads the whole feature array); use 'flat', "
                 "'ell' or 'auto' on a mesh")
-        if fmt == "hyb" and mesh is not None:
+        if fmt in ("hyb", "fold") and mesh is not None:
             raise ValueError(
-                "fmt='hyb' is the single-chip whole-level kernel (the "
+                f"fmt={fmt!r} is a single-chip whole-level kernel (the "
                 "arrow block structure exists to shape communication; "
                 "within one chip a general split-ELL SpMM replaces it, "
                 "the way the reference's per-rank cuSPARSE CSRMM does "
@@ -238,6 +238,12 @@ class MultiLevelArrow:
         max_rows = max(number_of_blocks(lvl.matrix, w) * w
                        for lvl, w in zip(levels, widths))
         self.total_rows = pad_to_multiple(max_rows, unit)
+
+        gather_budget = gather_budget_for(dense_budget)
+        self.folded = fmt == "fold"
+        if self.folded:
+            self._init_folded(levels, chunk, gather_budget, dtype)
+            return
 
         # Per-level block format.  "auto" densifies levels as long as the
         # *cumulative* dense footprint (total_rows · w · n_stacks ·
@@ -348,8 +354,6 @@ class MultiLevelArrow:
         # chunk="auto" sizes the ELL gather intermediate from the same
         # hardware-derived budget as the format choice (resolved per
         # level at trace time — shapes are static under jit).
-        gather_budget = gather_budget_for(dense_budget)
-
         # Blocks are explicit jit arguments, not closure captures: captured
         # arrays are inlined into the HLO as literal constants, which
         # bloats the program (and breaks remote-compile size limits).
@@ -372,6 +376,97 @@ class MultiLevelArrow:
 
         self._scan_steps = jax.jit(scan_steps, static_argnames=("n",))
 
+    # -- folded single-chip execution --------------------------------------
+
+    def _init_folded(self, levels, chunk, gather_budget: int, dtype) -> None:
+        """Compose the whole decomposition into ONE operator.
+
+        On a single chip the inter-level permutation exchanges buy
+        nothing: they are 2(K-1) full feature-array gathers per
+        iteration, each paying the XLA gather rate.  Exact identity:
+        ``A = sum_i P_i^T B_i P_i`` (the decomposition partitions the
+        edge set — reference tests/test_arrowdecomposition.py:93-99), so
+        the host reconstructs A conjugated into level-0 order and packs
+        it as one HybLevel; the step becomes a single general SpMM with
+        zero routing (the honest single-chip execution — the reference
+        at one rank likewise runs its whole share as one CSRMM).
+        Binary (all-ones) level data folds to a binary operator: levels
+        are edge-disjoint, so no duplicate positions sum.
+
+        Host-memory note: folding materializes the nnz triplets once
+        (O(nnz) host RAM); the streamed >RAM ingestion path keeps the
+        per-level formats on a mesh instead.
+        """
+        from arrow_matrix_tpu.ops.sell import sell_from_csr, sell_spmm_t
+
+        total = self.total_rows
+        perms = [pad_permutation(np.asarray(lvl.permutation), total)
+                 for lvl in levels]
+        self.perm0 = perms[0]
+        self.inv_perm0 = np.argsort(self.perm0)
+
+        rows_l, cols_l, data_l = [], [], []
+        implicit_ones = True
+        for lvl, p in zip(levels, perms):
+            mp = self.inv_perm0[p]          # level-i index -> level-0 index
+            if isinstance(lvl.matrix, sparse.csr_matrix):
+                coo = lvl.matrix.tocoo()
+                r, c, d = coo.row, coo.col, coo.data
+            else:
+                d, indices, indptr = lvl.matrix
+                indptr = np.asarray(indptr, dtype=np.int64)
+                nnz = int(indptr[-1])
+                r = np.repeat(np.arange(indptr.size - 1),
+                              np.diff(indptr)).astype(np.int64)
+                c = np.asarray(indices[:nnz])
+                if d is not None:
+                    d = np.asarray(d[:nnz])
+            rows_l.append(mp[r])
+            cols_l.append(mp[c])
+            if d is None:
+                data_l.append(np.ones(len(rows_l[-1]), dtype=np.float32))
+            else:
+                implicit_ones = False
+                data_l.append(np.asarray(d, dtype=np.float32))
+
+        folded = sparse.csr_matrix(
+            (np.concatenate(data_l),
+             (np.concatenate(rows_l), np.concatenate(cols_l))),
+            shape=(total, total))
+        folded.sum_duplicates()
+        folded.sort_indices()
+        if implicit_ones and not np.all(folded.data == 1.0):
+            raise AssertionError(
+                "edge-disjoint levels folded to duplicate positions")
+
+        # SELL packing in degree-sorted coordinates; the sort permutation
+        # is composed into the carried ordering (set_features/
+        # gather_result), so it is free at runtime.
+        sell, order = sell_from_csr(folded, pad_rows_to=total, dtype=dtype)
+        self.perm0 = self.perm0[order]
+        self.inv_perm0 = np.argsort(self.perm0)
+        self.blocks = [sell]
+        self.fmts = ["fold"]
+        self.routing = "none"
+        self.fwd = self.bwd = ()
+
+        def fold_step(xt, fwd, bwd, blocks):
+            if chunk == "auto":
+                return sell_spmm_t(blocks[0], xt,
+                                   gather_budget=gather_budget)
+            return sell_spmm_t(blocks[0], xt, chunk=chunk)
+
+        self._step = jax.jit(fold_step)
+
+        def fold_scan(xt, fwd, bwd, blocks, n):
+            def body(xc, _):
+                return fold_step(xc, fwd, bwd, blocks), None
+
+            out, _ = jax.lax.scan(body, xt, None, length=n)
+            return out
+
+        self._scan_steps = jax.jit(fold_scan, static_argnames=("n",))
+
     # -- feature placement -------------------------------------------------
 
     def _rows_sharding(self):
@@ -387,12 +482,16 @@ class MultiLevelArrow:
     def set_features(self, x_original: np.ndarray) -> jax.Array:
         """Host (n, k) features in *original* row order -> device array in
         level-0 order (reference set_features on matrix 0,
-        arrow_bench.py:114-116)."""
+        arrow_bench.py:114-116).  Folded mode returns (and ``step``/
+        ``run`` carry) the feature-major (k, total_rows) layout — the
+        padding-free device layout; ``gather_result`` undoes it."""
         n, k = x_original.shape
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
         padded = np.zeros((self.total_rows, k), dtype=x_original.dtype)
         padded[:n] = x_original
+        if self.folded:
+            return jnp.asarray(np.ascontiguousarray(padded[self.perm0].T))
         return self.place_features(padded[self.perm0])
 
     def real_row_mask(self, dtype=np.float32) -> jax.Array:
@@ -401,12 +500,19 @@ class MultiLevelArrow:
         iff its original index ``perm0[r] < n`` (perm0 pads with an
         identity tail).  Use this to keep padding rows out of losses,
         teleport mass, and other per-row reductions."""
+        if self.folded:
+            raise ValueError(
+                "real_row_mask is undefined for fmt='fold' (feature-"
+                "major step/run-only execution; the propagation models "
+                "that consume the mask reject fold up front)")
         return self.place_features(
             (self.perm0 < self.n).astype(dtype)[:, None])
 
     def gather_result(self, c: jax.Array) -> np.ndarray:
         """Device result (level-0 order, flat) -> host (n, k) array in
         original row order (reference allgather_result analog)."""
+        if self.folded:
+            return np.asarray(c).T[self.inv_perm0][:self.n]
         return np.asarray(c)[self.inv_perm0][:self.n]
 
     # -- iteration ---------------------------------------------------------
@@ -478,10 +584,11 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
             from arrow_matrix_tpu.ops.ell import auto_chunk
             from arrow_matrix_tpu.ops.hyb import hyb_spmm
 
-            m0 = blocks[i].light_cols.shape[-1]
+            m0 = blocks[i].light_cols.shape[0]   # slot-major (m0, rows)
             hyb_chunk = (auto_chunk(total, k, m0, gather_budget)
                          if chunk == "auto" else chunk)
-            partials.append(hyb_spmm(blocks[i], x_cur, chunk=hyb_chunk))
+            partials.append(hyb_spmm(blocks[i], x_cur, chunk=hyb_chunk,
+                                     heavy_chunk=hyb_chunk))
             continue
         w = widths[i]
         xb = x_cur.reshape(total // w, w, k)

@@ -240,42 +240,51 @@ def run_bench(result: dict) -> None:
     tol = numerics.relative_tolerance(nnz / max(n, 1), iters=1)
 
     # --- Device path: race the candidate single-chip execution configs
-    # at full scale and report the best (each gated for correctness
-    # individually; losers are freed before the next builds).
-    candidates = ([("auto", fmt), ("hyb", "hyb")] if fmt == "auto"
-                  else [(fmt, fmt)])
+    # at full scale and report the best.  Each candidate is gated for
+    # correctness individually AND isolated against failure: a compile
+    # OOM or kernel error in one format must cost only that candidate,
+    # not the race (round-2 postmortem: the all-ELL layout OOM'd at
+    # compile and the hyb candidate never ran).
+    candidates = ([("fold", "fold"), ("hyb", "hyb"), ("auto", fmt)]
+                  if fmt == "auto" else [(fmt, fmt)])
     runs = {}
     best = None
     for name, f in candidates:
         _progress(f"building fmt={f}")
-        t0 = time.perf_counter()
-        multi = MultiLevelArrow(levels, width, mesh=None, fmt=f,
-                                dense_budget=budget)
-        build_s = time.perf_counter() - t0
-        x = multi.set_features(x_host)
-        _progress(f"fmt={f} built in {build_s:.0f}s; compile+measure")
-        dev_ms = _measure(multi, x, iters)
-        err = numerics.relative_error(
-            multi.gather_result(multi.step(x)), want)
-        block_bytes = sum(b.device_nbytes() for b in multi.blocks)
-        runs[name] = {"ms": round(dev_ms, 3), "err": err,
-                      "build_s": round(build_s, 2),
-                      "fmts": list(multi.fmts),
-                      "block_bytes": block_bytes,
-                      "total_rows": multi.total_rows}
-        _progress(f"fmt={f}: {dev_ms:.2f} ms/iter err={err:.2e}")
-        if (np.isfinite(err) and err <= tol
-                and (best is None or dev_ms < runs[best]["ms"])):
-            best = name
-        del multi, x
+        try:
+            t0 = time.perf_counter()
+            multi = MultiLevelArrow(levels, width, mesh=None, fmt=f,
+                                    dense_budget=budget)
+            build_s = time.perf_counter() - t0
+            x = multi.set_features(x_host)
+            _progress(f"fmt={f} built in {build_s:.0f}s; compile+measure")
+            dev_ms = _measure(multi, x, iters)
+            err = numerics.relative_error(
+                multi.gather_result(multi.step(x)), want)
+            block_bytes = sum(b.device_nbytes() for b in multi.blocks)
+            runs[name] = {"ms": round(dev_ms, 3), "err": err,
+                          "build_s": round(build_s, 2),
+                          "fmts": list(multi.fmts),
+                          "block_bytes": block_bytes,
+                          "total_rows": multi.total_rows}
+            _progress(f"fmt={f}: {dev_ms:.2f} ms/iter err={err:.2e}")
+            if (np.isfinite(err) and err <= tol
+                    and (best is None or dev_ms < runs[best]["ms"])):
+                best = name
+        except Exception as e:
+            runs[name] = {"error": f"{type(e).__name__}: {str(e)[:400]}"}
+            _progress(f"fmt={f} FAILED: {type(e).__name__}")
+        finally:
+            multi = x = None   # free the loser before the next builds
 
     result["device_runs"] = {k: {kk: vv for kk, vv in v.items()
                                  if kk != "block_bytes" and kk != "total_rows"}
                              for k, v in runs.items()}
     if best is None:
         raise RuntimeError(
-            f"correctness gate failed for every config: "
-            f"{[(k, v['err']) for k, v in runs.items()]} vs {tol:.1e}")
+            f"every config failed or missed the correctness gate: "
+            f"{[(k, v.get('err', v.get('error'))) for k, v in runs.items()]}"
+            f" vs {tol:.1e}")
     win = runs[best]
     dev_ms = win["ms"]
     result["config"]["fmts"] = win["fmts"]
@@ -312,6 +321,7 @@ def run_bench(result: dict) -> None:
 
 # Ordered most-informative-first: the total budget may cut the tail.
 COMPARE_VARIANTS = {
+    "fold": dict(fmt="fold"),             # composed single-operator HYB
     "hyb": dict(fmt="hyb"),
     "ell": dict(fmt="ell"),               # platform-aware auto head
     "dense": dict(fmt="dense"),

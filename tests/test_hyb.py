@@ -103,3 +103,117 @@ def test_hyb_rejected_on_mesh():
     with pytest.raises(ValueError, match="single-chip"):
         MultiLevelArrow(levels, 16, mesh=make_mesh((8,), ("blocks",)),
                         fmt="hyb")
+
+
+def test_binary_hyb_detected_and_exact():
+    """Binary (implicit-ones) HYB: adjacency data is all ones, so the
+    data arrays are dropped and a per-row degree mask replaces the
+    multiply.  Must be bit-identical to the f32 path (the mask selects
+    the same addends in the same slot order)."""
+    a = barabasi_albert(300, 5, seed=11)
+    assert np.all(a.data == 1.0)
+    hb = hyb_from_csr(a)                      # auto-detects binary
+    hf = hyb_from_csr(a, binary=False)
+    assert hb.light_data is None and hb.light_deg is not None
+    assert hf.light_data is not None and hf.light_deg is None
+    # ~half the resident bytes on the light part.
+    assert hb.device_nbytes() < 0.6 * hf.device_nbytes()
+    x = random_dense(300, 8, seed=5)
+    out_b = np.asarray(hyb_spmm(hb, jnp.asarray(x)))
+    out_f = np.asarray(hyb_spmm(hf, jnp.asarray(x)))
+    np.testing.assert_array_equal(out_b, out_f)
+    np.testing.assert_allclose(out_b, a @ x, rtol=1e-5, atol=1e-5)
+
+
+def test_binary_hyb_chunked_and_padded():
+    a = barabasi_albert(200, 4, seed=13)
+    h = hyb_from_csr(a, pad_rows_to=256, heavy_cap=4)
+    assert h.light_data is None
+    x = random_dense(256, 4, seed=6)
+    out = np.asarray(hyb_spmm(h, jnp.asarray(x), chunk=8))
+    np.testing.assert_allclose(out[:200], a @ x[:200], rtol=1e-5, atol=1e-5)
+    assert np.all(out[200:] == 0)
+
+
+def test_binary_rejected_on_weighted_matrix():
+    """Non-unit data must NOT take the binary path under binary='auto',
+    and must raise when binary is forced."""
+    from arrow_matrix_tpu.utils.graphs import random_csr
+
+    a = random_csr(64, 64, 4, seed=3)
+    assert not np.all(a.data == 1.0)
+    h = hyb_from_csr(a)
+    assert h.light_data is not None
+    with pytest.raises(ValueError, match="binary"):
+        hyb_from_csr(a, binary=True)
+
+
+def test_multi_level_hyb_binary_end_to_end():
+    n, width = 480, 32
+    a = barabasi_albert(n, 6, seed=19)
+    levels = arrow_decomposition(a, width, max_levels=2,
+                                 block_diagonal=True, seed=2)
+    ml = MultiLevelArrow(levels, width, mesh=None, fmt="hyb")
+    assert all(b.light_data is None for b in ml.blocks)
+    x_host = random_dense(n, 8, seed=3)
+    out = ml.gather_result(ml.step(ml.set_features(x_host)))
+    np.testing.assert_allclose(out, a @ x_host, rtol=1e-3, atol=1e-3)
+
+
+def test_fold_matches_golden_and_iterates():
+    """fmt='fold': the whole decomposition composed into one operator
+    (exact edge partition => A reconstructed in level-0 order)."""
+    n, width = 480, 32
+    a = barabasi_albert(n, 6, seed=19)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    assert len(levels) >= 2
+    ml = MultiLevelArrow(levels, width, mesh=None, fmt="fold")
+    assert ml.fmts == ["fold"]
+    assert ml.blocks[0].binary          # adjacency folds to binary
+    x_host = random_dense(n, 8, seed=3)
+    xd = ml.set_features(x_host)
+    assert xd.shape[0] == 8             # feature-major carriage
+    out = ml.gather_result(ml.step(xd))
+    np.testing.assert_allclose(out, decomposition_spmm(levels, x_host),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out, a @ x_host, rtol=1e-4, atol=1e-4)
+
+    # Iterated scan run, weighted (non-binary) matrix.
+    a2 = (a / 8.0).tocsr().astype(np.float32)
+    levels2 = arrow_decomposition(a2, width, max_levels=3,
+                                  block_diagonal=True, seed=2)
+    ml2 = MultiLevelArrow(levels2, width, mesh=None, fmt="fold")
+    assert not ml2.blocks[0].binary
+    xd2 = ml2.run(ml2.set_features(x_host), 3)
+    want = x_host
+    for _ in range(3):
+        want = a2 @ want
+    np.testing.assert_allclose(ml2.gather_result(xd2), want,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_fold_equals_per_level_paths():
+    """fold and the per-level hyb/ell paths are the same operator."""
+    n, width = 320, 32
+    a = barabasi_albert(n, 4, seed=23)
+    levels = arrow_decomposition(a, width, max_levels=2,
+                                 block_diagonal=True, seed=1)
+    x_host = random_dense(n, 4, seed=9)
+    outs = {}
+    for f in ("fold", "hyb", "ell"):
+        ml = MultiLevelArrow(levels, width, mesh=None, fmt=f)
+        outs[f] = ml.gather_result(ml.step(ml.set_features(x_host)))
+    np.testing.assert_allclose(outs["fold"], outs["ell"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs["hyb"], outs["ell"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fold_rejected_on_mesh():
+    a = barabasi_albert(128, 3, seed=1)
+    levels = arrow_decomposition(a, 16, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    with pytest.raises(ValueError, match="single-chip"):
+        MultiLevelArrow(levels, 16, mesh=make_mesh((8,), ("blocks",)),
+                        fmt="fold")

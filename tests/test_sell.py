@@ -1,0 +1,101 @@
+"""SELL (sliced-ELL) kernel tests (ops/sell.py): the degree-sorted
+tiered format behind the folded single-chip execution."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import sparse
+
+from arrow_matrix_tpu.ops.sell import (
+    SellMatrix,
+    sell_from_csr,
+    sell_spmm_t,
+    tier_boundaries,
+)
+from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+from arrow_matrix_tpu.utils.graphs import random_csr
+
+
+def spmm_via_sell(a, x, **kw):
+    sell, order = sell_from_csr(a, **kw)
+    y = x[order] if x.shape[0] == sell.n_rows else None
+    assert y is not None
+    out_sorted = np.asarray(sell_spmm_t(sell, jnp.asarray(y.T)))
+    out = np.empty_like(out_sorted.T)
+    out[order] = out_sorted.T
+    return out, sell
+
+
+def test_tier_boundaries():
+    deg = np.array([0, 0, 8, 8, 8, 16, 24, 64, 64])
+    starts = tier_boundaries(deg, growth=1.5)
+    # zero tier, [8..8], [16..24], [64..64]
+    assert starts == [0, 2, 5, 7]
+    assert tier_boundaries(np.array([], dtype=np.int64)) == [0]
+    assert tier_boundaries(np.array([8, 8, 8])) == [0]
+
+
+def test_sell_matches_scipy_weighted():
+    rng = np.random.default_rng(0)
+    a = sparse.random(300, 300, density=0.03, format="csr",
+                      random_state=rng, dtype=np.float32)
+    a = a.tolil()
+    a[7, :] = rng.standard_normal(300).astype(np.float32)  # hub row
+    a[0, :] = 0.0                                          # empty row
+    a = a.tocsr()
+    a.sum_duplicates()
+    a.sort_indices()
+    x = random_dense(300, 8, seed=1)
+    out, sell = spmm_via_sell(a, x)
+    assert not sell.binary
+    np.testing.assert_allclose(out, a @ x, rtol=1e-4, atol=1e-5)
+
+
+def test_sell_binary_detection_and_padding_bound():
+    a = barabasi_albert(2000, 6, seed=3)
+    x = random_dense(2048, 8, seed=2)
+    out, sell = spmm_via_sell(a, x[:2000], pad_rows_to=None)
+    assert sell.binary
+    np.testing.assert_allclose(out, a @ x[:2000], rtol=1e-5, atol=1e-5)
+    # Padded gather slots bounded by growth x nnz (+ slot alignment).
+    align_bound = 8 * 2000
+    assert sell.n_slots <= 1.5 * a.nnz + align_bound
+
+
+def test_sell_pad_rows_and_budget_chunking():
+    a = barabasi_albert(100, 3, seed=4)
+    trip = (None, a.indices, a.indptr)   # implicit-ones triplet
+    sell, order = sell_from_csr(trip, pad_rows_to=128)
+    assert sell.n_rows == 128
+    x = random_dense(128, 4, seed=3)
+    y = x[order]
+    # Tiny budget forces slot chunking inside every tier.
+    out_sorted = np.asarray(sell_spmm_t(sell, jnp.asarray(y.T),
+                                        gather_budget=1 << 12))
+    out = np.empty_like(x)
+    out[order] = out_sorted.T
+    np.testing.assert_allclose(out[:100], a @ x[:100], rtol=1e-5, atol=1e-5)
+    assert np.all(out[100:] == 0)
+
+
+def test_sell_binary_forced_on_weighted_raises():
+    a = random_csr(64, 64, 4, seed=3)
+    with pytest.raises(ValueError, match="binary"):
+        sell_from_csr(a, binary=True)
+
+
+def test_fold_rejected_by_propagation_models():
+    """fold is step/run-only: the flat-feature model drivers must
+    reject it up front instead of mis-broadcasting."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.models.propagation import pagerank
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    a = barabasi_albert(128, 3, seed=1)
+    levels = arrow_decomposition(a, 16, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    ml = MultiLevelArrow(levels, 16, mesh=None, fmt="fold")
+    with pytest.raises(ValueError, match="fold"):
+        pagerank(ml, iterations=1)
+    with pytest.raises(ValueError, match="fold"):
+        ml.real_row_mask()
