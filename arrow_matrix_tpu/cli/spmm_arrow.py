@@ -66,10 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "slim, arrow_dec_mpi.py:131).  Default: "
                              "true.")
     parser.add_argument("--fmt", type=str, default="auto",
-                        choices=["auto", "dense", "ell", "hyb"],
+                        choices=["auto", "dense", "ell", "hyb", "fold"],
                         help="Device block format (TPU-specific: dense = "
                              "MXU batched matmuls, ell = gather path, "
-                             "hyb = whole-level split-ELL; hyb is "
+                             "hyb = whole-level split-ELL, fold = the "
+                             "whole decomposition composed into one "
+                             "degree-sorted sliced-ELL operator with "
+                             "zero inter-level routing; hyb/fold are "
                              "single-chip only).")
     parser.add_argument("--head_fmt", type=str, default="auto",
                         choices=["auto", "flat", "ell", "gell"],
@@ -123,9 +126,9 @@ def main(argv=None) -> int:
                          "(--blocked true); the reference enforces the "
                          "same (arrow_dec_mpi.py:131)")
     if args.mode == "space":
-        if args.fmt == "hyb":
+        if args.fmt in ("hyb", "fold"):
             raise SystemExit(
-                "--fmt hyb is the single-chip whole-level kernel; "
+                f"--fmt {args.fmt} is a single-chip kernel; "
                 "--mode space runs levels on disjoint device groups — "
                 "use --fmt auto/dense/ell")
         if args.head_fmt != "auto":
@@ -177,7 +180,12 @@ def main(argv=None) -> int:
     levels = as_levels(loaded, widths)
     n = levels[0].matrix.shape[0]
 
+    # Honor an explicit --devices request even when the backend was
+    # initialized earlier with more (force_cpu_devices cannot shrink an
+    # already-created backend; sub-meshes can).
     n_dev = len(jax.devices())
+    if args.devices > 0:
+        n_dev = min(n_dev, args.devices)
     # Version-string run name (reference arrow_bench.py:43-47 pattern),
     # derived from what actually runs: slim-style sharding, banded or
     # block-diagonal tiling, time- or space-shared level execution.
@@ -207,8 +215,18 @@ def main(argv=None) -> int:
                 print(f"warning: --routing {args.routing} applies only "
                       f"to --mode time; space-shared exchanges are the "
                       f"composed-gather + cross-group reduce")
-            multi = SpaceSharedArrow(levels, width, fmt=args.fmt)
+            # Explicit mesh so an explicit --devices clamp is honored
+            # (SpaceSharedArrow's default mesh spans every device).
+            multi = SpaceSharedArrow(
+                levels, width, fmt=args.fmt,
+                mesh=make_mesh((len(levels), n_dev // len(levels)),
+                               ("lvl", "blocks")))
         else:
+            if args.fmt in ("hyb", "fold") and n_dev > 1:
+                raise SystemExit(
+                    f"--fmt {args.fmt} is single-chip only; rerun with "
+                    f"--devices 1 (or pick --fmt auto/dense/ell for the "
+                    f"{n_dev}-device mesh)")
             mesh = make_mesh((n_dev,), ("blocks",)) if n_dev > 1 else None
             multi = MultiLevelArrow(levels, width, mesh=mesh,
                                     banded=not args.blocked, fmt=args.fmt,

@@ -52,14 +52,24 @@ from arrow_matrix_tpu.ops.ell import (
 
 @struct.dataclass
 class ArrowBlocks:
-    """Pytree of stacked ELL arrays for one arrow matrix (one level)."""
+    """Pytree of stacked ELL arrays for one arrow matrix (one level).
 
-    head_cols: jax.Array
-    head_data: jax.Array
-    diag_cols: jax.Array
-    diag_data: jax.Array
-    col_cols: jax.Array
-    col_data: jax.Array
+    Binary (implicit-ones) matrices — graph adjacency, the dominant
+    workload — drop every ``*_data`` value stack (None) and carry
+    per-row degree stacks ``*_deg`` (nb, w) instead: the slot-validity
+    mask is generated in registers by the kernels (ops/ell.py), halving
+    the streamed slot bytes.  Flat-COO heads need neither values nor
+    degrees in binary mode (padding entries scatter into the dummy
+    row).  Applies to ``fmt="ell"`` only; dense blocks always carry
+    values.
+    """
+
+    head_cols: jax.Array = None
+    head_data: Optional[jax.Array] = None
+    diag_cols: jax.Array = None
+    diag_data: Optional[jax.Array] = None
+    col_cols: jax.Array = None
+    col_data: Optional[jax.Array] = None
     lo_cols: Optional[jax.Array] = None
     lo_data: Optional[jax.Array] = None
     hi_cols: Optional[jax.Array] = None
@@ -70,6 +80,12 @@ class ArrowBlocks:
     # row padding there can blow up by orders of magnitude (measured
     # 150x on a 400k-row Barabasi graph); flat packing is O(nnz).
     head_rows: Optional[jax.Array] = None
+    # Degree stacks for binary mode ((nb, w) int32; gell head: (w,)).
+    head_deg: Optional[jax.Array] = None
+    diag_deg: Optional[jax.Array] = None
+    col_deg: Optional[jax.Array] = None
+    lo_deg: Optional[jax.Array] = None
+    hi_deg: Optional[jax.Array] = None
 
     width: int = struct.field(pytree_node=False, default=0)
     n_blocks: int = struct.field(pytree_node=False, default=0)
@@ -88,6 +104,10 @@ class ArrowBlocks:
     # scatters serialize; gathers stream).  Single-chip layout: the
     # gather reads the whole feature array, so it does not shard.
     head_gell: bool = struct.field(pytree_node=False, default=False)
+
+    @property
+    def binary(self) -> bool:
+        return self.diag_data is None
 
     @property
     def n_rows(self) -> int:
@@ -118,7 +138,8 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                           dtype=np.float32,
                           check: bool = True,
                           fmt: str = "ell",
-                          head_fmt: str = "auto") -> ArrowBlocks:
+                          head_fmt: str = "auto",
+                          binary="auto") -> ArrowBlocks:
     """Tile an arrow-shaped CSR (or memmapped triplet) into ArrowBlocks.
 
     Trailing all-zero rows beyond ``n_blocks * width`` are truncated
@@ -142,6 +163,7 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
     nb_padded = max(pad_blocks_to or nb, nb)
     captured = 0
     host_dtype = scipy_safe_dtype(dtype)
+    is_binary = resolve_blocks_binary(matrix, fmt, binary)
 
     def blk(i, j):
         nonlocal captured
@@ -154,17 +176,26 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
         raise ValueError(f"unknown block format {fmt!r}")
 
     def pack(mats):
+        """(cols, data, deg) — data None / deg present in binary mode."""
         if fmt == "dense":
             no_cols = np.zeros((len(mats), 0, 0), dtype=np.int32)
-            return no_cols, dense_pack_stack(mats, dtype=dtype, rows=width)
-        return ell_pack_stack(mats, dtype=dtype, rows=width)
+            return (no_cols, dense_pack_stack(mats, dtype=dtype, rows=width),
+                    None)
+        if is_binary:
+            from arrow_matrix_tpu.ops.ell import ell_pack_stack_binary
+
+            cols, deg = ell_pack_stack_binary(mats, rows=width)
+            return cols, None, deg
+        cols, data = ell_pack_stack(mats, dtype=dtype, rows=width)
+        return cols, data, None
 
     head_rows = None
+    head_deg = None
     head_flat = False
     head_gell = fmt == "ell" and head_fmt == "gell"
     if head_gell:
-        head_cols, head_data, head_nnz = _gell_head_pack(matrix, width,
-                                                         dtype=dtype)
+        head_cols, head_data, head_nnz, head_deg = _gell_head_pack(
+            matrix, width, dtype=dtype, binary=is_binary)
         captured += head_nnz
     else:
         head = [blk(0, j) if j < nb else None for j in range(nb_padded)]
@@ -175,12 +206,17 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
 
             head_rows, head_cols, head_data = flat_pack_stack(
                 head, dtype=dtype, rows=width)
+            if is_binary:
+                head_data = None   # dummy-row scatter needs no values
         else:
-            head_cols, head_data = pack(head)
+            head_cols, head_data, head_deg = pack(head)
     diag = [None] + [blk(i, i) if i < nb else None for i in range(1, nb_padded)]
     col = [None] + [blk(i, 0) if i < nb else None for i in range(1, nb_padded)]
-    diag_cols, diag_data = pack(diag)
-    col_cols, col_data = pack(col)
+    diag_cols, diag_data, diag_deg = pack(diag)
+    col_cols, col_data, col_deg = pack(col)
+
+    def dev(a):
+        return None if a is None else jnp.asarray(a)
 
     kw = {}
     if banded:
@@ -188,10 +224,11 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                              for i in range(2, nb_padded)]
         hi = [None] + [blk(i, i + 1) if i + 1 < nb else None
                        for i in range(1, nb_padded)]
-        lo_cols, lo_data = pack(lo)
-        hi_cols, hi_data = pack(hi)
-        kw = dict(lo_cols=jnp.asarray(lo_cols), lo_data=jnp.asarray(lo_data),
-                  hi_cols=jnp.asarray(hi_cols), hi_data=jnp.asarray(hi_data))
+        lo_cols, lo_data, lo_deg = pack(lo)
+        hi_cols, hi_data, hi_deg = pack(hi)
+        kw = dict(lo_cols=jnp.asarray(lo_cols), lo_data=dev(lo_data),
+                  hi_cols=jnp.asarray(hi_cols), hi_data=dev(hi_data),
+                  lo_deg=dev(lo_deg), hi_deg=dev(hi_deg))
 
     if check:
         if isinstance(matrix, sparse.csr_matrix):
@@ -206,20 +243,38 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                 f"level's achieved width exceed the requested width?)")
 
     return ArrowBlocks(
-        head_cols=jnp.asarray(head_cols), head_data=jnp.asarray(head_data),
-        diag_cols=jnp.asarray(diag_cols), diag_data=jnp.asarray(diag_data),
-        col_cols=jnp.asarray(col_cols), col_data=jnp.asarray(col_data),
+        head_cols=jnp.asarray(head_cols), head_data=dev(head_data),
+        diag_cols=jnp.asarray(diag_cols), diag_data=dev(diag_data),
+        col_cols=jnp.asarray(col_cols), col_data=dev(col_data),
         head_rows=(jnp.asarray(head_rows) if head_rows is not None
                    else None),
+        head_deg=dev(head_deg), diag_deg=dev(diag_deg), col_deg=dev(col_deg),
         width=width, n_blocks=nb_padded, banded=banded, fmt=fmt,
         head_flat=head_flat, head_gell=head_gell, **kw)
 
 
-def _gell_head_pack(matrix: CsrLike, width: int, dtype=np.float32
-                    ) -> tuple[np.ndarray, np.ndarray, int]:
+def resolve_blocks_binary(matrix: CsrLike, fmt: str, binary) -> bool:
+    """Level-wide binary decision for the stacked formats: implicit-ones
+    triplets are binary, "auto" detects all-ones CSR values; dense
+    blocks always carry values (the MXU multiplies anyway)."""
+    if fmt == "dense":
+        return False
+    from arrow_matrix_tpu.ops.hyb import resolve_binary
+
+    if isinstance(matrix, sparse.csr_matrix):
+        return resolve_binary(binary, matrix.data, nnz=matrix.nnz)
+    data, _, indptr = matrix
+    return resolve_binary(binary, data, nnz=int(np.asarray(indptr[-1])))
+
+
+def _gell_head_pack(matrix: CsrLike, width: int, dtype=np.float32,
+                    binary: bool = False
+                    ) -> tuple[np.ndarray, Optional[np.ndarray], int,
+                               Optional[np.ndarray]]:
     """Head rows [0, width) packed as ONE (width, m) ELL over *global*
     column indices (see ArrowBlocks.head_gell).  Returns
-    (cols, data, nnz); m is the max head-row degree, slot-aligned."""
+    (cols, data, nnz, deg); m is the max head-row degree, slot-aligned;
+    binary mode returns data=None with deg (width,) int32."""
     from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up, ell_pack
 
     n = num_rows(matrix)
@@ -240,7 +295,9 @@ def _gell_head_pack(matrix: CsrLike, width: int, dtype=np.float32
     need = int(counts.max()) if counts.size and counts.max() > 0 else 0
     m = align_up(need, SLOT_ALIGN) if need else 0
     cols, packed = ell_pack(sub, max_nnz=m, dtype=dtype)
-    return cols, packed, hi
+    if binary:
+        return cols, None, hi, counts.astype(np.int32)
+    return cols, packed, hi, None
 
 
 def choose_flat_head_from_stats(nb: int, width: int, max_row_nnz: int,
@@ -319,7 +376,8 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
                           dtype=np.float32,
                           check: bool = True,
                           fmt: str = "ell",
-                          head_fmt: str = "auto") -> ArrowBlocks:
+                          head_fmt: str = "auto",
+                          binary="auto") -> ArrowBlocks:
     """Streaming twin of ``arrow_blocks_from_csr`` for >RAM matrices.
 
     Never materializes a whole level on the host: a first streaming
@@ -342,6 +400,7 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
     nb = n_blocks if n_blocks is not None else number_of_blocks(matrix, width)
     nb_padded = max(pad_blocks_to or nb, nb)
     coords = _stack_coords(nb, nb_padded, banded)
+    is_binary = resolve_blocks_binary(matrix, fmt, binary)
 
     host_dtype = scipy_safe_dtype(dtype)
 
@@ -413,6 +472,8 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
                 if b.nnz:
                     rows[r_i], cols[r_i], data[r_i] = csr_flat_pack(
                         b, pad_to=head_budget, dtype=dtype)
+            if is_binary:
+                return rows, cols        # values never needed (dummy-row)
             return rows, cols, data
         if fmt == "dense":
             cols = np.zeros((len(cs), 0, 0), dtype=np.int32)
@@ -424,21 +485,34 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
             from arrow_matrix_tpu.ops.ell import ell_pack
 
             cols = np.zeros((len(cs), width, m), dtype=np.int32)
-            data = np.zeros((len(cs), width, m), dtype=dtype)
+            data = (None if is_binary
+                    else np.zeros((len(cs), width, m), dtype=dtype))
+            deg = np.zeros((len(cs), width), dtype=np.int32)
             for r, ij in enumerate(cs):
                 if ij is None:
                     continue
                 b = blk(ij)
                 if b.nnz:
-                    cols[r], data[r] = ell_pack(b, max_nnz=m, dtype=dtype)
+                    c_r, d_r = ell_pack(b, max_nnz=m, dtype=dtype,
+                                        with_data=not is_binary)
+                    cols[r] = c_r
+                    if is_binary:
+                        deg[r] = np.diff(b.tocsr().indptr).astype(np.int32)
+                    else:
+                        data[r] = d_r
+            if is_binary:
+                return cols, deg
         return cols, data
 
     def make_stack(name: str):
         m = slots[name]
         if name == "head" and head_flat:
-            shapes = [(nb_padded, head_budget)] * 3
+            shapes = ([(nb_padded, head_budget)] * 2 if is_binary
+                      else [(nb_padded, head_budget)] * 3)
         elif fmt == "dense":
             shapes = [(nb_padded, 0, 0), (nb_padded, width, width)]
+        elif is_binary:
+            shapes = [(nb_padded, width, m), (nb_padded, width)]
         else:
             shapes = [(nb_padded, width, m)] * 2
         dev_map = sharding.addressable_devices_indices_map(shapes[-1])
@@ -456,22 +530,29 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
     for name in coords:
         out = make_stack(name)
         if name == "head" and head_flat:
-            kw["head_rows"], kw["head_cols"], kw["head_data"] = out
+            if is_binary:
+                kw["head_rows"], kw["head_cols"] = out
+            else:
+                kw["head_rows"], kw["head_cols"], kw["head_data"] = out
+        elif fmt != "dense" and is_binary:
+            kw[f"{name}_cols"], kw[f"{name}_deg"] = out
         else:
             kw[f"{name}_cols"], kw[f"{name}_data"] = out
     return ArrowBlocks(width=width, n_blocks=nb_padded, banded=banded,
                        fmt=fmt, head_flat=head_flat, **kw)
 
 
-def block_spmm(fmt: str, cols: jax.Array, data: jax.Array, x: jax.Array,
-               chunk: Optional[int] = None) -> jax.Array:
+def block_spmm(fmt: str, cols: jax.Array, data: Optional[jax.Array],
+               x: jax.Array, chunk: Optional[int] = None,
+               deg: Optional[jax.Array] = None) -> jax.Array:
     """Batched per-block SpMM dispatching on the block format.
 
     cols/data: stacked blocks (b, ...); x: (b, w, k) -> (b, w, k).
+    Binary ELL stacks pass data=None with deg (b, w).
     """
     if fmt == "dense":
         return dense_spmm_batched(data, x)
-    return ell_spmm_batched(cols, data, x, chunk=chunk)
+    return ell_spmm_batched(cols, data, x, chunk=chunk, deg=deg)
 
 
 def head_block_spmm(blocks: ArrowBlocks, x: jax.Array,
@@ -493,20 +574,29 @@ def head_block_spmm(blocks: ArrowBlocks, x: jax.Array,
         from arrow_matrix_tpu.ops.ell import csr_flat_spmm
 
         w = blocks.width
+        if blocks.head_data is None:   # binary: no values needed at all
+            return jax.vmap(
+                lambda r, c, xx: csr_flat_spmm(r, c, None, xx, w))(
+                    blocks.head_rows, blocks.head_cols, x)
         return jax.vmap(
             lambda r, c, d, xx: csr_flat_spmm(r, c, d, xx, w))(
                 blocks.head_rows, blocks.head_cols, blocks.head_data, x)
     return block_spmm(blocks.fmt, blocks.head_cols, blocks.head_data, x,
-                      chunk=chunk)
+                      chunk=chunk, deg=blocks.head_deg)
 
 
-def block_spmm_shared(fmt: str, cols: jax.Array, data: jax.Array,
-                      x0: jax.Array, chunk: Optional[int] = None) -> jax.Array:
+def block_spmm_shared(fmt: str, cols: jax.Array, data: Optional[jax.Array],
+                      x0: jax.Array, chunk: Optional[int] = None,
+                      deg: Optional[jax.Array] = None) -> jax.Array:
     """Batched per-block SpMM against one shared operand (X_0):
     (b, ...) blocks x (w, k) -> (b, w, k)."""
     if fmt == "dense":
         return jnp.einsum("bri,ik->brk", data, x0,
                           preferred_element_type=jnp.float32).astype(x0.dtype)
+    if data is None:
+        return jax.vmap(
+            lambda cc, dg: ell_spmm(cc, None, x0, chunk=chunk, deg=dg))(
+                cols, deg)
     return jax.vmap(lambda cc, dd: ell_spmm(cc, dd, x0, chunk=chunk))(
         cols, data)
 
@@ -528,23 +618,24 @@ def arrow_spmm(blocks: ArrowBlocks, x: jax.Array,
         # only): the TPU-native head kernel — no scatter, MXU-friendly
         # weighted reduction, chunked like every other ELL stack.
         c0 = ell_spmm(blocks.head_cols, blocks.head_data,
-                      x.reshape(nb * w, k), chunk=chunk)
+                      x.reshape(nb * w, k), chunk=chunk,
+                      deg=blocks.head_deg)
     else:
         c0 = head_block_spmm(blocks, x, chunk=chunk).sum(axis=0)
 
     c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
-                   chunk=chunk)
+                   chunk=chunk, deg=blocks.diag_deg)
     c = c + block_spmm_shared(blocks.fmt, blocks.col_cols, blocks.col_data,
-                              x[0], chunk=chunk)
+                              x[0], chunk=chunk, deg=blocks.col_deg)
 
     if blocks.banded:
         zeros = jnp.zeros((1, w, k), dtype=x.dtype)
         x_lo = jnp.concatenate([zeros, x[:-1]], axis=0)   # block i sees X_{i-1}
         x_hi = jnp.concatenate([x[1:], zeros], axis=0)    # block i sees X_{i+1}
         c = c + block_spmm(blocks.fmt, blocks.lo_cols, blocks.lo_data, x_lo,
-                           chunk=chunk)
+                           chunk=chunk, deg=blocks.lo_deg)
         c = c + block_spmm(blocks.fmt, blocks.hi_cols, blocks.hi_data, x_hi,
-                           chunk=chunk)
+                           chunk=chunk, deg=blocks.hi_deg)
 
     return c.at[0].set(c0)
 

@@ -39,11 +39,14 @@ def align_up(x: int, align: int) -> int:
 
 
 def ell_pack(m: sparse.spmatrix, max_nnz: Optional[int] = None,
-             dtype=np.float32) -> tuple[np.ndarray, np.ndarray]:
+             dtype=np.float32, with_data: bool = True
+             ) -> tuple[np.ndarray, Optional[np.ndarray]]:
     """Pack a scipy sparse matrix into (cols, data) ELL arrays.
 
     Vectorized fill: O(nnz) numpy work, no per-row Python loop (matters
-    at the 100M-row scale this framework targets).
+    at the 100M-row scale this framework targets).  ``with_data=False``
+    skips the value array entirely (binary layouts need only cols —
+    allocating and discarding the values would double packing work).
     """
     csr = m.tocsr()
     csr.sum_duplicates()
@@ -56,12 +59,13 @@ def ell_pack(m: sparse.spmatrix, max_nnz: Optional[int] = None,
         raise ValueError(f"row has {need} nnz > max_nnz={max_nnz}")
     rows = csr.shape[0]
     cols = np.zeros((rows, max_nnz), dtype=np.int32)
-    data = np.zeros((rows, max_nnz), dtype=dtype)
+    data = np.zeros((rows, max_nnz), dtype=dtype) if with_data else None
     if csr.nnz:
         slot = np.arange(csr.nnz) - np.repeat(csr.indptr[:-1], counts)
         row = np.repeat(np.arange(rows), counts)
         cols[row, slot] = csr.indices
-        data[row, slot] = csr.data
+        if with_data:
+            data[row, slot] = csr.data
     return cols, data
 
 
@@ -120,42 +124,70 @@ def auto_chunk(rows: int, k: int, m: int, budget_bytes: int,
     return None if c >= m else c
 
 
-def ell_spmm(cols: jax.Array, data: jax.Array, x: jax.Array,
-             chunk: Optional[int] = None) -> jax.Array:
+def ell_spmm(cols: jax.Array, data: Optional[jax.Array], x: jax.Array,
+             chunk: Optional[int] = None,
+             deg: Optional[jax.Array] = None) -> jax.Array:
     """out[r] = sum_j data[r, j] * x[cols[r, j], :].
 
+    Binary mode (implicit-ones matrices — graph adjacency): pass
+    ``data=None`` and ``deg`` instead; the slot-validity mask is an
+    iota-vs-degree compare generated in registers, so the value
+    array's bytes vanish (half the streamed slot bytes).  Bit-identical
+    to the weighted kernel on 0/1 data.
+
     :param cols: (rows, m) int32 — column indices, 0 for padding.
-    :param data: (rows, m)       — values, 0 for padding.
+    :param data: (rows, m) values, 0 for padding; or None for binary.
+    :param deg:  (rows,) int32 valid-slot counts (binary mode only).
     :param x:    (n_cols, k)     — dense operand.
     :param chunk: slot-axis chunk size bounding the gather intermediate;
         None processes all slots at once.
     """
     rows, m = cols.shape
     k = x.shape[-1]
+    if data is None and deg is None and m > 0:
+        raise ValueError("binary ELL (data=None) requires deg")
     if m == 0:
         return jnp.zeros((rows, k), dtype=x.dtype)
     if chunk is None or chunk >= m:
+        w = (data if data is not None
+             else (jnp.arange(m, dtype=deg.dtype)[None, :]
+                   < deg[:, None]).astype(jnp.float32))
         gathered = jnp.take(x, cols, axis=0)          # (rows, m, k)
-        return jnp.einsum("rm,rmk->rk", data, gathered,
+        return jnp.einsum("rm,rmk->rk", w, gathered,
                           preferred_element_type=jnp.float32).astype(x.dtype)
 
     n_chunks = align_up(m, chunk) // chunk
     pad = n_chunks * chunk - m
     if pad:
         cols = jnp.pad(cols, ((0, 0), (0, pad)))
-        data = jnp.pad(data, ((0, 0), (0, pad)))
+        if data is not None:
+            data = jnp.pad(data, ((0, 0), (0, pad)))
     cols_c = cols.reshape(rows, n_chunks, chunk).transpose(1, 0, 2)
-    data_c = data.reshape(rows, n_chunks, chunk).transpose(1, 0, 2)
 
-    def body(acc, cd):
-        c, d = cd
+    def contribution(c, w):
         gathered = jnp.take(x, c, axis=0)             # (rows, chunk, k)
-        part = jnp.einsum("rm,rmk->rk", d, gathered,
+        return jnp.einsum("rm,rmk->rk", w, gathered,
                           preferred_element_type=jnp.float32)
-        return acc + part, None
+
+    if data is not None:
+        data_c = data.reshape(rows, n_chunks, chunk).transpose(1, 0, 2)
+
+        def body(acc, cd):
+            c, d = cd
+            return acc + contribution(c, d), None
+        xs = (cols_c, data_c)
+    else:
+        offsets = jnp.arange(n_chunks, dtype=deg.dtype) * chunk
+
+        def body(acc, co):
+            c, off = co
+            w = (off + jnp.arange(chunk, dtype=deg.dtype)[None, :]
+                 < deg[:, None]).astype(jnp.float32)
+            return acc + contribution(c, w), None
+        xs = (cols_c, offsets)
 
     acc0 = jnp.zeros((rows, k), dtype=jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0, (cols_c, data_c))
+    acc, _ = jax.lax.scan(body, acc0, xs)
     return acc.astype(x.dtype)
 
 
@@ -238,12 +270,18 @@ def ell_spmm_t(cols: jax.Array, x_t: jax.Array,
     return acc.astype(x_t.dtype)
 
 
-def ell_spmm_batched(cols: jax.Array, data: jax.Array, x: jax.Array,
-                     chunk: Optional[int] = None) -> jax.Array:
+def ell_spmm_batched(cols: jax.Array, data: Optional[jax.Array],
+                     x: jax.Array, chunk: Optional[int] = None,
+                     deg: Optional[jax.Array] = None) -> jax.Array:
     """Batched ELL SpMM over stacked blocks.
 
     cols/data: (b, rows, m); x: (b, n_cols, k) -> (b, rows, k).
+    Binary mode: data=None with deg (b, rows) degree stacks.
     """
+    if data is None:
+        return jax.vmap(
+            lambda c, dg, xx: ell_spmm(c, None, xx, chunk=chunk, deg=dg))(
+                cols, deg, x)
     return jax.vmap(lambda c, d, xx: ell_spmm(c, d, xx, chunk=chunk))(
         cols, data, x)
 
@@ -326,11 +364,46 @@ def flat_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
     return r, c, d
 
 
-def csr_flat_spmm(rows: jax.Array, cols: jax.Array, data: jax.Array,
-                  x: jax.Array, n_rows: int) -> jax.Array:
+def csr_flat_spmm(rows: jax.Array, cols: jax.Array,
+                  data: Optional[jax.Array], x: jax.Array,
+                  n_rows: int) -> jax.Array:
     """Scatter-add SpMM over a flat nonzero list: one extra dummy row
-    absorbs padding (row index == n_rows)."""
-    contrib = data[:, None] * jnp.take(x, cols, axis=0)     # (nnz, k)
+    absorbs padding (row index == n_rows).  ``data=None`` is the
+    binary (implicit-ones) mode: padding entries scatter their
+    (arbitrary) gathered row into the dummy row, so no values or masks
+    are needed at all."""
+    gathered = jnp.take(x, cols, axis=0)                     # (nnz, k)
+    contrib = gathered if data is None else data[:, None] * gathered
     out = jnp.zeros((n_rows + 1, x.shape[-1]), dtype=jnp.float32)
     out = out.at[rows].add(contrib)
     return out[:n_rows].astype(x.dtype)
+
+
+def ell_pack_stack_binary(mats: list[sparse.spmatrix],
+                          rows: Optional[int] = None,
+                          align: int = SLOT_ALIGN
+                          ) -> tuple[np.ndarray, np.ndarray]:
+    """Binary twin of ``ell_pack_stack``: (cols, deg) with cols
+    (b, rows, m) and deg (b, rows) int32 — no value array (the caller
+    must have verified all values are ones)."""
+    shapes = [m.shape for m in mats if m is not None]
+    if not shapes and rows is None:
+        raise ValueError("no non-empty blocks and no explicit row count")
+    rows = rows if rows is not None else shapes[0][0]
+    need = 0
+    for m in mats:
+        if m is None:
+            continue
+        counts = np.diff(m.tocsr().indptr)
+        if counts.size:
+            need = max(need, int(counts.max()))
+    m_slots = align_up(need, align) if need else 0
+    cols = np.zeros((len(mats), rows, m_slots), dtype=np.int32)
+    deg = np.zeros((len(mats), rows), dtype=np.int32)
+    for i, m in enumerate(mats):
+        if m is None or m.nnz == 0:
+            continue
+        csr = m.tocsr()
+        cols[i], _ = ell_pack(csr, max_nnz=m_slots, with_data=False)
+        deg[i] = np.diff(csr.indptr).astype(np.int32)
+    return cols, deg

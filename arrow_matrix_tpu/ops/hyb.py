@@ -92,20 +92,37 @@ def choose_light_slots(degrees: np.ndarray, heavy_cap: int,
 
 
 def resolve_binary(binary: Union[str, bool], data,
-                   nnz: Optional[int] = None) -> bool:
+                   nnz: Optional[int] = None,
+                   chunk: int = 1 << 24) -> bool:
     """One binary-mode rule: ``data is None`` (memmap implicit ones) is
     always binary; "auto" detects all-ones values; forcing ``True`` on
     non-unit values is an error (the degree mask would silently drop
     them).  ``nnz`` bounds the inspected prefix — value files may carry
-    slack beyond ``indptr[-1]`` which must not affect the decision."""
+    slack beyond ``indptr[-1]`` which must not affect the decision.
+
+    The scan is chunked with early exit so memmapped >RAM value files
+    are never materialized at once (the streamed-builder contract,
+    ops/arrow_blocks.py ``arrow_blocks_streamed``); weighted data
+    usually fails on the first chunk.
+    """
     if data is None:
         return True
-    vals = np.asarray(data if nnz is None else data[:nnz])
+    if binary is False:
+        return False
+
+    def all_ones() -> bool:
+        end = len(data) if nnz is None else nnz
+        for off in range(0, end, chunk):
+            if not np.all(np.asarray(data[off:min(off + chunk, end)])
+                          == 1.0):
+                return False
+        return True
+
     if binary == "auto":
-        return bool(np.all(vals == 1.0))
-    if binary and not np.all(vals == 1.0):
+        return all_ones()
+    if not all_ones():
         raise ValueError("binary=True but the matrix has non-unit values")
-    return bool(binary)
+    return True
 
 
 def hyb_from_csr(matrix: CsrLike, pad_rows_to: Optional[int] = None,

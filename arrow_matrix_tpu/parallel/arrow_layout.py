@@ -147,14 +147,15 @@ def _local_slim_step(blocks: ArrowBlocks, x: jax.Array, axis: str,
             x_lo, x_hi)
     else:
         c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
-                       chunk=chunk)
+                       chunk=chunk, deg=blocks.diag_deg)
         c = c + block_spmm_shared(blocks.fmt, blocks.col_cols,
-                                  blocks.col_data, x0, chunk=chunk)
+                                  blocks.col_data, x0, chunk=chunk,
+                                  deg=blocks.col_deg)
         if blocks.banded:
             c = c + block_spmm(blocks.fmt, blocks.lo_cols, blocks.lo_data,
-                               x_lo, chunk=chunk)
+                               x_lo, chunk=chunk, deg=blocks.lo_deg)
             c = c + block_spmm(blocks.fmt, blocks.hi_cols, blocks.hi_data,
-                               x_hi, chunk=chunk)
+                               x_hi, chunk=chunk, deg=blocks.hi_deg)
 
     # --- The head device's local block 0 is global block 0: its result
     # is the reduced C_0 (reference rank-0 buffer swap,
@@ -252,14 +253,15 @@ def _local_wide_step(blocks: ArrowBlocks, x: jax.Array, arm_axis: str,
     # (reference _ad_spmm_column_tile, arrow_mpi.py:177-222).
     def col_fn():
         c = block_spmm(blocks.fmt, blocks.diag_cols, blocks.diag_data, x,
-                       chunk=chunk)
+                       chunk=chunk, deg=blocks.diag_deg)
         c = c + block_spmm_shared(blocks.fmt, blocks.col_cols,
-                                  blocks.col_data, x0, chunk=chunk)
+                                  blocks.col_data, x0, chunk=chunk,
+                                  deg=blocks.col_deg)
         if blocks.banded:
             c = c + block_spmm(blocks.fmt, blocks.lo_cols, blocks.lo_data,
-                               x_lo, chunk=chunk)
+                               x_lo, chunk=chunk, deg=blocks.lo_deg)
             c = c + block_spmm(blocks.fmt, blocks.hi_cols, blocks.hi_data,
-                               x_hi, chunk=chunk)
+                               x_hi, chunk=chunk, deg=blocks.hi_deg)
         return c
 
     c = lax.cond(arm == 0, col_fn, lambda: jnp.zeros_like(x))

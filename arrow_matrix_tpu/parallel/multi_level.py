@@ -91,6 +91,19 @@ def resolve_block_dtype(dtype):
     return dtype
 
 
+def resolve_levels_binary(levels, binary) -> bool:
+    """Decomposition-wide binary decision (see MultiLevelArrow): "auto"
+    resolves True iff every level is implicit-ones / all-ones; an
+    explicit bool is validated per level (forcing binary on non-unit
+    values raises)."""
+    from arrow_matrix_tpu.ops.arrow_blocks import resolve_blocks_binary
+
+    if binary is False:
+        return False
+    return all(resolve_blocks_binary(lvl.matrix, "ell", binary)
+               for lvl in levels)
+
+
 def pad_permutation(perm: np.ndarray, total: int) -> np.ndarray:
     """Extend a permutation of [0, n) to [0, total) with an identity tail
     (padding rows are zero and permute among themselves)."""
@@ -153,7 +166,8 @@ class MultiLevelArrow:
                  banded: bool = False, dtype=np.float32,
                  chunk="auto", fmt: str = "auto",
                  dense_budget: Optional[int] = None, kernel: str = "xla",
-                 routing: str = "gather", head_fmt: str = "auto"):
+                 routing: str = "gather", head_fmt: str = "auto",
+                 binary="auto"):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -239,6 +253,13 @@ class MultiLevelArrow:
                        for lvl, w in zip(levels, widths))
         self.total_rows = pad_to_multiple(max_rows, unit)
 
+        # Binary (implicit-ones) mode is decided ONCE for the whole
+        # decomposition: a per-level auto decision could mix binary and
+        # weighted levels, which the stacked space-shared layout (and
+        # any cross-level pytree stacking) cannot represent.  "auto"
+        # means binary iff EVERY level is all-ones.
+        self.binary = resolve_levels_binary(levels, binary)
+
         gather_budget = gather_budget_for(dense_budget)
         self.folded = fmt == "fold"
         if self.folded:
@@ -303,18 +324,19 @@ class MultiLevelArrow:
 
                 return hyb_from_csr(lvl.matrix,
                                     pad_rows_to=self.total_rows,
-                                    dtype=dtype)
+                                    dtype=dtype, binary=self.binary)
             hf = resolve_head_fmt(lvl, w, f)
             if mesh is not None and not isinstance(lvl.matrix,
                                                    sparse.csr_matrix):
                 return arrow_blocks_streamed(
                     lvl.matrix, w, mesh, axis,
                     pad_blocks_to=self.total_rows // w,
-                    banded=bd, dtype=dtype, fmt=f, head_fmt=hf)
+                    banded=bd, dtype=dtype, fmt=f, head_fmt=hf,
+                    binary=self.binary)
             return arrow_blocks_from_csr(lvl.matrix, w,
                                          pad_blocks_to=self.total_rows // w,
                                          banded=bd, dtype=dtype, fmt=f,
-                                         head_fmt=hf)
+                                         head_fmt=hf, binary=self.binary)
 
         self.blocks: List[ArrowBlocks] = [
             build(lvl, w, bd, f)
@@ -442,7 +464,8 @@ class MultiLevelArrow:
         # SELL packing in degree-sorted coordinates; the sort permutation
         # is composed into the carried ordering (set_features/
         # gather_result), so it is free at runtime.
-        sell, order = sell_from_csr(folded, pad_rows_to=total, dtype=dtype)
+        sell, order = sell_from_csr(folded, pad_rows_to=total, dtype=dtype,
+                                    binary=self.binary)
         self.perm0 = self.perm0[order]
         self.inv_perm0 = np.argsort(self.perm0)
         self.blocks = [sell]

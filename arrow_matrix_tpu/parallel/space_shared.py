@@ -87,8 +87,14 @@ def stack_arrow_blocks(blocks_list: List[ArrowBlocks]) -> ArrowBlocks:
             out[f.name] = vals[0]
             continue
         m = max(v.shape[-1] for v in vals)
+        # Flat-head entry padding must point at the DUMMY row (width):
+        # a zero-padded row index would scatter real contributions into
+        # row 0.  The weighted path was saved by its zero values; the
+        # binary path has none (csr_flat_spmm drops dummy rows only).
+        fill = first.width if f.name == "head_rows" else 0
         padded = [np.pad(np.asarray(v),
-                         [(0, 0)] * (v.ndim - 1) + [(0, m - v.shape[-1])])
+                         [(0, 0)] * (v.ndim - 1) + [(0, m - v.shape[-1])],
+                         constant_values=fill)
                   for v in vals]
         out[f.name] = jnp.asarray(np.stack(padded))
     return ArrowBlocks(**out)
@@ -108,7 +114,7 @@ class SpaceSharedArrow:
                  lvl_axis: str = "lvl", axis: str = "blocks",
                  dtype=np.float32, fmt: str = "auto",
                  dense_budget: Optional[int] = None,
-                 chunk="auto"):
+                 chunk="auto", binary="auto"):
         if not levels:
             raise ValueError("empty decomposition")
         k_levels = len(levels)
@@ -183,10 +189,17 @@ class SpaceSharedArrow:
             head_fmt = "flat" if any(decisions) else "ell"
         else:
             head_fmt = "auto"  # dense blocks have no head variant
+        # Decomposition-wide binary decision (one rule with
+        # MultiLevelArrow): mixed binary/weighted levels cannot stack.
+        from arrow_matrix_tpu.parallel.multi_level import (
+            resolve_levels_binary,
+        )
+
+        self.binary = resolve_levels_binary(levels, binary)
         per_level = [
             arrow_blocks_from_csr(lvl.matrix, w, pad_blocks_to=nb,
                                   banded=True, dtype=dtype, fmt=fmt,
-                                  head_fmt=head_fmt)
+                                  head_fmt=head_fmt, binary=self.binary)
             for lvl in levels
         ]
         blocks = stack_arrow_blocks(per_level)

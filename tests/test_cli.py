@@ -188,3 +188,24 @@ def test_load_matlab_v73_pattern_only(tmp_path):
         del f["Problem"]["A"]["data"]
     loaded = load_sparse_matrix(path)
     np.testing.assert_allclose(loaded.toarray(), np.eye(5))
+
+
+def test_spmm_arrow_fold_single_chip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_arrow.main([
+        "--vertices", "300", "--width", "32", "--features", "4",
+        "--iterations", "2", "--validate", "true", "--device", "cpu",
+        "--devices", "1", "--fmt", "fold",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+
+
+def test_spmm_arrow_fold_rejects_mesh(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SystemExit, match="single-chip"):
+        spmm_arrow.main([
+            "--vertices", "300", "--width", "32", "--features", "4",
+            "--iterations", "1", "--device", "cpu", "--devices", "4",
+            "--fmt", "fold", "--logdir", str(tmp_path / "logs"),
+        ])

@@ -239,3 +239,65 @@ def test_head_auto_prefers_gell_on_tpu(monkeypatch):
     x_host = random_dense(n, 8, seed=4)
     out = ml.gather_result(ml.step(ml.set_features(x_host)))
     np.testing.assert_allclose(out, a @ x_host, rtol=1e-3, atol=1e-3)
+
+
+def test_arrow_blocks_binary_matches_weighted():
+    """Binary (degree-mask) stacked ELL must be bit-identical to the
+    weighted layout on 0/1 data, with the value stacks gone."""
+    import jax.numpy as jnp
+
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.ops.arrow_blocks import (
+        arrow_blocks_from_csr,
+        arrow_spmm,
+        block_features,
+    )
+    from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+    a = barabasi_albert(600, 4, seed=9)
+    lvl = arrow_decomposition(a, 64, max_levels=1, block_diagonal=False,
+                              seed=1)[0]
+    # One level keeps every edge: tile at the achieved width (the
+    # multi-level builder's grown-last-level rule).
+    w = -(-lvl.arrow_width // 64) * 64
+    nb = -(-lvl.matrix.shape[0] // w)
+    x = random_dense(nb * w, 8, seed=2)
+    for head_fmt in ("ell", "flat", "gell"):
+        bb = arrow_blocks_from_csr(lvl.matrix, w, banded=True,
+                                   head_fmt=head_fmt)
+        bw = arrow_blocks_from_csr(lvl.matrix, w, banded=True,
+                                   head_fmt=head_fmt, binary=False)
+        assert bb.binary and not bw.binary
+        assert bb.diag_data is None and bw.diag_data is not None
+        xb = jnp.asarray(block_features(x[:bb.n_rows], w, bb.n_blocks))
+        out_b = np.asarray(arrow_spmm(bb, xb))
+        out_w = np.asarray(arrow_spmm(bw, xb))
+        np.testing.assert_array_equal(out_b, out_w, err_msg=head_fmt)
+        # chunked path too
+        out_bc = np.asarray(arrow_spmm(bb, xb, chunk=8))
+        np.testing.assert_allclose(out_bc, out_w, rtol=1e-6, atol=1e-6)
+
+
+def test_mixed_value_levels_resolve_weighted():
+    """Decomposition-wide binary rule: if ANY level has non-unit values,
+    every level packs weighted (mixed layouts cannot stack)."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+    from arrow_matrix_tpu.parallel.multi_level import resolve_levels_binary
+    from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+    a = barabasi_albert(320, 4, seed=3)
+    levels = arrow_decomposition(a, 32, max_levels=3, block_diagonal=True,
+                                 seed=1)
+    assert resolve_levels_binary(levels, "auto")
+    # Scale ONE level's values: the whole decomposition goes weighted.
+    levels[0].matrix.data *= 0.5
+    assert not resolve_levels_binary(levels, "auto")
+    ml = MultiLevelArrow(levels, 32, mesh=None, fmt="ell")
+    assert not ml.binary
+    assert all(b.diag_data is not None for b in ml.blocks)
+    x = random_dense(320, 4, seed=2)
+    got = ml.gather_result(ml.step(ml.set_features(x)))
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-5)

@@ -52,13 +52,21 @@ def test_streamed_builder_matches_eager(decomp, fmt, banded):
                                   banded=banded, fmt=fmt)
     streamed = arrow_blocks_streamed(triplet, 64, mesh, pad_blocks_to=16,
                                      banded=banded, fmt=fmt)
-    for name in ("head", "diag", "col") + (("lo", "hi") if banded else ()):
-        for leaf in ("cols", "data"):
-            e = np.asarray(getattr(eager, f"{name}_{leaf}"))
-            s = np.asarray(getattr(streamed, f"{name}_{leaf}"))
-            np.testing.assert_array_equal(e, s, err_msg=f"{name}_{leaf}")
+    # Binary (implicit-ones) levels drop data for deg stacks; the two
+    # builders must agree on which leaves exist AND their exact bytes.
+    names = ("head", "diag", "col") + (("lo", "hi") if banded else ())
+    leaves = [f"{n}_{leaf}" for n in names
+              for leaf in ("cols", "data", "deg")] + ["head_rows"]
+    for leaf in leaves:
+        e, s = getattr(eager, leaf), getattr(streamed, leaf)
+        assert (e is None) == (s is None), leaf
+        if e is not None:
+            np.testing.assert_array_equal(np.asarray(e), np.asarray(s),
+                                          err_msg=leaf)
+    if fmt == "ell":   # adjacency data is all ones -> binary layout
+        assert eager.binary and streamed.binary
     # The streamed arrays really are sharded over the mesh.
-    assert len(streamed.diag_data.sharding.device_set) == 8
+    assert len(streamed.diag_cols.sharding.device_set) == 8
 
 
 def test_multi_level_streamed_end_to_end(decomp):

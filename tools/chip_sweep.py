@@ -45,10 +45,11 @@ def main() -> None:
     tol = numerics.relative_tolerance(nnz / max(n, 1), iters=1)
 
     configs = {
+        "fold": dict(fmt="fold"),
+        "hyb": dict(fmt="hyb"),
         "auto": dict(fmt="auto"),
         "ell_headflat": dict(fmt="ell", head_fmt="flat"),
         "ell_headgell": dict(fmt="ell", head_fmt="gell"),
-        "hyb": dict(fmt="hyb"),
         "hyb_bf16": dict(fmt="hyb", dtype="bf16"),
     }
     for name, kw in configs.items():
