@@ -147,9 +147,8 @@ def make_train_step(widths: tuple, hops: int,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("widths", "chunk"))
-def _power_body(x, fwd, bwd, blocks, widths, chunk):
-    y = multi_level_spmm(x, fwd, bwd, blocks, widths, chunk=chunk)
+@jax.jit
+def _normalize(y):
     return y / jnp.maximum(jnp.linalg.norm(y), 1e-30)
 
 
@@ -159,12 +158,15 @@ def power_iteration(multi: MultiLevelArrow, x0: np.ndarray,
 
     Returns (eigenvector in original row order, Rayleigh-quotient
     eigenvalue estimate).  ``x0``: host (n, 1) start vector.
+
+    Uses only ``multi.step`` plus whole-array reductions, both of which
+    are layout-agnostic — so this driver works on every execution mode
+    including the folded single-chip one (fmt="fold"), which carries
+    features feature-major.
     """
-    _check_not_folded(multi, "power_iteration")
     x = multi.set_features(x0.astype(np.float32))
     for _ in range(iterations):
-        x = _power_body(x, multi.fwd, multi.bwd, multi.blocks,
-                        tuple(multi.widths), multi.chunk)
+        x = _normalize(multi.step(x))
     # One more multiply for the Rayleigh quotient x^T A x / x^T x.
     y = multi.step(x)
     lam = float(jnp.vdot(x, y) / jnp.maximum(jnp.vdot(x, x), 1e-30))

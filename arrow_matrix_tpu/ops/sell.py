@@ -80,7 +80,9 @@ class SellMatrix:
 
 
 def tier_boundaries(sorted_aligned_deg: np.ndarray,
-                    growth: float = 1.5) -> list[int]:
+                    growth: float = 1.2) -> list[int]:
+    # Default 1.2 measured at n=1M BA-8: 1.25x nnz padded slots over 28
+    # tiers, vs 1.61x at growth=1.5 — padded slots ARE the gather cost.
     """Tier start indices over ascending aligned degrees: a new tier
     starts whenever the degree exceeds ``growth`` times the tier's
     first degree (so within-tier ELL padding is < growth), with the
@@ -102,7 +104,7 @@ def tier_boundaries(sorted_aligned_deg: np.ndarray,
 
 def sell_from_csr(matrix: CsrLike, pad_rows_to: Optional[int] = None,
                   dtype=np.float32, binary: Union[str, bool] = "auto",
-                  growth: float = 1.5,
+                  growth: float = 1.2,
                   ) -> tuple[SellMatrix, np.ndarray]:
     """Pack a CSR (or memmapped triplet) into sorted sliced-ELL.
 

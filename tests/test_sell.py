@@ -99,3 +99,22 @@ def test_fold_rejected_by_propagation_models():
         pagerank(ml, iterations=1)
     with pytest.raises(ValueError, match="fold"):
         ml.real_row_mask()
+
+
+def test_power_iteration_on_fold():
+    """power_iteration is layout-agnostic: the folded executor's
+    feature-major carriage works through step + whole-array reductions."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.models.propagation import power_iteration
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    a = barabasi_albert(200, 4, seed=7)
+    levels = arrow_decomposition(a, 16, max_levels=3, block_diagonal=True,
+                                 seed=0)
+    x0 = np.ones((200, 1), dtype=np.float32)
+    mlf = MultiLevelArrow(levels, 16, mesh=None, fmt="fold")
+    mle = MultiLevelArrow(levels, 16, mesh=None, fmt="ell")
+    vf, lf = power_iteration(mlf, x0, iterations=30)
+    ve, le = power_iteration(mle, x0, iterations=30)
+    assert abs(lf - le) < 1e-3 * abs(le)
+    np.testing.assert_allclose(np.abs(vf), np.abs(ve), rtol=1e-3, atol=1e-4)
