@@ -164,6 +164,9 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
     captured = 0
     host_dtype = scipy_safe_dtype(dtype)
     is_binary = resolve_blocks_binary(matrix, fmt, binary)
+    from arrow_matrix_tpu.ops.ell import block_index_dtype
+
+    idt = block_index_dtype(width)
 
     def blk(i, j):
         nonlocal captured
@@ -184,9 +187,11 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
         if is_binary:
             from arrow_matrix_tpu.ops.ell import ell_pack_stack_binary
 
-            cols, deg = ell_pack_stack_binary(mats, rows=width)
+            cols, deg = ell_pack_stack_binary(mats, rows=width,
+                                              index_dtype=idt)
             return cols, None, deg
-        cols, data = ell_pack_stack(mats, dtype=dtype, rows=width)
+        cols, data = ell_pack_stack(mats, dtype=dtype, rows=width,
+                                    index_dtype=idt)
         return cols, data, None
 
     head_rows = None
@@ -205,7 +210,7 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
             from arrow_matrix_tpu.ops.ell import flat_pack_stack
 
             head_rows, head_cols, head_data = flat_pack_stack(
-                head, dtype=dtype, rows=width)
+                head, dtype=dtype, rows=width, index_dtype=idt)
             if is_binary:
                 head_data = None   # dummy-row scatter needs no values
         else:
@@ -401,6 +406,9 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
     nb_padded = max(pad_blocks_to or nb, nb)
     coords = _stack_coords(nb, nb_padded, banded)
     is_binary = resolve_blocks_binary(matrix, fmt, binary)
+    from arrow_matrix_tpu.ops.ell import block_index_dtype
+
+    idt = block_index_dtype(width)
 
     host_dtype = scipy_safe_dtype(dtype)
 
@@ -462,8 +470,8 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
         if name == "head" and head_flat:
             from arrow_matrix_tpu.ops.ell import csr_flat_pack
 
-            rows = np.full((len(cs), head_budget), width, dtype=np.int32)
-            cols = np.zeros((len(cs), head_budget), dtype=np.int32)
+            rows = np.full((len(cs), head_budget), width, dtype=idt)
+            cols = np.zeros((len(cs), head_budget), dtype=idt)
             data = np.zeros((len(cs), head_budget), dtype=dtype)
             for r_i, ij in enumerate(cs):
                 if ij is None:
@@ -471,7 +479,8 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
                 b = blk(ij)
                 if b.nnz:
                     rows[r_i], cols[r_i], data[r_i] = csr_flat_pack(
-                        b, pad_to=head_budget, dtype=dtype)
+                        b, pad_to=head_budget, dtype=dtype,
+                        index_dtype=idt)
             if is_binary:
                 return rows, cols        # values never needed (dummy-row)
             return rows, cols, data
@@ -484,7 +493,7 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
         else:
             from arrow_matrix_tpu.ops.ell import ell_pack
 
-            cols = np.zeros((len(cs), width, m), dtype=np.int32)
+            cols = np.zeros((len(cs), width, m), dtype=idt)
             data = (None if is_binary
                     else np.zeros((len(cs), width, m), dtype=dtype))
             deg = np.zeros((len(cs), width), dtype=np.int32)
@@ -494,7 +503,8 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
                 b = blk(ij)
                 if b.nnz:
                     c_r, d_r = ell_pack(b, max_nnz=m, dtype=dtype,
-                                        with_data=not is_binary)
+                                        with_data=not is_binary,
+                                        index_dtype=idt)
                     cols[r] = c_r
                     if is_binary:
                         deg[r] = np.diff(b.tocsr().indptr).astype(np.int32)

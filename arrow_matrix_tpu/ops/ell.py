@@ -38,8 +38,16 @@ def align_up(x: int, align: int) -> int:
     return -(-x // align) * align
 
 
+def block_index_dtype(width: int):
+    """Index dtype for block-LOCAL columns/rows: int16 halves the
+    streamed index bytes whenever every representable value (columns
+    < width, plus the flat head's dummy row == width) fits."""
+    return np.int16 if width < np.iinfo(np.int16).max else np.int32
+
+
 def ell_pack(m: sparse.spmatrix, max_nnz: Optional[int] = None,
-             dtype=np.float32, with_data: bool = True
+             dtype=np.float32, with_data: bool = True,
+             index_dtype=np.int32
              ) -> tuple[np.ndarray, Optional[np.ndarray]]:
     """Pack a scipy sparse matrix into (cols, data) ELL arrays.
 
@@ -47,6 +55,9 @@ def ell_pack(m: sparse.spmatrix, max_nnz: Optional[int] = None,
     at the 100M-row scale this framework targets).  ``with_data=False``
     skips the value array entirely (binary layouts need only cols —
     allocating and discarding the values would double packing work).
+    ``index_dtype`` shrinks the column indices (block-LOCAL indices fit
+    int16 up to width 32767 — half the index bytes; see
+    ``block_index_dtype``).
     """
     csr = m.tocsr()
     csr.sum_duplicates()
@@ -58,7 +69,7 @@ def ell_pack(m: sparse.spmatrix, max_nnz: Optional[int] = None,
     if need > max_nnz:
         raise ValueError(f"row has {need} nnz > max_nnz={max_nnz}")
     rows = csr.shape[0]
-    cols = np.zeros((rows, max_nnz), dtype=np.int32)
+    cols = np.zeros((rows, max_nnz), dtype=index_dtype)
     data = np.zeros((rows, max_nnz), dtype=dtype) if with_data else None
     if csr.nnz:
         slot = np.arange(csr.nnz) - np.repeat(csr.indptr[:-1], counts)
@@ -71,7 +82,8 @@ def ell_pack(m: sparse.spmatrix, max_nnz: Optional[int] = None,
 
 def ell_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
                    align: int = SLOT_ALIGN,
-                   rows: Optional[int] = None) -> tuple[np.ndarray, np.ndarray]:
+                   rows: Optional[int] = None,
+                   index_dtype=np.int32) -> tuple[np.ndarray, np.ndarray]:
     """Pack a list of equal-shaped sparse blocks into stacked ELL arrays
     (b, rows, m) with one shared slot count m (max over blocks, aligned).
 
@@ -90,12 +102,13 @@ def ell_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
         if counts.size:
             need = max(need, int(counts.max()))
     m_slots = align_up(need, align) if need else 0
-    cols = np.zeros((len(mats), rows, m_slots), dtype=np.int32)
+    cols = np.zeros((len(mats), rows, m_slots), dtype=index_dtype)
     data = np.zeros((len(mats), rows, m_slots), dtype=dtype)
     for i, m in enumerate(mats):
         if m is None or m.nnz == 0:
             continue
-        c, d = ell_pack(m, max_nnz=m_slots, dtype=dtype)
+        c, d = ell_pack(m, max_nnz=m_slots, dtype=dtype,
+                        index_dtype=index_dtype)
         cols[i] = c
         data[i] = d
     return cols, data
@@ -317,22 +330,23 @@ def dense_spmm_batched(data: jax.Array, x: jax.Array) -> jax.Array:
 
 def csr_flat_pack(m: sparse.spmatrix, pad_to: Optional[int] = None,
                   dtype=np.float32,
-                  align: int = SLOT_ALIGN) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+                  align: int = SLOT_ALIGN,
+                  index_dtype=np.int32) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Flat COO-style packing (rows, cols, data) sorted by row, padded to a
     static nnz budget.  Padding entries use row=rows (scatter-dropped) and
     col=0.  Suits blocks with skewed row degrees where ELL padding blows
     up (the arrow head rows)."""
     coo = m.tocoo()
     order = np.argsort(coo.row, kind="stable")
-    r = coo.row[order].astype(np.int32)
-    c = coo.col[order].astype(np.int32)
+    r = coo.row[order].astype(index_dtype)
+    c = coo.col[order].astype(index_dtype)
     d = coo.data[order].astype(dtype)
     nnz = r.size
     budget = pad_to if pad_to is not None else align_up(max(nnz, 1), align)
     if nnz > budget:
         raise ValueError(f"nnz {nnz} exceeds budget {budget}")
-    rows_pad = np.full(budget, m.shape[0], dtype=np.int32)
-    cols_pad = np.zeros(budget, dtype=np.int32)
+    rows_pad = np.full(budget, m.shape[0], dtype=index_dtype)
+    cols_pad = np.zeros(budget, dtype=index_dtype)
     data_pad = np.zeros(budget, dtype=dtype)
     rows_pad[:nnz] = r
     cols_pad[:nnz] = c
@@ -341,7 +355,8 @@ def csr_flat_pack(m: sparse.spmatrix, pad_to: Optional[int] = None,
 
 
 def flat_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
-                    align: int = SLOT_ALIGN, rows: Optional[int] = None
+                    align: int = SLOT_ALIGN, rows: Optional[int] = None,
+                    index_dtype=np.int32
                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Pack equal-shaped sparse blocks into stacked flat-COO arrays
     (b, B) with one shared per-block nnz budget B (max over blocks,
@@ -354,13 +369,14 @@ def flat_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
     n_rows = rows if rows is not None else shapes[0][0]
     need = max((int(m.nnz) for m in mats if m is not None), default=0)
     budget = align_up(need, align) if need else 0
-    r = np.full((len(mats), budget), n_rows, dtype=np.int32)
-    c = np.zeros((len(mats), budget), dtype=np.int32)
+    r = np.full((len(mats), budget), n_rows, dtype=index_dtype)
+    c = np.zeros((len(mats), budget), dtype=index_dtype)
     d = np.zeros((len(mats), budget), dtype=dtype)
     for i, m in enumerate(mats):
         if m is None or m.nnz == 0:
             continue
-        r[i], c[i], d[i] = csr_flat_pack(m, pad_to=budget, dtype=dtype)
+        r[i], c[i], d[i] = csr_flat_pack(m, pad_to=budget, dtype=dtype,
+                                         index_dtype=index_dtype)
     return r, c, d
 
 
@@ -381,7 +397,8 @@ def csr_flat_spmm(rows: jax.Array, cols: jax.Array,
 
 def ell_pack_stack_binary(mats: list[sparse.spmatrix],
                           rows: Optional[int] = None,
-                          align: int = SLOT_ALIGN
+                          align: int = SLOT_ALIGN,
+                          index_dtype=np.int32
                           ) -> tuple[np.ndarray, np.ndarray]:
     """Binary twin of ``ell_pack_stack``: (cols, deg) with cols
     (b, rows, m) and deg (b, rows) int32 — no value array (the caller
@@ -398,12 +415,13 @@ def ell_pack_stack_binary(mats: list[sparse.spmatrix],
         if counts.size:
             need = max(need, int(counts.max()))
     m_slots = align_up(need, align) if need else 0
-    cols = np.zeros((len(mats), rows, m_slots), dtype=np.int32)
+    cols = np.zeros((len(mats), rows, m_slots), dtype=index_dtype)
     deg = np.zeros((len(mats), rows), dtype=np.int32)
     for i, m in enumerate(mats):
         if m is None or m.nnz == 0:
             continue
         csr = m.tocsr()
-        cols[i], _ = ell_pack(csr, max_nnz=m_slots, with_data=False)
+        cols[i], _ = ell_pack(csr, max_nnz=m_slots, with_data=False,
+                              index_dtype=index_dtype)
         deg[i] = np.diff(csr.indptr).astype(np.int32)
     return cols, deg

@@ -120,3 +120,30 @@ def test_routing_all_sizes(n_dev):
     x = jax.device_put(x_host, NamedSharding(mesh, P("blocks")))
     got = routed_take(x, route, mesh)
     np.testing.assert_allclose(np.asarray(got), x_host[table], rtol=0, atol=0)
+
+
+def test_features_128_mesh_and_fold():
+    """BASELINE configs 3/5 run 128 features; drive k=128 through the
+    sharded multi-level step and the folded single-chip executor."""
+    import numpy as np
+
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+    from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+    from arrow_matrix_tpu.utils import numerics
+
+    n, width, k = 1024, 64, 128
+    a = barabasi_albert(n, 4, seed=17)
+    levels = arrow_decomposition(a, width, max_levels=4,
+                                 block_diagonal=True, seed=3)
+    x = random_dense(n, k, seed=4)
+    want = decomposition_spmm(levels, x)
+    tol = numerics.relative_tolerance(
+        sum(l.matrix.nnz for l in levels) / n, iters=1)
+    for ml in (MultiLevelArrow(levels, width,
+                               mesh=make_mesh((8,), ("blocks",)),
+                               fmt="ell"),
+               MultiLevelArrow(levels, width, mesh=None, fmt="fold")):
+        got = ml.gather_result(ml.step(ml.set_features(x)))
+        assert numerics.relative_error(got, want) < tol

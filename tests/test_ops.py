@@ -301,3 +301,20 @@ def test_mixed_value_levels_resolve_weighted():
     from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
     np.testing.assert_allclose(got, decomposition_spmm(levels, x),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_block_index_dtype_selection():
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.ops.ell import block_index_dtype
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    assert block_index_dtype(2048) == np.int16
+    assert block_index_dtype(32766) == np.int16
+    assert block_index_dtype(32767) == np.int32
+    assert block_index_dtype(100_000) == np.int32
+
+    a = barabasi_albert(256, 4, seed=5)
+    lvl = arrow_decomposition(a, 64, max_levels=2, block_diagonal=True,
+                              seed=1)[0]
+    b = arrow_blocks_from_csr(lvl.matrix, 64)
+    assert b.diag_cols.dtype == jnp.int16     # block-local columns
