@@ -111,6 +111,24 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Decomposer linearization backend for the "
                              "generated-graph path (native C++ when "
                              "available; see arrow_decompose --backend).")
+    parser.add_argument("--carry", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Carry X across iterations (X := A @ X "
+                             "propagation, the GNN-style iterated run) "
+                             "instead of the reference benchmark's "
+                             "fresh random X per iteration.")
+    parser.add_argument("--checkpoint", type=str, default=None,
+                        help="Directory for iteration-state checkpoints "
+                             "(requires --carry): X and the iteration "
+                             "counter are saved every "
+                             "--checkpoint_every iterations (orbax "
+                             "when available — sharded arrays persist "
+                             "per-shard without a host gather) and the "
+                             "run resumes from the checkpoint when one "
+                             "exists.  Beyond reference parity: the "
+                             "reference's only resume point is the "
+                             "decomposition artifact.")
+    parser.add_argument("--checkpoint_every", type=int, default=10)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
@@ -125,6 +143,11 @@ def main(argv=None) -> int:
         raise SystemExit("--slim requires a block-diagonal decomposition "
                          "(--blocked true); the reference enforces the "
                          "same (arrow_dec_mpi.py:131)")
+    if args.checkpoint and not args.carry:
+        # Pure flag error: fail before any decomposition/compile work.
+        raise SystemExit("--checkpoint requires --carry (there is no "
+                         "iteration state to resume when X is fresh "
+                         "every iteration)")
     if args.mode == "space":
         if args.fmt in ("hyb", "fold"):
             raise SystemExit(
@@ -242,16 +265,37 @@ def main(argv=None) -> int:
 
     rng = np.random.default_rng(args.seed)
     fail = False
-    for it in range(args.iterations):
+    start_it = 0
+    x = None
+    if args.carry:
+        x = warm   # the warmup input IS the carry-mode initial state
+        if args.checkpoint:
+            from arrow_matrix_tpu.utils.checkpoint import load_state
+
+            state = load_state(args.checkpoint, like=x)
+            if state is not None:
+                x, start_it = state
+                print(f"resumed from {args.checkpoint} at iteration "
+                      f"{start_it}")
+    for it in range(start_it, args.iterations):
         wb.set_iteration_data({"iteration": it})
-        # Fresh random X every iteration (arrow_bench.py:114-116).
-        x_host = graphs.random_dense(n, args.features, seed=int(rng.integers(2**31)))
-        x = multi.set_features(x_host)
+        if args.carry:
+            x_host = None
+        else:
+            # Fresh random X every iteration (arrow_bench.py:114-116).
+            x_host = graphs.random_dense(n, args.features,
+                                         seed=int(rng.integers(2**31)))
+            x = multi.set_features(x_host)
         try:
+            if args.carry and args.validate:
+                # The golden compares one step from the CURRENT state.
+                x_host = multi.gather_result(x)
             tic = time.perf_counter()
             y = multi.step(x)
             jax.block_until_ready(y)
             wb.log({"spmm_time": time.perf_counter() - tic})
+            if args.carry:
+                x = y
         except Exception as e:  # abort like the collective LOR flag
             print(f"iteration {it} failed: {e}")
             fail = True
@@ -273,6 +317,14 @@ def main(argv=None) -> int:
             if not np.isfinite(err) or err > tol:
                 fail = True
                 break
+        # Checkpoint only a state that passed this iteration's gates —
+        # persisting before validation would let a rerun resume past
+        # (and so mask) a numerically bad iteration.
+        if (args.carry and args.checkpoint
+                and (it + 1) % max(args.checkpoint_every, 1) == 0):
+            from arrow_matrix_tpu.utils.checkpoint import save_state
+
+            save_state(args.checkpoint, x, it + 1)
 
     summary = wb.get_log().summarize()
     if "spmm_time" in summary:

@@ -148,7 +148,8 @@ def ell_spmm(cols: jax.Array, data: Optional[jax.Array], x: jax.Array,
     array's bytes vanish (half the streamed slot bytes).  Bit-identical
     to the weighted kernel on 0/1 data.
 
-    :param cols: (rows, m) int32 — column indices, 0 for padding.
+    :param cols: (rows, m) integer column indices (int32, or int16 from
+        the block packers at width < 32767), 0 for padding.
     :param data: (rows, m) values, 0 for padding; or None for binary.
     :param deg:  (rows,) int32 valid-slot counts (binary mode only).
     :param x:    (n_cols, k)     — dense operand.
@@ -227,7 +228,8 @@ def ell_spmm_t(cols: jax.Array, x_t: jax.Array,
     value array's bytes vanish entirely.  Bit-identical to the weighted
     kernel on 0/1 data (same addends, same slot order).
 
-    :param cols: (m, rows) int32 — column indices, 0 in padding slots.
+    :param cols: (m, rows) integer column indices (any int dtype), 0 in
+        padding slots.
     :param x_t:  (k, n_cols) — dense operand, feature-major.
     :param data: (m, rows) values, or None for binary.
     :param deg:  (rows,) int32 valid-slot counts (binary mode only).
