@@ -20,18 +20,26 @@ same jitted multi-level arrow SpMM:
 """
 
 from arrow_matrix_tpu.models.propagation import (
+    GCNModel,
     SGCModel,
     SGCParams,
+    gcn_forward,
+    gcn_init,
     label_propagation,
+    make_gcn_train_step,
     make_train_step,
     pagerank,
     power_iteration,
 )
 
 __all__ = [
+    "GCNModel",
     "SGCModel",
     "SGCParams",
+    "gcn_forward",
+    "gcn_init",
     "label_propagation",
+    "make_gcn_train_step",
     "make_train_step",
     "pagerank",
     "power_iteration",

@@ -140,6 +140,80 @@ def make_train_step(widths: tuple, hops: int,
 
 
 # ---------------------------------------------------------------------------
+# GCN: per-layer weights with a nonlinearity between propagation hops
+# (SGC collapses to one head exactly because it drops these).  Same
+# pure-function shape as SGC: blocks/routing are pytree arguments, so
+# the layers shard under a mesh unchanged.
+
+
+def gcn_init(rng: jax.Array, dims: Sequence[int],
+             dtype=jnp.float32) -> list[SGCParams]:
+    """Per-layer LeCun-normal init; ``dims`` = [k_in, h1, ..., k_out]."""
+    keys = jax.random.split(rng, len(dims) - 1)
+    return [sgc_init(k, d_in, d_out, dtype)
+            for k, d_in, d_out in zip(keys, dims[:-1], dims[1:])]
+
+
+def gcn_forward(params: Sequence[SGCParams], x: jax.Array, fwd: jax.Array,
+                bwd: jax.Array, blocks: Sequence[ArrowBlocks],
+                widths: tuple,
+                chunk: Optional[int] = None) -> jax.Array:
+    """Each layer: propagate through the decomposition, then a dense
+    layer; ReLU between layers, raw logits out of the last."""
+    for i, p in enumerate(params):
+        x = multi_level_spmm(x, fwd, bwd, blocks, widths, chunk=chunk)
+        x = x @ p.w + p.b
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def make_gcn_train_step(widths: tuple,
+                        optimizer: optax.GradientTransformation,
+                        chunk: Optional[int] = None) -> Callable:
+    """Jitted masked-MSE training step over the per-layer GCN weights
+    (same contract as ``make_train_step``)."""
+
+    def loss_fn(params, x, y, mask, fwd, bwd, blocks):
+        logits = gcn_forward(params, x, fwd, bwd, blocks, widths,
+                             chunk=chunk)
+        per_row = jnp.sum((logits - y) ** 2, axis=-1)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(per_row * mask) / denom
+
+    @jax.jit
+    def train_step(params, opt_state, x, y, mask, fwd, bwd, blocks):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, mask,
+                                                  fwd, bwd, blocks)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+class GCNModel:
+    """Multi-layer GCN over a fixed decomposed adjacency: the deep
+    counterpart of :class:`SGCModel` (which is its 1-head collapse)."""
+
+    def __init__(self, multi: MultiLevelArrow, dims: Sequence[int],
+                 seed: int = 0, chunk: Optional[int] = None):
+        _check_not_folded(multi, "GCNModel")
+        self.multi = multi
+        self.params = gcn_init(jax.random.key(seed), list(dims))
+        self._forward = jax.jit(functools.partial(
+            gcn_forward, widths=tuple(multi.widths), chunk=chunk))
+
+    def forward(self, x: jax.Array) -> jax.Array:
+        m = self.multi
+        return self._forward(self.params, x, m.fwd, m.bwd, m.blocks)
+
+    def predict(self, x_original: np.ndarray) -> np.ndarray:
+        m = self.multi
+        return m.gather_result(self.forward(m.set_features(x_original)))
+
+
+# ---------------------------------------------------------------------------
 # Solver-style model families on the same operator.  Bodies are
 # module-level jitted functions (widths/chunk static) so repeated solver
 # calls on the same decomposition shapes hit the jit cache instead of

@@ -118,3 +118,33 @@ def test_power_iteration_on_fold():
     ve, le = power_iteration(mle, x0, iterations=30)
     assert abs(lf - le) < 1e-3 * abs(le)
     np.testing.assert_allclose(np.abs(vf), np.abs(ve), rtol=1e-3, atol=1e-4)
+
+
+def test_fold_from_memmapped_artifact(tmp_path):
+    """fold consumes memmapped CsrLike triplet levels (implicit-ones
+    data) straight from an on-disk artifact."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.io import (
+        as_levels,
+        load_decomposition,
+        load_level_widths,
+        save_decomposition,
+    )
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    a = barabasi_albert(600, 3, seed=5)
+    levels = arrow_decomposition(a, 64, max_levels=3, block_diagonal=True,
+                                 seed=5)
+    base = str(tmp_path / "g")
+    save_decomposition(levels, base)
+    loaded = load_decomposition(base, 64, mem_map=True)
+    widths = load_level_widths(base, 64)
+    stream_levels = as_levels(loaded, widths if widths is not None else 64,
+                              materialize=False)
+    assert not hasattr(stream_levels[0].matrix, "nnz")  # triplet, not CSR
+
+    ml = MultiLevelArrow(stream_levels, 64, mesh=None, fmt="fold")
+    assert ml.blocks[0].binary          # implicit-ones artifact data
+    x = random_dense(600, 8, seed=2)
+    out = ml.gather_result(ml.step(ml.set_features(x)))
+    np.testing.assert_allclose(out, a @ x, rtol=1e-4, atol=1e-4)
