@@ -5,6 +5,7 @@ from arrow_matrix_tpu.ops.ell import (
     ell_pack_stack,
     ell_spmm,
     ell_spmm_batched,
+    ell_spmm_t,
 )
 from arrow_matrix_tpu.ops.arrow_blocks import (
     ArrowBlocks,
@@ -14,6 +15,11 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
     unblock_features,
 )
 from arrow_matrix_tpu.ops.hyb import HybLevel, hyb_from_csr, hyb_spmm
+from arrow_matrix_tpu.ops.sell import (
+    SellMatrix,
+    sell_from_csr,
+    sell_spmm_t,
+)
 # Pallas is optional: JAX builds without pallas/tpu support must still
 # import the (default, XLA-path) ops package.
 try:
@@ -37,11 +43,15 @@ __all__ = [
     "ell_pack_stack",
     "ell_spmm",
     "ell_spmm_batched",
+    "ell_spmm_t",
     "ArrowBlocks",
     "arrow_blocks_from_csr",
     "HybLevel",
+    "SellMatrix",
     "hyb_from_csr",
     "hyb_spmm",
+    "sell_from_csr",
+    "sell_spmm_t",
     "arrow_spmm",
     "arrow_spmm_pallas",
     "column_spmm_pallas",

@@ -209,3 +209,36 @@ def test_spmm_arrow_fold_rejects_mesh(tmp_path, monkeypatch):
             "--iterations", "1", "--device", "cpu", "--devices", "4",
             "--fmt", "fold", "--logdir", str(tmp_path / "logs"),
         ])
+
+
+def test_spmm_arrow_aborts_on_poisoned_artifact(tmp_path, monkeypatch):
+    """Failure detection: a NaN in the artifact data must fail the
+    validated run with nonzero rc (the reference's collective
+    allreduce(LOR) abort, arrow_bench.py:128-134 — here the gate is
+    the per-iteration validation)."""
+    import glob
+
+    import numpy as np
+
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.io import save_decomposition
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert
+
+    monkeypatch.chdir(tmp_path)
+    a = (barabasi_albert(300, 3, seed=2) * 0.5).tocsr()
+    levels = arrow_decomposition(a, 32, max_levels=2, block_diagonal=True,
+                                 seed=0)
+    base = str(tmp_path / "g")
+    save_decomposition(levels, base, block_diagonal=True)
+    data_files = sorted(glob.glob(base + "*_data.npy"))
+    assert data_files
+    d = np.load(data_files[0])
+    d[0] = np.nan
+    np.save(data_files[0], d)
+
+    rc = spmm_arrow.main([
+        "--path", base, "--width", "32", "--features", "4",
+        "--iterations", "2", "--validate", "true", "--device", "cpu",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc != 0

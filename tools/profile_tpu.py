@@ -67,7 +67,8 @@ def main():
           f"in {time.perf_counter() - t0:.1f}s", flush=True)
 
     budget = device_memory_budget(dev)
-    multi = MultiLevelArrow(levels, width, mesh=None, fmt="auto",
+    fmt = os.environ.get("AMT_PROFILE_FMT", "auto")
+    multi = MultiLevelArrow(levels, width, mesh=None, fmt=fmt,
                             dense_budget=budget)
     print(f"fmts: {multi.fmts}  total_rows: {multi.total_rows}", flush=True)
 
@@ -76,6 +77,28 @@ def main():
 
     ms = timeit(multi.step, x)
     print(f"full step: {ms:.1f} ms", flush=True)
+
+    if fmt == "fold":
+        # Per-tier attribution of the folded SELL operator.
+        from arrow_matrix_tpu.ops.ell import auto_chunk, ell_spmm_t
+        from arrow_matrix_tpu.parallel.multi_level import gather_budget_for
+
+        sell = multi.blocks[0]
+        gb = gather_budget_for(multi.dense_budget)
+        for t, cols in enumerate(sell.cols):
+            m_t, n_t = cols.shape
+            if m_t == 0:
+                print(f"tier {t}: m=0 n={n_t} (zero-degree rows)",
+                      flush=True)
+                continue
+            chunk = auto_chunk(n_t, k, m_t, gb)
+            f = jax.jit(lambda c, dg, xx, ch=chunk: ell_spmm_t(
+                c, xx, deg=dg, chunk=ch))
+            ms_t = timeit(f, cols, sell.deg[t], x)
+            print(f"tier {t}: m={m_t} n={n_t} slots={m_t * n_t} "
+                  f"{ms_t:.2f} ms ({m_t * n_t / ms_t / 1e3:.0f}M slots/s)",
+                  flush=True)
+        return
 
     total = multi.total_rows
     gather_budget = gather_budget_for(multi.dense_budget)
@@ -91,17 +114,20 @@ def main():
             head_ms = timeit(
                 jax.jit(lambda b, xx, c=chunk: ell_spmm(
                     b.head_cols, b.head_data,
-                    xx.reshape(-1, xx.shape[-1]), chunk=c)), blk, xb)
+                    xx.reshape(-1, xx.shape[-1]), chunk=c,
+                    deg=b.head_deg)), blk, xb)
         else:
             head_ms = timeit(
                 jax.jit(functools.partial(head_block_spmm, chunk=chunk)),
                 blk, xb)
         diag_ms = timeit(
             jax.jit(lambda b, xx, c=chunk: block_spmm(
-                b.fmt, b.diag_cols, b.diag_data, xx, chunk=c)), blk, xb)
+                b.fmt, b.diag_cols, b.diag_data, xx, chunk=c,
+                deg=b.diag_deg)), blk, xb)
         col_ms = timeit(
             jax.jit(lambda b, xx, c=chunk: block_spmm_shared(
-                b.fmt, b.col_cols, b.col_data, xx[0], chunk=c)), blk, xb)
+                b.fmt, b.col_cols, b.col_data, xx[0], chunk=c,
+                deg=b.col_deg)), blk, xb)
         nnz = int(levels[i].matrix.nnz)
         head_kind = ("gell" if blk.head_gell
                      else "flat" if blk.head_flat else blk.fmt)
