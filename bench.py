@@ -344,7 +344,14 @@ def run_one_variant(name: str) -> None:
     Runs in a subprocess spawned by ``kernel_compare`` so that a
     pathological kernel (e.g. a Mosaic compile that never returns — a
     hang SIGALRM cannot interrupt inside native code) costs its own
-    timeout, not the whole bench."""
+    timeout, not the whole bench.  ``AMT_BENCH_CPU=1`` pins the child
+    to the host CPU (JAX_PLATFORMS alone cannot stop a site-registered
+    TPU plugin from initializing) — for testing the variants without an
+    accelerator."""
+    if os.environ.get("AMT_BENCH_CPU") == "1":
+        from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices()
     import jax
 
     jax.config.update("jax_default_matmul_precision", "highest")
