@@ -63,11 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Build the exchange tables, print their "
                              "stats, skip the benchmark.")
     parser.add_argument("-m", "--memory", type=float, default=0.5,
-                        help="Fraction of device memory budgeted for "
-                             "kernel intermediates; drives the ELL "
-                             "slot-chunk auto-tiling (the reference's "
-                             "--memory OOM-model GPU tiling, "
-                             "spmm_petsc.py:323-395).")
+                        help="Fraction of currently-FREE device memory "
+                             "(net of this layout's own blocks) "
+                             "budgeted for kernel intermediates; "
+                             "drives the ELL slot-chunk auto-tiling "
+                             "(the reference's --memory OOM-model GPU "
+                             "tiling, spmm_petsc.py:323-395).  <= 0 "
+                             "disables chunking.")
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
     return parser
@@ -97,20 +99,10 @@ def main(argv=None) -> int:
     wb.init("PETSc_TPU_v1", name, config=vars(args))
 
     with wb.segment("build_time"):
-        from arrow_matrix_tpu.ops.ell import auto_chunk
-        from arrow_matrix_tpu.utils.platform import device_memory_budget
-
-        budget = device_memory_budget(jax.devices()[0],
-                                      fraction=args.memory)
-        dist = MatrixSlice1D(a, mesh)
-        # Auto slot-chunk from the budget (the reference's OOM-model
-        # tiling sizes, spmm_petsc.py:323-395): bound the per-slice
-        # gather intermediate.
-        m_slots = max(int(dist.l_cols[0].shape[-1]),
-                      int(dist.nl_cols[0].shape[-1]), 1)
-        chunk = auto_chunk(dist.l_rows, args.columns, m_slots, budget)
-        if chunk is not None:
-            dist = MatrixSlice1D(a, mesh, chunk=chunk)
+        dist = MatrixSlice1D(
+            a, mesh,
+            chunk="auto" if args.memory > 0 else None,
+            memory_fraction=args.memory if args.memory > 0 else 0.5)
     print(f"{n_dev} slices of <= {dist.l_rows} rows; exchange slot "
           f"{dist.slot} rows/pair")
     if args.dryrun:

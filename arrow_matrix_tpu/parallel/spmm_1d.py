@@ -74,7 +74,15 @@ class MatrixSlice1D:
 
     def __init__(self, a: sparse.spmatrix, mesh: Mesh, axis: str = "slices",
                  slices: Optional[Sequence[Tuple[int, int]]] = None,
-                 dtype=np.float32, chunk: Optional[int] = None):
+                 dtype=np.float32, chunk=None,
+                 memory_fraction: float = 0.5):
+        """``chunk``: slot-chunk bound for the two ELL gathers — an
+        explicit int, None (no chunking), or "auto": sized at trace
+        time from ``memory_fraction`` of the device's currently-free
+        memory net of this layout's own resident blocks (the
+        reference's OOM-model GPU tiling, spmm_petsc.py:323-395), with
+        a shared-pool division on host-CPU meshes where all shards
+        draw from one physical RAM."""
         self.mesh = mesh
         self.axis = axis
         n_dev = mesh.shape[axis]
@@ -189,6 +197,26 @@ class MatrixSlice1D:
         nl_cols, nl_data, _ = pack_stack(nonlocal_blocks)
 
         shard = NamedSharding(mesh, P(axis))
+        if chunk == "auto":
+            if not 0 < memory_fraction <= 1:
+                raise ValueError(
+                    f"memory_fraction must be in (0, 1], got "
+                    f"{memory_fraction}")
+            from arrow_matrix_tpu.utils.platform import device_memory_budget
+
+            block_bytes = (l_cols.nbytes + l_data.nbytes + nl_cols.nbytes
+                           + nl_data.nbytes + send_idx.nbytes)
+            dev = mesh.devices.flat[0]
+            budget = device_memory_budget(dev, fraction=memory_fraction)
+            floor = 1 << 26
+            if dev.platform == "cpu":
+                # Virtual devices share one physical pool: net out ALL
+                # resident blocks and split across concurrent shards.
+                per_dev = max(budget - block_bytes, floor) / max(n_dev, 1)
+            else:
+                per_dev = max(budget - block_bytes / max(n_dev, 1), floor)
+            chunk = ("auto", int(per_dev))
+
         self.l_cols = jax.device_put(l_cols, shard)
         self.l_data = jax.device_put(l_data, shard)
         self.nl_cols = jax.device_put(nl_cols, shard)
@@ -202,13 +230,20 @@ class MatrixSlice1D:
             # All operands carry this device's leading slice of size 1.
             x_loc = x[0]                       # (l_rows, k)
             k = x_loc.shape[-1]
-            from arrow_matrix_tpu.ops.ell import ell_spmm
+            from arrow_matrix_tpu.ops.ell import auto_chunk, ell_spmm
+
+            if isinstance(chunk, tuple):       # ("auto", budget_bytes)
+                budget = chunk[1]
+                c_l = auto_chunk(l_rows, k, l_cols.shape[-1], budget)
+                c_nl = auto_chunk(l_rows, k, nl_cols.shape[-1], budget)
+            else:
+                c_l = c_nl = chunk
 
             # Local SpMM first: in the reference it overlaps with the
             # in-flight row exchange (spmm_petsc.py:193-199); under XLA
             # the scheduler overlaps the independent all_to_all for us.
             y = ell_spmm(l_cols[0], l_data[0], x_loc,
-                         chunk=chunk).astype(jnp.float32)
+                         chunk=c_l).astype(jnp.float32)
 
             if slot > 0:
                 # Ship exactly the requested rows to every peer: one
@@ -219,7 +254,7 @@ class MatrixSlice1D:
                                       concat_axis=0, tiled=True)
                 x_nonlocal = recv.reshape(slot * send.shape[0], k)
                 y = y + ell_spmm(nl_cols[0], nl_data[0], x_nonlocal,
-                                 chunk=chunk).astype(jnp.float32)
+                                 chunk=c_nl).astype(jnp.float32)
             return y[None].astype(x.dtype)
 
         self._step = jax.jit(shard_map(

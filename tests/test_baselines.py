@@ -138,3 +138,23 @@ class TestMatrixSlice1D:
             want = a @ want
         np.testing.assert_allclose(dist.gather_result(xd), want,
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_matrix_slice_1d_auto_chunk_and_validation():
+    """chunk='auto' sizes the gather bound inside the layout (budget
+    net of resident blocks, shared-pool division on CPU meshes) and
+    still computes exactly; bad fractions are rejected."""
+    from arrow_matrix_tpu.parallel.spmm_1d import MatrixSlice1D
+    from arrow_matrix_tpu.utils.graphs import random_csr
+
+    a = random_csr(256, 256, 6, seed=5)
+    mesh = make_mesh((4,), ("slices",))
+    d = MatrixSlice1D(a, mesh, chunk="auto")
+    x = random_dense(256, 8, seed=1)
+    got = d.gather_result(d.spmm(d.set_features(x)))
+    np.testing.assert_allclose(got, a @ x, rtol=1e-4, atol=1e-5)
+
+    with pytest.raises(ValueError, match="memory_fraction"):
+        MatrixSlice1D(a, mesh, chunk="auto", memory_fraction=0.0)
+    with pytest.raises(ValueError, match="memory_fraction"):
+        MatrixSlice1D(a, mesh, chunk="auto", memory_fraction=1.5)
