@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Replication factor c; 0 = largest valid "
                              "power of two (spmm_15d_main.py:87-96).")
     parser.add_argument("--validate", type=str2bool, nargs="?", default=True)
+    parser.add_argument("-m", "--memory", type=float, default=0.5,
+                        help="Fraction of currently-free device memory "
+                             "budgeted for kernel intermediates "
+                             "(slot-chunk auto-tiling; the reference's "
+                             "--gpu-tiling analog, spmm_15d.py:371-449)."
+                             "  <= 0 disables chunking.")
     parser.add_argument("-z", "--iterations", type=int, default=10)
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
@@ -79,7 +85,10 @@ def main(argv=None) -> int:
 
     wb.init(f"15D_TPU_c_{c}", name, config=vars(args))
     with wb.segment("build_time"):
-        dist = SpMM15D(a, mesh)
+        dist = SpMM15D(
+            a, mesh,
+            chunk="auto" if args.memory > 0 else None,
+            memory_fraction=args.memory if args.memory > 0 else 0.5)
 
     x_host = random_dense(a.shape[1], args.columns, seed=args.seed)
     x = dist.set_features(x_host)

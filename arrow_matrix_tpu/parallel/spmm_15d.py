@@ -73,7 +73,13 @@ class SpMM15D:
 
     def __init__(self, a: sparse.spmatrix, mesh: Mesh,
                  rows_axis: str = "rows", repl_axis: str = "repl",
-                 dtype=np.float32, chunk: Optional[int] = None):
+                 dtype=np.float32, chunk=None,
+                 memory_fraction: float = 0.5):
+        """``chunk``: explicit int, None, or "auto" — sized at trace
+        time from ``memory_fraction`` of currently-free device memory
+        net of the resident blocks, shared-pool-divided on CPU meshes
+        (same rule as MatrixSlice1D; the reference's --gpu-tiling /
+        --memory OOM-model sizing, spmm_petsc.py:323-395)."""
         self.mesh = mesh
         self.rows_axis = rows_axis
         self.repl_axis = repl_axis
@@ -130,6 +136,24 @@ class SpMM15D:
                     cols[i, j, r, :bc.shape[0]] = bc
                     data[i, j, r, :bd.shape[0]] = bd
 
+        if chunk == "auto":
+            if not 0 < memory_fraction <= 1:
+                raise ValueError(
+                    f"memory_fraction must be in (0, 1], got "
+                    f"{memory_fraction}")
+            from arrow_matrix_tpu.utils.platform import device_memory_budget
+
+            n_dev = p_div_c * c
+            block_bytes = cols.nbytes + data.nbytes
+            dev = mesh.devices.flat[0]
+            budget = device_memory_budget(dev, fraction=memory_fraction)
+            floor = 1 << 26
+            if dev.platform == "cpu":
+                per_dev = max(budget - block_bytes, floor) / max(n_dev, 1)
+            else:
+                per_dev = max(budget - block_bytes / max(n_dev, 1), floor)
+            chunk = ("auto", int(per_dev))
+
         spec_a = NamedSharding(mesh, P(rows_axis, repl_axis))
         self.a_cols = jax.device_put(cols, spec_a)
         self.a_data = jax.device_put(data, spec_a)
@@ -146,6 +170,13 @@ class SpMM15D:
             j = lax.axis_index(repl_axis)
             x_loc = x[0]
             k = x_loc.shape[-1]
+            if isinstance(chunk, tuple):       # ("auto", budget_bytes)
+                from arrow_matrix_tpu.ops.ell import auto_chunk
+
+                c_r = auto_chunk(a_cols.shape[3], k, a_cols.shape[-1],
+                                 chunk[1])
+            else:
+                c_r = chunk
 
             def round_body(y, r):
                 q = j * rounds + r
@@ -154,7 +185,7 @@ class SpMM15D:
                     jnp.where(my_row == q, x_loc,
                               jnp.zeros_like(x_loc)), rows_axis)
                 y = y + ell_spmm(a_cols[0, 0, r], a_data[0, 0, r], buf,
-                                 chunk=chunk).astype(jnp.float32)
+                                 chunk=c_r).astype(jnp.float32)
                 return y, None
 
             y0 = jnp.zeros((a_cols.shape[3], k), dtype=jnp.float32)

@@ -158,3 +158,17 @@ def test_matrix_slice_1d_auto_chunk_and_validation():
         MatrixSlice1D(a, mesh, chunk="auto", memory_fraction=0.0)
     with pytest.raises(ValueError, match="memory_fraction"):
         MatrixSlice1D(a, mesh, chunk="auto", memory_fraction=1.5)
+
+
+def test_spmm_15d_auto_chunk():
+    from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D
+    from arrow_matrix_tpu.utils.graphs import random_csr
+
+    a = random_csr(256, 256, 6, seed=8)
+    mesh = make_mesh((4, 2), ("rows", "repl"))
+    d = SpMM15D(a, mesh, chunk="auto")
+    x = random_dense(256, 8, seed=2)
+    got = d.gather_result(d.spmm(d.set_features(x)))
+    np.testing.assert_allclose(got, a @ x, rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="memory_fraction"):
+        SpMM15D(a, mesh, chunk="auto", memory_fraction=2.0)
