@@ -319,20 +319,23 @@ def run_bench(result: dict) -> None:
     })
 
 
-# Ordered most-informative-first: the total budget may cut the tail.
+# Ordered most-informative-first: the total budget may cut the tail,
+# and the gather-family variants are cheap (small uploads, fast
+# compiles) while the dense/pallas ones ship GBs of blocks — run every
+# cheap one before the first expensive one.
 COMPARE_VARIANTS = {
-    "fold": dict(fmt="fold"),             # composed single-operator HYB
+    "fold": dict(fmt="fold"),             # composed single-operator SELL
     "hyb": dict(fmt="hyb"),
     "ell": dict(fmt="ell"),               # platform-aware auto head
-    "dense": dict(fmt="dense"),
-    "pallas": dict(fmt="dense", kernel="pallas"),
-    "dense_bf16": dict(fmt="dense", dtype="bf16"),
     # Head-stack kernel isolation: flat-COO head = scatter-add (TPU
     # scatters serialize), ELL/gell heads = gather + reduce.  The
     # spread between these is the head-kernel cost.
-    "ell_headflat": dict(fmt="ell", head_fmt="flat"),
     "ell_headgell": dict(fmt="ell", head_fmt="gell"),
+    "ell_headflat": dict(fmt="ell", head_fmt="flat"),
     "ell_headell": dict(fmt="ell", head_fmt="ell"),
+    "dense": dict(fmt="dense"),
+    "dense_bf16": dict(fmt="dense", dtype="bf16"),
+    "pallas": dict(fmt="dense", kernel="pallas"),
     "pallas_bf16": dict(fmt="dense", kernel="pallas", dtype="bf16"),
 }
 COMPARE_CONFIG = dict(n=65536, m=8, width=2048, k=16, iters=10)
