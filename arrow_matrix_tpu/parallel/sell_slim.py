@@ -1,0 +1,399 @@
+"""SellSlim — the padding-free distributed slim layout (single matrix).
+
+The stacked-ELL slim layout (parallel/arrow_layout.py) reproduces the
+reference's communication structure but stores row-major ``(nb, w, m)``
+blocks and carries ``(total, k)`` features — layouts the TPU physically
+pads 8-16x (PERFORMANCE.md "layout-padding law").  This module is the
+same distributed algorithm — X_0 broadcast (masked psum), per-device
+body compute, head-row reduction (psum) — rebuilt on the padding-free
+layouts the single-chip fold path proved out:
+
+  * features are carried **feature-major** ``(k, total)``, sharded on
+    the row axis (axis 1): the large dimension is minor everywhere;
+  * each device's share of the matrix is **two SELL operators** over
+    its local operand — a *body* operator (its rows >= w: diagonal
+    block + head-column block, columns in [shard] ∪ [0, w)) and a
+    *head* operator (rows [0, w), columns in its shard) whose per-device
+    partials psum into C_0 (reference Reduce, arrow_slim_mpi.py:104-119);
+  * rows are **tier-grouped by degree per shard** with one shared tier
+    shape across devices (shard_map needs one program): tier row
+    counts pad to the max over devices, padded rows have degree 0 and
+    produce zeros.  The resulting per-shard ordering — zero tier first,
+    ascending-degree tiers after, device 0's head rows leading the zero
+    tier — is composed into the carried permutation once on the host,
+    so it costs nothing at runtime (exactly the fold trick,
+    ops/sell.py).
+
+Communication is identical to the slim layout: one masked-psum X_0
+broadcast and one psum head reduction per step, both
+orientation-independent.  Covers the block-diagonal slim structure
+(the reference's default production layout, arrow_slim_mpi.py); the
+banded variant stays with the stacked layout.
+
+Reference counterpart: one ``ArrowSlimMPI`` matrix on t ranks
+(arrow/arrow_slim_mpi.py:246-280).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from scipy import sparse
+
+from arrow_matrix_tpu.io.graphio import CsrLike, num_rows
+from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up, ell_spmm_t
+from arrow_matrix_tpu.ops.hyb import resolve_binary
+
+
+def degree_ladder(max_deg: int, growth: float = 1.5,
+                  align: int = SLOT_ALIGN) -> list[int]:
+    """Fixed tier thresholds [0, align, align*g, ...] >= max_deg —
+    device-independent, so every shard shares one tier shape."""
+    ladder = [0]
+    t = align
+    while ladder[-1] < max_deg:
+        ladder.append(t)
+        t = align_up(max(int(t * growth), t + 1), align)
+    return ladder
+
+
+@struct.dataclass
+class SellShardStack:
+    """Per-device-stacked tiered SELL operators (leading device axis).
+
+    ``cols[t]``: (n_dev, m_t, n_t) int32 column indices into the local
+    operand; ``deg[t]``: (n_dev, n_t) int32 valid-slot counts (always
+    present — they mask tier row padding even in weighted mode);
+    ``data[t]``: (n_dev, m_t, n_t) values or None (binary).
+    """
+
+    cols: Tuple[jax.Array, ...]
+    deg: Tuple[jax.Array, ...]
+    data: Optional[Tuple[jax.Array, ...]] = None
+
+    def device_nbytes(self) -> int:
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(self))
+
+
+def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
+                      binary: bool, dtype,
+                      shared_degrees: Optional[np.ndarray] = None
+                      ) -> tuple[SellShardStack, np.ndarray, int]:
+    """Tier-group each device's share rows by degree under the shared
+    ladder; returns (stack, order, rows_out) where ``order[d, i]`` is
+    the share row stored at tiered position i of device d and
+    ``rows_out`` = padded per-device output length (sum of shared tier
+    row counts).
+
+    ``shared_degrees`` keys the buckets and ordering on one
+    device-independent degree vector (the head operator: psum'd
+    partials need identical row order on every device; local share
+    degrees never exceed the global row degree, so the shared tier
+    slots always suffice)."""
+    n_dev = len(shares)
+    degs = [np.diff(s.indptr) for s in shares]
+    # Stable sort by ladder bucket only: preserves original order
+    # within a bucket (device 0's head rows lead the zero tier).
+    if shared_degrees is not None:
+        b_shared = np.searchsorted(ladder, shared_degrees, side="left")
+        bucket = [b_shared] * n_dev
+        orders = [np.argsort(b_shared, kind="stable")] * n_dev
+    else:
+        bucket = [np.searchsorted(ladder, d, side="left") for d in degs]
+        orders = [np.argsort(b, kind="stable") for b in bucket]
+    # Shared tier row counts = max over devices per bucket.
+    n_buckets = len(ladder)
+    counts = np.zeros((n_dev, n_buckets), dtype=np.int64)
+    for d in range(n_dev):
+        np.add.at(counts[d], bucket[d], 1)
+    shared = counts.max(axis=0)
+    rows_out = int(shared.sum())
+
+    # order[d]: tiered position -> share row (or -1 padding).
+    order = np.full((n_dev, rows_out), -1, dtype=np.int64)
+    tier_starts = np.concatenate([[0], np.cumsum(shared)])
+    for d in range(n_dev):
+        sorted_bucket = bucket[d][orders[d]]
+        for b in range(n_buckets):
+            lo_i = np.searchsorted(sorted_bucket, b, side="left")
+            hi_i = np.searchsorted(sorted_bucket, b + 1, side="left")
+            rows_b = orders[d][lo_i:hi_i]
+            order[d, tier_starts[b]:tier_starts[b] + rows_b.size] = rows_b
+
+    cols_t, deg_t, data_t = [], [], []
+    for b in range(n_buckets):
+        m_t = ladder[b]
+        n_t = int(shared[b])
+        lo = int(tier_starts[b])
+        cols = np.zeros((n_dev, m_t, n_t), dtype=np.int32)
+        deg = np.zeros((n_dev, n_t), dtype=np.int32)
+        vals = None if binary else np.zeros((n_dev, m_t, n_t), dtype=dtype)
+        for d in range(n_dev):
+            s = shares[d]
+            for i in range(n_t):
+                r = order[d, lo + i]
+                if r < 0:
+                    continue
+                a, z = int(s.indptr[r]), int(s.indptr[r + 1])
+                deg[d, i] = z - a
+                cols[d, :z - a, i] = s.indices[a:z]
+                if not binary:
+                    vals[d, :z - a, i] = s.data[a:z]
+        cols_t.append(jnp.asarray(cols))
+        deg_t.append(jnp.asarray(deg))
+        if not binary:
+            data_t.append(jnp.asarray(vals))
+    stack = SellShardStack(cols=tuple(cols_t), deg=tuple(deg_t),
+                           data=tuple(data_t) if not binary else None)
+    return stack, order, rows_out
+
+
+def _stack_spmm_t(stack: SellShardStack, z_t: jax.Array) -> jax.Array:
+    """One device's tiered SpMM: operands carry a leading device axis of
+    size 1 inside shard_map.  Returns (k, rows_out)."""
+    outs = []
+    for t, cols in enumerate(stack.cols):
+        m_t = cols.shape[1]
+        n_t = cols.shape[2]
+        if m_t == 0:
+            outs.append(jnp.zeros((z_t.shape[0], n_t), dtype=z_t.dtype))
+            continue
+        outs.append(ell_spmm_t(
+            cols[0], z_t,
+            data=None if stack.data is None else stack.data[t][0],
+            deg=stack.deg[t][0]))
+    return jnp.concatenate(outs, axis=1)
+
+
+class SellSlim:
+    """One arrow matrix distributed over a mesh axis in padding-free
+    layouts (see module docstring).  API mirrors the other layouts:
+    ``set_features`` / ``spmm`` / ``gather_result``.
+    """
+
+    def __init__(self, matrix: CsrLike, width: int, mesh: Mesh,
+                 axis: str = "blocks", dtype=np.float32,
+                 binary="auto"):
+        if isinstance(matrix, sparse.csr_matrix):
+            a = matrix
+        else:  # memmapped triplet
+            data, indices, indptr = matrix
+            indptr = np.asarray(indptr, dtype=np.int64)
+            nnz = int(indptr[-1])
+            vals = (np.ones(nnz, dtype=np.float32) if data is None
+                    else np.asarray(data[:nnz]))
+            a = sparse.csr_matrix(
+                (vals, np.asarray(indices[:nnz]), indptr),
+                shape=(indptr.size - 1, indptr.size - 1))
+        a = a.tocsr().astype(np.float32)
+        a.sum_duplicates()
+        a.sort_indices()
+        n = num_rows(a)
+        n_dev = mesh.shape[axis]
+        self.mesh = mesh
+        self.axis = axis
+        self.n = n
+        self.width = w = width
+        is_binary = resolve_binary(binary, a.data, nnz=a.nnz)
+        self.binary = is_binary
+
+        # Contiguous block-aligned shards.
+        shard_len = align_up(-(-n // n_dev), w)
+        if shard_len < w:
+            shard_len = w
+        total = shard_len * n_dev
+        a_pad = a.copy()
+        a_pad.resize((total, total))
+
+        starts = np.arange(n_dev) * shard_len
+
+        # Per-device shares.  Body: rows of the shard with row >= w,
+        # columns in [shard] (diagonal blocks) or [0, w) (head column
+        # arm) — verified to capture every such nonzero.  Head: rows
+        # [0, w), columns in the shard.
+        body_shares, head_shares = [], []
+        captured = 0
+        for d in range(n_dev):
+            lo, hi = starts[d], starts[d] + shard_len
+            rows = a_pad[lo:hi].tocsr()
+            # body (skip global head rows, device 0's first w — the
+            # head operator covers them)
+            body = rows.copy()
+            if d == 0:
+                body.data[:body.indptr[w]] = 0
+                body.eliminate_zeros()
+            local = body[:, lo:hi]
+            headcol = body[:, :w]
+            if d == 0:
+                # device 0's local slice already contains the head
+                # columns; don't double them.
+                headcol = sparse.csr_matrix((shard_len, w),
+                                            dtype=np.float32)
+            share = sparse.hstack([local, headcol], format="csr")
+            captured += share.nnz
+            body_shares.append(share)
+            head = a_pad[:w, lo:hi].tocsr()
+            captured += head.nnz
+            head_shares.append(head)
+        if captured != a_pad.nnz:
+            raise ValueError(
+                f"slim shares captured {captured} of {a_pad.nnz} "
+                f"nonzeros: the matrix has entries outside the "
+                f"block-diagonal arrow pattern at width {w} (columns "
+                f"outside the owning shard and the head arm)")
+
+        ladder_body = degree_ladder(
+            max((int(np.diff(s.indptr).max()) if s.nnz else 0)
+                for s in body_shares))
+        head_glob_deg = np.diff(a_pad[:w].tocsr().indptr)
+        ladder_head = degree_ladder(
+            int(head_glob_deg.max()) if head_glob_deg.size else 0)
+
+        self.body, body_order, self.rows_out = _pack_shard_tiers(
+            body_shares, ladder_body, is_binary, dtype)
+        self.head, head_order, self.head_rows_out = _pack_shard_tiers(
+            head_shares, ladder_head, is_binary, dtype,
+            shared_degrees=head_glob_deg)
+
+        # Carried ordering: position i of device d holds global row
+        # starts[d] + body_order[d, i] (or padding when -1).  Device
+        # 0's head rows lead its zero tier (stable sort) — verify, the
+        # x0 broadcast depends on it.
+        if not np.array_equal(body_order[0, :w], np.arange(w)):
+            raise AssertionError(
+                "device 0's head rows must lead its tiered ordering "
+                "(stable zero-tier sort invariant)")
+        self.body_order = body_order
+
+        # Body column remap: local shard columns -> tiered positions,
+        # head columns -> rows_out + [0, w).
+        inv = np.zeros((n_dev, shard_len), dtype=np.int64)
+        for d in range(n_dev):
+            live = body_order[d] >= 0
+            inv[d, body_order[d][live]] = np.flatnonzero(live)
+        remapped_cols = []
+        for t, cols in enumerate(self.body.cols):
+            c = np.asarray(cols)
+            out = np.empty_like(c)
+            for d in range(n_dev):
+                cd = c[d]
+                is_head = cd >= shard_len
+                out[d] = np.where(
+                    is_head, self.rows_out + (cd - shard_len),
+                    inv[d, np.minimum(cd, shard_len - 1)])
+            remapped_cols.append(jnp.asarray(out))
+        self.body = self.body.replace(cols=tuple(remapped_cols))
+        # Head column remap: shard columns -> tiered positions.
+        remapped_head = []
+        for t, cols in enumerate(self.head.cols):
+            c = np.asarray(cols)
+            out = np.empty_like(c)
+            for d in range(n_dev):
+                out[d] = inv[d, np.minimum(c[d], shard_len - 1)]
+            remapped_head.append(jnp.asarray(out))
+        self.head = self.head.replace(cols=tuple(remapped_head))
+
+        # Head output: global-degree order shared by every device (the
+        # psum needs one order); unsort indices restore rows [0, w).
+        if not np.all(head_order[0] == head_order):
+            raise AssertionError("head tier ordering must be "
+                                 "device-independent")
+        self.head_order = head_order[0]
+        self.head_unsort = jnp.asarray(
+            np.argsort(self.head_order[:w])[:w].astype(np.int32))
+
+        self.shard_len = shard_len
+        self.n_dev = n_dev
+        self.total_out = self.rows_out * n_dev
+
+        shard_stack = NamedSharding(mesh, P(axis))
+        self.body = jax.tree_util.tree_map(
+            lambda arr: jax.device_put(arr, shard_stack), self.body)
+        self.head = jax.tree_util.tree_map(
+            lambda arr: jax.device_put(arr, shard_stack), self.head)
+        repl = NamedSharding(mesh, P())
+        self.head_unsort = jax.device_put(self.head_unsort, repl)
+
+        try:  # jax >= 0.8 promotes shard_map out of experimental
+            from jax import shard_map
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+        w_ = w
+        rows_out = self.rows_out
+
+        def local_step(body, head, head_unsort, xt):
+            # xt: (k, rows_out) local, feature-major.
+            dev = lax.axis_index(axis)
+            x0 = lax.psum(
+                jnp.where(dev == 0, xt[:, :w_],
+                          jnp.zeros_like(xt[:, :w_])), axis)
+            z = jnp.concatenate([xt, x0], axis=1)   # (k, rows_out + w)
+            out = _stack_spmm_t(body, z)            # (k, rows_out)
+            head_part = _stack_spmm_t(head, xt)     # (k, head_rows_out)
+            c0 = lax.psum(head_part, axis)
+            # Head result in original [0, w) order, into device 0's
+            # leading positions.
+            c0w = jnp.take(c0, head_unsort, axis=1)[:, :w_]
+            out = jnp.where(
+                (dev == 0)
+                & (jnp.arange(rows_out)[None, :] < w_),
+                jnp.pad(c0w, ((0, 0), (0, rows_out - w_))), out)
+            return out
+
+        self._step = jax.jit(shard_map(
+            local_step, mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(
+                          lambda _: P(axis), self.body),
+                      jax.tree_util.tree_map(
+                          lambda _: P(axis), self.head),
+                      P(), P(None, axis)),
+            out_specs=P(None, axis),
+            check_vma=False,
+        ))
+
+    # -- features ---------------------------------------------------------
+
+    def _feature_sharding(self):
+        return NamedSharding(self.mesh, P(None, self.axis))
+
+    def set_features(self, x: np.ndarray) -> jax.Array:
+        """Host (n, k) -> feature-major (k, total_out) sharded array in
+        the carried (per-shard tier-grouped) ordering."""
+        n, k = x.shape
+        if n != self.n:
+            raise ValueError(f"expected {self.n} rows, got {n}")
+        out = np.zeros((self.n_dev, self.rows_out, k), dtype=x.dtype)
+        for d in range(self.n_dev):
+            src = self.body_order[d]
+            live = src >= 0
+            g = d * self.shard_len + src[live]
+            valid = g < n
+            out[d][np.flatnonzero(live)[valid]] = x[g[valid]]
+        flat = out.reshape(self.total_out, k)
+        return jax.device_put(np.ascontiguousarray(flat.T),
+                              self._feature_sharding())
+
+    def spmm(self, xt: jax.Array) -> jax.Array:
+        """One distributed SpMM step; feature-major in and out (iterate
+        by feeding the result back)."""
+        return self._step(self.body, self.head, self.head_unsort, xt)
+
+    def gather_result(self, ct: jax.Array) -> np.ndarray:
+        """Device (k, total_out) -> host (n, k) in original row order."""
+        c = np.asarray(ct).T.reshape(self.n_dev, self.rows_out, -1)
+        out = np.zeros((self.n, c.shape[-1]), dtype=c.dtype)
+        for d in range(self.n_dev):
+            src = self.body_order[d]
+            live = src >= 0
+            g = d * self.shard_len + src[live]
+            valid = g < self.n
+            out[g[valid]] = c[d][np.flatnonzero(live)[valid]]
+        return out
