@@ -1,42 +1,44 @@
-"""SellSlim — the padding-free distributed slim layout (single matrix).
+"""Padding-free distributed layouts: SellSlim and SellMultiLevel.
 
-The stacked-ELL slim layout (parallel/arrow_layout.py) reproduces the
-reference's communication structure but stores row-major ``(nb, w, m)``
-blocks and carries ``(total, k)`` features — layouts the TPU physically
-pads 8-16x (PERFORMANCE.md "layout-padding law").  This module is the
-same distributed algorithm — X_0 broadcast (masked psum), per-device
-body compute, head-row reduction (psum) — rebuilt on the padding-free
-layouts the single-chip fold path proved out:
+The stacked-ELL layouts (parallel/arrow_layout.py, multi_level.py)
+reproduce the reference's communication structure but store row-major
+``(nb, w, m)`` blocks and carry ``(total, k)`` features — layouts the
+TPU physically pads 8-16x (PERFORMANCE.md "layout-padding law").  The
+classes here are the same distributed algorithms rebuilt on the
+padding-free layouts the single-chip fold path proved out:
 
   * features are carried **feature-major** ``(k, total)``, sharded on
     the row axis (axis 1): the large dimension is minor everywhere;
-  * each device's share of the matrix is **two SELL operators** over
-    its local operand — a *body* operator (its rows >= w: diagonal
-    block + head-column block, columns in [shard] ∪ [0, w)) and a
-    *head* operator (rows [0, w), columns in its shard) whose per-device
-    partials psum into C_0 (reference Reduce, arrow_slim_mpi.py:104-119);
+  * each device's share of a level is **two SELL operators** over its
+    local operand — a *body* (its rows >= w: diagonal/banded blocks +
+    head-column arm, columns in [shard] ∪ [0, w) ∪ the two w-wide
+    shard-edge halos) and a *head* (rows [0, w), columns in its shard)
+    whose per-device partials psum into C_0 (reference Reduce,
+    arrow_slim_mpi.py:104-119);
   * rows are **tier-grouped by degree per shard** with one shared tier
     shape across devices (shard_map needs one program): tier row
     counts pad to the max over devices, padded rows have degree 0 and
-    produce zeros.  The resulting per-shard ordering — zero tier first,
-    ascending-degree tiers after, device 0's head rows leading the zero
-    tier — is composed into the carried permutation once on the host,
-    so it costs nothing at runtime (exactly the fold trick,
-    ops/sell.py).
+    produce zeros.  The per-shard ordering — zero tier first,
+    ascending-degree tiers after, device 0's head rows leading the
+    zero tier — is composed into the carried permutation once on the
+    host, so it costs nothing at runtime (the fold trick, ops/sell.py).
 
-Communication is identical to the slim layout: one masked-psum X_0
-broadcast and one psum head reduction per step, both
-orientation-independent.  Covers the block-diagonal slim structure
-(the reference's default production layout, arrow_slim_mpi.py); the
-banded variant stays with the stacked layout.
+Communication per level: one masked-psum X_0 broadcast, one psum head
+reduction, and two edge ppermutes for the banded halos (reference
+nonblocking neighbor exchange, arrow_mpi.py:123-175) — all
+orientation-independent.  ``SellMultiLevel`` chains K levels with
+composed inter-level reordering gathers (the reference's Alltoallv
+feature movement, arrow_dec_mpi.py:404-550), left to the GSPMD
+partitioner like ``MultiLevelArrow(routing="gather")``.
 
-Reference counterpart: one ``ArrowSlimMPI`` matrix on t ranks
-(arrow/arrow_slim_mpi.py:246-280).
+Reference counterparts: ``ArrowSlimMPI`` (arrow/arrow_slim_mpi.py) and
+``ArrowDecompositionMPI`` (arrow/arrow_dec_mpi.py).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,9 +48,14 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scipy import sparse
 
-from arrow_matrix_tpu.io.graphio import CsrLike, num_rows
+from arrow_matrix_tpu.io.graphio import CsrLike
 from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up, ell_spmm_t
 from arrow_matrix_tpu.ops.hyb import resolve_binary
+
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 
 def degree_ladder(max_deg: int, growth: float = 1.5,
@@ -88,9 +95,8 @@ def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
                       ) -> tuple[SellShardStack, np.ndarray, int]:
     """Tier-group each device's share rows by degree under the shared
     ladder; returns (stack, order, rows_out) where ``order[d, i]`` is
-    the share row stored at tiered position i of device d and
-    ``rows_out`` = padded per-device output length (sum of shared tier
-    row counts).
+    the share row stored at tiered position i of device d (-1 padding)
+    and ``rows_out`` = sum of shared tier row counts.
 
     ``shared_degrees`` keys the buckets and ordering on one
     device-independent degree vector (the head operator: psum'd
@@ -172,6 +178,270 @@ def _stack_spmm_t(stack: SellShardStack, z_t: jax.Array) -> jax.Array:
     return jnp.concatenate(outs, axis=1)
 
 
+@dataclass
+class SlimLevelOps:
+    """Device-resident operators + host-side maps for one level."""
+
+    body: SellShardStack          # sharded P(axis) on the device axis
+    head: SellShardStack
+    head_unsort: jax.Array        # (w,) int32, replicated
+    orig_pos: jax.Array           # (n_dev, L) int32, sharded: share row
+                                  # r -> tiered position (halo sends)
+    body_order: np.ndarray        # (n_dev, rows_out) share row / -1
+    rows_out: int
+    shard_len: int
+    n_dev: int
+    width: int
+    hops: int                     # halo reach in whole-shard hops
+    binary: bool
+
+    @property
+    def total_out(self) -> int:
+        return self.rows_out * self.n_dev
+
+    def device_nbytes(self) -> int:
+        return (self.body.device_nbytes() + self.head.device_nbytes()
+                + self.orig_pos.size * self.orig_pos.dtype.itemsize)
+
+
+def as_canonical_csr(matrix: CsrLike) -> sparse.csr_matrix:
+    """CSR (or memmapped triplet) -> canonical (duplicate-summed,
+    sorted) f32 CSR.  The ONE place the CsrLike forms normalize for
+    these layouts — binary-mode detection must run on the canonical
+    values (duplicate all-ones entries sum to non-unit weights)."""
+    if isinstance(matrix, sparse.csr_matrix):
+        a = matrix
+    else:
+        data, indices, indptr = matrix
+        indptr = np.asarray(indptr, dtype=np.int64)
+        nnz = int(indptr[-1])
+        vals = (np.ones(nnz, dtype=np.float32) if data is None
+                else np.asarray(data[:nnz]))
+        a = sparse.csr_matrix(
+            (vals, np.asarray(indices[:nnz]), indptr),
+            shape=(indptr.size - 1, indptr.size - 1))
+    a = a.tocsr().astype(np.float32)
+    a.sum_duplicates()
+    a.sort_indices()
+    return a
+
+
+def as_padded_csr(a: sparse.csr_matrix, total: int) -> sparse.csr_matrix:
+    """Canonical CSR padded to (total, total)."""
+    if a.shape[0] > total:
+        raise ValueError(f"matrix has {a.shape[0]} rows > padded {total}")
+    a_pad = a.copy()
+    a_pad.resize((total, total))
+    return a_pad
+
+
+def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
+                     axis: str, dtype, binary: bool,
+                     shard_len: Optional[int] = None) -> SlimLevelOps:
+    """Build one level's per-device SELL operators (see module
+    docstring).  Captures the banded slim pattern: body columns may
+    fall in the shard, the head arm [0, w), or the two w-wide halo
+    regions at the shard edges (exchanged by ppermute at runtime)."""
+    n_dev = mesh.shape[axis]
+    w = width
+    a = as_canonical_csr(matrix)
+    n = a.shape[0]
+    if shard_len is None:
+        shard_len = align_up(-(-n // n_dev), w)
+        shard_len = max(shard_len, w)
+    total = shard_len * n_dev
+    a_pad = as_padded_csr(a, total)
+    L = shard_len
+    starts = np.arange(n_dev) * L
+
+    # Halo reach: how far body columns stray outside the owning shard
+    # (head-arm columns excluded).  hops = reach in whole shards; a
+    # converged block-diagonal level has reach 0 and pays no exchange,
+    # a grown banded last level gets exactly the hops it needs
+    # (reference neighbor exchange generalized, arrow_mpi.py:123-175).
+    coo_all = a_pad.tocoo()
+    body_mask = coo_all.row >= w
+    owner_r = np.minimum(coo_all.row // L, n_dev - 1)
+    g_all = coo_all.col
+    lo_all = owner_r * L
+    outside = body_mask & (g_all >= w) & (
+        (g_all < lo_all) | (g_all >= lo_all + L))
+    reach = 0
+    if outside.any():
+        go = g_all[outside]
+        lo_o = lo_all[outside]
+        reach = int(np.maximum(lo_o - go, go - (lo_o + L) + 1).max())
+    hops = -(-reach // L) if reach > 0 else 0
+    if hops > n_dev - 1:
+        hops = n_dev - 1
+    H = hops * L
+
+    # Per-device shares via prioritized column categorization (COO):
+    # local shard > head arm > halos; anything matching no category is
+    # out of pattern and counted missing.
+    body_shares, head_shares = [], []
+    captured = 0
+    for d in range(n_dev):
+        lo, hi = int(starts[d]), int(starts[d] + L)
+        rows = a_pad[lo:hi].tocoo()
+        r, g, v = rows.row, rows.col, rows.data
+        if d == 0:
+            # global head rows: the head operator covers them.
+            keep = r >= w
+            r, g, v = r[keep], g[keep], v[keep]
+        local = (g >= lo) & (g < hi)
+        head_arm = ~local & (g < w)
+        lo_h = ~local & ~head_arm & (g >= lo - H) & (g < lo)
+        hi_h = ~local & ~head_arm & (g >= hi) & (g < hi + H)
+        cat = local | head_arm | lo_h | hi_h
+        captured += int(cat.sum())
+        mapped = np.where(
+            local, g - lo,
+            np.where(head_arm, L + g,
+                     np.where(lo_h, L + w + (g - (lo - H)),
+                              L + w + H + (g - hi))))
+        share = sparse.csr_matrix(
+            (v[cat], (r[cat], mapped[cat])), shape=(L, L + w + 2 * H))
+        share.sum_duplicates()
+        share.sort_indices()
+        body_shares.append(share)
+        head = a_pad[:w, lo:hi].tocsr()
+        captured += head.nnz
+        head_shares.append(head)
+    if captured != a_pad.nnz:
+        raise ValueError(
+            f"slim shares captured {captured} of {a_pad.nnz} nonzeros: "
+            f"the matrix has entries outside the slim pattern at width "
+            f"{w} / {hops}-hop halos (head rows/arm + shard +- reach)")
+
+    ladder_body = degree_ladder(
+        max((int(np.diff(s.indptr).max()) if s.nnz else 0)
+            for s in body_shares))
+    head_glob_deg = np.diff(a_pad[:w].tocsr().indptr)
+    ladder_head = degree_ladder(
+        int(head_glob_deg.max()) if head_glob_deg.size else 0)
+
+    body, body_order, rows_out = _pack_shard_tiers(
+        body_shares, ladder_body, binary, dtype)
+    head, head_order, _ = _pack_shard_tiers(
+        head_shares, ladder_head, binary, dtype,
+        shared_degrees=head_glob_deg)
+
+    if not np.array_equal(body_order[0, :w], np.arange(w)):
+        raise AssertionError(
+            "device 0's head rows must lead its tiered ordering "
+            "(stable zero-tier sort invariant)")
+
+    # Local-position maps.  inv[d, r] = tiered position of share row r.
+    inv = np.zeros((n_dev, L), dtype=np.int64)
+    for d in range(n_dev):
+        live = body_order[d] >= 0
+        inv[d, body_order[d][live]] = np.flatnonzero(live)
+
+    # Body column remap: share column c ->
+    #   [0, L): local -> tiered position;   [L, L+w): head -> R + (c-L)
+    #   [L+w, L+w+H): lo halo;              [L+w+H, L+w+2H): hi halo
+    # (halo regions pass through at the same offsets past R).
+    R = rows_out
+    remapped = []
+    for cols in body.cols:
+        c = np.asarray(cols)
+        out = np.empty_like(c)
+        for d in range(n_dev):
+            cd = c[d].astype(np.int64)
+            local = inv[d, np.minimum(cd, L - 1)]
+            out[d] = np.where(cd < L, local, R + (cd - L)).astype(np.int32)
+        remapped.append(jnp.asarray(out))
+    body = body.replace(cols=tuple(remapped))
+
+    remapped_head = []
+    for cols in head.cols:
+        c = np.asarray(cols)
+        out = np.empty_like(c)
+        for d in range(n_dev):
+            out[d] = inv[d, np.minimum(c[d], L - 1)].astype(np.int32)
+        remapped_head.append(jnp.asarray(out))
+    head = head.replace(cols=tuple(remapped_head))
+
+    if not np.all(head_order[0] == head_order):
+        raise AssertionError("head tier ordering must be "
+                             "device-independent")
+    head_unsort = np.argsort(head_order[0][:w])[:w].astype(np.int32)
+
+    shard_stack = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    body = jax.tree_util.tree_map(
+        lambda arr: jax.device_put(arr, shard_stack), body)
+    head = jax.tree_util.tree_map(
+        lambda arr: jax.device_put(arr, shard_stack), head)
+    return SlimLevelOps(
+        body=body, head=head,
+        head_unsort=jax.device_put(jnp.asarray(head_unsort), repl),
+        orig_pos=jax.device_put(jnp.asarray(inv.astype(np.int32)),
+                                shard_stack),
+        body_order=body_order, rows_out=rows_out, shard_len=L,
+        n_dev=n_dev, width=w, hops=hops, binary=binary)
+
+
+def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
+                      hops: int = 0):
+    """Raw (traceable) shard_map'd slim step for one level:
+    ``step(body, head, head_unsort, orig_pos, xt) -> ct`` on
+    feature-major (k, total_out) arrays.
+
+    ``hops`` whole-shard ppermute chains feed the halo regions (0 for
+    converged block-diagonal levels — no exchange at all; a grown
+    banded level gets exactly the reach it needs)."""
+    w = width
+    n_dev = mesh.shape[axis]
+
+    def local_step(body, head, head_unsort, orig_pos, xt):
+        dev = lax.axis_index(axis)
+        x0 = lax.psum(
+            jnp.where(dev == 0, xt[:, :w], jnp.zeros_like(xt[:, :w])),
+            axis)
+        parts = [xt, x0]
+        if hops:
+            # Whole-shard halo chains: my rows in ORIGINAL shard order,
+            # shifted j hops right feed the lo region, j hops left the
+            # hi region.  ppermute leaves chain ends zero — the
+            # boundary condition (reference arrow_mpi.py:150-162).
+            mine = jnp.take(xt, orig_pos[0], axis=1)     # (k, L)
+            fwd = [(i, i + 1) for i in range(n_dev - 1)]
+            bwd = [(i + 1, i) for i in range(n_dev - 1)]
+            lo_chain, hi_chain = [], []
+            cur_lo = cur_hi = mine
+            for _ in range(hops):
+                cur_lo = lax.ppermute(cur_lo, axis, perm=fwd)
+                cur_hi = lax.ppermute(cur_hi, axis, perm=bwd)
+                lo_chain.append(cur_lo)   # j hops left neighbor
+                hi_chain.append(cur_hi)   # j hops right neighbor
+            # lo region covers [lo - hops*L, lo): farthest first.
+            parts += list(reversed(lo_chain)) + hi_chain
+        z = jnp.concatenate(parts, axis=1)
+        out = _stack_spmm_t(body, z)                 # (k, rows_out)
+        head_part = _stack_spmm_t(head, xt)
+        c0 = lax.psum(head_part, axis)
+        c0w = jnp.take(c0, head_unsort, axis=1)[:, :w]
+        out = jnp.where(
+            (dev == 0) & (jnp.arange(rows_out)[None, :] < w),
+            jnp.pad(c0w, ((0, 0), (0, rows_out - w))), out)
+        return out
+
+    spec = lambda tree: jax.tree_util.tree_map(lambda _: P(axis), tree)
+
+    def step(body, head, head_unsort, orig_pos, xt):
+        return shard_map(
+            local_step, mesh=mesh,
+            in_specs=(spec(body), spec(head), P(), P(axis),
+                      P(None, axis)),
+            out_specs=P(None, axis),
+            check_vma=False,
+        )(body, head, head_unsort, orig_pos, xt)
+
+    return step
+
+
 class SellSlim:
     """One arrow matrix distributed over a mesh axis in padding-free
     layouts (see module docstring).  API mirrors the other layouts:
@@ -181,185 +451,25 @@ class SellSlim:
     def __init__(self, matrix: CsrLike, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32,
                  binary="auto"):
-        if isinstance(matrix, sparse.csr_matrix):
-            a = matrix
-        else:  # memmapped triplet
-            data, indices, indptr = matrix
-            indptr = np.asarray(indptr, dtype=np.int64)
-            nnz = int(indptr[-1])
-            vals = (np.ones(nnz, dtype=np.float32) if data is None
-                    else np.asarray(data[:nnz]))
-            a = sparse.csr_matrix(
-                (vals, np.asarray(indices[:nnz]), indptr),
-                shape=(indptr.size - 1, indptr.size - 1))
-        a = a.tocsr().astype(np.float32)
-        a.sum_duplicates()
-        a.sort_indices()
-        n = num_rows(a)
-        n_dev = mesh.shape[axis]
+        a = as_canonical_csr(matrix)
+        # Binary detection AFTER canonicalization: duplicate all-ones
+        # entries sum to non-unit weights and must go weighted.
+        is_binary = resolve_binary(binary, a.data, nnz=a.nnz)
+        self.n = a.shape[0]
+        self.binary = is_binary
         self.mesh = mesh
         self.axis = axis
-        self.n = n
-        self.width = w = width
-        is_binary = resolve_binary(binary, a.data, nnz=a.nnz)
-        self.binary = is_binary
-
-        # Contiguous block-aligned shards.
-        shard_len = align_up(-(-n // n_dev), w)
-        if shard_len < w:
-            shard_len = w
-        total = shard_len * n_dev
-        a_pad = a.copy()
-        a_pad.resize((total, total))
-
-        starts = np.arange(n_dev) * shard_len
-
-        # Per-device shares.  Body: rows of the shard with row >= w,
-        # columns in [shard] (diagonal blocks) or [0, w) (head column
-        # arm) — verified to capture every such nonzero.  Head: rows
-        # [0, w), columns in the shard.
-        body_shares, head_shares = [], []
-        captured = 0
-        for d in range(n_dev):
-            lo, hi = starts[d], starts[d] + shard_len
-            rows = a_pad[lo:hi].tocsr()
-            # body (skip global head rows, device 0's first w — the
-            # head operator covers them)
-            body = rows.copy()
-            if d == 0:
-                body.data[:body.indptr[w]] = 0
-                body.eliminate_zeros()
-            local = body[:, lo:hi]
-            headcol = body[:, :w]
-            if d == 0:
-                # device 0's local slice already contains the head
-                # columns; don't double them.
-                headcol = sparse.csr_matrix((shard_len, w),
-                                            dtype=np.float32)
-            share = sparse.hstack([local, headcol], format="csr")
-            captured += share.nnz
-            body_shares.append(share)
-            head = a_pad[:w, lo:hi].tocsr()
-            captured += head.nnz
-            head_shares.append(head)
-        if captured != a_pad.nnz:
-            raise ValueError(
-                f"slim shares captured {captured} of {a_pad.nnz} "
-                f"nonzeros: the matrix has entries outside the "
-                f"block-diagonal arrow pattern at width {w} (columns "
-                f"outside the owning shard and the head arm)")
-
-        ladder_body = degree_ladder(
-            max((int(np.diff(s.indptr).max()) if s.nnz else 0)
-                for s in body_shares))
-        head_glob_deg = np.diff(a_pad[:w].tocsr().indptr)
-        ladder_head = degree_ladder(
-            int(head_glob_deg.max()) if head_glob_deg.size else 0)
-
-        self.body, body_order, self.rows_out = _pack_shard_tiers(
-            body_shares, ladder_body, is_binary, dtype)
-        self.head, head_order, self.head_rows_out = _pack_shard_tiers(
-            head_shares, ladder_head, is_binary, dtype,
-            shared_degrees=head_glob_deg)
-
-        # Carried ordering: position i of device d holds global row
-        # starts[d] + body_order[d, i] (or padding when -1).  Device
-        # 0's head rows lead its zero tier (stable sort) — verify, the
-        # x0 broadcast depends on it.
-        if not np.array_equal(body_order[0, :w], np.arange(w)):
-            raise AssertionError(
-                "device 0's head rows must lead its tiered ordering "
-                "(stable zero-tier sort invariant)")
-        self.body_order = body_order
-
-        # Body column remap: local shard columns -> tiered positions,
-        # head columns -> rows_out + [0, w).
-        inv = np.zeros((n_dev, shard_len), dtype=np.int64)
-        for d in range(n_dev):
-            live = body_order[d] >= 0
-            inv[d, body_order[d][live]] = np.flatnonzero(live)
-        remapped_cols = []
-        for t, cols in enumerate(self.body.cols):
-            c = np.asarray(cols)
-            out = np.empty_like(c)
-            for d in range(n_dev):
-                cd = c[d]
-                is_head = cd >= shard_len
-                out[d] = np.where(
-                    is_head, self.rows_out + (cd - shard_len),
-                    inv[d, np.minimum(cd, shard_len - 1)])
-            remapped_cols.append(jnp.asarray(out))
-        self.body = self.body.replace(cols=tuple(remapped_cols))
-        # Head column remap: shard columns -> tiered positions.
-        remapped_head = []
-        for t, cols in enumerate(self.head.cols):
-            c = np.asarray(cols)
-            out = np.empty_like(c)
-            for d in range(n_dev):
-                out[d] = inv[d, np.minimum(c[d], shard_len - 1)]
-            remapped_head.append(jnp.asarray(out))
-        self.head = self.head.replace(cols=tuple(remapped_head))
-
-        # Head output: global-degree order shared by every device (the
-        # psum needs one order); unsort indices restore rows [0, w).
-        if not np.all(head_order[0] == head_order):
-            raise AssertionError("head tier ordering must be "
-                                 "device-independent")
-        self.head_order = head_order[0]
-        self.head_unsort = jnp.asarray(
-            np.argsort(self.head_order[:w])[:w].astype(np.int32))
-
-        self.shard_len = shard_len
-        self.n_dev = n_dev
-        self.total_out = self.rows_out * n_dev
-
-        shard_stack = NamedSharding(mesh, P(axis))
-        self.body = jax.tree_util.tree_map(
-            lambda arr: jax.device_put(arr, shard_stack), self.body)
-        self.head = jax.tree_util.tree_map(
-            lambda arr: jax.device_put(arr, shard_stack), self.head)
-        repl = NamedSharding(mesh, P())
-        self.head_unsort = jax.device_put(self.head_unsort, repl)
-
-        try:  # jax >= 0.8 promotes shard_map out of experimental
-            from jax import shard_map
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
-
-        w_ = w
-        rows_out = self.rows_out
-
-        def local_step(body, head, head_unsort, xt):
-            # xt: (k, rows_out) local, feature-major.
-            dev = lax.axis_index(axis)
-            x0 = lax.psum(
-                jnp.where(dev == 0, xt[:, :w_],
-                          jnp.zeros_like(xt[:, :w_])), axis)
-            z = jnp.concatenate([xt, x0], axis=1)   # (k, rows_out + w)
-            out = _stack_spmm_t(body, z)            # (k, rows_out)
-            head_part = _stack_spmm_t(head, xt)     # (k, head_rows_out)
-            c0 = lax.psum(head_part, axis)
-            # Head result in original [0, w) order, into device 0's
-            # leading positions.
-            c0w = jnp.take(c0, head_unsort, axis=1)[:, :w_]
-            out = jnp.where(
-                (dev == 0)
-                & (jnp.arange(rows_out)[None, :] < w_),
-                jnp.pad(c0w, ((0, 0), (0, rows_out - w_))), out)
-            return out
-
-        self._step = jax.jit(shard_map(
-            local_step, mesh=mesh,
-            in_specs=(jax.tree_util.tree_map(
-                          lambda _: P(axis), self.body),
-                      jax.tree_util.tree_map(
-                          lambda _: P(axis), self.head),
-                      P(), P(None, axis)),
-            out_specs=P(None, axis),
-            check_vma=False,
-        ))
-
-    # -- features ---------------------------------------------------------
+        self.width = width
+        ops = build_slim_level(a, width, mesh, axis, dtype, is_binary)
+        self.ops = ops
+        self.body, self.head = ops.body, ops.head
+        self.body_order = ops.body_order
+        self.rows_out, self.shard_len = ops.rows_out, ops.shard_len
+        self.n_dev = ops.n_dev
+        self.total_out = ops.total_out
+        self._step = jax.jit(make_sharded_step(mesh, axis, width,
+                                               ops.rows_out,
+                                               hops=ops.hops))
 
     def _feature_sharding(self):
         return NamedSharding(self.mesh, P(None, self.axis))
@@ -384,7 +494,8 @@ class SellSlim:
     def spmm(self, xt: jax.Array) -> jax.Array:
         """One distributed SpMM step; feature-major in and out (iterate
         by feeding the result back)."""
-        return self._step(self.body, self.head, self.head_unsort, xt)
+        o = self.ops
+        return self._step(o.body, o.head, o.head_unsort, o.orig_pos, xt)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         """Device (k, total_out) -> host (n, k) in original row order."""
@@ -396,4 +507,160 @@ class SellSlim:
             g = d * self.shard_len + src[live]
             valid = g < self.n
             out[g[valid]] = c[d][np.flatnonzero(live)[valid]]
+        return out
+
+
+class SellMultiLevel:
+    """K decomposition levels on the padding-free layouts: per-level
+    SellSlim compute chained by composed reordering gathers (the
+    feature-major counterpart of ``MultiLevelArrow`` on a mesh).
+
+    Semantics match MultiLevelArrow.step (reference
+    arrow_dec_mpi.py:283-307): X carried in level-0's tiered ordering;
+    forward gathers re-order it into each level's ordering, every level
+    runs the slim step, partial results aggregate backward.  The
+    inter-level gathers are left to the GSPMD partitioner (the
+    ``routing="gather"`` lowering); their indices compose the level
+    permutations AND the per-shard tier orderings, so the tier sorts
+    stay free.
+    """
+
+    def __init__(self, levels, width: int, mesh: Mesh,
+                 axis: str = "blocks", dtype=np.float32, binary="auto"):
+        from arrow_matrix_tpu.parallel.multi_level import pad_permutation
+
+        if not levels:
+            raise ValueError("empty decomposition")
+        self.mesh = mesh
+        self.axis = axis
+        self.width = width
+        n_dev = mesh.shape[axis]
+        canon = [as_canonical_csr(lvl.matrix) for lvl in levels]
+        self.n = canon[0].shape[0]
+        if binary is False:
+            self.binary = False
+        else:
+            self.binary = all(
+                resolve_binary(binary, c.data, nnz=c.nnz) for c in canon)
+
+        shard_len = max(align_up(-(-self.n // n_dev), width), width)
+        total = shard_len * n_dev
+        self.ops: List[SlimLevelOps] = [
+            build_slim_level(c, width, mesh, axis, dtype,
+                             self.binary, shard_len=shard_len)
+            for c in canon
+        ]
+
+        # Carried-position <-> original-row maps per level.  Position p
+        # (device d, tiered slot) of level i holds level-i row
+        # r = d*L + body_order_i[d, slot], i.e. original row
+        # sigma_i_pad[r]; -1 slots are tier padding.
+        orig_of_pos, pos_of_orig = [], []
+        for lvl, ops in zip(levels, self.ops):
+            perm = pad_permutation(np.asarray(lvl.permutation), total)
+            oop = np.full(ops.total_out, -1, dtype=np.int64)
+            for d in range(n_dev):
+                src = ops.body_order[d]
+                live = src >= 0
+                oop[d * ops.rows_out + np.flatnonzero(live)] = perm[
+                    d * shard_len + src[live]]
+            poo = np.full(total, -1, dtype=np.int64)
+            live = oop >= 0
+            poo[oop[live]] = np.flatnonzero(live)
+            orig_of_pos.append(oop)
+            pos_of_orig.append(poo)
+        self._orig_of_pos0 = orig_of_pos[0]
+
+        repl = NamedSharding(mesh, P())
+
+        def route(dst_oop, src_poo):
+            """positions of the destination ordering -> positions of the
+            source ordering holding the same original row (0 for tier
+            padding — those values are never consumed)."""
+            idx = np.where(dst_oop >= 0,
+                           src_poo[np.minimum(dst_oop, total - 1)], 0)
+            return jax.device_put(
+                jnp.asarray(np.maximum(idx, 0).astype(np.int32)), repl)
+
+        k_levels = len(levels)
+        self.fwd = [route(orig_of_pos[i], pos_of_orig[i - 1])
+                    for i in range(1, k_levels)]
+        self.bwd = [route(orig_of_pos[i - 1], pos_of_orig[i])
+                    for i in range(1, k_levels)]
+
+        steps = [make_sharded_step(mesh, axis, width, ops.rows_out,
+                                   hops=ops.hops)
+                 for ops in self.ops]
+        feat_shard = NamedSharding(mesh, P(None, axis))
+
+        def step_fn(xt, level_ops, fwd, bwd):
+            x_cur = xt
+            partials = []
+            for i in range(k_levels):
+                if i > 0:
+                    x_cur = lax.with_sharding_constraint(
+                        jnp.take(x_cur, fwd[i - 1], axis=1), feat_shard)
+                o = level_ops[i]
+                partials.append(steps[i](o.body, o.head, o.head_unsort,
+                                         o.orig_pos, x_cur))
+            agg = partials[-1]
+            for i in range(k_levels - 1, 0, -1):
+                agg = partials[i - 1] + lax.with_sharding_constraint(
+                    jnp.take(agg, bwd[i - 1], axis=1), feat_shard)
+            return agg
+
+        # Levels as pytree args would be natural, but SlimLevelOps is a
+        # plain dataclass; pass the arrays through a tuple-of-stacks
+        # pytree instead.
+        self._level_args = tuple(
+            (o.body, o.head, o.head_unsort, o.orig_pos)
+            for o in self.ops)
+
+        def step_packed(xt, level_args, fwd, bwd):
+            class _O:  # tiny adaptor so step_fn reads .body etc.
+                __slots__ = ("body", "head", "head_unsort", "orig_pos")
+
+                def __init__(self, t):
+                    (self.body, self.head, self.head_unsort,
+                     self.orig_pos) = t
+
+            return step_fn(xt, [_O(t) for t in level_args], fwd, bwd)
+
+        self._step = jax.jit(step_packed)
+
+        def scan_steps(xt, level_args, fwd, bwd, n):
+            def body(xc, _):
+                return step_packed(xc, level_args, fwd, bwd), None
+
+            out, _ = lax.scan(body, xt, None, length=n)
+            return out
+
+        self._scan = jax.jit(scan_steps, static_argnames=("n",))
+
+    def set_features(self, x: np.ndarray) -> jax.Array:
+        """Host (n, k) original order -> (k, total_out_0) carried."""
+        n, k = x.shape
+        if n != self.n:
+            raise ValueError(f"expected {self.n} rows, got {n}")
+        oop = self._orig_of_pos0
+        feat = np.zeros((oop.size, k), dtype=x.dtype)
+        live = (oop >= 0) & (oop < n)
+        feat[live] = x[oop[live]]
+        return jax.device_put(
+            np.ascontiguousarray(feat.T),
+            NamedSharding(self.mesh, P(None, self.axis)))
+
+    def step(self, xt: jax.Array) -> jax.Array:
+        return self._step(xt, self._level_args, self.fwd, self.bwd)
+
+    def run(self, xt: jax.Array, iterations: int) -> jax.Array:
+        return self._scan(xt, self._level_args, self.fwd, self.bwd,
+                          n=iterations)
+
+    def gather_result(self, ct: jax.Array) -> np.ndarray:
+        c = np.asarray(ct).T
+        oop = self._orig_of_pos0
+        out = np.zeros((self.n, c.shape[-1]), dtype=c.dtype)
+        live = (oop >= 0) & (oop < self.n)
+        out[oop[live]] = c[live]
         return out
