@@ -66,14 +66,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "slim, arrow_dec_mpi.py:131).  Default: "
                              "true.")
     parser.add_argument("--fmt", type=str, default="auto",
-                        choices=["auto", "dense", "ell", "hyb", "fold"],
+                        choices=["auto", "dense", "ell", "hyb", "fold",
+                                 "sell"],
                         help="Device block format (TPU-specific: dense = "
                              "MXU batched matmuls, ell = gather path, "
                              "hyb = whole-level split-ELL, fold = the "
                              "whole decomposition composed into one "
                              "degree-sorted sliced-ELL operator with "
-                             "zero inter-level routing; hyb/fold are "
-                             "single-chip only).")
+                             "zero inter-level routing (single-chip, "
+                             "like hyb), sell = the padding-free "
+                             "feature-major mesh orchestration "
+                             "(SellMultiLevel; mesh only).")
     parser.add_argument("--head_fmt", type=str, default="auto",
                         choices=["auto", "flat", "ell", "gell"],
                         help="Head-stack storage for ELL levels: flat "
@@ -149,7 +152,7 @@ def main(argv=None) -> int:
                          "iteration state to resume when X is fresh "
                          "every iteration)")
     if args.mode == "space":
-        if args.fmt in ("hyb", "fold"):
+        if args.fmt in ("hyb", "fold", "sell"):
             raise SystemExit(
                 f"--fmt {args.fmt} is a single-chip kernel; "
                 "--mode space runs levels on disjoint device groups — "
@@ -248,14 +251,26 @@ def main(argv=None) -> int:
             if args.fmt in ("hyb", "fold") and n_dev > 1:
                 raise SystemExit(
                     f"--fmt {args.fmt} is single-chip only; rerun with "
-                    f"--devices 1 (or pick --fmt auto/dense/ell for the "
-                    f"{n_dev}-device mesh)")
+                    f"--devices 1 (or pick --fmt auto/dense/ell/sell "
+                    f"for the {n_dev}-device mesh)")
+            if args.fmt == "sell" and n_dev < 2:
+                raise SystemExit(
+                    "--fmt sell is the mesh orchestration; on one chip "
+                    "use --fmt fold (same layouts, zero routing)")
             mesh = make_mesh((n_dev,), ("blocks",)) if n_dev > 1 else None
-            multi = MultiLevelArrow(levels, width, mesh=mesh,
-                                    banded=not args.blocked, fmt=args.fmt,
-                                    head_fmt=args.head_fmt,
-                                    routing=(args.routing if mesh is not None
-                                             else "gather"))
+            if args.fmt == "sell":
+                from arrow_matrix_tpu.parallel.sell_slim import (
+                    SellMultiLevel,
+                )
+
+                multi = SellMultiLevel(levels, width, mesh)
+            else:
+                multi = MultiLevelArrow(
+                    levels, width, mesh=mesh,
+                    banded=not args.blocked, fmt=args.fmt,
+                    head_fmt=args.head_fmt,
+                    routing=(args.routing if mesh is not None
+                             else "gather"))
 
     # Untimed warmup: trace + compile must not pollute iteration 0's
     # spmm_time (the sibling baseline CLIs warm up the same way).

@@ -242,3 +242,14 @@ def test_spmm_arrow_aborts_on_poisoned_artifact(tmp_path, monkeypatch):
         "--logdir", str(tmp_path / "logs"),
     ])
     assert rc != 0
+
+
+def test_spmm_arrow_sell_mesh(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_arrow.main([
+        "--vertices", "400", "--width", "32", "--features", "4",
+        "--iterations", "2", "--validate", "true", "--device", "cpu",
+        "--devices", "4", "--fmt", "sell",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
