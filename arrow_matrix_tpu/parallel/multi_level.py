@@ -353,17 +353,16 @@ class MultiLevelArrow:
             self.blocks = [shard_arrow_blocks(b, mesh, axis)
                            for b in self.blocks]
             if routing == "a2a":
-                from arrow_matrix_tpu.parallel.routing import build_route
+                from arrow_matrix_tpu.parallel.routing import (
+                    build_route,
+                    shard_route,
+                )
 
                 n_dev = mesh.shape[axis]
-                shard = NamedSharding(mesh, P(axis))
-
-                def put(rt):
-                    return jax.tree_util.tree_map(
-                        lambda a: jax.device_put(a, shard), rt)
-
-                self.fwd = [put(build_route(t, n_dev)) for t in fwd]
-                self.bwd = [put(build_route(t, n_dev)) for t in bwd]
+                self.fwd = [shard_route(build_route(t, n_dev), mesh, axis)
+                            for t in fwd]
+                self.bwd = [shard_route(build_route(t, n_dev), mesh, axis)
+                            for t in bwd]
             else:
                 # Routing tables replicated (they index global rows).
                 repl = NamedSharding(mesh, P())

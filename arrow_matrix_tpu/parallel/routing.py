@@ -53,32 +53,58 @@ class RouteTables:
     send_idx: jax.Array    # (n_dev, n_dev, S) rows device s sends to d
     recv_dst: jax.Array    # (n_dev, n_dev, S) where rows from s land on d
 
-    rows_per_dev: int = struct.field(pytree_node=False, default=0)
+    rows_src: int = struct.field(pytree_node=False, default=0)
+    rows_dst: int = struct.field(pytree_node=False, default=0)
     n_dev: int = struct.field(pytree_node=False, default=0)
+
+    @property
+    def rows_per_dev(self) -> int:   # permutation-exchange convenience
+        assert self.rows_src == self.rows_dst
+        return self.rows_src
 
     def device_bytes_per_exchange(self, k: int, itemsize: int = 4) -> int:
         """all_to_all payload bytes per device (the padded volume)."""
         return self.send_idx.shape[1] * self.send_idx.shape[2] * k * itemsize
 
 
-def build_route(table: np.ndarray, n_dev: int) -> RouteTables:
-    """Compile a global gather table into RouteTables.
+def build_route(table: np.ndarray, n_dev: int,
+                src_total: Optional[int] = None,
+                pad_mask: Optional[np.ndarray] = None) -> RouteTables:
+    """Compile a global gather table ``out[j] = x[table[j]]`` into
+    RouteTables.
 
-    ``table`` must be a permutation of [0, total) with ``total``
-    divisible by ``n_dev`` (the padded uniform row count guarantees
-    both: multi_level.compose_routing).
+    For a permutation exchange (multi_level.compose_routing) source and
+    destination sizes coincide; ``src_total`` supports rectangular
+    exchanges between carried orderings of different padded lengths
+    (SellMultiLevel).  Destination positions flagged by ``pad_mask``
+    (tier padding — their values are never consumed) are routed from
+    the LOCAL dummy row instead of their table entry, so they cost no
+    cross-device slots and come out zero.
     """
     table = np.asarray(table, dtype=np.int64)
     total = table.size
-    if total % n_dev != 0:
-        raise ValueError(f"{total} rows not divisible by {n_dev} devices")
-    r = total // n_dev
+    if src_total is None:
+        src_total = total
+    if total % n_dev != 0 or src_total % n_dev != 0:
+        raise ValueError(f"{total}/{src_total} rows not divisible by "
+                         f"{n_dev} devices")
+    r_dst = total // n_dev
+    r_src = src_total // n_dev
 
+    live = np.ones(total, dtype=bool) if pad_mask is None else ~np.asarray(
+        pad_mask, dtype=bool)
+    if not ((table[live] >= 0) & (table[live] < src_total)).all():
+        # Fail loudly at build time: a clamped bad entry would deliver
+        # a wrong row silently at runtime.
+        raise ValueError("gather table entries outside [0, src_total)")
     j = np.arange(total)
-    dst_dev = j // r
-    src_dev = table // r
-    src_off = table % r
-    dst_off = j % r
+    dst_dev = j // r_dst
+    src_dev = np.where(live, table // r_src, 0)
+    src_off = table % r_src
+    dst_off = j % r_dst
+    if pad_mask is not None:
+        src_dev = np.where(live, src_dev, dst_dev)
+        src_off = np.where(live, src_off, r_src)       # local dummy row
     is_local = dst_dev == src_dev
 
     def slots_within_groups(keys: np.ndarray) -> np.ndarray:
@@ -94,8 +120,8 @@ def build_route(table: np.ndarray, n_dev: int) -> RouteTables:
     loc = np.nonzero(is_local)[0]          # already ascending in j
     loc_counts = np.bincount(dst_dev[loc], minlength=n_dev)
     l_max = int(loc_counts.max()) if loc.size else 0
-    local_src = np.full((n_dev, l_max), r, dtype=np.int32)
-    local_dst = np.full((n_dev, l_max), r, dtype=np.int32)
+    local_src = np.full((n_dev, l_max), r_src, dtype=np.int32)
+    local_dst = np.full((n_dev, l_max), r_dst, dtype=np.int32)
     if loc.size:
         slot = slots_within_groups(dst_dev[loc])
         local_src[dst_dev[loc], slot] = src_off[loc]
@@ -106,16 +132,16 @@ def build_route(table: np.ndarray, n_dev: int) -> RouteTables:
     # enumerate j in ascending order within the pair).
     cross = np.nonzero(~is_local)[0]
     s_max = 0
-    send_idx = np.full((n_dev, n_dev, max(s_max, 0)), r, dtype=np.int32)
-    recv_dst = np.full((n_dev, n_dev, max(s_max, 0)), r, dtype=np.int32)
+    send_idx = np.full((n_dev, n_dev, max(s_max, 0)), r_src, dtype=np.int32)
+    recv_dst = np.full((n_dev, n_dev, max(s_max, 0)), r_dst, dtype=np.int32)
     if cross.size:
         order = np.lexsort((cross, dst_dev[cross], src_dev[cross]))
         cross = cross[order]
         s, d = src_dev[cross], dst_dev[cross]
         slot = slots_within_groups(s * n_dev + d)
         s_max = int(slot.max()) + 1
-        send_idx = np.full((n_dev, n_dev, s_max), r, dtype=np.int32)
-        recv_dst = np.full((n_dev, n_dev, s_max), r, dtype=np.int32)
+        send_idx = np.full((n_dev, n_dev, s_max), r_src, dtype=np.int32)
+        recv_dst = np.full((n_dev, n_dev, s_max), r_dst, dtype=np.int32)
         send_idx[s, d, slot] = src_off[cross]
         recv_dst[d, s, slot] = dst_off[cross]
 
@@ -123,7 +149,18 @@ def build_route(table: np.ndarray, n_dev: int) -> RouteTables:
                        local_dst=jnp.asarray(local_dst),
                        send_idx=jnp.asarray(send_idx),
                        recv_dst=jnp.asarray(recv_dst),
-                       rows_per_dev=r, n_dev=n_dev)
+                       rows_src=r_src, rows_dst=r_dst, n_dev=n_dev)
+
+
+def shard_route(route: RouteTables, mesh: Mesh,
+                axis: str = "blocks") -> RouteTables:
+    """Place every table leaf sharded on its leading device axis (one
+    recipe for all callers)."""
+    from jax.sharding import NamedSharding
+
+    shard = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, shard), route)
 
 
 def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
@@ -135,14 +172,14 @@ def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
     columns over ``feat_axis``); the exchange is one fixed-shape
     all_to_all + local gather/scatter per device.
     """
-    r = route.rows_per_dev
+    r_src, r_dst = route.rows_src, route.rows_dst
 
     def local_fn(xl, local_src, local_dst, send_idx, recv_dst):
         # Per-device operands (leading device axis stripped to size 1).
-        xl = xl.reshape(r, -1)
+        xl = xl.reshape(r_src, -1)
         xe = jnp.concatenate(
             [xl, jnp.zeros((1, xl.shape[1]), xl.dtype)], axis=0)
-        out = jnp.zeros_like(xe)
+        out = jnp.zeros((r_dst + 1, xl.shape[1]), xl.dtype)
         # Rows that stay local.
         out = out.at[local_dst[0]].set(xe[local_src[0]])
         # Rows that cross devices: device p sends payload[d] to d and
@@ -153,7 +190,7 @@ def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
                                       concat_axis=0, tiled=False)
             out = out.at[recv_dst[0].reshape(-1)].set(
                 recv.reshape(-1, xl.shape[1]))
-        return out[:r]
+        return out[:r_dst]
 
     spec = P(axis)
     x_spec = P(axis, feat_axis) if feat_axis else spec
@@ -162,6 +199,39 @@ def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
                    out_specs=x_spec,
                    check_vma=False)
     return fn(x, route.local_src, route.local_dst, route.send_idx,
+              route.recv_dst)
+
+
+def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
+                  axis: str = "blocks") -> jax.Array:
+    """Feature-major twin of ``routed_take``: ``out[:, j] =
+    xt[:, table[j]]`` on a (k, total) array sharded on axis 1 — the
+    exchange for the padding-free carried layouts
+    (parallel/sell_slim.py)."""
+    r_src, r_dst = route.rows_src, route.rows_dst
+
+    def local_fn(xl, local_src, local_dst, send_idx, recv_dst):
+        k = xl.shape[0]
+        xe = jnp.concatenate(
+            [xl, jnp.zeros((k, 1), xl.dtype)], axis=1)  # (k, r_src+1)
+        out = jnp.zeros((k, r_dst + 1), xl.dtype)
+        out = out.at[:, local_dst[0]].set(xe[:, local_src[0]])
+        payload = xe[:, send_idx[0].reshape(-1)]        # (k, n_dev*S)
+        S = send_idx.shape[-1]
+        if S > 0:
+            payload = payload.reshape(k, route.n_dev, S)
+            recv = jax.lax.all_to_all(payload, axis, split_axis=1,
+                                      concat_axis=1, tiled=False)
+            out = out.at[:, recv_dst[0].reshape(-1)].set(
+                recv.reshape(k, -1))
+        return out[:, :r_dst]
+
+    spec = P(axis)
+    fn = shard_map(local_fn, mesh=mesh,
+                   in_specs=(P(None, axis), spec, spec, spec, spec),
+                   out_specs=P(None, axis),
+                   check_vma=False)
+    return fn(xt, route.local_src, route.local_dst, route.send_idx,
               route.recv_dst)
 
 

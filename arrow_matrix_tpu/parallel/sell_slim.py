@@ -526,8 +526,18 @@ class SellMultiLevel:
     """
 
     def __init__(self, levels, width: int, mesh: Mesh,
-                 axis: str = "blocks", dtype=np.float32, binary="auto"):
+                 axis: str = "blocks", dtype=np.float32, binary="auto",
+                 routing: str = "gather"):
+        """``routing``: "gather" leaves the inter-level reorderings to
+        the GSPMD partitioner (may all-gather); "a2a" compiles them
+        into explicit per-device send/recv tables over one fixed-shape
+        all_to_all each (parallel/routing.py — tier-padding positions
+        route from the local dummy and cost no cross-device slots)."""
         from arrow_matrix_tpu.parallel.multi_level import pad_permutation
+
+        if routing not in ("gather", "a2a"):
+            raise ValueError(f"unknown routing {routing!r}")
+        self.routing = routing
 
         if not levels:
             raise ValueError("empty decomposition")
@@ -573,19 +583,32 @@ class SellMultiLevel:
 
         repl = NamedSharding(mesh, P())
 
-        def route(dst_oop, src_poo):
+        def route(dst_oop, src_poo, src_total_out):
             """positions of the destination ordering -> positions of the
-            source ordering holding the same original row (0 for tier
-            padding — those values are never consumed)."""
+            source ordering holding the same original row (tier-padding
+            destinations carry no value: GSPMD mode points them at 0 —
+            never consumed — and a2a mode routes them from the local
+            dummy, coming out zero)."""
             idx = np.where(dst_oop >= 0,
                            src_poo[np.minimum(dst_oop, total - 1)], 0)
-            return jax.device_put(
-                jnp.asarray(np.maximum(idx, 0).astype(np.int32)), repl)
+            idx = np.maximum(idx, 0)
+            if routing == "a2a":
+                from arrow_matrix_tpu.parallel.routing import (
+                    build_route,
+                    shard_route,
+                )
+
+                rt = build_route(idx, n_dev, src_total=src_total_out,
+                                 pad_mask=dst_oop < 0)
+                return shard_route(rt, mesh, axis)
+            return jax.device_put(jnp.asarray(idx.astype(np.int32)), repl)
 
         k_levels = len(levels)
-        self.fwd = [route(orig_of_pos[i], pos_of_orig[i - 1])
+        self.fwd = [route(orig_of_pos[i], pos_of_orig[i - 1],
+                          self.ops[i - 1].total_out)
                     for i in range(1, k_levels)]
-        self.bwd = [route(orig_of_pos[i - 1], pos_of_orig[i])
+        self.bwd = [route(orig_of_pos[i - 1], pos_of_orig[i],
+                          self.ops[i].total_out)
                     for i in range(1, k_levels)]
 
         steps = [make_sharded_step(mesh, axis, width, ops.rows_out,
@@ -593,20 +616,29 @@ class SellMultiLevel:
                  for ops in self.ops]
         feat_shard = NamedSharding(mesh, P(None, axis))
 
+        from arrow_matrix_tpu.parallel.routing import (
+            RouteTables,
+            routed_take_t,
+        )
+
+        def reorder(xt, table):
+            if isinstance(table, RouteTables):
+                return routed_take_t(xt, table, mesh, axis)
+            return lax.with_sharding_constraint(
+                jnp.take(xt, table, axis=1), feat_shard)
+
         def step_fn(xt, level_ops, fwd, bwd):
             x_cur = xt
             partials = []
             for i in range(k_levels):
                 if i > 0:
-                    x_cur = lax.with_sharding_constraint(
-                        jnp.take(x_cur, fwd[i - 1], axis=1), feat_shard)
+                    x_cur = reorder(x_cur, fwd[i - 1])
                 o = level_ops[i]
                 partials.append(steps[i](o.body, o.head, o.head_unsort,
                                          o.orig_pos, x_cur))
             agg = partials[-1]
             for i in range(k_levels - 1, 0, -1):
-                agg = partials[i - 1] + lax.with_sharding_constraint(
-                    jnp.take(agg, bwd[i - 1], axis=1), feat_shard)
+                agg = partials[i - 1] + reorder(agg, bwd[i - 1])
             return agg
 
         # Levels as pytree args would be natural, but SlimLevelOps is a

@@ -152,3 +152,26 @@ def test_sell_slim_duplicate_ones_go_weighted():
     got = d.gather_result(d.spmm(d.set_features(x)))
     a2 = a.copy(); a2.sum_duplicates()
     np.testing.assert_allclose(got, a2 @ x, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("routing", ["gather", "a2a"])
+def test_sell_multi_level_routing_modes(routing):
+    """Explicit a2a routing for the feature-major carriage must equal
+    the GSPMD-gather lowering (and the golden)."""
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    n, width = 768, 32
+    a = barabasi_albert(n, 4, seed=13)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    mesh = make_mesh((4,), ("blocks",))
+    sm = SellMultiLevel(levels, width, mesh, routing=routing)
+    x = random_dense(n, 8, seed=3)
+    got = sm.gather_result(sm.step(sm.set_features(x)))
+    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-4)
+    # iterated run through the scan path too
+    x2 = sm.gather_result(sm.run(sm.set_features(x), 2))
+    want = np.asarray(a @ np.asarray(a @ x))
+    np.testing.assert_allclose(x2, want, rtol=1e-3, atol=1e-3)

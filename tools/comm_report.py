@@ -81,13 +81,14 @@ def main() -> None:
     # GSPMD-lowered inter-level reordering gathers.
     from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
 
-    sm = SellMultiLevel(levels, width, make_mesh((n_dev,), ("blocks",)))
-    xm = sm.set_features(x_host)
-    reports["sell (feature-major)"] = (
-        commstats.collective_stats(sm._step, xm, sm._level_args,
-                                   sm.fwd, sm.bwd),
-        sm,
-    )
+    for routing in ("gather", "a2a"):
+        sm = SellMultiLevel(levels, width, mesh, routing=routing)
+        xm = sm.set_features(x_host)
+        reports[f"sell/{routing}"] = (
+            commstats.collective_stats(sm._step, xm, sm._level_args,
+                                       sm.fwd, sm.bwd),
+            sm,
+        )
 
     some_ml = next(iter(reports.values()))[1]
     perms = [pad_permutation(np.asarray(l.permutation), some_ml.total_rows)
