@@ -175,3 +175,51 @@ def test_sell_multi_level_routing_modes(routing):
     x2 = sm.gather_result(sm.run(sm.set_features(x), 2))
     want = np.asarray(a @ np.asarray(a @ x))
     np.testing.assert_allclose(x2, want, rtol=1e-3, atol=1e-3)
+
+
+def test_sell_multi_level_k128_and_16dev():
+    """BASELINE's 128-feature configs and the largest virtual pool."""
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    n, width = 1024, 32
+    a = barabasi_albert(n, 3, seed=31)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=4)
+    mesh = make_mesh((16,), ("blocks",))
+    sm = SellMultiLevel(levels, width, mesh, routing="a2a")
+    x = random_dense(n, 128, seed=2)
+    got = sm.gather_result(sm.step(sm.set_features(x)))
+    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sell_multi_level_from_artifact(tmp_path):
+    """Memmapped artifact triplets flow into the feature-major mesh
+    orchestration (as_canonical_csr materializes per level)."""
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.io import (
+        as_levels,
+        load_decomposition,
+        load_level_widths,
+        save_decomposition,
+    )
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    a = barabasi_albert(600, 3, seed=5)
+    levels = arrow_decomposition(a, 64, max_levels=3, block_diagonal=True,
+                                 seed=5)
+    base = str(tmp_path / "g")
+    save_decomposition(levels, base)
+    widths = load_level_widths(base, 64)
+    stream_levels = as_levels(load_decomposition(base, 64, mem_map=True),
+                              widths if widths is not None else 64,
+                              materialize=False)
+    assert not hasattr(stream_levels[0].matrix, "nnz")
+
+    sm = SellMultiLevel(stream_levels, 64, make_mesh((4,), ("blocks",)))
+    assert sm.binary
+    x = random_dense(600, 8, seed=2)
+    got = sm.gather_result(sm.step(sm.set_features(x)))
+    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-4)
