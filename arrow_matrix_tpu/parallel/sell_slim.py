@@ -142,16 +142,31 @@ def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
         deg = np.zeros((n_dev, n_t), dtype=np.int32)
         vals = None if binary else np.zeros((n_dev, m_t, n_t), dtype=dtype)
         for d in range(n_dev):
+            # Vectorized tier fill: flat (slot, tier-local row)
+            # coordinates, O(tier nnz) numpy work (a per-row Python
+            # loop here would dominate protocol-scale builds).
             s = shares[d]
-            for i in range(n_t):
-                r = order[d, lo + i]
-                if r < 0:
-                    continue
-                a, z = int(s.indptr[r]), int(s.indptr[r + 1])
-                deg[d, i] = z - a
-                cols[d, :z - a, i] = s.indices[a:z]
-                if not binary:
-                    vals[d, :z - a, i] = s.data[a:z]
+            rows_b = order[d, lo:lo + n_t]
+            live = np.flatnonzero(rows_b >= 0)
+            if live.size == 0 or m_t == 0:
+                continue
+            r_live = rows_b[live]
+            degs_live = (s.indptr[r_live + 1] - s.indptr[r_live]).astype(
+                np.int64)
+            deg[d, live] = degs_live
+            nz = degs_live > 0
+            if not nz.any():
+                continue
+            starts_src = s.indptr[r_live[nz]]
+            d_nz = degs_live[nz]
+            span = np.repeat(starts_src, d_nz)
+            slot = (np.arange(span.size)
+                    - np.repeat(np.cumsum(d_nz) - d_nz, d_nz))
+            tloc = np.repeat(live[nz], d_nz)
+            src = span + slot
+            cols[d, slot, tloc] = s.indices[src]
+            if not binary:
+                vals[d, slot, tloc] = s.data[src]
         cols_t.append(jnp.asarray(cols))
         deg_t.append(jnp.asarray(deg))
         if not binary:
