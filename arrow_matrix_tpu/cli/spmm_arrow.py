@@ -263,7 +263,8 @@ def main(argv=None) -> int:
                     SellMultiLevel,
                 )
 
-                multi = SellMultiLevel(levels, width, mesh)
+                multi = SellMultiLevel(levels, width, mesh,
+                                       routing=args.routing)
             else:
                 multi = MultiLevelArrow(
                     levels, width, mesh=mesh,
