@@ -69,6 +69,14 @@ def main() -> None:
                   f"time-shared {t_ml:8.2f} ms/iter   "
                   f"space-shared {t_ss:8.2f} ms/iter   "
                   f"ratio {t_ml / t_ss:.2f}x")
+        # Feature-major orchestration on the same mesh (a2a routing).
+        from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+        sm = SellMultiLevel(levels, w,
+                            make_mesh((n_dev,), ("blocks",)),
+                            routing="a2a")
+        t_sm = ms_per_iter(sm, sm.set_features(x_host))
+        print(f"w={w} K={k_lvl} sell/a2a:    {t_sm:8.2f} ms/iter")
 
 
 if __name__ == "__main__":
