@@ -1,0 +1,50 @@
+"""30-"rank" parity test (subprocess): the reference's largest test runs
+mpiexec -n 30 (reference tests/test_arrowmpi.py:11-17, run_tests.sh);
+the JAX device count is fixed per process, so a fresh interpreter pins
+a 30-device virtual CPU pool and drives the distributed paths there."""
+
+import os
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=30"
+from arrow_matrix_tpu.utils.platform import force_cpu_devices
+force_cpu_devices(30)
+import numpy as np
+from arrow_matrix_tpu.decomposition import arrow_decomposition
+from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+from arrow_matrix_tpu.parallel import MultiLevelArrow, SellMultiLevel, make_mesh
+from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+n, width = 1200, 32
+a = barabasi_albert(n, 3, seed=30)
+levels = arrow_decomposition(a, width, max_levels=3, block_diagonal=True,
+                             seed=1)
+x = random_dense(n, 4, seed=2)
+want = decomposition_spmm(levels, x)
+mesh = make_mesh((30,), ("blocks",))
+for build in (lambda: MultiLevelArrow(levels, width, mesh=mesh, fmt="ell"),
+              lambda: MultiLevelArrow(levels, width, mesh=mesh, fmt="ell",
+                                      routing="a2a"),
+              lambda: SellMultiLevel(levels, width, mesh, routing="a2a")):
+    ml = build()
+    got = ml.gather_result(ml.step(ml.set_features(x)))
+    err = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert err < 1e-5, err
+print("OK30")
+"""
+
+
+def test_thirty_virtual_devices():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", _SCRIPT],
+                          capture_output=True, text=True, timeout=600,
+                          env=env, cwd=os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK30" in proc.stdout
