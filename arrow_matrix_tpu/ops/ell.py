@@ -115,7 +115,8 @@ def ell_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
 
 
 def auto_chunk(rows: int, k: int, m: int, budget_bytes: int,
-               itemsize: int = 4) -> Optional[int]:
+               itemsize: int = 4,
+               lanes: Optional[int] = None) -> Optional[int]:
     """Slot-chunk size bounding the ELL gather intermediate
     (``rows × chunk × k`` elements) to ``budget_bytes``; ``None`` when
     the whole slot axis fits.  The auto-sizing counterpart of the
@@ -123,12 +124,23 @@ def auto_chunk(rows: int, k: int, m: int, budget_bytes: int,
     (reference arrow/baseline/spmm_petsc.py:323-395) — derive
     ``budget_bytes`` from the live chip via
     ``utils.platform.device_memory_budget``.
+
+    The budget is enforced against the intermediate's PHYSICAL bytes:
+    on TPU its minor dimension k pads to the 128-lane tile (the
+    layout-padding law, PERFORMANCE.md), so a k=16 temp occupies 8x its
+    logical size and the chunk must shrink accordingly.  ``lanes``
+    overrides the detected lane width (1 = no padding).
     """
     if m == 0 or rows <= 0 or k <= 0:
         return None
-    if rows * m * k * itemsize <= budget_bytes:
+    if lanes is None:
+        import jax
+
+        lanes = 128 if jax.default_backend() == "tpu" else 1
+    k_phys = max(k, lanes)
+    if rows * m * k_phys * itemsize <= budget_bytes:
         return None
-    per_slot = rows * k * itemsize
+    per_slot = rows * k_phys * itemsize
     # Align DOWN so the chunked intermediate stays under budget; the
     # SLOT_ALIGN floor is the one allowed overshoot (a narrower chunk
     # cannot be tiled).

@@ -318,3 +318,18 @@ def test_block_index_dtype_selection():
                               seed=1)[0]
     b = arrow_blocks_from_csr(lvl.matrix, 64)
     assert b.diag_cols.dtype == jnp.int16     # block-local columns
+
+
+def test_auto_chunk_accounts_for_lane_padding():
+    from arrow_matrix_tpu.ops.ell import auto_chunk
+
+    # Logical fit, physical 8x overflow on 128-lane hardware.
+    rows, k, m = 1 << 20, 16, 64
+    budget = rows * k * 4 * m // 2            # logical: chunk = m//2
+    c_cpu = auto_chunk(rows, k, m, budget, lanes=1)
+    c_tpu = auto_chunk(rows, k, m, budget, lanes=128)
+    assert c_cpu == m // 2
+    assert c_tpu is not None and c_tpu <= max(m // 16, 8)
+    # k >= lanes: no padding difference.
+    assert auto_chunk(rows, 128, m, budget * 8, lanes=128) == \
+        auto_chunk(rows, 128, m, budget * 8, lanes=1)
