@@ -90,3 +90,19 @@ def test_cli_checkpoint_requires_carry(tmp_path, monkeypatch):
         spmm_arrow.main(["--vertices", "200", "--width", "32",
                          "--device", "cpu",
                          "--checkpoint", str(tmp_path / "x")])
+
+
+def test_checkpoint_roundtrip_sell_multilevel(small):
+    """Feature-major sharded carriage (SellMultiLevel) through the
+    checkpoint: restore lands on the executor's sharding."""
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    _, levels, tmp = small
+    sm = SellMultiLevel(levels, 32, make_mesh((8,), ("blocks",)))
+    x = sm.set_features(random_dense(256, 8, seed=2))
+    x2 = sm.run(x, 2)
+    save_state(str(tmp / "cks"), x2, 2)
+    xr, step = load_state(str(tmp / "cks"), like=x)
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(xr), np.asarray(x2))
+    assert xr.sharding == x.sharding
