@@ -400,14 +400,18 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
 
 
 def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
-                      hops: int = 0):
+                      hops: int = 0, feat_axis: Optional[str] = None):
     """Raw (traceable) shard_map'd slim step for one level:
     ``step(body, head, head_unsort, orig_pos, xt) -> ct`` on
     feature-major (k, total_out) arrays.
 
     ``hops`` whole-shard ppermute chains feed the halo regions (0 for
     converged block-diagonal levels — no exchange at all; a grown
-    banded level gets exactly the reach it needs)."""
+    banded level gets exactly the reach it needs).  ``feat_axis``
+    additionally shards the feature rows (axis 0) — the k-dimension
+    tiling axis (reference GPU feature blocking): the per-level
+    compute and collectives never mix feature rows, so the extra axis
+    composes transparently."""
     w = width
     n_dev = mesh.shape[axis]
 
@@ -446,12 +450,13 @@ def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
 
     spec = lambda tree: jax.tree_util.tree_map(lambda _: P(axis), tree)
 
+    x_spec = P(feat_axis, axis)
+
     def step(body, head, head_unsort, orig_pos, xt):
         return shard_map(
             local_step, mesh=mesh,
-            in_specs=(spec(body), spec(head), P(), P(axis),
-                      P(None, axis)),
-            out_specs=P(None, axis),
+            in_specs=(spec(body), spec(head), P(), P(axis), x_spec),
+            out_specs=x_spec,
             check_vma=False,
         )(body, head, head_unsort, orig_pos, xt)
 
@@ -543,7 +548,8 @@ class SellMultiLevel:
 
     def __init__(self, levels, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32, binary="auto",
-                 routing: str = "a2a"):
+                 routing: str = "a2a",
+                 feat_axis: Optional[str] = None):
         """``routing``: "a2a" (default) compiles the inter-level
         reorderings into explicit per-device send/recv tables over one
         fixed-shape all_to_all each (parallel/routing.py — tier-padding
@@ -555,7 +561,12 @@ class SellMultiLevel:
 
         if routing not in ("gather", "a2a"):
             raise ValueError(f"unknown routing {routing!r}")
+        if feat_axis is not None and routing == "a2a":
+            raise ValueError(
+                "feat_axis composes with routing='gather' (the explicit "
+                "a2a exchange shards the feature rows per device)")
         self.routing = routing
+        self.feat_axis = feat_axis
 
         if not levels:
             raise ValueError("empty decomposition")
@@ -630,9 +641,9 @@ class SellMultiLevel:
                     for i in range(1, k_levels)]
 
         steps = [make_sharded_step(mesh, axis, width, ops.rows_out,
-                                   hops=ops.hops)
+                                   hops=ops.hops, feat_axis=feat_axis)
                  for ops in self.ops]
-        feat_shard = NamedSharding(mesh, P(None, axis))
+        feat_shard = NamedSharding(mesh, P(feat_axis, axis))
 
         from arrow_matrix_tpu.parallel.routing import (
             RouteTables,
@@ -698,7 +709,7 @@ class SellMultiLevel:
         feat[live] = x[oop[live]]
         return jax.device_put(
             np.ascontiguousarray(feat.T),
-            NamedSharding(self.mesh, P(None, self.axis)))
+            NamedSharding(self.mesh, P(self.feat_axis, self.axis)))
 
     def step(self, xt: jax.Array) -> jax.Array:
         return self._step(xt, self._level_args, self.fwd, self.bwd)

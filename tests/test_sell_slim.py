@@ -223,3 +223,25 @@ def test_sell_multi_level_from_artifact(tmp_path):
     got = sm.gather_result(sm.step(sm.set_features(x)))
     np.testing.assert_allclose(got, decomposition_spmm(levels, x),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_sell_multi_level_feat_axis():
+    """k-dimension tiling: feature rows sharded over a second mesh axis
+    compose with the sell orchestration (gather routing)."""
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    n, width = 512, 32
+    a = barabasi_albert(n, 3, seed=41)
+    levels = arrow_decomposition(a, width, max_levels=2,
+                                 block_diagonal=True, seed=1)
+    mesh = make_mesh((4, 2), ("blocks", "feat"))
+    sm = SellMultiLevel(levels, width, mesh, routing="gather",
+                        feat_axis="feat")
+    x = random_dense(n, 8, seed=2)
+    got = sm.gather_result(sm.step(sm.set_features(x)))
+    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="feat_axis"):
+        SellMultiLevel(levels, width, mesh, routing="a2a",
+                       feat_axis="feat")
