@@ -432,22 +432,43 @@ def main() -> None:
     if len(sys.argv) == 3 and sys.argv[1] == "--variant":
         run_one_variant(sys.argv[2])
         return
+    # Deadline alarm: a HALF-healthy tunnel (probe passes, a later
+    # compile/dispatch wedges) would otherwise hang the parent past the
+    # driver's timeout with no JSON emitted.  SIGALRM raises at the
+    # next Python bytecode boundary — enough for RPC-polling hangs —
+    # and the BaseException handler below still prints the diagnosable
+    # line.  AMT_BENCH_DEADLINE=0 disables.
+    import signal
+
+    deadline = int(os.environ.get("AMT_BENCH_DEADLINE", 3300))
+    if deadline > 0 and hasattr(signal, "SIGALRM"):
+        def _on_alarm(signum, frame):
+            raise TimeoutError(
+                f"bench deadline ({deadline}s) exceeded — accelerator "
+                f"wedged mid-run?")
+
+        signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(deadline)
     result = {"metric": "spmm_iter_ms", "value": None, "unit": "ms",
               "vs_baseline": None}
-    platform, probe_err = probe_backend()
-    if probe_err:
-        result["backend_probe_error"] = probe_err
-    # Kernel comparison runs FIRST, before this process initializes the
-    # accelerator backend: each variant subprocess needs the chip to
-    # itself (TPU ownership is exclusive per process), so the parent
-    # must not be holding it yet.
-    _, small = _degraded_small(platform)
-    if not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1":
-        try:
-            result["kernel_compare"] = kernel_compare()
-        except Exception as e:  # comparison is diagnostics, not the gate
-            result["kernel_compare"] = {"error": f"{type(e).__name__}: {e}"}
+    # EVERY phase runs under the one JSON-emitting guard: the deadline
+    # alarm (or any failure) during the probe or the comparison must
+    # still produce the diagnosable line.
     try:
+        platform, probe_err = probe_backend()
+        if probe_err:
+            result["backend_probe_error"] = probe_err
+        # Kernel comparison runs FIRST, before this process initializes
+        # the accelerator backend: each variant subprocess needs the
+        # chip to itself (TPU ownership is exclusive per process), so
+        # the parent must not be holding it yet.
+        _, small = _degraded_small(platform)
+        if not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1":
+            try:
+                result["kernel_compare"] = kernel_compare()
+            except Exception as e:  # diagnostics, not the gate
+                result["kernel_compare"] = {
+                    "error": f"{type(e).__name__}: {e}"}
         run_bench(result)
     except BaseException as e:
         result["error"] = f"{type(e).__name__}: {e}"
