@@ -245,3 +245,30 @@ def test_sell_multi_level_feat_axis():
     with pytest.raises(ValueError, match="feat_axis"):
         SellMultiLevel(levels, width, mesh, routing="a2a",
                        feat_axis="feat")
+
+
+def test_directed_graph_through_fold_and_sell():
+    """Asymmetric adjacency end-to-end (reference supports directed via
+    symmetrize-before-linearize; the runtime operators must be exact on
+    the asymmetric matrix itself)."""
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    n, width = 512, 32
+    a = barabasi_albert(n, 3, seed=43, directed=True)
+    assert (abs(a - a.T)).nnz > 0   # genuinely asymmetric
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    x = random_dense(n, 4, seed=1)
+    want = decomposition_spmm(levels, x)
+
+    mlf = MultiLevelArrow(levels, width, mesh=None, fmt="fold")
+    np.testing.assert_allclose(
+        mlf.gather_result(mlf.step(mlf.set_features(x))), want,
+        rtol=1e-4, atol=1e-4)
+
+    sm = SellMultiLevel(levels, width, make_mesh((4,), ("blocks",)))
+    np.testing.assert_allclose(
+        sm.gather_result(sm.step(sm.set_features(x))), want,
+        rtol=1e-4, atol=1e-4)
