@@ -8,11 +8,26 @@ configs), and compares against the same iterated SpMM via scipy CSR on
 the host CPU (the reference's CPU kernel, SURVEY.md §2 "Device kernel
 bridge").
 
-Robustness contract (round-1 postmortem): the accelerator backend is
-probed in a *subprocess with a timeout* — a hung PJRT plugin (e.g. an
-unreachable TPU tunnel) must degrade to a diagnosable CPU run, not hang
-or crash the bench — and exactly ONE JSON line is always printed, with
-an "error" field when anything failed:
+Robustness contract (round-1 and round-2 postmortems):
+
+- The accelerator backend is probed in a *subprocess with a timeout* —
+  a hung PJRT plugin (an unreachable TPU tunnel) must degrade to a
+  diagnosable CPU run, not hang the bench.
+- The PARENT process never initializes the accelerator.  Every device
+  touch — each format candidate of the headline race and each kernel
+  variant of the comparison — runs in its own subprocess with a hard
+  timeout, because a tunneled TPU can wedge *mid-transfer* inside a
+  native RPC wait where no signal handler runs (observed: a ~1.3 GB
+  block upload wedging the tunnel; SIGALRM alone cannot interrupt it).
+  A wedge therefore costs one candidate's timeout, not the bench.
+- After any candidate timeout the chip is re-probed; if the probe also
+  hangs, the race stops and reports `accelerator_wedged` instead of
+  burning the deadline on doomed candidates.
+- The headline race runs FIRST (the tunnel is healthiest early); the
+  kernel comparison is diagnostics and runs after, inside whatever
+  deadline remains.
+- Exactly ONE JSON line is always printed, with an "error" field when
+  anything failed:
 
   {"metric": "spmm_iter_ms", "value": N, "unit": "ms",
    "vs_baseline": scipy_ms / device_ms, ...diagnostics}
@@ -51,24 +66,41 @@ def _peak_bw(device_kind: str) -> float | None:
 
 
 def probe_backend(timeout_s: float = 60.0, retries: int = 2
-                  ) -> tuple[str, str | None]:
+                  ) -> tuple[str, str, str | None]:
     """Initialize-check the default JAX backend in a subprocess.
 
-    Returns (platform, error).  On repeated failure (nonzero rc *or
-    hang* — the round-1 failure mode was `jax.devices()` hanging inside
-    the site-registered TPU tunnel plugin) pins ``JAX_PLATFORMS=cpu``
-    in this process and reports the last error so the bench still
-    produces a measurement, flagged as degraded.
+    Returns (platform, device_kind, error).  On repeated failure
+    (nonzero rc *or hang* — the round-1 failure mode was
+    `jax.devices()` hanging inside the site-registered TPU tunnel
+    plugin) reports platform "cpu" and the last error so the bench
+    still produces a measurement, flagged as degraded.  The parent
+    process itself never touches a backend.
+
+    The probe round-trips a small computation, not just device
+    enumeration: a HALF-healthy tunnel (round-2 failure mode) passes
+    backend init but wedges on the first transfer — `jax.devices()`
+    alone would wave it through and every race candidate would then
+    burn its full timeout against a dead link.
     """
-    code = "import jax; print(jax.devices()[0].platform)"
+    code = ("import jax; d = jax.devices()[0]; "
+            "v = float(jax.numpy.ones((8, 8)).sum()); "
+            "print(d.platform); print(d.device_kind)")
     err = None
     for attempt in range(retries):
         try:
             proc = subprocess.run([sys.executable, "-c", code],
                                   capture_output=True, text=True,
                                   timeout=timeout_s)
-            if proc.returncode == 0 and proc.stdout.strip():
-                return proc.stdout.split()[-1], None
+            # Anchor on the LAST two lines: a site plugin may print a
+            # banner to stdout before our prints, and a corrupted
+            # platform string would silently disable every
+            # platform-keyed guard (FORCECPU, degraded mode).
+            lines = [ln.strip() for ln in proc.stdout.splitlines()
+                     if ln.strip()]
+            if proc.returncode == 0 and len(lines) >= 2:
+                return lines[-2], lines[-1], None
+            if proc.returncode == 0 and lines:
+                return lines[-1], "unknown", None
             err = (f"backend probe rc={proc.returncode}: "
                    f"{proc.stderr.strip()[-400:]}")
         except subprocess.TimeoutExpired:
@@ -76,13 +108,19 @@ def probe_backend(timeout_s: float = 60.0, retries: int = 2
                    f"(PJRT plugin init hang)")
         if attempt < retries - 1:
             time.sleep(min(5.0 * 2 ** attempt, 30.0))
-    # JAX_PLATFORMS=cpu alone does NOT stop a site-registered plugin
-    # from initializing (and hanging) at the first backend access —
-    # force_cpu_devices also drops the plugin's backend factory.
-    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+    return "cpu", "host", err
 
-    force_cpu_devices()
-    return "cpu", err
+
+def _maybe_force_cpu() -> None:
+    """Pin this (child) process to the host CPU when either pin flag is
+    set — ONE mechanism behind two accepted names (AMT_BENCH_FORCECPU
+    set by the parent's spawn helpers, AMT_BENCH_CPU the documented
+    manual knob), so a caller setting either gets the same behavior."""
+    if (os.environ.get("AMT_BENCH_FORCECPU") == "1"
+            or os.environ.get("AMT_BENCH_CPU") == "1"):
+        from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices()
 
 
 def _measure(multi, x, iters: int) -> float:
@@ -102,9 +140,6 @@ def _measure(multi, x, iters: int) -> float:
 
 
 def _degraded_small(platform: str) -> tuple[bool, bool]:
-    """One derivation of the degraded/small mode from a platform string
-    (used by main() with the probe's answer and by run_bench with the
-    live backend's — they must agree on the rule)."""
     degraded = (platform == "cpu"
                 and os.environ.get("AMT_BENCH_FULL") != "1")
     small = degraded or os.environ.get("AMT_BENCH_SMALL") == "1"
@@ -165,7 +200,47 @@ def _progress(msg: str) -> None:
 _T0 = time.perf_counter()
 
 
-def run_bench(result: dict) -> None:
+def _bench_config(platform: str) -> dict:
+    """One derivation of the benchmark shape from the probed platform,
+    shared by the parent (baseline, roofline) and the candidate
+    subprocesses (build + measure) via AMT_BENCH_CFG."""
+    degraded, small = _degraded_small(platform)
+    if small:
+        # Degraded/diagnostic scale: large enough that the folded SELL
+        # operator beats the host scipy baseline even on CPU (measured
+        # 1.24x at 2^17; at the old 32k smoke scale scipy won), small
+        # enough to finish in seconds.
+        cfg = dict(n=1 << 17, m=8, width=2048, k=16, iters=5, fmt="fold")
+    else:
+        # Protocol scale (BASELINE.md: >=1M rows, features 16, 10 iters).
+        cfg = dict(n=1 << 20, m=8, width=2048, k=16, iters=10, fmt="auto")
+    cfg["n"] = int(os.environ.get("AMT_BENCH_N", cfg["n"]))
+    cfg["fmt"] = os.environ.get("AMT_BENCH_FMT", cfg["fmt"])
+    # max_levels high enough to converge: a capped decomposition leaves
+    # a grown last level holding half the nonzeros at near-full-matrix
+    # width (measured 657k-wide at n=1M with the old cap of 4), which
+    # no kernel can tile well.  At 1M/BA-8 the recursion exhausts after
+    # 10 levels, all at the base width.
+    cfg["max_levels"] = int(os.environ.get("AMT_BENCH_LEVELS", 12))
+    cfg["degraded"] = degraded
+    cfg["platform"] = platform
+    cfg["k128"] = (cfg["k"] != 128
+                   and os.environ.get("AMT_BENCH_K128", "1") == "1")
+    return cfg
+
+
+def run_one_candidate(fmt: str) -> None:
+    """Build + measure ONE headline-race format candidate at the
+    configured scale; prints one JSON line with its numbers.
+
+    Runs in a subprocess spawned by the parent race so that a wedging
+    accelerator transfer or a pathological compile costs its own
+    timeout, not the bench (the observed round-2 failure mode: a large
+    block upload hanging inside a native RPC wait, uninterruptible by
+    SIGALRM).  ``AMT_BENCH_FORCECPU=1`` pins the subprocess to the
+    host CPU for degraded mode."""
+    cfg = json.loads(os.environ["AMT_BENCH_CFG"])
+    _maybe_force_cpu()
     import jax
 
     # Full-f32 matmul passes: the correctness gate is parity with the
@@ -174,64 +249,147 @@ def run_bench(result: dict) -> None:
     # costs ~1e-3 relative error for ~10% speed.
     jax.config.update("jax_default_matmul_precision", "highest")
 
-    from arrow_matrix_tpu.decomposition.decompose import (
-        arrow_decomposition,
-        decomposition_spmm,
-    )
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
     from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
     from arrow_matrix_tpu.utils import numerics
-    from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense
+    from arrow_matrix_tpu.utils.graphs import random_dense
     from arrow_matrix_tpu.utils.platform import device_memory_budget
 
-    dev = jax.devices()[0]
-    # On a CPU fallback (accelerator unreachable or absent) the point is
-    # a diagnosable measurement, not protocol numbers: drop to smoke
-    # scale with the cheap-to-pack ELL format so the bench finishes in
-    # seconds on one host core.  AMT_BENCH_FULL=1 overrides.
-    degraded, small = _degraded_small(dev.platform)
-    # Protocol scale (BASELINE.md: >=1M rows, features 16, 10 iters).
-    if small:
-        # Degraded/diagnostic scale: large enough that the folded SELL
-        # operator beats the host scipy baseline even on CPU (measured
-        # 1.24x at 2^17; at the old 32k smoke scale scipy won), small
-        # enough to finish in seconds.
-        n, m, width, k, iters = 1 << 17, 8, 2048, 16, 5
-        fmt = "fold"
-    else:
-        n, m, width, k, iters = 1 << 20, 8, 2048, 16, 10
-        fmt = "auto"
-    n = int(os.environ.get("AMT_BENCH_N", n))
-    fmt = os.environ.get("AMT_BENCH_FMT", fmt)
+    levels = _cached_levels(cfg["n"], cfg["m"], cfg["width"], seed=7,
+                            max_levels=cfg["max_levels"])
+    budget = device_memory_budget(jax.devices()[0])
 
-    budget = device_memory_budget(dev)
-    result["config"] = {"n": n, "width": width, "features": k,
-                        "iterations": iters, "ba_neighbors": m,
-                        "dense_budget_gb": round(budget / 2**30, 2)}
-    result["platform"] = dev.platform
-    result["device_kind"] = dev.device_kind
-    if degraded:
+    t0 = time.perf_counter()
+    multi = MultiLevelArrow(levels, cfg["width"], mesh=None, fmt=fmt,
+                            dense_budget=budget)
+    build_s = time.perf_counter() - t0
+    _progress(f"fmt={fmt} built in {build_s:.0f}s; compile+measure")
+    out = {
+        "build_s": round(build_s, 2),
+        "fmts": list(multi.fmts),
+        "block_bytes": sum(b.device_nbytes() for b in multi.blocks),
+        "total_rows": multi.total_rows,
+        "dense_budget_gb": round(budget / 2**30, 2),
+    }
+    if cfg.get("k128_run"):
+        # Secondary feature width (the north-star metric names 16 AND
+        # 128 features), measured ONLY in this winner-rerun mode:
+        # inside the race it would triple the full-scale device work
+        # (a fresh n x 128 upload per candidate) and could time out a
+        # candidate whose k=16 number was valid.  The k=16 measure is
+        # skipped here — the race already produced it.
+        try:
+            _progress(f"fmt={fmt}: k=128 measurement")
+            x128 = multi.set_features(random_dense(cfg["n"], 128, seed=4))
+            out["k128_ms"] = round(_measure(multi, x128, cfg["iters"]), 3)
+        except Exception as e:   # secondary metric, never the gate
+            out["k128_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    else:
+        x_host = random_dense(cfg["n"], cfg["k"], seed=3)
+        x = multi.set_features(x_host)
+        out["ms"] = round(_measure(multi, x, cfg["iters"]), 3)
+        want = decomposition_spmm(levels, x_host)
+        out["err"] = numerics.relative_error(
+            multi.gather_result(multi.step(x)), want)
+    print(json.dumps(out), flush=True)
+
+
+def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
+    """One candidate subprocess -> its parsed JSON (or an error dict).
+    Every failure shape — nonzero rc, hang, unparseable stdout — is
+    contained to the returned dict (one candidate costs one candidate).
+
+    FORCECPU keys on the probed *platform*, not the degraded flag:
+    AMT_BENCH_FULL=1 with an unreachable accelerator (the full-scale
+    CPU control run) has degraded=False but must still pin children to
+    the host CPU or each would hang in the dead TPU plugin."""
+    env = dict(os.environ, AMT_BENCH_CFG=json.dumps(cfg))
+    if cfg["platform"] == "cpu":
+        env["AMT_BENCH_FORCECPU"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--candidate", fmt],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            _progress(f"fmt={fmt} FAILED rc={proc.returncode}")
+            return {"error": f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-400:]}"}
+        run = json.loads(proc.stdout.strip().splitlines()[-1])
+        _progress(f"fmt={fmt}: {run.get('ms')} ms/iter "
+                  f"err={run.get('err')}")
+        return run
+    except subprocess.TimeoutExpired:
+        return {"error": f"timed out after {timeout_s:.0f}s",
+                "timed_out": True}
+    # Narrow: ONLY child-output parse errors.  A blanket Exception here
+    # would swallow the one-shot deadline TimeoutError raised by the
+    # SIGALRM handler while the parent waits in subprocess.run — the
+    # race would then keep running past the deadline and the driver
+    # would kill the bench with no JSON emitted.
+    except (json.JSONDecodeError, IndexError) as e:
+        return {"error": f"unusable child output: "
+                         f"{type(e).__name__}: {str(e)[:200]}"}
+
+
+def race_candidates(result: dict, cfg: dict, finalize,
+                    timeout_s: float = 900.0) -> dict:
+    """Run each format candidate in its own subprocess, folding every
+    completed result into `result` via ``finalize`` AS THE RACE RUNS —
+    a deadline alarm (or any crash) mid-race must not discard a
+    headline number a finished candidate already earned.  After a
+    timeout the chip is re-probed and the race stops if it wedged
+    (every later candidate would burn its timeout against a dead
+    tunnel)."""
+    candidates = (["fold", "hyb", "auto"] if cfg["fmt"] == "auto"
+                  else [cfg["fmt"]])
+    runs = {}
+    for f in candidates:
+        _progress(f"candidate fmt={f}")
+        runs[f] = _spawn_candidate(f, cfg, timeout_s)
+        timed_out = runs[f].pop("timed_out", False)
+        finalize(runs)
+        if timed_out:
+            _progress(f"fmt={f} timed out; re-probing the chip")
+            if cfg["platform"] != "cpu":
+                platform, _, perr = probe_backend(timeout_s=60.0, retries=1)
+                if platform == "cpu":
+                    result["accelerator_wedged"] = (
+                        f"chip probe failed after fmt={f} timeout: {perr}")
+                    _progress("accelerator wedged — stopping the race")
+                    break
+    return runs
+
+
+def run_bench(result: dict, platform: str, device_kind: str) -> None:
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.utils import numerics
+    from arrow_matrix_tpu.utils.graphs import random_dense
+
+    cfg = _bench_config(platform)
+    n, k, iters = cfg["n"], cfg["k"], cfg["iters"]
+    result["config"] = {"n": n, "width": cfg["width"], "features": k,
+                        "iterations": iters, "ba_neighbors": cfg["m"]}
+    result["platform"] = platform
+    result["device_kind"] = device_kind
+    if cfg["degraded"]:
         result["degraded"] = True
 
-    _progress(f"platform={dev.platform} kind={dev.device_kind} n={n} fmt={fmt}")
-    # max_levels high enough to converge: a capped decomposition leaves
-    # a grown last level holding half the nonzeros at near-full-matrix
-    # width (measured 657k-wide at n=1M with the old cap of 4), which
-    # no kernel can tile well.  At 1M/BA-8 the recursion exhausts after
-    # 10 levels, all at the base width.
+    _progress(f"platform={platform} kind={device_kind} n={n} "
+              f"fmt={cfg['fmt']}")
     t0 = time.perf_counter()
-    levels = _cached_levels(n, m, width, seed=7,
-                            max_levels=int(os.environ.get(
-                                "AMT_BENCH_LEVELS", 12)))
+    levels = _cached_levels(n, cfg["m"], cfg["width"], seed=7,
+                            max_levels=cfg["max_levels"])
     result["config"]["decompose_s"] = round(time.perf_counter() - t0, 2)
-
     result["config"]["levels"] = len(levels)
     nnz = sum(int(l.matrix.nnz) for l in levels)
     result["config"]["edges_nnz"] = nnz
 
-    x_host = random_dense(n, k, seed=3)
-
     # --- Host CPU baseline: scipy CSR through the decomposition (the
-    # reference's CPU path: per-level CSRMM + permutations).
+    # reference's CPU path: per-level CSRMM + permutations).  Runs in
+    # the parent BEFORE the race so candidate subprocesses (which own
+    # the accelerator) never contend with it for host cores.
+    x_host = random_dense(n, k, seed=3)
     base_iters = 3 if n > (1 << 18) else iters
     _progress(f"decomposed in {result['config']['decompose_s']}s; "
               f"scipy baseline")
@@ -240,105 +398,98 @@ def run_bench(result: dict) -> None:
     for _ in range(base_iters):
         xb = decomposition_spmm(levels, xb)
     scipy_ms = (time.perf_counter() - t0) / base_iters * 1e3
-    want = decomposition_spmm(levels, x_host)
     tol = numerics.relative_tolerance(nnz / max(n, 1), iters=1)
+    _progress(f"scipy baseline {scipy_ms:.0f} ms/iter; racing candidates")
+
+    def finalize(runs: dict) -> None:
+        """Fold the current race state into `result` (called after
+        every candidate): sanitized per-candidate numbers plus the
+        best-so-far headline metrics.  Idempotent — later calls with
+        more candidates overwrite with at-least-as-good winners."""
+        result["device_runs"] = {
+            name: {kk: vv for kk, vv in r.items()
+                   if kk not in ("block_bytes", "total_rows",
+                                 "dense_budget_gb")}
+            for name, r in runs.items()}
+        best = None
+        for name, r in runs.items():
+            if ("ms" in r and np.isfinite(r["err"]) and r["err"] <= tol
+                    and (best is None or r["ms"] < runs[best]["ms"])):
+                best = name
+        if best is None:
+            return
+        win = runs[best]
+        dev_ms = win["ms"]
+        result["config"]["fmts"] = win["fmts"]
+        result["config"]["build_s"] = win["build_s"]
+        result["config"]["dense_budget_gb"] = win["dense_budget_gb"]
+        result["fmt_used"] = best
+
+        flops = 2.0 * nnz * k
+        # Bandwidth roofline: one iteration streams every resident
+        # block array once and reads+writes the feature array once per
+        # level (+ the routing gathers, ~2 more feature passes per
+        # level beyond the first).  This is the memory floor;
+        # achieved/floor bandwidth against the chip's peak is the MFU
+        # analog for a bandwidth-bound kernel.
+        feat_bytes = win["total_rows"] * k * 4
+        n_lvl = len(levels)
+        bytes_per_iter = win["block_bytes"] + feat_bytes * (
+            2 * n_lvl + 2 * (n_lvl - 1))
+        achieved_gbps = bytes_per_iter / (dev_ms * 1e-3) / 1e9
+        peak = _peak_bw(device_kind)
+        result.update({
+            "value": dev_ms,
+            "vs_baseline": round(scipy_ms / dev_ms, 3),
+            "scipy_cpu_ms": round(scipy_ms, 3),
+            "gflops": round(flops / (dev_ms * 1e-3) / 1e9, 2),
+            "frobenius_err_vs_cpu": win["err"],
+            "frobenius_gate": tol,
+            "bytes_per_iter_gb": round(bytes_per_iter / 2**30, 3),
+            "achieved_gbps": round(achieved_gbps, 1),
+            "roofline_frac": (round(achieved_gbps / peak, 3)
+                              if peak else None),
+        })
 
     # --- Device path: race the candidate single-chip execution configs
-    # at full scale and report the best.  Each candidate is gated for
-    # correctness individually AND isolated against failure: a compile
-    # OOM or kernel error in one format must cost only that candidate,
-    # not the race (round-2 postmortem: the all-ELL layout OOM'd at
-    # compile and the hyb candidate never ran).
-    candidates = ([("fold", "fold"), ("hyb", "hyb"), ("auto", fmt)]
-                  if fmt == "auto" else [(fmt, fmt)])
-    runs = {}
-    best = None
-    best_multi = multi = None
-    for name, f in candidates:
-        _progress(f"building fmt={f}")
-        try:
-            t0 = time.perf_counter()
-            multi = MultiLevelArrow(levels, width, mesh=None, fmt=f,
-                                    dense_budget=budget)
-            build_s = time.perf_counter() - t0
-            x = multi.set_features(x_host)
-            _progress(f"fmt={f} built in {build_s:.0f}s; compile+measure")
-            dev_ms = _measure(multi, x, iters)
-            err = numerics.relative_error(
-                multi.gather_result(multi.step(x)), want)
-            block_bytes = sum(b.device_nbytes() for b in multi.blocks)
-            runs[name] = {"ms": round(dev_ms, 3), "err": err,
-                          "build_s": round(build_s, 2),
-                          "fmts": list(multi.fmts),
-                          "block_bytes": block_bytes,
-                          "total_rows": multi.total_rows}
-            _progress(f"fmt={f}: {dev_ms:.2f} ms/iter err={err:.2e}")
-            if (np.isfinite(err) and err <= tol
-                    and (best is None or dev_ms < runs[best]["ms"])):
-                best = name
-                best_multi = multi   # kept for the k=128 measurement
-        except Exception as e:
-            runs[name] = {"error": f"{type(e).__name__}: {str(e)[:400]}"}
-            _progress(f"fmt={f} FAILED: {type(e).__name__}")
-        finally:
-            if multi is not best_multi:
-                multi = None       # free the loser before the next builds
-            x = None
-
-    result["device_runs"] = {k: {kk: vv for kk, vv in v.items()
-                                 if kk != "block_bytes" and kk != "total_rows"}
-                             for k, v in runs.items()}
-    if best is None:
+    # at full scale (each in its own subprocess, see race_candidates)
+    # and report the best.  Each candidate is gated for correctness
+    # individually AND isolated against failure: a compile OOM, kernel
+    # error, or wedged transfer in one format costs only that
+    # candidate, not the race.
+    runs = race_candidates(result, cfg, finalize)
+    if result.get("value") is None:
+        outcomes = [(name, r.get("err", r.get("error")))
+                    for name, r in runs.items()]
         raise RuntimeError(
             f"every config failed or missed the correctness gate: "
-            f"{[(k, v.get('err', v.get('error'))) for k, v in runs.items()]}"
-            f" vs {tol:.1e}")
-    win = runs[best]
-    dev_ms = win["ms"]
-    result["config"]["fmts"] = win["fmts"]
-    result["config"]["build_s"] = win["build_s"]
-    result["fmt_used"] = best
+            f"{outcomes} vs {tol:.1e}")
 
-    flops = 2.0 * nnz * k
-    # Bandwidth roofline: one iteration streams every resident block
-    # array once and reads+writes the feature array once per level
-    # (+ the routing gathers, ~2 more feature passes per level beyond
-    # the first).  This is the memory floor; achieved/floor bandwidth
-    # against the chip's peak is the MFU analog for a bandwidth-bound
-    # kernel.
-    feat_bytes = win["total_rows"] * k * 4
-    n_lvl = len(levels)
-    bytes_per_iter = win["block_bytes"] + feat_bytes * (2 * n_lvl
-                                                        + 2 * (n_lvl - 1))
-    achieved_gbps = bytes_per_iter / (dev_ms * 1e-3) / 1e9
-    peak = _peak_bw(dev.device_kind)
-
-    result.update({
-        "value": dev_ms,
-        "vs_baseline": round(scipy_ms / dev_ms, 3),
-        "scipy_cpu_ms": round(scipy_ms, 3),
-        "gflops": round(flops / (dev_ms * 1e-3) / 1e9, 2),
-        "frobenius_err_vs_cpu": win["err"],
-        "frobenius_gate": tol,
-        "bytes_per_iter_gb": round(bytes_per_iter / 2**30, 3),
-        "achieved_gbps": round(achieved_gbps, 1),
-        "roofline_frac": (round(achieved_gbps / peak, 3)
-                          if peak else None),
-    })
-
-    # Secondary feature width (the north-star metric names 16 AND 128
-    # features): re-measure the winning executor at k=128 — a gathered
-    # row moves 8x the bytes for the same slot cost, so this is the
-    # amortized regime.
-    if k != 128 and os.environ.get("AMT_BENCH_K128", "1") == "1":
-        try:
-            _progress("k=128 measurement on the winner")
-            x128 = best_multi.set_features(random_dense(n, 128, seed=4))
-            ms128 = _measure(best_multi, x128, iters)
-            result["k128_ms"] = round(ms128, 3)
-            _progress(f"k=128: {ms128:.2f} ms/iter")
-        except Exception as e:   # secondary metric, never the gate
-            result["k128_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    # Secondary feature width on the WINNER only (north-star names 16
+    # and 128 features): one extra subprocess re-builds the winning
+    # format and measures k=128 — never inside the race, where it
+    # would triple the device work and could time out a candidate
+    # whose k=16 number was valid.
+    if cfg["k128"] and not result.get("accelerator_wedged"):
+        _progress(f"k=128 rerun on winner fmt={result['fmt_used']}")
+        rerun = _spawn_candidate(result["fmt_used"],
+                                 dict(cfg, k128_run=True),
+                                 timeout_s=900.0)
+        if "k128_ms" in rerun:
+            result["k128_ms"] = rerun["k128_ms"]
+        elif rerun.get("k128_error") or rerun.get("error"):
+            result["k128_error"] = (rerun.get("k128_error")
+                                    or rerun.get("error"))
+        # Same wedge contract as the race: a timed-out rerun (e.g. the
+        # larger k=128 upload wedging a half-healthy tunnel) must stop
+        # the bench from then running kernel_compare against the dead
+        # chip.
+        if rerun.pop("timed_out", False) and cfg["platform"] != "cpu":
+            platform2, _, perr = probe_backend(timeout_s=60.0, retries=1)
+            if platform2 == "cpu":
+                result["accelerator_wedged"] = (
+                    f"chip probe failed after k=128 rerun timeout: {perr}")
+                _progress("accelerator wedged after k=128 rerun")
 
 
 # Ordered most-informative-first: the total budget may cut the tail,
@@ -373,10 +524,7 @@ def run_one_variant(name: str) -> None:
     to the host CPU (JAX_PLATFORMS alone cannot stop a site-registered
     TPU plugin from initializing) — for testing the variants without an
     accelerator."""
-    if os.environ.get("AMT_BENCH_CPU") == "1":
-        from arrow_matrix_tpu.utils.platform import force_cpu_devices
-
-        force_cpu_devices()
+    _maybe_force_cpu()
     import jax
 
     jax.config.update("jax_default_matmul_precision", "highest")
@@ -395,17 +543,30 @@ def run_one_variant(name: str) -> None:
 
 
 def kernel_compare(timeout_s: float = 300.0,
-                   total_budget_s: float = 900.0) -> dict:
+                   total_budget_s: float = 900.0,
+                   cpu: bool = False, out: dict | None = None) -> dict:
     """ms/iter of the ELL / dense / Pallas / bf16 block kernels on one
     mid-size config (dense must fit): the data for VERDICT r1 item 6
     (integrate Pallas or retire it with numbers).  One subprocess per
     variant, each with a hard timeout; a total budget stops the sweep
     early if the device starts wedging (comparison is diagnostics — it
-    must never eat the bench's own time)."""
-    out = {"config": dict(COMPARE_CONFIG)}
+    must never eat the bench's own time).  ``cpu=True`` pins the
+    children to the host CPU — when the probe reported a dead
+    accelerator (AMT_BENCH_FULL control runs), each variant child
+    would otherwise hang in the dead plugin and burn its timeout.
+
+    ``out`` may be passed in (e.g. a dict already hanging off the
+    bench's result): it is filled variant-by-variant AS THE SWEEP
+    RUNS, so a deadline alarm mid-sweep keeps every number already
+    measured instead of replacing them all with one error."""
+    if out is None:
+        out = {}
+    out["config"] = dict(COMPARE_CONFIG)
+    env = dict(os.environ, AMT_BENCH_CPU="1") if cpu else None
     t_start = time.perf_counter()
     for name in COMPARE_VARIANTS:
-        if time.perf_counter() - t_start > total_budget_s:
+        left = total_budget_s - (time.perf_counter() - t_start)
+        if left <= 0:
             out[name + "_ms"] = None
             out[name + "_error"] = "compare budget exhausted"
             continue
@@ -414,7 +575,9 @@ def kernel_compare(timeout_s: float = 300.0,
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--variant", name],
-                capture_output=True, text=True, timeout=timeout_s)
+                capture_output=True, text=True,
+                timeout=min(timeout_s, left),
+                env=env)
             if proc.returncode == 0 and proc.stdout.strip():
                 out[name + "_ms"] = json.loads(
                     proc.stdout.strip().splitlines()[-1])["ms"]
@@ -424,7 +587,8 @@ def kernel_compare(timeout_s: float = 300.0,
                                         f"{proc.stderr.strip()[-300:]}")
         except subprocess.TimeoutExpired:
             out[name + "_ms"] = None
-            out[name + "_error"] = f"timed out after {timeout_s:.0f}s"
+            out[name + "_error"] = (f"timed out after "
+                                    f"{min(timeout_s, left):.0f}s")
     return out
 
 
@@ -432,12 +596,13 @@ def main() -> None:
     if len(sys.argv) == 3 and sys.argv[1] == "--variant":
         run_one_variant(sys.argv[2])
         return
-    # Deadline alarm: a HALF-healthy tunnel (probe passes, a later
-    # compile/dispatch wedges) would otherwise hang the parent past the
-    # driver's timeout with no JSON emitted.  SIGALRM raises at the
-    # next Python bytecode boundary — enough for RPC-polling hangs —
-    # and the BaseException handler below still prints the diagnosable
-    # line.  AMT_BENCH_DEADLINE=0 disables.
+    if len(sys.argv) == 3 and sys.argv[1] == "--candidate":
+        run_one_candidate(sys.argv[2])
+        return
+    # Deadline alarm: the parent spends its time in subprocess waits
+    # (interruptible), so SIGALRM fires reliably here even when a
+    # child is wedged inside native code.  AMT_BENCH_DEADLINE=0
+    # disables.
     import signal
 
     deadline = int(os.environ.get("AMT_BENCH_DEADLINE", 3300))
@@ -455,26 +620,42 @@ def main() -> None:
     # alarm (or any failure) during the probe or the comparison must
     # still produce the diagnosable line.
     try:
-        platform, probe_err = probe_backend()
+        platform, device_kind, probe_err = probe_backend()
         if probe_err:
             result["backend_probe_error"] = probe_err
-        # Kernel comparison runs FIRST, before this process initializes
-        # the accelerator backend: each variant subprocess needs the
-        # chip to itself (TPU ownership is exclusive per process), so
-        # the parent must not be holding it yet.
+        # The headline race runs FIRST — a tunneled accelerator is
+        # healthiest early, and a later wedge must not cost the
+        # round's number.  The kernel comparison follows as
+        # diagnostics inside whatever deadline remains — INCLUDING
+        # after a total race failure (the per-kernel numbers are
+        # exactly what diagnoses an all-candidates-failed round).
+        try:
+            run_bench(result, platform, device_kind)
+        except Exception as e:
+            result["error"] = f"{type(e).__name__}: {e}"
         _, small = _degraded_small(platform)
-        if not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1":
+        remaining = deadline - (time.perf_counter() - _T0) if deadline else 1e9
+        if (not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1"
+                and not result.get("accelerator_wedged")
+                and remaining > 360):
             try:
-                result["kernel_compare"] = kernel_compare()
-            except Exception as e:  # diagnostics, not the gate
-                result["kernel_compare"] = {
-                    "error": f"{type(e).__name__}: {e}"}
-        run_bench(result)
+                kernel_compare(
+                    total_budget_s=min(900.0, remaining - 60),
+                    cpu=(platform == "cpu"),
+                    out=result.setdefault("kernel_compare", {}))
+            except Exception as e:  # diagnostics, not the gate:
+                # partial numbers already collected stay in place
+                result["kernel_compare"]["error"] = (
+                    f"{type(e).__name__}: {e}")
     except BaseException as e:
-        result["error"] = f"{type(e).__name__}: {e}"
-        print(json.dumps(result), flush=True)
-        raise SystemExit(1)
+        # A late failure (e.g. the deadline alarm during diagnostics)
+        # must not discard a headline number the race already earned —
+        # finalize() folds winners into `result` incrementally, so
+        # whatever is there is valid and measured.
+        result.setdefault("error", f"{type(e).__name__}: {e}")
     print(json.dumps(result), flush=True)
+    if result.get("value") is None:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
