@@ -1,0 +1,82 @@
+"""Contract tests for the end-of-round bench (bench.py).
+
+The bench is a driver gate: whatever happens — healthy accelerator,
+wedged tunnel, no accelerator at all — it must print exactly one JSON
+line with the metric contract and exit 0 iff a headline value exists
+(mirrors the reference's bench always reporting through wb_logging,
+arrow/arrow_bench.py:12-137).  These tests drive the real CLI in a
+subprocess in degraded (CPU-pinned) mode with the probe
+short-circuited, exercising the candidate-subprocess race end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(tmp_path, extra_env, timeout=420):
+    env = dict(os.environ)
+    env.update({
+        "AMT_BENCH_PLATFORM": "cpu",   # skip the 2x60s dead-plugin probe
+        "AMT_BENCH_N": "32768",
+        "AMT_BENCH_COMPARE": "0",
+        "AMT_BENCH_K128": "0",
+        "AMT_BENCH_DEADLINE": str(timeout - 60),
+    })
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, cwd=tmp_path, env=env)
+
+
+@pytest.fixture(scope="module")
+def bench_success(tmp_path_factory):
+    """One shared successful degraded run (the subprocess race is the
+    expensive part; both contract tests read the same record)."""
+    return _run_bench(tmp_path_factory.mktemp("bench"), {})
+
+
+def test_degraded_run_succeeds_with_contract(bench_success):
+    proc = bench_success
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"exactly one JSON line expected: {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "spmm_iter_ms"
+    assert out["unit"] == "ms"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+    assert out["degraded"] is True
+    assert out["fmt_used"] in out["device_runs"]
+    win = out["device_runs"][out["fmt_used"]]
+    assert win["err"] <= out["frobenius_gate"]
+    assert out["scipy_cpu_ms"] > 0
+
+
+def test_degraded_run_reports_roofline_inputs(bench_success):
+    out = json.loads(bench_success.stdout.strip().splitlines()[-1])
+    assert out["bytes_per_iter_gb"] > 0
+    assert out["achieved_gbps"] > 0
+    assert out["config"]["levels"] >= 1
+    assert out["config"]["edges_nnz"] > 0
+
+
+def test_failed_race_exits_nonzero_with_error_json(tmp_path):
+    """An impossible format must produce the diagnosable error line and
+    rc=1 — the round-1 postmortem contract (no silent rc without
+    JSON)."""
+    proc = _run_bench(tmp_path, {"AMT_BENCH_FMT": "no_such_format"},
+                      timeout=240)
+    assert proc.returncode == 1
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["value"] is None
+    assert "error" in out
+    assert "no_such_format" in json.dumps(out["device_runs"])
