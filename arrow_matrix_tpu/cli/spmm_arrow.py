@@ -76,7 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "zero inter-level routing (single-chip, "
                              "like hyb), sell = the padding-free "
                              "feature-major mesh orchestration "
-                             "(SellMultiLevel; mesh only).")
+                             "(SellMultiLevel time-shared, "
+                             "SellSpaceShared with --mode space; mesh "
+                             "only).")
     parser.add_argument("--head_fmt", type=str, default="auto",
                         choices=["auto", "flat", "ell", "gell"],
                         help="Head-stack storage for ELL levels: flat "
@@ -152,11 +154,12 @@ def main(argv=None) -> int:
                          "iteration state to resume when X is fresh "
                          "every iteration)")
     if args.mode == "space":
-        if args.fmt in ("hyb", "fold", "sell"):
+        if args.fmt in ("hyb", "fold"):
             raise SystemExit(
                 f"--fmt {args.fmt} is a single-chip kernel; "
                 "--mode space runs levels on disjoint device groups — "
-                "use --fmt auto/dense/ell")
+                "use --fmt auto/dense/ell (stacked) or sell "
+                "(feature-major)")
         if args.head_fmt != "auto":
             print(f"warning: --head_fmt {args.head_fmt} applies only to "
                   f"--mode time; the space-shared runtime pre-agrees "
@@ -242,11 +245,18 @@ def main(argv=None) -> int:
                       f"to --mode time; space-shared exchanges are the "
                       f"composed-gather + cross-group reduce")
             # Explicit mesh so an explicit --devices clamp is honored
-            # (SpaceSharedArrow's default mesh spans every device).
-            multi = SpaceSharedArrow(
-                levels, width, fmt=args.fmt,
-                mesh=make_mesh((len(levels), n_dev // len(levels)),
-                               ("lvl", "blocks")))
+            # (the default meshes span every device).
+            space_mesh = make_mesh((len(levels), n_dev // len(levels)),
+                                   ("lvl", "blocks"))
+            if args.fmt == "sell":
+                from arrow_matrix_tpu.parallel.sell_space import (
+                    SellSpaceShared,
+                )
+
+                multi = SellSpaceShared(levels, width, mesh=space_mesh)
+            else:
+                multi = SpaceSharedArrow(levels, width, fmt=args.fmt,
+                                         mesh=space_mesh)
         else:
             if args.fmt in ("hyb", "fold") and n_dev > 1:
                 raise SystemExit(

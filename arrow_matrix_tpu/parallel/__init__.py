@@ -40,6 +40,7 @@ from arrow_matrix_tpu.parallel.arrow_layout import (
 )
 from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
 from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel, SellSlim
+from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared
 from arrow_matrix_tpu.parallel.space_shared import SpaceSharedArrow
 from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D, largest_replication
 from arrow_matrix_tpu.parallel.spmm_1d import MatrixSlice1D, equal_slices

@@ -99,19 +99,25 @@ def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
     the share row stored at tiered position i of device d (-1 padding)
     and ``rows_out`` = sum of shared tier row counts.
 
-    ``shared_degrees`` keys the buckets and ordering on one
+    ``shared_degrees`` keys the buckets and ordering on a
     device-independent degree vector (the head operator: psum'd
     partials need identical row order on every device; local share
     degrees never exceed the global row degree, so the shared tier
-    slots always suffice)."""
+    slots always suffice).  It may be a LIST of vectors, one per
+    share — the space-shared build flattens (level, device) into one
+    share list where each level group shares its own head-degree
+    vector but tier shapes must unify across all groups."""
     n_dev = len(shares)
     degs = [np.diff(s.indptr) for s in shares]
     # Stable sort by ladder bucket only: preserves original order
     # within a bucket (device 0's head rows lead the zero tier).
     if shared_degrees is not None:
-        b_shared = np.searchsorted(ladder, shared_degrees, side="left")
-        bucket = [b_shared] * n_dev
-        orders = [np.argsort(b_shared, kind="stable")] * n_dev
+        per_share = (list(shared_degrees)
+                     if isinstance(shared_degrees, (list, tuple))
+                     else [shared_degrees] * n_dev)
+        bucket = [np.searchsorted(ladder, sd, side="left")
+                  for sd in per_share]
+        orders = [np.argsort(b, kind="stable") for b in bucket]
     else:
         bucket = [np.searchsorted(ladder, d, side="left") for d in degs]
         orders = [np.argsort(b, kind="stable") for b in bucket]
@@ -251,30 +257,13 @@ def as_padded_csr(a: sparse.csr_matrix, total: int) -> sparse.csr_matrix:
     return a_pad
 
 
-def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
-                     axis: str, dtype, binary: bool,
-                     shard_len: Optional[int] = None) -> SlimLevelOps:
-    """Build one level's per-device SELL operators (see module
-    docstring).  Captures the banded slim pattern: body columns may
-    fall in the shard, the head arm [0, w), or the two w-wide halo
-    regions at the shard edges (exchanged by ppermute at runtime)."""
-    n_dev = mesh.shape[axis]
-    w = width
-    a = as_canonical_csr(matrix)
-    n = a.shape[0]
-    if shard_len is None:
-        shard_len = align_up(-(-n // n_dev), w)
-        shard_len = max(shard_len, w)
-    total = shard_len * n_dev
-    a_pad = as_padded_csr(a, total)
-    L = shard_len
-    starts = np.arange(n_dev) * L
-
-    # Halo reach: how far body columns stray outside the owning shard
-    # (head-arm columns excluded).  hops = reach in whole shards; a
-    # converged block-diagonal level has reach 0 and pays no exchange,
-    # a grown banded last level gets exactly the hops it needs
-    # (reference neighbor exchange generalized, arrow_mpi.py:123-175).
+def _banded_reach_hops(a_pad: sparse.csr_matrix, w: int, L: int,
+                       n_dev: int) -> int:
+    """Halo reach: how far body columns stray outside the owning shard
+    (head-arm columns excluded), in whole-shard hops.  A converged
+    block-diagonal level has reach 0 and pays no exchange; a grown
+    banded last level gets exactly the hops it needs (reference
+    neighbor exchange generalized, arrow_mpi.py:123-175)."""
     coo_all = a_pad.tocoo()
     body_mask = coo_all.row >= w
     owner_r = np.minimum(coo_all.row // L, n_dev - 1)
@@ -288,13 +277,18 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
         lo_o = lo_all[outside]
         reach = int(np.maximum(lo_o - go, go - (lo_o + L) + 1).max())
     hops = -(-reach // L) if reach > 0 else 0
-    if hops > n_dev - 1:
-        hops = n_dev - 1
-    H = hops * L
+    return min(hops, n_dev - 1)
 
-    # Per-device shares via prioritized column categorization (COO):
-    # local shard > head arm > halos; anything matching no category is
-    # out of pattern and counted missing.
+
+def _slim_shares(a_pad: sparse.csr_matrix, w: int, L: int, n_dev: int,
+                 hops: int) -> tuple[list, list]:
+    """Per-device (body, head) shares via prioritized column
+    categorization (COO): local shard > head arm > halos; anything
+    matching no category is out of pattern and raises.  Body share
+    columns: [0, L) local, [L, L+w) head arm, then the lo/hi halo
+    regions of width hops*L each."""
+    H = hops * L
+    starts = np.arange(n_dev) * L
     body_shares, head_shares = [], []
     captured = 0
     for d in range(n_dev):
@@ -329,6 +323,70 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
             f"slim shares captured {captured} of {a_pad.nnz} nonzeros: "
             f"the matrix has entries outside the slim pattern at width "
             f"{w} / {hops}-hop halos (head rows/arm + shard +- reach)")
+    return body_shares, head_shares
+
+
+def _positions_inv(body_order: np.ndarray, L: int) -> np.ndarray:
+    """inv[d, r] = tiered position of share row r on share d."""
+    n_shares = body_order.shape[0]
+    inv = np.zeros((n_shares, L), dtype=np.int64)
+    for d in range(n_shares):
+        live = body_order[d] >= 0
+        inv[d, body_order[d][live]] = np.flatnonzero(live)
+    return inv
+
+
+def _remap_body_cols(body: SellShardStack, inv: np.ndarray, L: int,
+                     rows_out: int) -> SellShardStack:
+    """Body column remap: share column c ->
+      [0, L): local -> tiered position;   [L, L+w): head -> R + (c-L)
+      [L+w, L+w+H): lo halo;              [L+w+H, L+w+2H): hi halo
+    (halo regions pass through at the same offsets past R)."""
+    R = rows_out
+    remapped = []
+    for cols in body.cols:
+        c = np.asarray(cols)
+        out = np.empty_like(c)
+        for d in range(c.shape[0]):
+            cd = c[d].astype(np.int64)
+            local = inv[d, np.minimum(cd, L - 1)]
+            out[d] = np.where(cd < L, local, R + (cd - L)).astype(np.int32)
+        remapped.append(jnp.asarray(out))
+    return body.replace(cols=tuple(remapped))
+
+
+def _remap_head_cols(head: SellShardStack, inv: np.ndarray,
+                     L: int) -> SellShardStack:
+    remapped_head = []
+    for cols in head.cols:
+        c = np.asarray(cols)
+        out = np.empty_like(c)
+        for d in range(c.shape[0]):
+            out[d] = inv[d, np.minimum(c[d], L - 1)].astype(np.int32)
+        remapped_head.append(jnp.asarray(out))
+    return head.replace(cols=tuple(remapped_head))
+
+
+def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
+                     axis: str, dtype, binary: bool,
+                     shard_len: Optional[int] = None) -> SlimLevelOps:
+    """Build one level's per-device SELL operators (see module
+    docstring).  Captures the banded slim pattern: body columns may
+    fall in the shard, the head arm [0, w), or the two w-wide halo
+    regions at the shard edges (exchanged by ppermute at runtime)."""
+    n_dev = mesh.shape[axis]
+    w = width
+    a = as_canonical_csr(matrix)
+    n = a.shape[0]
+    if shard_len is None:
+        shard_len = align_up(-(-n // n_dev), w)
+        shard_len = max(shard_len, w)
+    total = shard_len * n_dev
+    a_pad = as_padded_csr(a, total)
+    L = shard_len
+
+    hops = _banded_reach_hops(a_pad, w, L, n_dev)
+    body_shares, head_shares = _slim_shares(a_pad, w, L, n_dev, hops)
 
     ladder_body = degree_ladder(
         max((int(np.diff(s.indptr).max()) if s.nnz else 0)
@@ -348,36 +406,9 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
             "device 0's head rows must lead its tiered ordering "
             "(stable zero-tier sort invariant)")
 
-    # Local-position maps.  inv[d, r] = tiered position of share row r.
-    inv = np.zeros((n_dev, L), dtype=np.int64)
-    for d in range(n_dev):
-        live = body_order[d] >= 0
-        inv[d, body_order[d][live]] = np.flatnonzero(live)
-
-    # Body column remap: share column c ->
-    #   [0, L): local -> tiered position;   [L, L+w): head -> R + (c-L)
-    #   [L+w, L+w+H): lo halo;              [L+w+H, L+w+2H): hi halo
-    # (halo regions pass through at the same offsets past R).
-    R = rows_out
-    remapped = []
-    for cols in body.cols:
-        c = np.asarray(cols)
-        out = np.empty_like(c)
-        for d in range(n_dev):
-            cd = c[d].astype(np.int64)
-            local = inv[d, np.minimum(cd, L - 1)]
-            out[d] = np.where(cd < L, local, R + (cd - L)).astype(np.int32)
-        remapped.append(jnp.asarray(out))
-    body = body.replace(cols=tuple(remapped))
-
-    remapped_head = []
-    for cols in head.cols:
-        c = np.asarray(cols)
-        out = np.empty_like(c)
-        for d in range(n_dev):
-            out[d] = inv[d, np.minimum(c[d], L - 1)].astype(np.int32)
-        remapped_head.append(jnp.asarray(out))
-    head = head.replace(cols=tuple(remapped_head))
+    inv = _positions_inv(body_order, L)
+    body = _remap_body_cols(body, inv, L, rows_out)
+    head = _remap_head_cols(head, inv, L)
 
     if not np.all(head_order[0] == head_order):
         raise AssertionError("head tier ordering must be "
@@ -399,6 +430,48 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
         n_dev=n_dev, width=w, hops=hops, binary=binary)
 
 
+def _slim_local_step(axis: str, w: int, rows_out: int, hops: int,
+                     n_dev: int, body, head, head_unsort, orig_pos, xt):
+    """One device's slim step body, shared by the time-shared
+    (make_sharded_step) and space-shared (sell_space) orchestrations —
+    masked-psum X_0 broadcast, halo ppermute chains, tiered SpMM, head
+    psum + device-0 overwrite.  All collectives name only ``axis``, so
+    under a 2-D (lvl, blocks) shard_map they stay within each level
+    group by construction.  ``head_unsort``: (w,) tiered head position
+    of each head row, already resolved by the caller."""
+    dev = lax.axis_index(axis)
+    x0 = lax.psum(
+        jnp.where(dev == 0, xt[:, :w], jnp.zeros_like(xt[:, :w])),
+        axis)
+    parts = [xt, x0]
+    if hops:
+        # Whole-shard halo chains: my rows in ORIGINAL shard order,
+        # shifted j hops right feed the lo region, j hops left the
+        # hi region.  ppermute leaves chain ends zero — the
+        # boundary condition (reference arrow_mpi.py:150-162).
+        mine = jnp.take(xt, orig_pos[0], axis=1)     # (k, L)
+        fwd = [(i, i + 1) for i in range(n_dev - 1)]
+        bwd = [(i + 1, i) for i in range(n_dev - 1)]
+        lo_chain, hi_chain = [], []
+        cur_lo = cur_hi = mine
+        for _ in range(hops):
+            cur_lo = lax.ppermute(cur_lo, axis, perm=fwd)
+            cur_hi = lax.ppermute(cur_hi, axis, perm=bwd)
+            lo_chain.append(cur_lo)   # j hops left neighbor
+            hi_chain.append(cur_hi)   # j hops right neighbor
+        # lo region covers [lo - hops*L, lo): farthest first.
+        parts += list(reversed(lo_chain)) + hi_chain
+    z = jnp.concatenate(parts, axis=1)
+    out = _stack_spmm_t(body, z)                 # (k, rows_out)
+    head_part = _stack_spmm_t(head, xt)
+    c0 = lax.psum(head_part, axis)
+    c0w = jnp.take(c0, head_unsort, axis=1)[:, :w]
+    out = jnp.where(
+        (dev == 0) & (jnp.arange(rows_out)[None, :] < w),
+        jnp.pad(c0w, ((0, 0), (0, rows_out - w))), out)
+    return out
+
+
 def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
                       hops: int = 0, feat_axis: Optional[str] = None):
     """Raw (traceable) shard_map'd slim step for one level:
@@ -416,37 +489,8 @@ def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
     n_dev = mesh.shape[axis]
 
     def local_step(body, head, head_unsort, orig_pos, xt):
-        dev = lax.axis_index(axis)
-        x0 = lax.psum(
-            jnp.where(dev == 0, xt[:, :w], jnp.zeros_like(xt[:, :w])),
-            axis)
-        parts = [xt, x0]
-        if hops:
-            # Whole-shard halo chains: my rows in ORIGINAL shard order,
-            # shifted j hops right feed the lo region, j hops left the
-            # hi region.  ppermute leaves chain ends zero — the
-            # boundary condition (reference arrow_mpi.py:150-162).
-            mine = jnp.take(xt, orig_pos[0], axis=1)     # (k, L)
-            fwd = [(i, i + 1) for i in range(n_dev - 1)]
-            bwd = [(i + 1, i) for i in range(n_dev - 1)]
-            lo_chain, hi_chain = [], []
-            cur_lo = cur_hi = mine
-            for _ in range(hops):
-                cur_lo = lax.ppermute(cur_lo, axis, perm=fwd)
-                cur_hi = lax.ppermute(cur_hi, axis, perm=bwd)
-                lo_chain.append(cur_lo)   # j hops left neighbor
-                hi_chain.append(cur_hi)   # j hops right neighbor
-            # lo region covers [lo - hops*L, lo): farthest first.
-            parts += list(reversed(lo_chain)) + hi_chain
-        z = jnp.concatenate(parts, axis=1)
-        out = _stack_spmm_t(body, z)                 # (k, rows_out)
-        head_part = _stack_spmm_t(head, xt)
-        c0 = lax.psum(head_part, axis)
-        c0w = jnp.take(c0, head_unsort, axis=1)[:, :w]
-        out = jnp.where(
-            (dev == 0) & (jnp.arange(rows_out)[None, :] < w),
-            jnp.pad(c0w, ((0, 0), (0, rows_out - w))), out)
-        return out
+        return _slim_local_step(axis, w, rows_out, hops, n_dev,
+                                body, head, head_unsort, orig_pos, xt)
 
     spec = lambda tree: jax.tree_util.tree_map(lambda _: P(axis), tree)
 

@@ -1,0 +1,334 @@
+"""Space-shared feature-major execution: K levels on disjoint device
+groups in the padding-free SELL layouts.
+
+Completes the execution-mode matrix: ``MultiLevelArrow`` /
+``SellMultiLevel`` time-share all devices over the levels sequentially;
+``SpaceSharedArrow`` runs the levels concurrently on disjoint groups in
+the stacked row-major layouts; this module is the concurrent mode on
+the slot-major/feature-major layouts the measured layout-padding law
+demands (PERFORMANCE.md).  Reference counterpart: the K arrow matrices
+of one decomposition running simultaneously on disjoint MPI rank
+groups with permutation-routed feature/result exchanges
+(arrow/arrow_dec_mpi.py:106-177, 210-281, 404-550).
+
+Mapping to SPMD:
+
+* mesh ``("lvl", "blocks")`` — one ``lvl`` slice per level (the
+  reference's per-matrix ``Comm.Create`` groups), ``blocks`` the
+  feature-major slim layout axis within each group;
+* every level's body/head SELL operators stack on ONE leading
+  (level x device) axis sharded over both mesh axes jointly
+  (``P(("lvl", "blocks"))``), so the whole decomposition is a single
+  SPMD program: tier ladders and tier row counts are unified across
+  levels AND devices by one ``_pack_shard_tiers`` call over the
+  flattened share list (the degree-ladder trick of sell_slim.py, one
+  dimension higher), and every group runs the max halo reach over
+  levels — converged levels pay the unified exchange, the structural
+  cost of space-sharing (SpaceSharedArrow pays the analogous uniform
+  banded width);
+* the reference's K-1 sequential backward/forward exchange chains
+  collapse to composed static tables exactly as in SpaceSharedArrow:
+  ``bwd0[g]`` maps level-0 carried positions to level-g partial
+  positions (one gather + a sum over groups = the cross-group
+  reduction), ``fwd0[g]`` re-distributes the aggregate into every
+  group's carried ordering.  Both compose the level permutations AND
+  the per-shard tier orderings, so the tier sorts stay free.
+
+Carried state is feature-major ``(k, K * total_out)`` — all K carried
+orderings materialized, level g's slice in level-g order (the
+reference forward-propagates X to every matrix before the first
+compute; each group materializes its own ordering up front).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from arrow_matrix_tpu.io.graphio import num_rows
+from arrow_matrix_tpu.ops.ell import align_up
+from arrow_matrix_tpu.ops.hyb import resolve_binary
+from arrow_matrix_tpu.parallel.mesh import make_mesh
+from arrow_matrix_tpu.parallel.sell_slim import (
+    _banded_reach_hops,
+    _pack_shard_tiers,
+    _positions_inv,
+    _remap_body_cols,
+    _remap_head_cols,
+    _slim_local_step,
+    _slim_shares,
+    as_canonical_csr,
+    as_padded_csr,
+    degree_ladder,
+    shard_map,
+)
+
+
+class SellSpaceShared:
+    """K decomposition levels concurrent on disjoint device groups of a
+    ("lvl", "blocks") mesh, in the padding-free SELL layouts.
+
+    Same feature API as the other orchestrations: ``set_features`` /
+    ``step`` / ``run`` / ``gather_result``; carried state is
+    feature-major (k, K * total_out).
+    """
+
+    def __init__(self, levels, width: int, mesh: Optional[Mesh] = None,
+                 lvl_axis: str = "lvl", axis: str = "blocks",
+                 dtype=np.float32, binary="auto"):
+        from arrow_matrix_tpu.parallel.multi_level import pad_permutation
+
+        if not levels:
+            raise ValueError("empty decomposition")
+        k_levels = len(levels)
+        if mesh is None:
+            n_all = len(jax.devices())
+            if n_all % k_levels != 0:
+                raise ValueError(
+                    f"{n_all} devices not divisible by {k_levels} levels; "
+                    f"pass an explicit mesh")
+            mesh = make_mesh((k_levels, n_all // k_levels),
+                             (lvl_axis, axis))
+        if mesh.shape[lvl_axis] != k_levels:
+            raise ValueError(
+                f"mesh axis {lvl_axis!r} has size {mesh.shape[lvl_axis]}, "
+                f"need one slice per level ({k_levels})")
+        self.mesh = mesh
+        self.lvl_axis = lvl_axis
+        self.axis = axis
+        self.k_levels = k_levels
+        n_dev = mesh.shape[axis]
+        w = width
+
+        canon = [as_canonical_csr(lvl.matrix) for lvl in levels]
+        self.n = num_rows(levels[0].matrix)
+        if binary is False:
+            self.binary = False
+        else:
+            self.binary = all(
+                resolve_binary(binary, c.data, nnz=c.nnz) for c in canon)
+
+        L = max(align_up(-(-self.n // n_dev), w), w)
+        total = L * n_dev
+        a_pads = [as_padded_csr(c, total) for c in canon]
+
+        # One SPMD program runs every group, so all levels share the
+        # max halo reach (see module docstring).
+        hops = max(_banded_reach_hops(a, w, L, n_dev) for a in a_pads)
+        shares = [_slim_shares(a, w, L, n_dev, hops) for a in a_pads]
+        body_flat = [s for body, _ in shares for s in body]
+        head_flat = [s for _, head in shares for s in head]
+
+        ladder_body = degree_ladder(max(
+            (int(np.diff(s.indptr).max()) if s.nnz else 0)
+            for s in body_flat))
+        head_degs = [np.diff(a[:w].tocsr().indptr) for a in a_pads]
+        ladder_head = degree_ladder(max(
+            (int(d.max()) if d.size else 0) for d in head_degs))
+
+        # ONE packing call over the flattened (level, device) share
+        # list unifies tier shapes across everything; each level group
+        # keys its head ordering on its own global head degrees
+        # (device-independent within the group — its psum needs that).
+        body, body_order, rows_out = _pack_shard_tiers(
+            body_flat, ladder_body, self.binary, dtype)
+        head, head_order, _ = _pack_shard_tiers(
+            head_flat, ladder_head, self.binary, dtype,
+            shared_degrees=[head_degs[g]
+                            for g in range(k_levels)
+                            for _ in range(n_dev)])
+        for g in range(k_levels):
+            grp = head_order[g * n_dev:(g + 1) * n_dev]
+            if not np.array_equal(body_order[g * n_dev, :w],
+                                  np.arange(w)):
+                raise AssertionError(
+                    f"level {g}: device 0's head rows must lead its "
+                    f"tiered ordering")
+            if not np.all(grp[0] == grp):
+                raise AssertionError(
+                    f"level {g}: head tier ordering must be "
+                    f"device-independent within the group")
+
+        inv = _positions_inv(body_order, L)
+        body = _remap_body_cols(body, inv, L, rows_out)
+        head = _remap_head_cols(head, inv, L)
+        # head_unsort[g][j] = tiered head position of head row j.  The
+        # cross-group tier unification maxes tier counts over ALL
+        # groups, so a group whose bucket is smaller gets -1 padding
+        # slots INTERLEAVED in its head tiers — sell_slim's
+        # argsort-of-prefix shortcut (valid there: within one level the
+        # shared-degree buckets are identical across devices, so no
+        # padding exists) would scramble here.
+        head_unsort = np.zeros((k_levels, w), dtype=np.int32)
+        for g in range(k_levels):
+            ho = head_order[g * n_dev]
+            live = ho >= 0
+            head_unsort[g, ho[live]] = np.flatnonzero(live).astype(
+                np.int32)
+
+        self.width = w
+        self.rows_out = rows_out
+        self.shard_len = L
+        self.n_dev = n_dev
+        self.hops = hops
+        self.total_out = rows_out * n_dev          # per level
+        T = self.total_out
+
+        # Carried-position <-> original-row maps per level (flattened
+        # share index s = g*n_dev + d; same construction as
+        # SellMultiLevel).
+        orig_of_pos, pos_of_orig = [], []
+        for g, lvl in enumerate(levels):
+            perm = pad_permutation(np.asarray(lvl.permutation), total)
+            oop = np.full(T, -1, dtype=np.int64)
+            for d in range(n_dev):
+                src = body_order[g * n_dev + d]
+                live = src >= 0
+                oop[d * rows_out + np.flatnonzero(live)] = perm[
+                    d * L + src[live]]
+            poo = np.full(total, -1, dtype=np.int64)
+            live = oop >= 0
+            poo[oop[live]] = np.flatnonzero(live)
+            orig_of_pos.append(oop)
+            pos_of_orig.append(poo)
+        self._orig_of_pos = orig_of_pos
+
+        # Composed cross-group tables with WITHIN-LEVEL indices (each
+        # group reorders its own partial into level-0 order before the
+        # cross-group sum — a group-local all-to-all, not a cross-slice
+        # gather; the stacked SpaceSharedArrow lowers the same way).
+        # Tier padding routes from position 0 — never consumed by any
+        # live slot (SellMultiLevel's established convention).
+        bwd0 = np.zeros((k_levels, T), dtype=np.int64)
+        fwd0 = np.zeros((k_levels, T), dtype=np.int64)
+        oop0, poo0 = orig_of_pos[0], pos_of_orig[0]
+        for g in range(k_levels):
+            idx = np.where(oop0 >= 0,
+                           pos_of_orig[g][np.minimum(oop0, total - 1)], 0)
+            bwd0[g] = np.maximum(idx, 0)
+            idxf = np.where(
+                orig_of_pos[g] >= 0,
+                poo0[np.minimum(orig_of_pos[g], total - 1)], 0)
+            fwd0[g] = np.maximum(idxf, 0)
+
+        both = NamedSharding(mesh, P((lvl_axis, axis)))
+        lvl_only = NamedSharding(mesh, P(lvl_axis))
+        self._feat_sharding = NamedSharding(mesh,
+                                            P(None, (lvl_axis, axis)))
+        self.body = jax.tree_util.tree_map(
+            lambda a_: jax.device_put(a_, both), body)
+        self.head = jax.tree_util.tree_map(
+            lambda a_: jax.device_put(a_, both), head)
+        self.head_unsort = jax.device_put(jnp.asarray(head_unsort),
+                                          lvl_only)
+        self.orig_pos = jax.device_put(
+            jnp.asarray(inv.astype(np.int32)), both)
+        self.bwd0 = jax.device_put(
+            jnp.asarray(bwd0.astype(np.int32)), lvl_only)
+        self.fwd0 = jax.device_put(
+            jnp.asarray(fwd0.astype(np.int32)), lvl_only)
+
+        # Concurrent slim step over BOTH mesh axes: the per-group body
+        # IS sell_slim's shared step body — its collectives name only
+        # the "blocks" axis, so psum/ppermute stay within each level
+        # group by construction (the reference's per-matrix
+        # communicators, for free).  head_unsort arrives (1, w) here
+        # (its lvl slice); the shared body wants the resolved (w,).
+        def local_step(body, head, head_unsort, orig_pos, xt):
+            return _slim_local_step(axis, w, rows_out, hops, n_dev,
+                                    body, head, head_unsort[0],
+                                    orig_pos, xt)
+
+        spec = lambda tree: jax.tree_util.tree_map(
+            lambda _: P((lvl_axis, axis)), tree)
+        x_spec = P(None, (lvl_axis, axis))
+
+        def sharded_compute(body, head, head_unsort, orig_pos, xt):
+            return shard_map(
+                local_step, mesh=mesh,
+                in_specs=(spec(body), spec(head), P(lvl_axis),
+                          P((lvl_axis, axis)), x_spec),
+                out_specs=x_spec,
+                check_vma=False,
+            )(body, head, head_unsort, orig_pos, xt)
+
+        def space_step(xt, body, head, head_unsort, orig_pos,
+                       bwd0, fwd0):
+            ct = sharded_compute(body, head, head_unsort, orig_pos, xt)
+            # Collapsed backward chain: per-level composed gather into
+            # level-0 order + sum over groups (cross-group reduce);
+            # forward chain: the aggregate gathered into every group's
+            # ordering.  Left to the GSPMD partitioner, like
+            # SpaceSharedArrow (lowers to all-to-all + all-reduce).
+            k = ct.shape[0]
+            ctk = ct.reshape(k, k_levels, T)
+            # Each group reorders its own partial into level-0 order
+            # (within-level indices -> group-local movement), the sum
+            # over the lvl axis is the one cross-group reduce, and the
+            # forward redistribution reads each group's copy of the
+            # reduced aggregate in its own ordering (group-local
+            # again).
+            c0 = jnp.take_along_axis(ctk, bwd0[None], axis=2)
+            agg = c0.sum(axis=1)
+            nxt = jnp.take_along_axis(
+                jnp.broadcast_to(agg[:, None, :], (k, k_levels, T)),
+                fwd0[None], axis=2)
+            return lax.with_sharding_constraint(
+                nxt.reshape(k, k_levels * T), self._feat_sharding)
+
+        self._step = jax.jit(space_step)
+
+        def scan_steps(xt, body, head, head_unsort, orig_pos,
+                       bwd0, fwd0, n):
+            def step_body(xc, _):
+                return space_step(xc, body, head, head_unsort, orig_pos,
+                                  bwd0, fwd0), None
+
+            out, _ = lax.scan(step_body, xt, None, length=n)
+            return out
+
+        self._scan = jax.jit(scan_steps, static_argnames=("n",))
+
+    def _args(self):
+        return (self.body, self.head, self.head_unsort, self.orig_pos,
+                self.bwd0, self.fwd0)
+
+    def device_nbytes(self) -> int:
+        return (self.body.device_nbytes() + self.head.device_nbytes()
+                + self.orig_pos.size * self.orig_pos.dtype.itemsize)
+
+    def set_features(self, x: np.ndarray) -> jax.Array:
+        """Host (n, k) original order -> (k, K * total_out), level g's
+        slice in level-g carried order."""
+        n, k = x.shape
+        if n != self.n:
+            raise ValueError(f"expected {self.n} rows, got {n}")
+        T = self.total_out
+        feat = np.zeros((self.k_levels * T, k), dtype=x.dtype)
+        for g in range(self.k_levels):
+            oop = self._orig_of_pos[g]
+            live = (oop >= 0) & (oop < n)
+            feat[g * T + np.flatnonzero(live)] = x[oop[live]]
+        return jax.device_put(np.ascontiguousarray(feat.T),
+                              self._feat_sharding)
+
+    def step(self, xt: jax.Array) -> jax.Array:
+        return self._step(xt, *self._args())
+
+    def run(self, xt: jax.Array, iterations: int) -> jax.Array:
+        return self._scan(xt, *self._args(), n=iterations)
+
+    def gather_result(self, ct: jax.Array) -> np.ndarray:
+        """Device (k, K * total_out) -> host (n, k) original order
+        (level 0's slice IS the canonical aggregate)."""
+        c = np.asarray(ct[:, :self.total_out]).T
+        oop = self._orig_of_pos[0]
+        out = np.zeros((self.n, c.shape[-1]), dtype=c.dtype)
+        live = (oop >= 0) & (oop < self.n)
+        out[oop[live]] = c[live]
+        return out

@@ -253,3 +253,28 @@ def test_spmm_arrow_sell_mesh(tmp_path, monkeypatch):
         "--logdir", str(tmp_path / "logs"),
     ])
     assert rc == 0
+
+
+def test_spmm_arrow_sell_space_shared(tmp_path, monkeypatch):
+    """--mode space --fmt sell = SellSpaceShared: levels concurrent on
+    disjoint groups in the feature-major layouts, validated against the
+    host golden through the full CLI (artifact pre-saved so the level
+    count divides the device count)."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.io import save_decomposition
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert
+
+    monkeypatch.chdir(tmp_path)
+    a = barabasi_albert(400, 3, seed=2)
+    levels = arrow_decomposition(a, 32, max_levels=2,
+                                 block_diagonal=True, seed=0)
+    assert len(levels) == 2
+    base = str(tmp_path / "g")
+    save_decomposition(levels, base, block_diagonal=True)
+    rc = spmm_arrow.main([
+        "--path", base, "--width", "32", "--features", "4",
+        "--iterations", "2", "--validate", "true", "--device", "cpu",
+        "--devices", "4", "--fmt", "sell", "--mode", "space",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0

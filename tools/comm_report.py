@@ -11,8 +11,13 @@ rows) analytic lower bound:
   * time-shared, routing="a2a"     (explicit precomputed send/recv
     tables over one fixed-shape all_to_all per exchange — the
     reference's Alltoallv tables, arrow_dec_mpi.py:210-281)
-  * space-shared                   (composed-gather + cross-group
+  * space-shared (stacked)         (composed-gather + cross-group
     reduce, parallel/space_shared.py)
+  * sell/gather, sell/a2a          (feature-major time-shared
+    orchestration, parallel/sell_slim.py)
+  * sell/space-shared              (feature-major concurrent groups,
+    parallel/sell_space.py: within-level composed tables + one
+    cross-group reduce)
 
 Usage: python tools/comm_report.py [n] [width] [k] [n_dev]
 """
@@ -88,6 +93,16 @@ def main() -> None:
             commstats.collective_stats(sm._step, xm, sm._level_args,
                                        sm.fwd, sm.bwd),
             sm,
+        )
+
+    if n_dev % len(levels) == 0:
+        from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared
+
+        sp = SellSpaceShared(levels, width)
+        xp = sp.set_features(x_host)
+        reports["sell/space-shared"] = (
+            commstats.collective_stats(sp._step, xp, *sp._args()),
+            sp,
         )
 
     some_ml = next(iter(reports.values()))[1]
