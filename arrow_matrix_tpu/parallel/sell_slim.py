@@ -326,6 +326,44 @@ def _slim_shares(a_pad: sparse.csr_matrix, w: int, L: int, n_dev: int,
     return body_shares, head_shares
 
 
+def _carried_maps(perm: np.ndarray, body_order: np.ndarray, L: int,
+                  total: int) -> tuple[np.ndarray, np.ndarray]:
+    """Carried-position <-> original-row maps for one level's tiered
+    ordering.  Position p (device d, tiered slot) holds level row
+    r = d*L + body_order[d, slot], i.e. original row perm[r]; -1 slots
+    are tier padding.  Returns (orig_of_pos (T,), pos_of_orig (total,)),
+    both -1 where undefined.  Shared by SellMultiLevel and
+    SellSpaceShared."""
+    n_dev, rows_out = body_order.shape
+    oop = np.full(rows_out * n_dev, -1, dtype=np.int64)
+    for d in range(n_dev):
+        src = body_order[d]
+        live = src >= 0
+        oop[d * rows_out + np.flatnonzero(live)] = perm[
+            d * L + src[live]]
+    poo = np.full(total, -1, dtype=np.int64)
+    live = oop >= 0
+    poo[oop[live]] = np.flatnonzero(live)
+    return oop, poo
+
+
+def _scatter_carried(x: np.ndarray, oop: np.ndarray, n: int) -> np.ndarray:
+    """Host (n, k) original-order features -> (T, k) carried ordering
+    (tier padding and rows past n stay zero)."""
+    feat = np.zeros((oop.size, x.shape[1]), dtype=x.dtype)
+    live = (oop >= 0) & (oop < n)
+    feat[live] = x[oop[live]]
+    return feat
+
+
+def _gather_carried(c: np.ndarray, oop: np.ndarray, n: int) -> np.ndarray:
+    """(T, k) carried-order result -> host (n, k) original order."""
+    out = np.zeros((n, c.shape[-1]), dtype=c.dtype)
+    live = (oop >= 0) & (oop < n)
+    out[oop[live]] = c[live]
+    return out
+
+
 def _positions_inv(body_order: np.ndarray, L: int) -> np.ndarray:
     """inv[d, r] = tiered position of share row r on share d."""
     n_shares = body_order.shape[0]
@@ -532,6 +570,11 @@ class SellSlim:
         self.rows_out, self.shard_len = ops.rows_out, ops.shard_len
         self.n_dev = ops.n_dev
         self.total_out = ops.total_out
+        # Single-matrix carriage = the identity-permutation case of the
+        # multi-level carried maps.
+        self._oop, _ = _carried_maps(
+            np.arange(self.shard_len * self.n_dev), ops.body_order,
+            self.shard_len, self.shard_len * self.n_dev)
         self._step = jax.jit(make_sharded_step(mesh, axis, width,
                                                ops.rows_out,
                                                hops=ops.hops))
@@ -545,15 +588,8 @@ class SellSlim:
         n, k = x.shape
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
-        out = np.zeros((self.n_dev, self.rows_out, k), dtype=x.dtype)
-        for d in range(self.n_dev):
-            src = self.body_order[d]
-            live = src >= 0
-            g = d * self.shard_len + src[live]
-            valid = g < n
-            out[d][np.flatnonzero(live)[valid]] = x[g[valid]]
-        flat = out.reshape(self.total_out, k)
-        return jax.device_put(np.ascontiguousarray(flat.T),
+        feat = _scatter_carried(x, self._oop, n)
+        return jax.device_put(np.ascontiguousarray(feat.T),
                               self._feature_sharding())
 
     def spmm(self, xt: jax.Array) -> jax.Array:
@@ -564,15 +600,7 @@ class SellSlim:
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         """Device (k, total_out) -> host (n, k) in original row order."""
-        c = np.asarray(ct).T.reshape(self.n_dev, self.rows_out, -1)
-        out = np.zeros((self.n, c.shape[-1]), dtype=c.dtype)
-        for d in range(self.n_dev):
-            src = self.body_order[d]
-            live = src >= 0
-            g = d * self.shard_len + src[live]
-            valid = g < self.n
-            out[g[valid]] = c[d][np.flatnonzero(live)[valid]]
-        return out
+        return _gather_carried(np.asarray(ct).T, self._oop, self.n)
 
 
 class SellMultiLevel:
@@ -634,22 +662,13 @@ class SellMultiLevel:
             for c in canon
         ]
 
-        # Carried-position <-> original-row maps per level.  Position p
-        # (device d, tiered slot) of level i holds level-i row
-        # r = d*L + body_order_i[d, slot], i.e. original row
-        # sigma_i_pad[r]; -1 slots are tier padding.
+        # Carried-position <-> original-row maps per level
+        # (_carried_maps: perm composed with the tiered ordering).
         orig_of_pos, pos_of_orig = [], []
         for lvl, ops in zip(levels, self.ops):
             perm = pad_permutation(np.asarray(lvl.permutation), total)
-            oop = np.full(ops.total_out, -1, dtype=np.int64)
-            for d in range(n_dev):
-                src = ops.body_order[d]
-                live = src >= 0
-                oop[d * ops.rows_out + np.flatnonzero(live)] = perm[
-                    d * shard_len + src[live]]
-            poo = np.full(total, -1, dtype=np.int64)
-            live = oop >= 0
-            poo[oop[live]] = np.flatnonzero(live)
+            oop, poo = _carried_maps(perm, ops.body_order, shard_len,
+                                     total)
             orig_of_pos.append(oop)
             pos_of_orig.append(poo)
         self._orig_of_pos0 = orig_of_pos[0]
@@ -747,10 +766,7 @@ class SellMultiLevel:
         n, k = x.shape
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
-        oop = self._orig_of_pos0
-        feat = np.zeros((oop.size, k), dtype=x.dtype)
-        live = (oop >= 0) & (oop < n)
-        feat[live] = x[oop[live]]
+        feat = _scatter_carried(x, self._orig_of_pos0, n)
         return jax.device_put(
             np.ascontiguousarray(feat.T),
             NamedSharding(self.mesh, P(self.feat_axis, self.axis)))
@@ -763,9 +779,5 @@ class SellMultiLevel:
                           n=iterations)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
-        c = np.asarray(ct).T
-        oop = self._orig_of_pos0
-        out = np.zeros((self.n, c.shape[-1]), dtype=c.dtype)
-        live = (oop >= 0) & (oop < self.n)
-        out[oop[live]] = c[live]
-        return out
+        return _gather_carried(np.asarray(ct).T, self._orig_of_pos0,
+                               self.n)

@@ -56,10 +56,13 @@ from arrow_matrix_tpu.ops.hyb import resolve_binary
 from arrow_matrix_tpu.parallel.mesh import make_mesh
 from arrow_matrix_tpu.parallel.sell_slim import (
     _banded_reach_hops,
+    _carried_maps,
+    _gather_carried,
     _pack_shard_tiers,
     _positions_inv,
     _remap_body_cols,
     _remap_head_cols,
+    _scatter_carried,
     _slim_local_step,
     _slim_shares,
     as_canonical_csr,
@@ -179,21 +182,14 @@ class SellSpaceShared:
         self.total_out = rows_out * n_dev          # per level
         T = self.total_out
 
-        # Carried-position <-> original-row maps per level (flattened
-        # share index s = g*n_dev + d; same construction as
-        # SellMultiLevel).
+        # Carried-position <-> original-row maps per level
+        # (_carried_maps on each level's slice of the flattened share
+        # axis, s = g*n_dev + d).
         orig_of_pos, pos_of_orig = [], []
         for g, lvl in enumerate(levels):
             perm = pad_permutation(np.asarray(lvl.permutation), total)
-            oop = np.full(T, -1, dtype=np.int64)
-            for d in range(n_dev):
-                src = body_order[g * n_dev + d]
-                live = src >= 0
-                oop[d * rows_out + np.flatnonzero(live)] = perm[
-                    d * L + src[live]]
-            poo = np.full(total, -1, dtype=np.int64)
-            live = oop >= 0
-            poo[oop[live]] = np.flatnonzero(live)
+            oop, poo = _carried_maps(
+                perm, body_order[g * n_dev:(g + 1) * n_dev], L, total)
             orig_of_pos.append(oop)
             pos_of_orig.append(poo)
         self._orig_of_pos = orig_of_pos
@@ -308,12 +304,9 @@ class SellSpaceShared:
         n, k = x.shape
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
-        T = self.total_out
-        feat = np.zeros((self.k_levels * T, k), dtype=x.dtype)
-        for g in range(self.k_levels):
-            oop = self._orig_of_pos[g]
-            live = (oop >= 0) & (oop < n)
-            feat[g * T + np.flatnonzero(live)] = x[oop[live]]
+        feat = np.concatenate(
+            [_scatter_carried(x, self._orig_of_pos[g], n)
+             for g in range(self.k_levels)])
         return jax.device_put(np.ascontiguousarray(feat.T),
                               self._feat_sharding)
 
@@ -326,9 +319,5 @@ class SellSpaceShared:
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         """Device (k, K * total_out) -> host (n, k) original order
         (level 0's slice IS the canonical aggregate)."""
-        c = np.asarray(ct[:, :self.total_out]).T
-        oop = self._orig_of_pos[0]
-        out = np.zeros((self.n, c.shape[-1]), dtype=c.dtype)
-        live = (oop >= 0) & (oop < self.n)
-        out[oop[live]] = c[live]
-        return out
+        return _gather_carried(np.asarray(ct[:, :self.total_out]).T,
+                               self._orig_of_pos[0], self.n)

@@ -77,6 +77,12 @@ def main() -> None:
                             routing="a2a")
         t_sm = ms_per_iter(sm, sm.set_features(x_host))
         print(f"w={w} K={k_lvl} sell/a2a:    {t_sm:8.2f} ms/iter")
+        # Feature-major concurrent groups.
+        from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared
+
+        sp = SellSpaceShared(levels, w)
+        t_sp = ms_per_iter(sp, sp.set_features(x_host))
+        print(f"w={w} K={k_lvl} sell/space:  {t_sp:8.2f} ms/iter")
 
 
 if __name__ == "__main__":
