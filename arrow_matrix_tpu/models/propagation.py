@@ -222,8 +222,18 @@ class GCNModel:
 
 
 @jax.jit
-def _normalize(y):
-    return y / jnp.maximum(jnp.linalg.norm(y), 1e-30)
+@jax.jit
+def _normalize(y, m):
+    """y / ||y * m||.  ``m`` is scalar 1.0 for layouts whose pads are
+    zero, or a carried-validity mask (sell orchestrations) — one jitted
+    fused call either way."""
+    return y / jnp.maximum(jnp.linalg.norm(y * m), 1e-30)
+
+
+@jax.jit
+def _rayleigh(x, y, m):
+    xm, ym = x * m, y * m
+    return jnp.vdot(xm, ym) / jnp.maximum(jnp.vdot(xm, xm), 1e-30)
 
 
 def power_iteration(multi: MultiLevelArrow, x0: np.ndarray,
@@ -234,16 +244,22 @@ def power_iteration(multi: MultiLevelArrow, x0: np.ndarray,
     eigenvalue estimate).  ``x0``: host (n, 1) start vector.
 
     Uses only ``multi.step`` plus whole-array reductions, both of which
-    are layout-agnostic — so this driver works on every execution mode
-    including the folded single-chip one (fmt="fold"), which carries
-    features feature-major.
+    are layout-agnostic — so this driver works on every execution mode:
+    the flat layouts and the folded single-chip one (whose pads stay
+    zero), and the sell orchestrations, whose ``carried_mask`` weights
+    the reductions — their tier pads hold routed filler after a step,
+    and the space-shared carriage holds K copies of the vector that
+    must count once.
     """
+    mask_fn = getattr(multi, "carried_mask", None)
+    m = mask_fn() if mask_fn is not None else jnp.float32(1.0)
+
     x = multi.set_features(x0.astype(np.float32))
     for _ in range(iterations):
-        x = _normalize(multi.step(x))
+        x = _normalize(multi.step(x), m)
     # One more multiply for the Rayleigh quotient x^T A x / x^T x.
     y = multi.step(x)
-    lam = float(jnp.vdot(x, y) / jnp.maximum(jnp.vdot(x, x), 1e-30))
+    lam = float(_rayleigh(x, y, m))
     return multi.gather_result(x), lam
 
 

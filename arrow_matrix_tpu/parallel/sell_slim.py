@@ -781,3 +781,14 @@ class SellMultiLevel:
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         return _gather_carried(np.asarray(ct).T, self._orig_of_pos0,
                                self.n)
+
+    def carried_mask(self) -> jax.Array:
+        """(1, total_out_0) f32 validity mask of the carried ordering:
+        1 where a position holds a real original row, 0 at tier
+        padding.  Whole-state reductions (norms, dot products — e.g.
+        power iteration) must mask pads: after a step they hold routed
+        filler, not zeros."""
+        oop = self._orig_of_pos0
+        m = ((oop >= 0) & (oop < self.n)).astype(np.float32)[None, :]
+        return jax.device_put(
+            m, NamedSharding(self.mesh, P(None, self.axis)))

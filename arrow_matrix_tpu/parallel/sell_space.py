@@ -321,3 +321,15 @@ class SellSpaceShared:
         (level 0's slice IS the canonical aggregate)."""
         return _gather_carried(np.asarray(ct[:, :self.total_out]).T,
                                self._orig_of_pos[0], self.n)
+
+    def carried_mask(self) -> jax.Array:
+        """(1, K * total_out) f32 validity mask: live positions of the
+        CANONICAL (level-0) slice only — the other slices carry copies
+        of the same vector, so whole-state reductions must count each
+        row once (and skip tier padding, which holds routed filler
+        after a step)."""
+        T = self.total_out
+        m = np.zeros((1, self.k_levels * T), dtype=np.float32)
+        oop = self._orig_of_pos[0]
+        m[0, :T] = ((oop >= 0) & (oop < self.n)).astype(np.float32)
+        return jax.device_put(m, self._feat_sharding)

@@ -113,6 +113,32 @@ def test_power_iteration_dominant_eigenpair():
     assert res < 5e-2
 
 
+def test_power_iteration_on_sell_orchestrations():
+    """power_iteration on the feature-major mesh orchestrations: their
+    tier pads hold routed filler after a step and the space-shared
+    carriage holds K copies of the vector — carried_mask weights the
+    reductions so the eigenpair still comes out right."""
+    from arrow_matrix_tpu.parallel import SellMultiLevel, SellSpaceShared
+    from arrow_matrix_tpu.parallel.mesh import make_mesh
+
+    n = 96
+    a, levels = _problem(n, seed=4)
+    assert len(levels) == 2
+    w = np.linalg.eigvalsh(a.toarray())
+    lam_true = w[np.argmax(np.abs(w))]
+    for multi in (
+        SellMultiLevel(levels, WIDTH, make_mesh((4,), ("blocks",))),
+        SellSpaceShared(levels, WIDTH,
+                        make_mesh((2, 2), ("lvl", "blocks"))),
+    ):
+        v, lam = power_iteration(multi, np.ones((n, 1), np.float32),
+                                 iterations=150)
+        assert abs(lam - lam_true) / abs(lam_true) < 1e-2, type(multi)
+        res = (np.linalg.norm(a @ v - lam * v)
+               / (abs(lam) * np.linalg.norm(v)))
+        assert res < 5e-2, type(multi)
+
+
 def test_pagerank_matches_dense_iteration():
     n, d, iters = 96, 0.85, 40
     a, _ = _problem(n, seed=5)
