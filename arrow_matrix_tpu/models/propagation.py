@@ -222,7 +222,6 @@ class GCNModel:
 
 
 @jax.jit
-@jax.jit
 def _normalize(y, m):
     """y / ||y * m||.  ``m`` is scalar 1.0 for layouts whose pads are
     zero, or a carried-validity mask (sell orchestrations) — one jitted

@@ -347,11 +347,18 @@ def _carried_maps(perm: np.ndarray, body_order: np.ndarray, L: int,
     return oop, poo
 
 
+def _live(oop: np.ndarray, n: int) -> np.ndarray:
+    """Positions of a carried ordering that hold a real original row
+    (< n): THE pad-sentinel definition — scatter, gather, and the
+    reduction masks must all agree on it."""
+    return (oop >= 0) & (oop < n)
+
+
 def _scatter_carried(x: np.ndarray, oop: np.ndarray, n: int) -> np.ndarray:
     """Host (n, k) original-order features -> (T, k) carried ordering
     (tier padding and rows past n stay zero)."""
     feat = np.zeros((oop.size, x.shape[1]), dtype=x.dtype)
-    live = (oop >= 0) & (oop < n)
+    live = _live(oop, n)
     feat[live] = x[oop[live]]
     return feat
 
@@ -359,7 +366,7 @@ def _scatter_carried(x: np.ndarray, oop: np.ndarray, n: int) -> np.ndarray:
 def _gather_carried(c: np.ndarray, oop: np.ndarray, n: int) -> np.ndarray:
     """(T, k) carried-order result -> host (n, k) original order."""
     out = np.zeros((n, c.shape[-1]), dtype=c.dtype)
-    live = (oop >= 0) & (oop < n)
+    live = _live(oop, n)
     out[oop[live]] = c[live]
     return out
 
@@ -788,7 +795,6 @@ class SellMultiLevel:
         padding.  Whole-state reductions (norms, dot products — e.g.
         power iteration) must mask pads: after a step they hold routed
         filler, not zeros."""
-        oop = self._orig_of_pos0
-        m = ((oop >= 0) & (oop < self.n)).astype(np.float32)[None, :]
+        m = _live(self._orig_of_pos0, self.n).astype(np.float32)[None, :]
         return jax.device_put(
             m, NamedSharding(self.mesh, P(None, self.axis)))

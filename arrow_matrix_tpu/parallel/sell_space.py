@@ -58,6 +58,7 @@ from arrow_matrix_tpu.parallel.sell_slim import (
     _banded_reach_hops,
     _carried_maps,
     _gather_carried,
+    _live,
     _pack_shard_tiers,
     _positions_inv,
     _remap_body_cols,
@@ -83,11 +84,19 @@ class SellSpaceShared:
 
     def __init__(self, levels, width: int, mesh: Optional[Mesh] = None,
                  lvl_axis: str = "lvl", axis: str = "blocks",
-                 dtype=np.float32, binary="auto"):
+                 dtype=np.float32, binary="auto",
+                 feat_axis: Optional[str] = None):
+        """``feat_axis`` additionally shards the feature rows (the
+        k-dimension tiling axis, reference GPU feature blocking) — with
+        ``lvl`` and ``blocks`` that makes a 3-axis sharding: levels x
+        block-rows x feature columns.  Neither the per-group compute
+        nor the cross-group exchanges mix feature rows, so the axis
+        composes transparently."""
         from arrow_matrix_tpu.parallel.multi_level import pad_permutation
 
         if not levels:
             raise ValueError("empty decomposition")
+        self.feat_axis = feat_axis
         k_levels = len(levels)
         if mesh is None:
             n_all = len(jax.devices())
@@ -214,8 +223,8 @@ class SellSpaceShared:
 
         both = NamedSharding(mesh, P((lvl_axis, axis)))
         lvl_only = NamedSharding(mesh, P(lvl_axis))
-        self._feat_sharding = NamedSharding(mesh,
-                                            P(None, (lvl_axis, axis)))
+        self._feat_sharding = NamedSharding(
+            mesh, P(feat_axis, (lvl_axis, axis)))
         self.body = jax.tree_util.tree_map(
             lambda a_: jax.device_put(a_, both), body)
         self.head = jax.tree_util.tree_map(
@@ -242,7 +251,7 @@ class SellSpaceShared:
 
         spec = lambda tree: jax.tree_util.tree_map(
             lambda _: P((lvl_axis, axis)), tree)
-        x_spec = P(None, (lvl_axis, axis))
+        x_spec = P(feat_axis, (lvl_axis, axis))
 
         def sharded_compute(body, head, head_unsort, orig_pos, xt):
             return shard_map(
@@ -330,6 +339,9 @@ class SellSpaceShared:
         after a step)."""
         T = self.total_out
         m = np.zeros((1, self.k_levels * T), dtype=np.float32)
-        oop = self._orig_of_pos[0]
-        m[0, :T] = ((oop >= 0) & (oop < self.n)).astype(np.float32)
-        return jax.device_put(m, self._feat_sharding)
+        m[0, :T] = _live(self._orig_of_pos[0], self.n).astype(np.float32)
+        # Size-1 feature dim: replicate over feat_axis (it cannot
+        # shard), positions follow the carriage.
+        return jax.device_put(
+            m, NamedSharding(self.mesh,
+                             P(None, (self.lvl_axis, self.axis))))

@@ -95,6 +95,20 @@ def test_three_levels_uneven_groups():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_feat_axis_three_axis_mesh():
+    """3-axis sharding: levels x block-rows x feature columns on a
+    (2, 2, 2) mesh — k-dimension tiling composes with the concurrent
+    groups."""
+    n, width = 512, 32
+    a, levels = two_levels(n, width, seed=23)
+    mesh = make_mesh((2, 2, 2), ("lvl", "blocks", "feat"))
+    ss = SellSpaceShared(levels, width, mesh, feat_axis="feat")
+    x = random_dense(n, 8, seed=4)
+    got = ss.gather_result(ss.step(ss.set_features(x)))
+    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_mesh_level_mismatch_raises():
     n, width = 512, 32
     _, levels = two_levels(n, width, seed=19)
