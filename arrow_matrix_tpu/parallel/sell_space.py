@@ -97,6 +97,12 @@ class SellSpaceShared:
         if not levels:
             raise ValueError("empty decomposition")
         self.feat_axis = feat_axis
+        if feat_axis is not None and (mesh is None
+                                      or feat_axis not in mesh.shape):
+            raise ValueError(
+                f"feat_axis={feat_axis!r} requires an explicit mesh "
+                f"containing that axis (e.g. make_mesh((K, b, f), "
+                f"('lvl', 'blocks', {feat_axis!r})))")
         k_levels = len(levels)
         if mesh is None:
             n_all = len(jax.devices())

@@ -106,3 +106,22 @@ def test_checkpoint_roundtrip_sell_multilevel(small):
     assert step == 2
     np.testing.assert_array_equal(np.asarray(xr), np.asarray(x2))
     assert xr.sharding == x.sharding
+
+
+def test_checkpoint_roundtrip_sell_space_shared(small):
+    """The concurrent-group carriage (K carried orderings on the 2-D
+    (lvl, blocks) mesh) through the checkpoint."""
+    from arrow_matrix_tpu.parallel import SellSpaceShared
+
+    _, levels, tmp = small
+    if len(levels) < 2:
+        pytest.skip("need >=2 levels for a lvl axis")
+    sp = SellSpaceShared(levels[:2], 32,
+                         make_mesh((2, 4), ("lvl", "blocks")))
+    x = sp.set_features(random_dense(256, 8, seed=2))
+    x2 = sp.run(x, 2)
+    save_state(str(tmp / "cksp"), x2, 2)
+    xr, step = load_state(str(tmp / "cksp"), like=x)
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(xr), np.asarray(x2))
+    assert xr.sharding == x.sharding

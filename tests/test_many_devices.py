@@ -34,6 +34,17 @@ for build in (lambda: MultiLevelArrow(levels, width, mesh=mesh, fmt="ell"),
     got = ml.gather_result(ml.step(ml.set_features(x)))
     err = np.linalg.norm(got - want) / np.linalg.norm(want)
     assert err < 1e-5, err
+
+# Concurrent groups at 30 "ranks": K level groups x 30/K devices
+# (non-power-of-two group width, the reference's odd-rank shapes).
+from arrow_matrix_tpu.parallel import SellSpaceShared
+K = len(levels)
+if 30 % K == 0:
+    sp = SellSpaceShared(levels, width,
+                         make_mesh((K, 30 // K), ("lvl", "blocks")))
+    got = sp.gather_result(sp.step(sp.set_features(x)))
+    err = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert err < 1e-5, err
 print("OK30")
 """
 
