@@ -106,6 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "(O(moved rows) volume; the reference's "
                              "Alltoallv tables, "
                              "arrow_dec_mpi.py:210-281).")
+    parser.add_argument("--memmap", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Memory-map the decomposition artifact and "
+                             "stream blocks/shares to the device "
+                             "builders without materializing any level "
+                             "on the host (reference memmap loading "
+                             "graphio.py:283-294 + streaming "
+                             "distribution arrow_dec_mpi.py:629-887).")
     parser.add_argument("--validate", type=str2bool, nargs="?",
                         default=False,
                         help="Compare each iteration against the host "
@@ -202,12 +210,20 @@ def main(argv=None) -> int:
 
     # Both branches above guarantee a nonzero width (it names the
     # artifact files).
-    loaded = load_decomposition(path, width, block_diagonal=args.blocked)
+    loaded = load_decomposition(path, width, block_diagonal=args.blocked,
+                                mem_map=args.memmap)
     widths = load_level_widths(path, width, block_diagonal=args.blocked)
     if widths is None:
         widths = width
-    levels = as_levels(loaded, widths)
-    n = levels[0].matrix.shape[0]
+    levels = as_levels(loaded, widths, materialize=not args.memmap)
+    # The host golden (decomposition_spmm) needs CSR levels; under
+    # --memmap they materialize ONLY when --validate asks for the
+    # golden (a >RAM run validates offline instead).
+    golden_levels = (as_levels(loaded, widths)
+                     if args.memmap and args.validate else levels)
+    from arrow_matrix_tpu.io.graphio import num_rows
+
+    n = num_rows(levels[0].matrix)
 
     # Honor an explicit --devices request even when the backend was
     # initialized earlier with more (force_cpu_devices cannot shrink an
@@ -330,13 +346,14 @@ def main(argv=None) -> int:
             from arrow_matrix_tpu.utils import numerics
 
             got = multi.gather_result(y)
-            want = decomposition_spmm(levels, x_host)
+            want = decomposition_spmm(golden_levels, x_host)
             err = numerics.relative_error(got, want)
             # One step separates the compared states (X is fresh per
             # iteration); tolerance per the documented accumulation-
             # order policy (utils/numerics.py).
             tol = numerics.relative_tolerance(
-                sum(l.matrix.nnz for l in levels) / max(n, 1), iters=1)
+                sum(l.matrix.nnz for l in golden_levels) / max(n, 1),
+                iters=1)
             wb.log({"frobenius_err": float(err)})
             print(f"iteration {it}: rel err vs host {err:.3e} "
                   f"(gate {tol:.1e})")

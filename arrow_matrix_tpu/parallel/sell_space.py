@@ -52,7 +52,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from arrow_matrix_tpu.io.graphio import num_rows
 from arrow_matrix_tpu.ops.ell import align_up
-from arrow_matrix_tpu.ops.hyb import resolve_binary
 from arrow_matrix_tpu.parallel.mesh import make_mesh
 from arrow_matrix_tpu.parallel.sell_slim import (
     _banded_reach_hops,
@@ -64,10 +63,9 @@ from arrow_matrix_tpu.parallel.sell_slim import (
     _remap_body_cols,
     _remap_head_cols,
     _scatter_carried,
+    _SliceSource,
     _slim_local_step,
     _slim_shares,
-    as_canonical_csr,
-    as_padded_csr,
     degree_ladder,
     shard_map,
 )
@@ -123,29 +121,33 @@ class SellSpaceShared:
         n_dev = mesh.shape[axis]
         w = width
 
-        canon = [as_canonical_csr(lvl.matrix) for lvl in levels]
         self.n = num_rows(levels[0].matrix)
+        L = max(align_up(-(-self.n // n_dev), w), w)
+        total = L * n_dev
+        # Streaming sources (sell_slim._SliceSource): memmapped-triplet
+        # levels build device share by device share, never
+        # materializing a level on the host.
+        srcs = [_SliceSource(lvl.matrix, n_dev, w, shard_len=L)
+                for lvl in levels]
         if binary is False:
             self.binary = False
         else:
-            self.binary = all(
-                resolve_binary(binary, c.data, nnz=c.nnz) for c in canon)
-
-        L = max(align_up(-(-self.n // n_dev), w), w)
-        total = L * n_dev
-        a_pads = [as_padded_csr(c, total) for c in canon]
+            self.binary = all(s.resolve_binary(binary) for s in srcs)
 
         # One SPMD program runs every group, so all levels share the
         # max halo reach (see module docstring).
-        hops = max(_banded_reach_hops(a, w, L, n_dev) for a in a_pads)
-        shares = [_slim_shares(a, w, L, n_dev, hops) for a in a_pads]
+        hops = max(_banded_reach_hops(s, w) for s in srcs)
+        shares = [_slim_shares(s, w, hops) for s in srcs]
         body_flat = [s for body, _ in shares for s in body]
         head_flat = [s for _, head in shares for s in head]
 
         ladder_body = degree_ladder(max(
             (int(np.diff(s.indptr).max()) if s.nnz else 0)
             for s in body_flat))
-        head_degs = [np.diff(a[:w].tocsr().indptr) for a in a_pads]
+        # Per-level global head degrees from the shares (columns
+        # partition [0, total)) — no second head-block read.
+        head_degs = [sum(np.diff(h.indptr) for h in heads)
+                     for _, heads in shares]
         ladder_head = degree_ladder(max(
             (int(d.max()) if d.size else 0) for d in head_degs))
 

@@ -278,3 +278,28 @@ def test_spmm_arrow_sell_space_shared(tmp_path, monkeypatch):
         "--logdir", str(tmp_path / "logs"),
     ])
     assert rc == 0
+
+
+def test_spmm_arrow_memmap_streaming(tmp_path, monkeypatch):
+    """--memmap streams the artifact to the builders (no level
+    materialized) and still validates: stacked mesh, sell mesh, and
+    single-chip fold all consume the triplet path."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.io import save_decomposition
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert
+
+    monkeypatch.chdir(tmp_path)
+    a = barabasi_albert(400, 3, seed=3)
+    levels = arrow_decomposition(a, 32, max_levels=2,
+                                 block_diagonal=True, seed=0)
+    base = str(tmp_path / "g")
+    save_decomposition(levels, base, block_diagonal=True)
+    for extra in (["--devices", "4"],
+                  ["--devices", "4", "--fmt", "sell"],
+                  ["--devices", "1", "--fmt", "fold"]):
+        rc = spmm_arrow.main([
+            "--path", base, "--width", "32", "--features", "4",
+            "--iterations", "1", "--validate", "true", "--device", "cpu",
+            "--memmap", "true", "--logdir", str(tmp_path / "logs"),
+        ] + extra)
+        assert rc == 0, extra

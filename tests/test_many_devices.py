@@ -37,14 +37,18 @@ for build in (lambda: MultiLevelArrow(levels, width, mesh=mesh, fmt="ell"),
 
 # Concurrent groups at 30 "ranks": K level groups x 30/K devices
 # (non-power-of-two group width, the reference's odd-rank shapes).
+# Loud divisibility guard: if the decomposition's level count ever
+# stops dividing 30, this coverage must not vanish silently.
 from arrow_matrix_tpu.parallel import SellSpaceShared
 K = len(levels)
-if 30 % K == 0:
-    sp = SellSpaceShared(levels, width,
-                         make_mesh((K, 30 // K), ("lvl", "blocks")))
-    got = sp.gather_result(sp.step(sp.set_features(x)))
-    err = np.linalg.norm(got - want) / np.linalg.norm(want)
-    assert err < 1e-5, err
+assert 30 % K == 0, (
+    f"level count {K} no longer divides 30 - pick a config whose "
+    f"K does, or the concurrent-group parity coverage is lost")
+sp = SellSpaceShared(levels, width,
+                     make_mesh((K, 30 // K), ("lvl", "blocks")))
+got = sp.gather_result(sp.step(sp.set_features(x)))
+err = np.linalg.norm(got - want) / np.linalg.norm(want)
+assert err < 1e-5, err
 print("OK30")
 """
 

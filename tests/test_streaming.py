@@ -99,3 +99,37 @@ def test_streamed_capture_check(decomp):
     mesh = make_mesh((8,), ("blocks",))
     with pytest.raises(ValueError, match="captured"):
         arrow_blocks_streamed(loaded[-1][0], 8, mesh, pad_blocks_to=80)
+
+
+def test_sell_paths_streamed_end_to_end(decomp):
+    """The feature-major orchestrations build from memmapped triplets
+    (sell_slim._SliceSource streams device slices) — bit-identical to
+    the in-memory build."""
+    from arrow_matrix_tpu.parallel import SellMultiLevel, SellSpaceShared
+
+    a, levels, base = decomp
+    widths = load_level_widths(base, 64)
+    loaded = load_decomposition(base, 64, mem_map=True)
+    stream_levels = as_levels(loaded, widths, materialize=False)
+    assert not hasattr(stream_levels[0].matrix, "nnz")
+    x_host = random_dense(600, 8, seed=6)
+    want = decomposition_spmm(levels, x_host)
+    tol = numerics.relative_tolerance(a.nnz / a.shape[0], 1)
+
+    mesh = make_mesh((4,), ("blocks",))
+    sm_s = SellMultiLevel(stream_levels, 64, mesh, routing="a2a")
+    sm_m = SellMultiLevel(levels, 64, mesh, routing="a2a")
+    got_s = sm_s.gather_result(sm_s.step(sm_s.set_features(x_host)))
+    got_m = sm_m.gather_result(sm_m.step(sm_m.set_features(x_host)))
+    np.testing.assert_array_equal(got_s, got_m)
+    assert numerics.relative_error(got_s, want) < tol
+    assert sm_s.binary == sm_m.binary
+
+    if len(stream_levels) == 2:
+        mesh2 = make_mesh((2, 4), ("lvl", "blocks"))
+        sp_s = SellSpaceShared(stream_levels, 64, mesh2)
+        sp_m = SellSpaceShared(levels, 64, mesh2)
+        got_s = sp_s.gather_result(sp_s.step(sp_s.set_features(x_host)))
+        got_m = sp_m.gather_result(sp_m.step(sp_m.set_features(x_host)))
+        np.testing.assert_array_equal(got_s, got_m)
+        assert numerics.relative_error(got_s, want) < tol

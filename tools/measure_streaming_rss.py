@@ -32,13 +32,20 @@ from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
 mode = {mode!r}
 base = {base!r}
 width = {width}
+streamed = mode.endswith("streamed")
 widths = load_level_widths(base, width)
-loaded = load_decomposition(base, width, mem_map=(mode == "streamed"))
-levels = as_levels(loaded, widths, materialize=(mode == "eager"))
+loaded = load_decomposition(base, width, mem_map=streamed)
+levels = as_levels(loaded, widths, materialize=not streamed)
 mesh = make_mesh((8,), ("blocks",))
-ml = MultiLevelArrow(levels, width, mesh=mesh, fmt="ell")
+if mode.startswith("sell"):
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    ml = SellMultiLevel(levels, width, mesh, routing="a2a")
+    dev_bytes = sum(o.device_nbytes() for o in ml.ops)
+else:
+    ml = MultiLevelArrow(levels, width, mesh=mesh, fmt="ell")
+    dev_bytes = sum(b.device_nbytes() for b in ml.blocks)
 peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-dev_bytes = sum(b.device_nbytes() for b in ml.blocks)
 print(json.dumps({{"mode": mode, "peak_rss_mb": peak_kb / 1024,
                   "device_mb": dev_bytes / 2**20}}))
 """
@@ -69,7 +76,7 @@ def main() -> None:
           f"{len(levels)} levels", flush=True)
 
     results = {}
-    for mode in ("streamed", "eager"):
+    for mode in ("streamed", "eager", "sell-streamed", "sell-eager"):
         code = CHILD.format(repo=repo, mode=mode, base=base, width=width)
         out = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True, timeout=3600)
@@ -81,11 +88,12 @@ def main() -> None:
         print(f"{mode:9s}: peak RSS {r['peak_rss_mb']:{8}.0f} MB "
               f"(device-resident {r['device_mb']:.0f} MB)", flush=True)
 
-    if len(results) == 2:
-        saved = (results["eager"]["peak_rss_mb"]
-                 - results["streamed"]["peak_rss_mb"])
-        print(f"streaming saves {saved:.0f} MB of peak host RSS "
-              f"(artifact {artifact_mb:.0f} MB on disk)")
+    for pre, label in (("", "stacked"), ("sell-", "sell")):
+        if pre + "eager" in results and pre + "streamed" in results:
+            saved = (results[pre + "eager"]["peak_rss_mb"]
+                     - results[pre + "streamed"]["peak_rss_mb"])
+            print(f"{label}: streaming saves {saved:.0f} MB of peak "
+                  f"host RSS (artifact {artifact_mb:.0f} MB on disk)")
 
 
 if __name__ == "__main__":
