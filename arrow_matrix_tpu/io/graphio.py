@@ -362,16 +362,46 @@ def convert_decomposition(base: str, width: Optional[int] = None,
 
 
 def num_rows(matrix: CsrLike) -> int:
-    if isinstance(matrix, sparse.csr_matrix):
+    if sparse.issparse(matrix):
         return matrix.shape[0]
-    return matrix[2].size - 1
+    return len(matrix[2]) - 1
 
 
 def nnz_per_row(matrix: CsrLike) -> np.ndarray:
-    if isinstance(matrix, sparse.csr_matrix):
-        return np.diff(matrix.indptr)
+    if sparse.issparse(matrix):
+        return np.diff(matrix.tocsr().indptr)
     indptr = matrix[2]
     return np.asarray(indptr[1:]) - np.asarray(indptr[:-1])
+
+
+def csr_row_range(matrix: CsrLike, row_start: int, row_stop: int,
+                  ncols: int, dtype=np.float32) -> sparse.csr_matrix:
+    """Rows [row_start, row_stop) of a CSR / (memmapped) triplet as a
+    (row_stop-row_start, ncols) CSR — only the touched row range is
+    read (reference graphio.py:449-495); rows past the matrix end come
+    out empty; data=None means implicit ones.  NOT canonicalized (the
+    callers decide).  The ONE copy of the triplet row-slicing
+    mechanics, shared by load_block and the sell streaming source."""
+    n = num_rows(matrix)
+    lo_r, hi_r = min(row_start, n), min(row_stop, n)
+    if sparse.issparse(matrix):
+        m = matrix.tocsr()
+        data, indices, indptr = m.data, m.indices, m.indptr
+    else:
+        data, indices, indptr = matrix
+    if lo_r >= hi_r:
+        return sparse.csr_matrix((row_stop - row_start, ncols),
+                                 dtype=dtype)
+    i0, i1 = int(indptr[lo_r]), int(indptr[hi_r])
+    ip = np.full(row_stop - row_start + 1, i1 - i0, dtype=np.int64)
+    ip[:hi_r - row_start + 1] = np.asarray(indptr[lo_r:hi_r + 1],
+                                           dtype=np.int64) - i0
+    idx = np.asarray(indices[i0:i1])
+    vals = (np.ones(i1 - i0, dtype=dtype) if data is None
+            else np.asarray(data[i0:i1], dtype=dtype))
+    return sparse.csr_matrix((vals, idx, ip),
+                             shape=(row_stop - row_start, ncols),
+                             dtype=dtype)
 
 
 def number_of_blocks(matrix: CsrLike, width: int) -> int:
@@ -406,20 +436,7 @@ def load_block(matrix: CsrLike, row_start: int, row_stop: int,
     (reference graphio.py:449-495: only the touched row range is read)."""
     n = num_rows(matrix)
     row_stop = min(row_stop, n)
-    if isinstance(matrix, sparse.csr_matrix):
-        data, indices, indptr = matrix.data, matrix.indices, matrix.indptr
-    else:
-        data, indices, indptr = matrix
-
-    lo = int(indptr[row_start])
-    hi = int(indptr[row_stop])
-    sub_indptr = np.asarray(indptr[row_start:row_stop + 1], dtype=np.int64) - lo
-    sub_indices = np.asarray(indices[lo:hi])
-    sub_data = (np.ones(hi - lo, dtype=dtype) if data is None
-                else np.asarray(data[lo:hi]))
-
-    rows = sparse.csr_matrix((sub_data, sub_indices, sub_indptr),
-                             shape=(row_stop - row_start, n), dtype=dtype)
+    rows = csr_row_range(matrix, row_start, row_stop, n, dtype=dtype)
     block = rows[:, col_start:min(col_stop, n)]
 
     pad_rows = block_size - block.shape[0]

@@ -310,20 +310,9 @@ class _SliceSource:
         """Canonical CSR of padded rows [lo, hi) x [0, total)."""
         if self._csr is not None:
             return self._csr[lo:hi]
-        data, indices, indptr = self._trip
-        lo_r, hi_r = min(lo, self.n), min(hi, self.n)
-        if lo_r >= hi_r:
-            return sparse.csr_matrix((hi - lo, self.total),
-                                     dtype=np.float32)
-        i0, i1 = int(indptr[lo_r]), int(indptr[hi_r])
-        ip = np.full(hi - lo + 1, i1 - i0, dtype=np.int64)
-        ip[:hi_r - lo + 1] = np.asarray(indptr[lo_r:hi_r + 1],
-                                        dtype=np.int64) - i0
-        idx = np.asarray(indices[i0:i1], dtype=np.int32)
-        vals = (np.ones(i1 - i0, dtype=np.float32) if data is None
-                else np.asarray(data[i0:i1], dtype=np.float32))
-        out = sparse.csr_matrix((vals, idx, ip),
-                                shape=(hi - lo, self.total))
+        from arrow_matrix_tpu.io.graphio import csr_row_range
+
+        out = csr_row_range(self._trip, lo, hi, self.total)
         nnz0 = out.nnz
         out.sum_duplicates()
         out.sort_indices()

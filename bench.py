@@ -140,9 +140,16 @@ def _measure(multi, x, iters: int) -> float:
 
 
 def _degraded_small(platform: str) -> tuple[bool, bool]:
-    degraded = (platform == "cpu"
-                and os.environ.get("AMT_BENCH_FULL") != "1")
-    small = degraded or os.environ.get("AMT_BENCH_SMALL") == "1"
+    """degraded = accelerator unreachable (probe fell back to CPU) —
+    the bench still runs the FULL protocol scale with the known-best
+    format (an honest fallback number: the fold CPU run beats the
+    scipy baseline ~2.5x at n=2^20, and the deadline math holds even
+    with a cold decomposition cache).  AMT_BENCH_SMALL=1 requests the
+    quick diagnostic scale instead; AMT_BENCH_FULL=1 additionally
+    re-enables the full fold/hyb/auto race on CPU (the control-run
+    mode)."""
+    degraded = platform == "cpu"
+    small = os.environ.get("AMT_BENCH_SMALL") == "1"
     return degraded, small
 
 
@@ -206,11 +213,17 @@ def _bench_config(platform: str) -> dict:
     subprocesses (build + measure) via AMT_BENCH_CFG."""
     degraded, small = _degraded_small(platform)
     if small:
-        # Degraded/diagnostic scale: large enough that the folded SELL
+        # Quick diagnostic scale: large enough that the folded SELL
         # operator beats the host scipy baseline even on CPU (measured
         # 1.24x at 2^17; at the old 32k smoke scale scipy won), small
         # enough to finish in seconds.
         cfg = dict(n=1 << 17, m=8, width=2048, k=16, iters=5, fmt="fold")
+    elif degraded and os.environ.get("AMT_BENCH_FULL") != "1":
+        # Accelerator unreachable: full protocol scale, single
+        # known-best candidate (racing hyb/auto on one host CPU costs
+        # ~15 min for numbers that only restate the fold win).
+        cfg = dict(n=1 << 20, m=8, width=2048, k=16, iters=10,
+                   fmt="fold")
     else:
         # Protocol scale (BASELINE.md: >=1M rows, features 16, 10 iters).
         cfg = dict(n=1 << 20, m=8, width=2048, k=16, iters=10, fmt="auto")
@@ -299,10 +312,10 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
     Every failure shape — nonzero rc, hang, unparseable stdout — is
     contained to the returned dict (one candidate costs one candidate).
 
-    FORCECPU keys on the probed *platform*, not the degraded flag:
-    AMT_BENCH_FULL=1 with an unreachable accelerator (the full-scale
-    CPU control run) has degraded=False but must still pin children to
-    the host CPU or each would hang in the dead TPU plugin."""
+    FORCECPU keys on the probed *platform*: any CPU run — including an
+    AMT_BENCH_FULL=1 control run, which is flagged degraded like every
+    accelerator-unreachable run — must pin children to the host CPU or
+    each would hang in the dead TPU plugin."""
     env = dict(os.environ, AMT_BENCH_CFG=json.dumps(cfg))
     if cfg["platform"] == "cpu":
         env["AMT_BENCH_FORCECPU"] = "1"
@@ -316,8 +329,11 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
             return {"error": f"rc={proc.returncode}: "
                              f"{proc.stderr.strip()[-400:]}"}
         run = json.loads(proc.stdout.strip().splitlines()[-1])
-        _progress(f"fmt={fmt}: {run.get('ms')} ms/iter "
-                  f"err={run.get('err')}")
+        if "k128_ms" in run and "ms" not in run:
+            _progress(f"fmt={fmt}: k=128 {run['k128_ms']} ms/iter")
+        else:
+            _progress(f"fmt={fmt}: {run.get('ms')} ms/iter "
+                      f"err={run.get('err')}")
         return run
     except subprocess.TimeoutExpired:
         return {"error": f"timed out after {timeout_s:.0f}s",
@@ -651,8 +667,13 @@ def main() -> None:
             result["error"] = f"{type(e).__name__}: {e}"
         _, small = _degraded_small(platform)
         remaining = deadline - (time.perf_counter() - _T0) if deadline else 1e9
-        if (not small and os.environ.get("AMT_BENCH_COMPARE", "1") == "1"
-                and not result.get("accelerator_wedged")
+        # "auto": compare only on a real accelerator — CPU variant
+        # times are not chip diagnostics and cost ~15 min; "1"/"0"
+        # force.
+        compare = os.environ.get("AMT_BENCH_COMPARE", "auto")
+        if (not small and not result.get("accelerator_wedged")
+                and (compare == "1"
+                     or (compare == "auto" and platform != "cpu"))
                 and remaining > 360):
             try:
                 kernel_compare(
