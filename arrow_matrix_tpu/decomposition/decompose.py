@@ -112,12 +112,24 @@ def _linear_order(a: sparse.csr_matrix, width: int, deterministic: bool,
 
     if middle.size:
         bfs_fn, forest_fn = _resolve_backend(backend)
-        sub = sym[middle][:, middle]
-        if deterministic:
-            sub_order = bfs_fn(sub)
+        from arrow_matrix_tpu.decomposition import native as _native
+
+        if not deterministic and forest_fn is _native.random_forest_order:
+            # Native fast path: the induced submatrix never
+            # materializes — one label-and-filter pass inside the C++
+            # replaces scipy's fancy-indexed sym[middle][:, middle]
+            # (saves a full per-level edge copy; ~5% end-to-end at
+            # n=2^22 — the forest pass itself dominates, PERFORMANCE.md
+            # decomposer profile).
+            sub_order = _native.random_forest_order_masked(
+                sym, middle, rng, base_size=min(width - 1, 16))
         else:
-            sub_order = forest_fn(sub, rng,
-                                  base_size=min(width - 1, 16))
+            sub = sym[middle][:, middle]
+            if deterministic:
+                sub_order = bfs_fn(sub)
+            else:
+                sub_order = forest_fn(sub, rng,
+                                      base_size=min(width - 1, 16))
         middle_order = middle[sub_order]
     else:
         middle_order = middle

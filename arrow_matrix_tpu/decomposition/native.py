@@ -84,6 +84,10 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_int64, i64p, i64p, ctypes.c_uint64,
                 ctypes.c_int64, i64p]
             lib.amt_random_forest_order.restype = ctypes.c_int
+            lib.amt_random_forest_order_masked.argtypes = [
+                ctypes.c_int64, i64p, i64p, ctypes.c_uint64,
+                ctypes.c_int64, ctypes.c_int64, i64p, i64p]
+            lib.amt_random_forest_order_masked.restype = ctypes.c_int
             lib.amt_bfs_order.argtypes = [
                 ctypes.c_int64, i64p, i64p, ctypes.c_int64, i64p]
             lib.amt_bfs_order.restype = ctypes.c_int
@@ -133,6 +137,38 @@ def random_forest_order(adj_sym: sparse.csr_matrix,
     if rc != 0:
         raise RuntimeError("native random_forest_order failed "
                            "(emitted order is not a permutation)")
+    return out
+
+
+def random_forest_order_masked(adj_sym: sparse.csr_matrix,
+                               active: np.ndarray,
+                               rng: np.random.Generator,
+                               base_size: int = 16) -> np.ndarray:
+    """Forest order of the induced submatrix ``adj_sym[active][:,
+    active]`` without materializing it — same contract as
+    ``random_forest_order(adj_sym[active][:, active], ...)`` (positions
+    into ``active``), one O(n + m) native pass instead of scipy's
+    fancy-indexed row+column extraction — saves a full per-level edge
+    copy (measured ~5% end-to-end at n=2^22; the forest pass itself
+    dominates)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decomposer unavailable: {_load_error}")
+    n = adj_sym.shape[0]
+    k = int(active.size)
+    out = np.empty(k, dtype=np.int64)
+    if k == 0:
+        return out
+    indptr, indices = _csr_int64(adj_sym)
+    act = np.ascontiguousarray(active, dtype=np.int64)
+    seed = int(rng.integers(0, 2**63 - 1))
+    rc = lib.amt_random_forest_order_masked(
+        n, _ptr(indptr), _ptr(indices), seed, int(base_size), k,
+        _ptr(act), _ptr(out))
+    if rc != 0:
+        raise RuntimeError(
+            "native random_forest_order_masked failed "
+            f"(rc={rc}: invalid subset or non-permutation output)")
     return out
 
 

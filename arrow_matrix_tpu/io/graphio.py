@@ -417,7 +417,7 @@ def number_of_blocks(matrix: CsrLike, width: int) -> int:
     nz = np.nonzero(counts)[0]
     extent = 0 if nz.size == 0 else int(nz[-1]) + 1
 
-    indices = (matrix.indices if isinstance(matrix, sparse.csr_matrix)
+    indices = (matrix.tocsr().indices if sparse.issparse(matrix)
                else matrix[1])
     nnz = int(indices.shape[0])
     step = 1 << 24

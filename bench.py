@@ -571,9 +571,11 @@ def kernel_compare(timeout_s: float = 300.0,
     variant, each with a hard timeout; a total budget stops the sweep
     early if the device starts wedging (comparison is diagnostics — it
     must never eat the bench's own time).  ``cpu=True`` pins the
-    children to the host CPU — when the probe reported a dead
-    accelerator (AMT_BENCH_FULL control runs), each variant child
-    would otherwise hang in the dead plugin and burn its timeout.
+    children to the host CPU — needed whenever the probe reported a
+    dead accelerator, or each variant child would hang in the dead
+    plugin and burn its timeout.  The sweep itself defaults OFF on CPU
+    platforms (AMT_BENCH_COMPARE="auto"); a CPU control run that wants
+    these numbers must set AMT_BENCH_COMPARE=1 explicitly.
 
     ``out`` may be passed in (e.g. a dict already hanging off the
     bench's result): it is filled variant-by-variant AS THE SWEEP

@@ -102,3 +102,30 @@ def test_backend_validation():
     a = barabasi_albert(64, 2, seed=1)
     with pytest.raises(ValueError):
         arrow_decomposition(a, 8, backend="julia")
+
+
+def test_masked_forest_order_matches_submatrix_contract():
+    """random_forest_order_masked(A, active) == a valid forest order of
+    A[active][:, active] in submatrix positions (a permutation; the
+    induced-subgraph edges drive it — isolated actives become size-1
+    components), without materializing the submatrix."""
+    import numpy as np
+
+    from arrow_matrix_tpu.decomposition import native
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert, symmetrize
+
+    if not native.available():
+        pytest.skip(f"native unavailable: {native.load_error()}")
+    a = symmetrize(barabasi_albert(3000, 4, seed=5))
+    deg = np.diff(a.indptr)
+    middle = np.argsort(-deg, kind="stable")[64:2900]
+    rng = np.random.default_rng(3)
+    order = native.random_forest_order_masked(a, middle, rng)
+    assert np.array_equal(np.sort(order), np.arange(middle.size))
+    # An out-of-range or duplicated subset must be rejected.
+    with pytest.raises(RuntimeError):
+        native.random_forest_order_masked(
+            a, np.array([0, 0], dtype=np.int64), rng)
+    with pytest.raises(RuntimeError):
+        native.random_forest_order_masked(
+            a, np.array([-1], dtype=np.int64), rng)
