@@ -21,6 +21,7 @@ same jitted multi-level arrow SpMM:
 
 from arrow_matrix_tpu.models.propagation import (
     GCNModel,
+    SGCCarried,
     SGCModel,
     SGCParams,
     gcn_forward,
@@ -34,6 +35,7 @@ from arrow_matrix_tpu.models.propagation import (
 
 __all__ = [
     "GCNModel",
+    "SGCCarried",
     "SGCModel",
     "SGCParams",
     "gcn_forward",

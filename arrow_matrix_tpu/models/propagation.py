@@ -221,6 +221,101 @@ class GCNModel:
 # ---------------------------------------------------------------------------
 
 
+class SGCCarried:
+    """SGC on the feature-major (carried) executors — `SellMultiLevel`,
+    `SellSpaceShared`, and the folded single-chip `MultiLevelArrow` —
+    i.e. anything with ``set_features``/``run``/``gather_result`` over
+    a ``(k, positions)`` carriage.
+
+    SGC's defining property (only the dense head trains) makes the
+    propagation a fixed preprocessing: ``X_prop = A^hops X`` runs once
+    on the executor, then the head fits on carried positions.  The
+    executor's ``carried_mask`` weights the loss — tier pads hold
+    routed filler and the space-shared carriage holds K copies of each
+    row (count once); the fold carriage pads with zeros, so the
+    default all-ones mask is exact there.
+    """
+
+    def __init__(self, multi, k_in: int, k_out: int, hops: int = 2,
+                 seed: int = 0):
+        # Mirror of _check_not_folded for the opposite mistake: a flat
+        # row-major executor would feed (rows, k) into the
+        # feature-major head and die deep inside jit.
+        if not (getattr(multi, "folded", False)
+                or hasattr(multi, "carried_mask")):
+            raise ValueError(
+                "SGCCarried needs a feature-major executor (fmt='fold' "
+                "MultiLevelArrow, SellMultiLevel, or SellSpaceShared); "
+                "for the flat layouts use SGCModel")
+        self.multi = multi
+        self.hops = hops
+        self.params = sgc_init(jax.random.key(seed), k_in, k_out)
+        mask_fn = getattr(multi, "carried_mask", None)
+        self._mask = mask_fn() if mask_fn is not None else None
+
+    def propagate(self, x_host: np.ndarray) -> jax.Array:
+        """Host (n, k_in) -> carried ``(k_in, positions)`` after
+        ``hops`` applications of the decomposed operator."""
+        xt = self.multi.set_features(x_host.astype(np.float32))
+        return self.multi.run(xt, self.hops) if self.hops else xt
+
+    def predict(self, x_original: np.ndarray) -> np.ndarray:
+        """Host (n, k_in) original order -> host (n, k_out) logits."""
+        logits_t = _sgc_head(self.params, self.propagate(x_original))
+        return self.multi.gather_result(logits_t)
+
+    def fit(self, x_host: np.ndarray, y_host: np.ndarray, *,
+            steps: int = 100,
+            optimizer: Optional[optax.GradientTransformation] = None
+            ) -> list[float]:
+        """Masked-MSE fit of the head on carried positions; returns the
+        per-step losses."""
+        xp = self.propagate(x_host)
+        yt = self.multi.set_features(y_host.astype(np.float32))
+        mask = (self._mask if self._mask is not None
+                else jnp.ones((1, yt.shape[1]), yt.dtype))
+        # Adaptive default: propagated features carry degree^hops
+        # magnitudes, which blow fixed-step SGD up on power-law graphs.
+        opt = optimizer or optax.adam(1e-2)
+        opt_state = opt.init(self.params)
+        # Carried operands are ARGUMENTS of the jitted step (the
+        # make_train_step pattern): baking them in as closure constants
+        # would duplicate them in the executable and retrace per fit.
+        train_step = _make_carried_train_step(opt)
+
+        losses = []
+        for _ in range(steps):
+            self.params, opt_state, loss = train_step(
+                self.params, opt_state, xp, yt, mask)
+            losses.append(float(loss))
+        return losses
+
+
+@jax.jit
+def _sgc_head(params: SGCParams, xp: jax.Array) -> jax.Array:
+    """Feature-major head: (k_out, positions) logits."""
+    return params.w.T @ xp + params.b[:, None]
+
+
+@functools.lru_cache(maxsize=8)
+def _make_carried_train_step(optimizer: optax.GradientTransformation):
+    """Jitted masked-MSE head step over carried operands (cached per
+    optimizer so repeated fit() calls reuse the compilation)."""
+
+    @jax.jit
+    def train_step(params, opt_state, xp, yt, mask):
+        def loss_fn(p):
+            per = ((_sgc_head(p, xp) - yt) ** 2).sum(
+                axis=0, keepdims=True)
+            return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return train_step
+
+
 @jax.jit
 def _normalize(y, m):
     """y / ||y * m||.  ``m`` is scalar 1.0 for layouts whose pads are

@@ -239,3 +239,48 @@ def test_gcn_forward_matches_dense_golden():
                                        mls.fwd, mls.bwd, mls.blocks)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_sgc_carried_on_feature_major_executors():
+    """SGCCarried == flat SGCModel forward (same seed/hops) on every
+    feature-major executor — fold single-chip, SellMultiLevel,
+    SellSpaceShared — and its head fit converges with the carried
+    mask."""
+    from arrow_matrix_tpu.models.propagation import SGCCarried, SGCModel
+    from arrow_matrix_tpu.parallel import (
+        SellMultiLevel,
+        SellSpaceShared,
+        make_mesh,
+    )
+
+    n, k_in, k_out, hops = 128, 8, 4, 2
+    a, levels = _problem(n)
+    assert len(levels) == 2
+    x = random_dense(n, k_in, seed=2)
+
+    flat = SGCModel(MultiLevelArrow(levels, WIDTH, mesh=None),
+                    k_in, k_out, hops=hops, seed=0)
+    want = flat.predict(x)
+
+    executors = [
+        MultiLevelArrow(levels, WIDTH, mesh=None, fmt="fold"),
+        SellMultiLevel(levels, WIDTH, make_mesh((4,), ("blocks",))),
+        SellSpaceShared(levels, WIDTH,
+                        make_mesh((2, 2), ("lvl", "blocks"))),
+    ]
+    for multi in executors:
+        m = SGCCarried(multi, k_in, k_out, hops=hops, seed=0)
+        got = m.predict(x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # Head fit converges (same contract as the flat training test).
+    rng = np.random.default_rng(5)
+    y = rng.standard_normal((n, k_out)).astype(np.float32)
+    m = SGCCarried(executors[1], k_in, k_out, hops=hops, seed=0)
+    losses = m.fit(x, y, steps=60)
+    assert losses[-1] < 0.5 * losses[0], losses[::15]
+
+    # Flat executors are the sibling class's job - rejected up front.
+    with pytest.raises(ValueError, match="feature-major"):
+        SGCCarried(MultiLevelArrow(levels, WIDTH, mesh=None),
+                   k_in, k_out)
