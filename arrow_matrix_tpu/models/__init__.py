@@ -20,6 +20,7 @@ same jitted multi-level arrow SpMM:
 """
 
 from arrow_matrix_tpu.models.propagation import (
+    GCNCarried,
     GCNModel,
     SGCCarried,
     SGCModel,
@@ -34,6 +35,7 @@ from arrow_matrix_tpu.models.propagation import (
 )
 
 __all__ = [
+    "GCNCarried",
     "GCNModel",
     "SGCCarried",
     "SGCModel",

@@ -221,6 +221,29 @@ class GCNModel:
 # ---------------------------------------------------------------------------
 
 
+# One stateless default optimizer shared by every carried model: a
+# fresh optax transform per fit() would defeat the per-optimizer
+# train-step caches and recompile the backprop program each call.
+_DEFAULT_CARRIED_OPT = optax.adam(1e-2)
+
+
+def _check_carried(multi, what: str) -> None:
+    """Mirror of _check_not_folded for the opposite mistake: a flat
+    row-major executor would feed (rows, k) into the feature-major
+    head and die deep inside jit."""
+    if not getattr(multi, "carries_feature_major", False):
+        raise ValueError(
+            f"{what} needs a feature-major executor (fmt='fold' "
+            f"MultiLevelArrow, SellMultiLevel, or SellSpaceShared); "
+            f"for the flat layouts use the non-Carried sibling class")
+
+
+def _carried_mask_or_ones(multi, total: int) -> jax.Array:
+    if getattr(multi, "carries_feature_major", False):
+        return multi.carried_mask()
+    return jnp.ones((1, total), jnp.float32)
+
+
 class SGCCarried:
     """SGC on the feature-major (carried) executors — `SellMultiLevel`,
     `SellSpaceShared`, and the folded single-chip `MultiLevelArrow` —
@@ -231,27 +254,18 @@ class SGCCarried:
     propagation a fixed preprocessing: ``X_prop = A^hops X`` runs once
     on the executor, then the head fits on carried positions.  The
     executor's ``carried_mask`` weights the loss — tier pads hold
-    routed filler and the space-shared carriage holds K copies of each
-    row (count once); the fold carriage pads with zeros, so the
-    default all-ones mask is exact there.
+    routed filler, the space-shared carriage holds K copies of each
+    row (count once), and even the zero-padded fold carriage needs it
+    so pad positions don't dilute the denominator and drag the output
+    bias toward zero.
     """
 
     def __init__(self, multi, k_in: int, k_out: int, hops: int = 2,
                  seed: int = 0):
-        # Mirror of _check_not_folded for the opposite mistake: a flat
-        # row-major executor would feed (rows, k) into the
-        # feature-major head and die deep inside jit.
-        if not (getattr(multi, "folded", False)
-                or hasattr(multi, "carried_mask")):
-            raise ValueError(
-                "SGCCarried needs a feature-major executor (fmt='fold' "
-                "MultiLevelArrow, SellMultiLevel, or SellSpaceShared); "
-                "for the flat layouts use SGCModel")
+        _check_carried(multi, "SGCCarried")
         self.multi = multi
         self.hops = hops
         self.params = sgc_init(jax.random.key(seed), k_in, k_out)
-        mask_fn = getattr(multi, "carried_mask", None)
-        self._mask = mask_fn() if mask_fn is not None else None
 
     def propagate(self, x_host: np.ndarray) -> jax.Array:
         """Host (n, k_in) -> carried ``(k_in, positions)`` after
@@ -272,11 +286,10 @@ class SGCCarried:
         per-step losses."""
         xp = self.propagate(x_host)
         yt = self.multi.set_features(y_host.astype(np.float32))
-        mask = (self._mask if self._mask is not None
-                else jnp.ones((1, yt.shape[1]), yt.dtype))
+        mask = _carried_mask_or_ones(self.multi, yt.shape[1])
         # Adaptive default: propagated features carry degree^hops
         # magnitudes, which blow fixed-step SGD up on power-law graphs.
-        opt = optimizer or optax.adam(1e-2)
+        opt = optimizer or _DEFAULT_CARRIED_OPT
         opt_state = opt.init(self.params)
         # Carried operands are ARGUMENTS of the jitted step (the
         # make_train_step pattern): baking them in as closure constants
@@ -295,6 +308,94 @@ class SGCCarried:
 def _sgc_head(params: SGCParams, xp: jax.Array) -> jax.Array:
     """Feature-major head: (k_out, positions) logits."""
     return params.w.T @ xp + params.b[:, None]
+
+
+class GCNCarried:
+    """GCN on the feature-major executors — per-layer weights with ReLU
+    between propagation steps, gradients flowing THROUGH the executor's
+    step (the shard_map collectives — psum, ppermute, the routed
+    gathers — differentiate natively), so the same distributed program
+    that serves inference backpropagates.
+
+    Works on any carried-layout executor exposing ``step_operands``
+    (fold ``MultiLevelArrow``, ``SellMultiLevel``, ``SellSpaceShared``);
+    loss is masked by ``carried_mask`` like :class:`SGCCarried`.
+    """
+
+    def __init__(self, multi, dims: Sequence[int], seed: int = 0):
+        _check_carried(multi, "GCNCarried")
+        self.multi = multi
+        self.params = gcn_init(jax.random.key(seed), dims)
+        # Per-instance jits (NOT a module cache: every executor's
+        # step_fn is a per-instance object, so a global cache could
+        # never hit across instances and would pin dropped executors'
+        # device blocks alive).
+        self._forward = _make_carried_gcn_forward(multi.step_fn)
+        self._train_steps: dict = {}
+
+    def predict(self, x_original: np.ndarray) -> np.ndarray:
+        xt = self.multi.set_features(x_original.astype(np.float32))
+        logits = self._forward(self.params, xt,
+                               self.multi.step_operands())
+        return self.multi.gather_result(logits)
+
+    def fit(self, x_host: np.ndarray, y_host: np.ndarray, *,
+            steps: int = 100,
+            optimizer: Optional[optax.GradientTransformation] = None
+            ) -> list[float]:
+        """Masked-MSE fit of every layer; propagation recomputes inside
+        each step (the weights sit between hops — GCN's defining
+        difference from SGC)."""
+        xt = self.multi.set_features(x_host.astype(np.float32))
+        yt = self.multi.set_features(y_host.astype(np.float32))
+        mask = _carried_mask_or_ones(self.multi, yt.shape[1])
+        opt = optimizer or _DEFAULT_CARRIED_OPT
+        opt_state = opt.init(self.params)
+        train_step = self._train_steps.get(opt)
+        if train_step is None:
+            train_step = _make_carried_gcn_train_step(self._forward, opt)
+            self._train_steps[opt] = train_step
+
+        operands = self.multi.step_operands()
+        losses = []
+        for _ in range(steps):
+            self.params, opt_state, loss = train_step(
+                self.params, opt_state, xt, yt, mask, operands)
+            losses.append(float(loss))
+        return losses
+
+
+def _make_carried_gcn_forward(step_fn):
+    """Jitted carried-layout GCN forward for one executor step
+    callable; operands thread through as arguments (no baked
+    constants)."""
+
+    @jax.jit
+    def forward(params, xt, operands):
+        for i, p in enumerate(params):
+            xt = step_fn(xt, *operands)
+            xt = p.w.T @ xt + p.b[:, None]
+            if i < len(params) - 1:
+                xt = jax.nn.relu(xt)
+        return xt
+
+    return forward
+
+
+def _make_carried_gcn_train_step(forward,
+                                 optimizer: optax.GradientTransformation):
+    @jax.jit
+    def train_step(params, opt_state, xt, yt, mask, operands):
+        def loss_fn(ps):
+            per = ((forward(ps, xt, operands) - yt) ** 2).sum(
+                axis=0, keepdims=True)
+            return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return train_step
 
 
 @functools.lru_cache(maxsize=8)
@@ -345,8 +446,10 @@ def power_iteration(multi: MultiLevelArrow, x0: np.ndarray,
     and the space-shared carriage holds K copies of the vector that
     must count once.
     """
-    mask_fn = getattr(multi, "carried_mask", None)
-    m = mask_fn() if mask_fn is not None else jnp.float32(1.0)
+    if getattr(multi, "carries_feature_major", False):
+        m = multi.carried_mask()
+    else:
+        m = jnp.float32(1.0)   # flat layouts: pads are zeros
 
     x = multi.set_features(x0.astype(np.float32))
     for _ in range(iterations):

@@ -262,6 +262,9 @@ class MultiLevelArrow:
 
         gather_budget = gather_budget_for(dense_budget)
         self.folded = fmt == "fold"
+        # The carried-layout capability flag the models key on
+        # (SGCCarried/GCNCarried vs the flat SGCModel/GCNModel).
+        self.carries_feature_major = self.folded
         if self.folded:
             self._init_folded(levels, chunk, gather_budget, dtype)
             return
@@ -538,6 +541,33 @@ class MultiLevelArrow:
         return np.asarray(c)[self.inv_perm0][:self.n]
 
     # -- iteration ---------------------------------------------------------
+
+    @property
+    def step_fn(self):
+        """The jitted step callable, public half of the pair
+        ``step(x) == step_fn(x, *step_operands())`` — for callers
+        (models) that trace the step inside their own jit."""
+        return self._step
+
+    def step_operands(self):
+        """The device operands of one step, for callers that trace the
+        step inside their own jit (models): ``step(x) ==
+        step_fn(x, *step_operands())`` — threading these as jit
+        ARGUMENTS keeps them out of the trace as baked constants."""
+        return (self.fwd, self.bwd, self.blocks)
+
+    def carried_mask(self) -> jax.Array:
+        """(1, total_rows) validity mask of the folded feature-major
+        carriage: 1 where a position holds a real original row.  The
+        fold counterpart of ``real_row_mask`` — fold pads carry zeros
+        through the operator, but loss denominators and whole-state
+        reductions must still count only real rows."""
+        if not self.folded:
+            raise ValueError(
+                "carried_mask is defined for fmt='fold' (feature-major "
+                "carriage); the flat layouts use real_row_mask")
+        return jnp.asarray(
+            (self.perm0 < self.n).astype(np.float32)[None, :])
 
     def step(self, x: jax.Array) -> jax.Array:
         """One iteration ``X := A @ X`` through all levels; input and

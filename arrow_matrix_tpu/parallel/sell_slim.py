@@ -852,6 +852,18 @@ class SellMultiLevel:
             np.ascontiguousarray(feat.T),
             NamedSharding(self.mesh, P(self.feat_axis, self.axis)))
 
+    carries_feature_major = True
+
+    @property
+    def step_fn(self):
+        """Jitted step callable (see MultiLevelArrow.step_fn)."""
+        return self._step
+
+    def step_operands(self):
+        """Device operands of one step (see MultiLevelArrow
+        .step_operands)."""
+        return (self._level_args, self.fwd, self.bwd)
+
     def step(self, xt: jax.Array) -> jax.Array:
         return self._step(xt, self._level_args, self.fwd, self.bwd)
 

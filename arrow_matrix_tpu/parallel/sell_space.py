@@ -311,6 +311,18 @@ class SellSpaceShared:
         return (self.body, self.head, self.head_unsort, self.orig_pos,
                 self.bwd0, self.fwd0)
 
+    carries_feature_major = True
+
+    @property
+    def step_fn(self):
+        """Jitted step callable (see MultiLevelArrow.step_fn)."""
+        return self._step
+
+    def step_operands(self):
+        """Device operands of one step (see MultiLevelArrow
+        .step_operands)."""
+        return self._args()
+
     def device_nbytes(self) -> int:
         return (self.body.device_nbytes() + self.head.device_nbytes()
                 + self.orig_pos.size * self.orig_pos.dtype.itemsize)

@@ -284,3 +284,44 @@ def test_sgc_carried_on_feature_major_executors():
     with pytest.raises(ValueError, match="feature-major"):
         SGCCarried(MultiLevelArrow(levels, WIDTH, mesh=None),
                    k_in, k_out)
+
+
+def test_gcn_carried_on_feature_major_executors():
+    """GCNCarried forward parity with the flat GCNModel (same seed) on
+    fold / sell / sell-space, and training THROUGH the distributed
+    step (grads across shard_map psum/ppermute/gathers) converges."""
+    from arrow_matrix_tpu.models.propagation import GCNCarried, GCNModel
+    from arrow_matrix_tpu.parallel import (
+        SellMultiLevel,
+        SellSpaceShared,
+        make_mesh,
+    )
+
+    n, dims = 128, (8, 12, 4)
+    a, levels = _problem(n)
+    assert len(levels) == 2
+    x = random_dense(n, dims[0], seed=2)
+
+    flat = GCNModel(MultiLevelArrow(levels, WIDTH, mesh=None),
+                    dims=dims, seed=0)
+    want = flat.predict(x)
+
+    executors = [
+        MultiLevelArrow(levels, WIDTH, mesh=None, fmt="fold"),
+        SellMultiLevel(levels, WIDTH, make_mesh((4,), ("blocks",))),
+        SellSpaceShared(levels, WIDTH,
+                        make_mesh((2, 2), ("lvl", "blocks"))),
+    ]
+    for multi in executors:
+        m = GCNCarried(multi, dims=dims, seed=0)
+        np.testing.assert_allclose(m.predict(x), want,
+                                   rtol=1e-4, atol=1e-4)
+
+    rng = np.random.default_rng(5)
+    y = rng.standard_normal((n, dims[-1])).astype(np.float32)
+    m = GCNCarried(executors[2], dims=dims, seed=0)
+    losses = m.fit(x, y, steps=60)
+    assert losses[-1] < 0.5 * losses[0], losses[::15]
+
+    with pytest.raises(ValueError, match="feature-major"):
+        GCNCarried(MultiLevelArrow(levels, WIDTH, mesh=None), dims=dims)
