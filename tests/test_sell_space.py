@@ -109,6 +109,23 @@ def test_feat_axis_three_axis_mesh():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_directed_graph_space_shared():
+    """Asymmetric adjacency through the concurrent groups (the runtime
+    operators must be exact on the asymmetric matrix itself)."""
+    n, width = 512, 32
+    a = barabasi_albert(n, 3, seed=43, directed=True)
+    assert (abs(a - a.T)).nnz > 0
+    levels = arrow_decomposition(a, width, max_levels=2,
+                                 block_diagonal=True, seed=2)
+    assert len(levels) == 2
+    ss = SellSpaceShared(levels, width,
+                         make_mesh((2, 4), ("lvl", "blocks")))
+    x = random_dense(n, 4, seed=1)
+    np.testing.assert_allclose(
+        ss.gather_result(ss.step(ss.set_features(x))),
+        decomposition_spmm(levels, x), rtol=1e-4, atol=1e-4)
+
+
 def test_mesh_level_mismatch_raises():
     n, width = 512, 32
     _, levels = two_levels(n, width, seed=19)
