@@ -28,9 +28,11 @@ from arrow_matrix_tpu.models.propagation import (
     gcn_forward,
     gcn_init,
     label_propagation,
+    label_propagation_carried,
     make_gcn_train_step,
     make_train_step,
     pagerank,
+    pagerank_carried,
     power_iteration,
 )
 
@@ -43,8 +45,10 @@ __all__ = [
     "gcn_forward",
     "gcn_init",
     "label_propagation",
+    "label_propagation_carried",
     "make_gcn_train_step",
     "make_train_step",
     "pagerank",
+    "pagerank_carried",
     "power_iteration",
 ]

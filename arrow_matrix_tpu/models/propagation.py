@@ -417,6 +417,61 @@ def _make_carried_train_step(optimizer: optax.GradientTransformation):
     return train_step
 
 
+def pagerank_carried(multi, damping: float = 0.85,
+                     iterations: int = 50) -> np.ndarray:
+    """PageRank on a feature-major executor (fold / SellMultiLevel /
+    SellSpaceShared): ``r := d * A_norm r + (1-d)/n`` like
+    :func:`pagerank`, with the teleport vector scattered through
+    ``set_features`` — that places it at every live carried position
+    (including each slice of the space-shared K-copy carriage), so the
+    iteration needs no executor-specific masking at all."""
+    _check_carried(multi, "pagerank_carried")
+    n = multi.n
+    r = multi.set_features(np.full((n, 1), 1.0 / n, np.float32))
+    tele = multi.set_features(
+        np.full((n, 1), (1.0 - damping) / n, np.float32))
+    operands = multi.step_operands()
+    d = jnp.float32(damping)
+    for _ in range(iterations):
+        r = _pagerank_carried_body(multi.step_fn, r, d, tele, operands)
+    return multi.gather_result(r)
+
+
+def label_propagation_carried(multi, labels: np.ndarray,
+                              seed_mask: np.ndarray,
+                              iterations: int = 20) -> np.ndarray:
+    """Label propagation on a feature-major executor: ``Y := A_norm Y``
+    then clamp seed rows, like :func:`label_propagation` (same default
+    iteration count); the seed values and the seed indicator travel
+    through ``set_features`` so clamping is pure positionwise
+    arithmetic on the carriage."""
+    _check_carried(multi, "label_propagation_carried")
+    labels = labels.astype(np.float32)
+    y = multi.set_features(labels)
+    seeds = multi.set_features(labels * seed_mask[:, None])
+    m = multi.set_features(seed_mask[:, None].astype(np.float32))
+    operands = multi.step_operands()
+    for _ in range(iterations):
+        y = _label_prop_carried_body(multi.step_fn, y, seeds, m,
+                                     operands)
+    return multi.gather_result(y)
+
+
+# Module-level jits with the executor step as a STATIC argument: like
+# the flat _pagerank_body/_label_prop_body, repeated calls hit the jit
+# cache (keyed per step callable) instead of recompiling the whole
+# distributed step program.
+@functools.partial(jax.jit, static_argnums=(0,))
+def _pagerank_carried_body(step_fn, r, d, tele, operands):
+    return d * step_fn(r, *operands) + tele
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _label_prop_carried_body(step_fn, y, seeds, m, operands):
+    y = step_fn(y, *operands)
+    return jnp.where(m > 0, seeds, y)
+
+
 @jax.jit
 def _normalize(y, m):
     """y / ||y * m||.  ``m`` is scalar 1.0 for layouts whose pads are

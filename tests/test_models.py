@@ -325,3 +325,53 @@ def test_gcn_carried_on_feature_major_executors():
 
     with pytest.raises(ValueError, match="feature-major"):
         GCNCarried(MultiLevelArrow(levels, WIDTH, mesh=None), dims=dims)
+
+
+def test_pagerank_and_labelprop_on_carried_executors():
+    """pagerank_carried / label_propagation_carried match the flat
+    drivers bit-for-tolerance on fold, sell, and sell-space — the
+    teleport/seed vectors ride set_features, so every carriage
+    (including the space-shared K-copy one) clamps correctly."""
+    from arrow_matrix_tpu.models.propagation import (
+        label_propagation,
+        label_propagation_carried,
+        pagerank,
+        pagerank_carried,
+    )
+    from arrow_matrix_tpu.parallel import (
+        SellMultiLevel,
+        SellSpaceShared,
+        make_mesh,
+    )
+
+    n, iters = 96, 25
+    a, _ = _problem(n, seed=5)
+    deg = np.maximum(np.asarray(a.sum(axis=0)).ravel(), 1.0)
+    a_norm = (a @ sparse.diags(1.0 / deg)).tocsr()
+    levels = arrow_decomposition(a_norm, arrow_width=WIDTH, max_levels=2,
+                                 block_diagonal=True, seed=5)
+    assert len(levels) == 2
+
+    flat = MultiLevelArrow(levels, WIDTH, mesh=None)
+    want_pr = pagerank(flat, damping=0.85, iterations=iters)
+
+    rng = np.random.default_rng(1)
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    seed_mask = rng.random(n) < 0.2
+    want_lp = label_propagation(flat, labels, seed_mask,
+                                iterations=iters)
+
+    executors = [
+        MultiLevelArrow(levels, WIDTH, mesh=None, fmt="fold"),
+        SellMultiLevel(levels, WIDTH, make_mesh((4,), ("blocks",))),
+        SellSpaceShared(levels, WIDTH,
+                        make_mesh((2, 2), ("lvl", "blocks"))),
+    ]
+    for multi in executors:
+        got_pr = pagerank_carried(multi, damping=0.85, iterations=iters)
+        np.testing.assert_allclose(got_pr, want_pr, rtol=1e-4,
+                                   atol=1e-6, err_msg=str(type(multi)))
+        got_lp = label_propagation_carried(multi, labels, seed_mask,
+                                           iterations=iters)
+        np.testing.assert_allclose(got_lp, want_lp, rtol=1e-4,
+                                   atol=1e-5, err_msg=str(type(multi)))
