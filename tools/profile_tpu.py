@@ -68,6 +68,49 @@ def main():
 
     budget = device_memory_budget(dev)
     fmt = os.environ.get("AMT_PROFILE_FMT", "auto")
+    if fmt in ("sell", "sell-space"):
+        # Feature-major mesh orchestrations: per-level step attribution
+        # (one shard_map'd slim step each) + full chained step.  Mesh
+        # from AMT_PROFILE_DEVICES (default: all).
+        from arrow_matrix_tpu.parallel import (
+            SellMultiLevel,
+            SellSpaceShared,
+            make_mesh,
+        )
+
+        n_dev = int(os.environ.get("AMT_PROFILE_DEVICES",
+                                   len(jax.devices())))
+        x_host = random_dense(n, k, seed=3)
+        if fmt == "sell":
+            sm = SellMultiLevel(levels, width,
+                                make_mesh((n_dev,), ("blocks",)),
+                                routing="a2a")
+            print(f"sell/a2a on {n_dev} devices; "
+                  f"total_out={sm.ops[0].total_out}", flush=True)
+            from arrow_matrix_tpu.parallel.sell_slim import (
+                make_sharded_step,
+            )
+
+            x = sm.set_features(x_host)
+            print(f"full step: {timeit(sm.step, x):.1f} ms", flush=True)
+            steps = [make_sharded_step(sm.mesh, sm.axis, width,
+                                       o.rows_out, hops=o.hops)
+                     for o in sm.ops]
+            for i, (o, st) in enumerate(zip(sm.ops, steps)):
+                f = jax.jit(st)
+                ms_i = timeit(f, o.body, o.head, o.head_unsort,
+                              o.orig_pos, x[:, :o.total_out])
+                print(f"level {i}: hops={o.hops} rows_out={o.rows_out} "
+                      f"{ms_i:.2f} ms", flush=True)
+        else:
+            K = len(levels)
+            sp = SellSpaceShared(levels, width,
+                                 make_mesh((K, max(n_dev // K, 1)),
+                                           ("lvl", "blocks")))
+            x = sp.set_features(x_host)
+            print(f"sell/space on ({K},{max(n_dev // K, 1)}) mesh: "
+                  f"full step {timeit(sp.step, x):.1f} ms", flush=True)
+        return
     multi = MultiLevelArrow(levels, width, mesh=None, fmt=fmt,
                             dense_budget=budget)
     print(f"fmts: {multi.fmts}  total_rows: {multi.total_rows}", flush=True)
