@@ -50,7 +50,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scipy import sparse
 
 from arrow_matrix_tpu.io.graphio import CsrLike, num_rows
-from arrow_matrix_tpu.ops.ell import SLOT_ALIGN, align_up, ell_spmm_t
+from arrow_matrix_tpu.ops.ell import (
+    SLOT_ALIGN,
+    align_up,
+    block_index_dtype,
+    ell_spmm_t,
+)
 
 try:  # jax >= 0.8 promotes shard_map out of experimental
     from jax import shard_map
@@ -450,33 +455,48 @@ def _positions_inv(body_order: np.ndarray, L: int) -> np.ndarray:
     return inv
 
 
+def _local_operand_width(rows_out: int, w: int, hops: int, L: int) -> int:
+    """Width of the z operand one device's tiered SpMM gathers from:
+    [tiered rows | head arm w | lo halos hops*L | hi halos hops*L] —
+    must mirror _slim_shares' share width (L + w + 2H) after the
+    local-part remap to rows_out, and _slim_local_step's z concat.
+    The ONE bound the int16 index decision keys on."""
+    return rows_out + w + 2 * hops * L
+
+
 def _remap_body_cols(body: SellShardStack, inv: np.ndarray, L: int,
-                     rows_out: int) -> SellShardStack:
+                     rows_out: int, w: int, hops: int) -> SellShardStack:
     """Body column remap: share column c ->
       [0, L): local -> tiered position;   [L, L+w): head -> R + (c-L)
       [L+w, L+w+H): lo halo;              [L+w+H, L+w+2H): hi halo
-    (halo regions pass through at the same offsets past R)."""
+    (halo regions pass through at the same offsets past R).
+    Indices narrow to int16 whenever the local operand width fits
+    (half the streamed index bytes — the block_index_dtype rule of the
+    stacked formats, ops/ell.py)."""
     R = rows_out
+    idx_dtype = block_index_dtype(_local_operand_width(rows_out, w,
+                                                       hops, L))
     remapped = []
     for cols in body.cols:
         c = np.asarray(cols)
-        out = np.empty_like(c)
+        out = np.empty(c.shape, dtype=idx_dtype)
         for d in range(c.shape[0]):
             cd = c[d].astype(np.int64)
             local = inv[d, np.minimum(cd, L - 1)]
-            out[d] = np.where(cd < L, local, R + (cd - L)).astype(np.int32)
+            out[d] = np.where(cd < L, local, R + (cd - L)).astype(idx_dtype)
         remapped.append(jnp.asarray(out))
     return body.replace(cols=tuple(remapped))
 
 
-def _remap_head_cols(head: SellShardStack, inv: np.ndarray,
-                     L: int) -> SellShardStack:
+def _remap_head_cols(head: SellShardStack, inv: np.ndarray, L: int,
+                     rows_out: int) -> SellShardStack:
+    idx_dtype = block_index_dtype(rows_out)
     remapped_head = []
     for cols in head.cols:
         c = np.asarray(cols)
-        out = np.empty_like(c)
+        out = np.empty(c.shape, dtype=idx_dtype)
         for d in range(c.shape[0]):
-            out[d] = inv[d, np.minimum(c[d], L - 1)].astype(np.int32)
+            out[d] = inv[d, np.minimum(c[d], L - 1)].astype(idx_dtype)
         remapped_head.append(jnp.asarray(out))
     return head.replace(cols=tuple(remapped_head))
 
@@ -521,8 +541,8 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
             "(stable zero-tier sort invariant)")
 
     inv = _positions_inv(body_order, L)
-    body = _remap_body_cols(body, inv, L, rows_out)
-    head = _remap_head_cols(head, inv, L)
+    body = _remap_body_cols(body, inv, L, rows_out, w, hops)
+    head = _remap_head_cols(head, inv, L, rows_out)
 
     if not np.all(head_order[0] == head_order):
         raise AssertionError("head tier ordering must be "

@@ -175,8 +175,8 @@ class SellSpaceShared:
                     f"device-independent within the group")
 
         inv = _positions_inv(body_order, L)
-        body = _remap_body_cols(body, inv, L, rows_out)
-        head = _remap_head_cols(head, inv, L)
+        body = _remap_body_cols(body, inv, L, rows_out, w, hops)
+        head = _remap_head_cols(head, inv, L, rows_out)
         # head_unsort[g][j] = tiered head position of head row j.  The
         # cross-group tier unification maxes tier counts over ALL
         # groups, so a group whose bucket is smaller gets -1 padding
