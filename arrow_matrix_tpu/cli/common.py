@@ -41,12 +41,45 @@ def add_device_args(parser: argparse.ArgumentParser) -> None:
              "Implies --device cpu.")
 
 
+def add_distributed_args(parser: argparse.ArgumentParser) -> None:
+    """Multi-process launch flags (the mpiexec-rank analog: one OS
+    process per host, `jax.distributed` joins them into one runtime).
+
+    Launch N processes with the same --coordinator/--num-processes and
+    distinct --process-id 0..N-1; on TPU pods the three are
+    auto-detected and none is needed.
+    """
+    parser.add_argument(
+        "--coordinator", type=str, default=None,
+        help="host:port of process 0's coordination service; enables "
+             "multi-process execution (jax.distributed.initialize).")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+
+
 def setup_platform(args: argparse.Namespace) -> None:
-    """Pin the JAX platform per --device/--devices (must run before
+    """Pin the JAX platform per --device/--devices, and join the
+    multi-process runtime when --coordinator is given (must run before
     anything initializes a JAX backend)."""
     from arrow_matrix_tpu.utils.platform import force_cpu_devices
 
-    if args.device == "cpu" or args.devices > 0:
+    coordinator = getattr(args, "coordinator", None)
+    cpu = args.device == "cpu" or args.devices > 0
+    if coordinator is not None:
+        from arrow_matrix_tpu.parallel.mesh import initialize_multihost
+
+        if cpu:
+            # Pin + gloo even without an explicit count (--device cpu
+            # alone must behave like the single-process path).
+            import jax
+
+            force_cpu_devices(args.devices if args.devices > 0 else None)
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        initialize_multihost(coordinator, args.num_processes,
+                             args.process_id)
+        return
+    if cpu:
         force_cpu_devices(args.devices if args.devices > 0 else None)
     elif args.device == "tpu":
         os.environ.setdefault("JAX_PLATFORMS", "tpu")

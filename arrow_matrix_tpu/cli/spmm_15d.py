@@ -17,6 +17,7 @@ import numpy as np
 
 from arrow_matrix_tpu.cli.common import (
     add_device_args,
+    add_distributed_args,
     load_sparse_matrix,
     normalize_scale,
     random_adjacency,
@@ -49,6 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-z", "--iterations", type=int, default=10)
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
+    add_distributed_args(parser)
     return parser
 
 

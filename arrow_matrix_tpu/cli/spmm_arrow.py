@@ -26,6 +26,7 @@ import numpy as np
 
 from arrow_matrix_tpu.cli.common import (
     add_device_args,
+    add_distributed_args,
     setup_platform,
     str2bool,
 )
@@ -145,6 +146,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
+    add_distributed_args(parser)
     return parser
 
 
@@ -193,14 +195,24 @@ def main(argv=None) -> int:
         # Generate + decompose + save (reference arrow_bench.py:28-41).
         width = width or 512
         n = args.vertices
-        print(f"generating Barabasi-Albert graph n={n} "
-              f"m={args.ba_neighbors}")
-        a = graphs.barabasi_albert(n, args.ba_neighbors, seed=args.seed)
-        levels = arrow_decomposition(a, arrow_width=width, max_levels=10,
-                                     block_diagonal=args.blocked,
-                                     seed=args.seed, backend=args.backend)
         base = os.path.join(".", f"ba_{n}_{args.ba_neighbors}")
-        save_decomposition(levels, base, block_diagonal=args.blocked)
+        # Multi-process: only process 0 generates and writes (the
+        # reference's rank-0 generate + barrier, arrow_bench.py:28-41);
+        # everyone loads the shared artifact after a cross-process sync.
+        if jax.process_index() == 0:
+            print(f"generating Barabasi-Albert graph n={n} "
+                  f"m={args.ba_neighbors}")
+            a = graphs.barabasi_albert(n, args.ba_neighbors,
+                                       seed=args.seed)
+            levels = arrow_decomposition(
+                a, arrow_width=width, max_levels=10,
+                block_diagonal=args.blocked, seed=args.seed,
+                backend=args.backend)
+            save_decomposition(levels, base, block_diagonal=args.blocked)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("decomposition_saved")
         path = base
     else:
         path = args.path
@@ -230,7 +242,10 @@ def main(argv=None) -> int:
     # already-created backend; sub-meshes can).
     n_dev = len(jax.devices())
     if args.devices > 0:
-        n_dev = min(n_dev, args.devices)
+        # Under --coordinator, --devices counts THIS process's local
+        # devices; the mesh is global (every process must drive every
+        # device of a multi-controller mesh).
+        n_dev = min(n_dev, args.devices * jax.process_count())
     # Version-string run name (reference arrow_bench.py:43-47 pattern),
     # derived from what actually runs: slim-style sharding, banded or
     # block-diagonal tiling, time- or space-shared level execution.

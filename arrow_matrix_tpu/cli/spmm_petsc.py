@@ -23,6 +23,7 @@ from scipy import sparse
 
 from arrow_matrix_tpu.cli.common import (
     add_device_args,
+    add_distributed_args,
     load_sparse_matrix,
     normalize_scale,
     random_adjacency,
@@ -72,6 +73,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "disables chunking.")
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
+    add_distributed_args(parser)
     return parser
 
 

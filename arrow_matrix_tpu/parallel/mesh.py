@@ -8,6 +8,7 @@ collectives over a subset of axes.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -54,6 +55,54 @@ def blocks_sharding(mesh: Mesh, axis: str = "blocks") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def put_global(x, sharding: NamedSharding) -> jax.Array:
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Single-process (every device of the sharding is local): plain
+    ``jax.device_put`` — the fast path, unchanged.  Multi-process: each
+    process materializes ONLY its addressable shards via
+    ``jax.make_array_from_callback`` (``device_put`` of a host array
+    onto non-addressable devices is an error).  With a memmapped ``x``
+    the callback slicing means each host reads only its own shards from
+    disk — the IO-parallel loading of the reference's per-rank slice
+    files (reference arrow/baseline/spmm_petsc.py:421-440), for free.
+    """
+    if all(d.process_index == jax.process_index()
+           for d in sharding.device_set):
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    # dtype explicitly: a process holding NO shard of this array (e.g.
+    # a replicated table on a sub-mesh owned by other processes) cannot
+    # infer it from its (empty) shard list.
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: np.ascontiguousarray(x[idx]),
+        dtype=x.dtype)
+
+
+def fetch_replicated(arr) -> np.ndarray:
+    """Global (possibly multi-process) array -> host numpy, identical on
+    every process.
+
+    Fully-addressable arrays convert directly.  Otherwise the array is
+    resharded to fully-replicated — one XLA all-gather across hosts
+    (riding ICI/DCN; the counterpart of the reference's result
+    ``Gather`` to rank 0, reference arrow/arrow_slim_mpi.py:423) — and
+    every process reads its now-local copy.
+    """
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    repl = NamedSharding(arr.sharding.mesh, P())
+    arr = _replicator(repl)(arr)
+    return np.asarray(arr.addressable_data(0))
+
+
+@functools.lru_cache(maxsize=None)
+def _replicator(repl: NamedSharding):
+    # One jitted identity per target sharding: a fresh lambda per fetch
+    # would miss the jit cache and recompile the all-gather every call.
+    return jax.jit(lambda a: a, out_shardings=repl)
+
+
 def shard_blocked(x, mesh: Mesh, axis: str = "blocks") -> jax.Array:
     """Place a blocked (nb, ...) array with its leading axis sharded.
 
@@ -66,7 +115,7 @@ def shard_blocked(x, mesh: Mesh, axis: str = "blocks") -> jax.Array:
     if nb % n_dev != 0:
         raise ValueError(f"{nb} blocks not divisible by {n_dev} devices "
                          f"on axis {axis!r}; pad with pad_blocks_to")
-    return jax.device_put(x, blocks_sharding(mesh, axis))
+    return put_global(x, blocks_sharding(mesh, axis))
 
 
 def shard_arrow_blocks(blocks, mesh: Mesh, axis: str = "blocks"):
@@ -83,7 +132,8 @@ def pad_to_multiple(nb: int, n_dev: int) -> int:
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> int:
+                         process_id: Optional[int] = None,
+                         cpu_devices: Optional[int] = None) -> int:
     """Join a multi-host JAX runtime (the framework's scale-out story;
     the counterpart of the reference's MPI launch across nodes,
     reference README.md:10 Cray-MPICH).
@@ -92,10 +142,22 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     single-SPMD-program code runs unchanged — collectives ride ICI
     within a slice and DCN across slices.  On TPU pods the arguments
     are auto-detected from the environment; pass them explicitly for
-    CPU/GPU clusters.  Returns this process's index.
+    CPU clusters.  Returns this process's index.
+
+    ``cpu_devices``: pin this process to the host CPU with that many
+    virtual devices and gloo cross-process collectives BEFORE joining —
+    the multi-process testing fixture (the reference's ``mpiexec -n``
+    analog with real process boundaries, reference
+    scripts/run_tests.sh), and the CPU-cluster path.  Must be the
+    process's first backend touch.
     """
     import jax
 
+    if cpu_devices is not None:
+        from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(cpu_devices)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

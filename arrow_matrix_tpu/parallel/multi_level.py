@@ -59,7 +59,9 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
 )
 from arrow_matrix_tpu.ops.hyb import HybLevel
 from arrow_matrix_tpu.parallel.mesh import (
+    fetch_replicated,
     pad_to_multiple,
+    put_global,
     shard_arrow_blocks,
 )
 
@@ -369,8 +371,8 @@ class MultiLevelArrow:
             else:
                 # Routing tables replicated (they index global rows).
                 repl = NamedSharding(mesh, P())
-                self.fwd = jax.device_put(fwd, repl)
-                self.bwd = jax.device_put(bwd, repl)
+                self.fwd = put_global(np.asarray(fwd), repl)
+                self.bwd = put_global(np.asarray(bwd), repl)
         else:
             self.fwd = jnp.asarray(fwd)
             self.bwd = jnp.asarray(bwd)
@@ -502,7 +504,7 @@ class MultiLevelArrow:
         flat sharded device array."""
         if self.mesh is None:
             return jnp.asarray(x_level0)
-        return jax.device_put(x_level0, self._rows_sharding())
+        return put_global(x_level0, self._rows_sharding())
 
     def set_features(self, x_original: np.ndarray) -> jax.Array:
         """Host (n, k) features in *original* row order -> device array in
@@ -538,7 +540,7 @@ class MultiLevelArrow:
         original row order (reference allgather_result analog)."""
         if self.folded:
             return np.asarray(c).T[self.inv_perm0][:self.n]
-        return np.asarray(c)[self.inv_perm0][:self.n]
+        return fetch_replicated(c)[self.inv_perm0][:self.n]
 
     # -- iteration ---------------------------------------------------------
 

@@ -158,9 +158,11 @@ def shard_route(route: RouteTables, mesh: Mesh,
     recipe for all callers)."""
     from jax.sharding import NamedSharding
 
+    from arrow_matrix_tpu.parallel.mesh import put_global
+
     shard = NamedSharding(mesh, P(axis))
     return jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, shard), route)
+        lambda a: put_global(np.asarray(a), shard), route)
 
 
 def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,

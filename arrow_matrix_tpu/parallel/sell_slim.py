@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scipy import sparse
 
 from arrow_matrix_tpu.io.graphio import CsrLike, num_rows
+from arrow_matrix_tpu.parallel.mesh import fetch_replicated, put_global
 from arrow_matrix_tpu.ops.ell import (
     SLOT_ALIGN,
     align_up,
@@ -552,14 +553,13 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
     shard_stack = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     body = jax.tree_util.tree_map(
-        lambda arr: jax.device_put(arr, shard_stack), body)
+        lambda arr: put_global(arr, shard_stack), body)
     head = jax.tree_util.tree_map(
-        lambda arr: jax.device_put(arr, shard_stack), head)
+        lambda arr: put_global(arr, shard_stack), head)
     return SlimLevelOps(
         body=body, head=head,
-        head_unsort=jax.device_put(jnp.asarray(head_unsort), repl),
-        orig_pos=jax.device_put(jnp.asarray(inv.astype(np.int32)),
-                                shard_stack),
+        head_unsort=put_global(head_unsort, repl),
+        orig_pos=put_global(inv.astype(np.int32), shard_stack),
         body_order=body_order, rows_out=rows_out, shard_len=L,
         n_dev=n_dev, width=w, hops=hops, binary=binary)
 
@@ -687,8 +687,8 @@ class SellSlim:
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
         feat = _scatter_carried(x, self._oop, n)
-        return jax.device_put(np.ascontiguousarray(feat.T),
-                              self._feature_sharding())
+        return put_global(np.ascontiguousarray(feat.T),
+                          self._feature_sharding())
 
     def spmm(self, xt: jax.Array) -> jax.Array:
         """One distributed SpMM step; feature-major in and out (iterate
@@ -698,7 +698,7 @@ class SellSlim:
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         """Device (k, total_out) -> host (n, k) in original row order."""
-        return _gather_carried(np.asarray(ct).T, self._oop, self.n)
+        return _gather_carried(fetch_replicated(ct).T, self._oop, self.n)
 
 
 class SellMultiLevel:
@@ -794,7 +794,7 @@ class SellMultiLevel:
                 rt = build_route(idx, n_dev, src_total=src_total_out,
                                  pad_mask=dst_oop < 0)
                 return shard_route(rt, mesh, axis)
-            return jax.device_put(jnp.asarray(idx.astype(np.int32)), repl)
+            return put_global(idx.astype(np.int32), repl)
 
         k_levels = len(levels)
         self.fwd = [route(orig_of_pos[i], pos_of_orig[i - 1],
@@ -868,7 +868,7 @@ class SellMultiLevel:
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
         feat = _scatter_carried(x, self._orig_of_pos0, n)
-        return jax.device_put(
+        return put_global(
             np.ascontiguousarray(feat.T),
             NamedSharding(self.mesh, P(self.feat_axis, self.axis)))
 
@@ -892,7 +892,7 @@ class SellMultiLevel:
                           n=iterations)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
-        return _gather_carried(np.asarray(ct).T, self._orig_of_pos0,
+        return _gather_carried(fetch_replicated(ct).T, self._orig_of_pos0,
                                self.n)
 
     def carried_mask(self) -> jax.Array:
@@ -902,5 +902,5 @@ class SellMultiLevel:
         power iteration) must mask pads: after a step they hold routed
         filler, not zeros."""
         m = _live(self._orig_of_pos0, self.n).astype(np.float32)[None, :]
-        return jax.device_put(
+        return put_global(
             m, NamedSharding(self.mesh, P(None, self.axis)))

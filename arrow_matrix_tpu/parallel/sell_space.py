@@ -52,7 +52,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from arrow_matrix_tpu.io.graphio import num_rows
 from arrow_matrix_tpu.ops.ell import align_up
-from arrow_matrix_tpu.parallel.mesh import make_mesh
+from arrow_matrix_tpu.parallel.mesh import (fetch_replicated, make_mesh,
+                                             put_global)
 from arrow_matrix_tpu.parallel.sell_slim import (
     _banded_reach_hops,
     _carried_maps,
@@ -234,17 +235,13 @@ class SellSpaceShared:
         self._feat_sharding = NamedSharding(
             mesh, P(feat_axis, (lvl_axis, axis)))
         self.body = jax.tree_util.tree_map(
-            lambda a_: jax.device_put(a_, both), body)
+            lambda a_: put_global(a_, both), body)
         self.head = jax.tree_util.tree_map(
-            lambda a_: jax.device_put(a_, both), head)
-        self.head_unsort = jax.device_put(jnp.asarray(head_unsort),
-                                          lvl_only)
-        self.orig_pos = jax.device_put(
-            jnp.asarray(inv.astype(np.int32)), both)
-        self.bwd0 = jax.device_put(
-            jnp.asarray(bwd0.astype(np.int32)), lvl_only)
-        self.fwd0 = jax.device_put(
-            jnp.asarray(fwd0.astype(np.int32)), lvl_only)
+            lambda a_: put_global(a_, both), head)
+        self.head_unsort = put_global(head_unsort, lvl_only)
+        self.orig_pos = put_global(inv.astype(np.int32), both)
+        self.bwd0 = put_global(bwd0.astype(np.int32), lvl_only)
+        self.fwd0 = put_global(fwd0.astype(np.int32), lvl_only)
 
         # Concurrent slim step over BOTH mesh axes: the per-group body
         # IS sell_slim's shared step body — its collectives name only
@@ -336,8 +333,8 @@ class SellSpaceShared:
         feat = np.concatenate(
             [_scatter_carried(x, self._orig_of_pos[g], n)
              for g in range(self.k_levels)])
-        return jax.device_put(np.ascontiguousarray(feat.T),
-                              self._feat_sharding)
+        return put_global(np.ascontiguousarray(feat.T),
+                          self._feat_sharding)
 
     def step(self, xt: jax.Array) -> jax.Array:
         return self._step(xt, *self._args())
@@ -348,8 +345,9 @@ class SellSpaceShared:
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         """Device (k, K * total_out) -> host (n, k) original order
         (level 0's slice IS the canonical aggregate)."""
-        return _gather_carried(np.asarray(ct[:, :self.total_out]).T,
-                               self._orig_of_pos[0], self.n)
+        return _gather_carried(
+            fetch_replicated(ct[:, :self.total_out]).T,
+            self._orig_of_pos[0], self.n)
 
     def carried_mask(self) -> jax.Array:
         """(1, K * total_out) f32 validity mask: live positions of the
@@ -362,6 +360,6 @@ class SellSpaceShared:
         m[0, :T] = _live(self._orig_of_pos[0], self.n).astype(np.float32)
         # Size-1 feature dim: replicate over feat_axis (it cannot
         # shard), positions follow the carriage.
-        return jax.device_put(
+        return put_global(
             m, NamedSharding(self.mesh,
                              P(None, (self.lvl_axis, self.axis))))

@@ -60,7 +60,8 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
     arrow_blocks_from_csr,
     arrow_spmm,
 )
-from arrow_matrix_tpu.parallel.mesh import make_mesh, pad_to_multiple
+from arrow_matrix_tpu.parallel.mesh import (fetch_replicated, make_mesh,
+                                             pad_to_multiple, put_global)
 from arrow_matrix_tpu.parallel.multi_level import pad_permutation
 
 
@@ -218,9 +219,10 @@ class SpaceSharedArrow:
         lvl_rows = NamedSharding(mesh, P(lvl_axis, axis))
         lvl_only = NamedSharding(mesh, P(lvl_axis))
         self.blocks = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, lvl_rows), blocks)
-        self.bwd0 = jax.device_put(bwd0.astype(np.int32), lvl_only)
-        self.fwd0 = jax.device_put(fwd0.astype(np.int32), lvl_only)
+            lambda a: put_global(a, lvl_rows), blocks)
+        self._fwd0_host = fwd0.astype(np.int32)
+        self.bwd0 = put_global(bwd0.astype(np.int32), lvl_only)
+        self.fwd0 = put_global(self._fwd0_host, lvl_only)
 
         # The ELL gather intermediate of one level shards only over the
         # block axis, and each device runs exactly one level (lvl axis
@@ -255,14 +257,14 @@ class SpaceSharedArrow:
         padded = np.zeros((self.total_rows, k), dtype=x_original.dtype)
         padded[:n] = x_original
         x0 = padded[self.perm0]
-        x_all = x0[np.asarray(self.fwd0)]          # (K, total, k)
-        return jax.device_put(
+        x_all = x0[self._fwd0_host]                # (K, total, k)
+        return put_global(
             x_all, NamedSharding(self.mesh, P(self.lvl_axis, self.axis)))
 
     def gather_result(self, x_all: jax.Array) -> np.ndarray:
         """(K, total, k) device result -> host (n, k) in original row
         order (level 0's slice IS the canonical aggregate)."""
-        return np.asarray(x_all[0])[self.inv_perm0][:self.n]
+        return fetch_replicated(x_all[0])[self.inv_perm0][:self.n]
 
     def step(self, x_all: jax.Array) -> jax.Array:
         return self._step(x_all, self.bwd0, self.fwd0, self.blocks)

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from arrow_matrix_tpu.parallel.mesh import fetch_replicated, put_global
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scipy import sparse
 
@@ -155,8 +157,8 @@ class SpMM15D:
             chunk = ("auto", int(per_dev))
 
         spec_a = NamedSharding(mesh, P(rows_axis, repl_axis))
-        self.a_cols = jax.device_put(cols, spec_a)
-        self.a_data = jax.device_put(data, spec_a)
+        self.a_cols = put_global(cols, spec_a)
+        self.a_data = put_global(data, spec_a)
         del cols, data, blocks
 
         rounds = self.rounds
@@ -215,8 +217,8 @@ class SpMM15D:
         padded = np.zeros((total, k), dtype=x.dtype)
         padded[:nk] = x
         blocked = padded.reshape(self.p_div_c, self.l_nkb, k)
-        return jax.device_put(blocked,
-                              NamedSharding(self.mesh, P(self.rows_axis)))
+        return put_global(blocked,
+                          NamedSharding(self.mesh, P(self.rows_axis)))
 
     def spmm(self, x: jax.Array) -> jax.Array:
         """One distributed SpMM: blocked X (p/c, l_nkb, k) ->
@@ -232,5 +234,5 @@ class SpMM15D:
 
     def gather_result(self, y: jax.Array) -> np.ndarray:
         """Blocked (p/c, c, l_ni, k) device result -> host (ni, k)."""
-        arr = np.asarray(y[:, 0])
+        arr = fetch_replicated(y[:, 0])
         return arr.reshape(-1, arr.shape[-1])[:self.shape[0]]

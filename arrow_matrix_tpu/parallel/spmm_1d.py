@@ -44,6 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from arrow_matrix_tpu.parallel.mesh import fetch_replicated, put_global
 from scipy import sparse
 
 try:  # jax >= 0.8 promotes shard_map out of experimental
@@ -217,11 +219,11 @@ class MatrixSlice1D:
                 per_dev = max(budget - block_bytes / max(n_dev, 1), floor)
             chunk = ("auto", int(per_dev))
 
-        self.l_cols = jax.device_put(l_cols, shard)
-        self.l_data = jax.device_put(l_data, shard)
-        self.nl_cols = jax.device_put(nl_cols, shard)
-        self.nl_data = jax.device_put(nl_data, shard)
-        self.send_idx = jax.device_put(send_idx[:, None], shard)  # (n_dev,1,n_dev,slot)
+        self.l_cols = put_global(l_cols, shard)
+        self.l_data = put_global(l_data, shard)
+        self.nl_cols = put_global(nl_cols, shard)
+        self.nl_data = put_global(nl_data, shard)
+        self.send_idx = put_global(send_idx[:, None], shard)  # (n_dev,1,n_dev,slot)
 
         slot = self.slot
         l_rows = self.l_rows
@@ -275,8 +277,8 @@ class MatrixSlice1D:
         blocked = np.zeros((self.n_dev, self.l_rows, k), dtype=x.dtype)
         for d, (lo, hi) in enumerate(self.slices):
             blocked[d, :hi - lo] = x[lo:hi]
-        return jax.device_put(blocked,
-                              NamedSharding(self.mesh, P(self.axis)))
+        return put_global(blocked,
+                          NamedSharding(self.mesh, P(self.axis)))
 
     def spmm(self, x: jax.Array) -> jax.Array:
         """One distributed SpMM preserving the blocked layout."""
@@ -285,7 +287,7 @@ class MatrixSlice1D:
 
     def gather_result(self, y: jax.Array) -> np.ndarray:
         """Blocked (n_dev, l_rows, k) device result -> host (n, k)."""
-        arr = np.asarray(y)
+        arr = fetch_replicated(y)
         out = np.empty((self.n, arr.shape[-1]), dtype=arr.dtype)
         for d, (lo, hi) in enumerate(self.slices):
             out[lo:hi] = arr[d, :hi - lo]

@@ -42,8 +42,13 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
         ckpt.save(path, {"x": x, "step": np.int64(step)}, force=True)
         return
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from arrow_matrix_tpu.parallel.mesh import fetch_replicated
+
+    x_host = fetch_replicated(x)   # collective: every process joins
+    if jax.process_index() != 0:
+        return                     # one writer (shared filesystem)
     tmp = path + ".tmp.npz"
-    np.savez(tmp, x=np.asarray(x), step=np.int64(step))
+    np.savez(tmp, x=x_host, step=np.int64(step))
     os.replace(tmp, path + ".npz")
 
 
@@ -78,8 +83,10 @@ def load_state(path: str, like: Optional[jax.Array] = None
         with np.load(path + ".npz") as z:
             x, step = z["x"], int(z["step"])
         if like is not None:
-            x = jax.device_put(np.asarray(x, dtype=like.dtype),
-                               like.sharding)
+            from arrow_matrix_tpu.parallel.mesh import put_global
+
+            x = put_global(np.asarray(x, dtype=like.dtype),
+                           like.sharding)
     else:
         return None
     if like is not None and tuple(x.shape) != tuple(like.shape):

@@ -1,0 +1,70 @@
+"""Child process of the multi-process distributed test (the reference's
+``mpiexec -n`` analog with REAL process boundaries, reference
+scripts/run_tests.sh): joins a 2-process gloo-backed JAX runtime, builds
+the feature-major multi-level executor over the GLOBAL mesh (devices
+spanning both processes), iterates, and checks against the host golden.
+
+Run by tests/test_multihost.py; usable standalone:
+
+    python tests/_multihost_child.py <pid> <nproc> <port> &
+    python tests/_multihost_child.py <pid+1> <nproc> <port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from arrow_matrix_tpu.parallel.mesh import initialize_multihost
+
+    try:
+        initialize_multihost(f"127.0.0.1:{port}", nproc, pid,
+                             cpu_devices=2)
+    except Exception as e:  # no gloo in this jaxlib, firewalled, ...
+        print(f"CHILD_SKIP {type(e).__name__}: {e}", flush=True)
+        return
+
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == nproc
+    n_global = len(jax.devices())
+    assert n_global == 2 * nproc, n_global
+    assert len(jax.local_devices()) == 2
+
+    from arrow_matrix_tpu.decomposition.decompose import (
+        arrow_decomposition,
+        decomposition_spmm,
+    )
+    from arrow_matrix_tpu.parallel.mesh import fetch_replicated, make_mesh
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert
+    from arrow_matrix_tpu.utils.numerics import relative_error
+
+    # Every process derives the same inputs from the seed (the reference
+    # likewise regenerates rank-deterministic test data per rank).
+    n, width, k, iters = 256, 32, 8, 2
+    a = barabasi_albert(n, 4, seed=5)
+    levels = arrow_decomposition(a, arrow_width=width, max_levels=3,
+                                 block_diagonal=True, seed=5)
+    x = np.random.default_rng(3).uniform(-1, 1, (n, k)).astype(np.float32)
+
+    mesh = make_mesh((n_global,), ("blocks",))
+    ml = SellMultiLevel(levels, width, mesh, routing="a2a")
+    xt = ml.set_features(x)
+    assert not xt.is_fully_addressable   # the point of this test
+    out = ml.gather_result(ml.run(xt, iters))
+
+    want = x
+    for _ in range(iters):
+        want = decomposition_spmm(levels, want)
+    err = relative_error(out, want)
+    print(f"CHILD_OK pid={pid} devices={n_global} err={err:.2e}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
