@@ -266,7 +266,11 @@ def ell_spmm_t(cols: jax.Array, x_t: jax.Array,
     def contribution(cols_c, w_c):
         g = jnp.take(x_t, cols_c.reshape(-1), axis=1)
         g = g.reshape(k, c, rows)
-        return (g * w_c[None].astype(g.dtype)).sum(axis=1)
+        # f32 accumulation whatever the carried feature dtype: bf16
+        # features (half the gathered bytes — the k=128 bandwidth
+        # lever) must not also mean bf16 sums.  No-op for f32 inputs.
+        return (g * w_c[None].astype(g.dtype)).sum(
+            axis=1, dtype=jnp.float32)
 
     if n_chunks == 1:
         if data is not None:

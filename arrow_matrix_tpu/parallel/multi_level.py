@@ -169,7 +169,7 @@ class MultiLevelArrow:
                  chunk="auto", fmt: str = "auto",
                  dense_budget: Optional[int] = None, kernel: str = "xla",
                  routing: str = "gather", head_fmt: str = "auto",
-                 binary="auto"):
+                 binary="auto", feature_dtype=None):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -181,6 +181,21 @@ class MultiLevelArrow:
         if not levels:
             raise ValueError("empty decomposition")
         dtype = resolve_block_dtype(dtype)
+        # Carried-feature storage dtype (None keeps the caller's f32).
+        # bf16 halves the bytes every gathered row moves — the
+        # amortization lever at k=128, where the gather turns
+        # bandwidth-bound (PERFORMANCE.md cost model); accumulation
+        # stays f32 in the kernels, but iterated results round to bf16
+        # each step, so this is an opt-in accuracy trade (~1e-3 rel
+        # err/step) outside the f32 benchmark gate.
+        self.feature_dtype = (None if feature_dtype is None
+                              else resolve_block_dtype(feature_dtype))
+        if self.feature_dtype == np.float32:
+            self.feature_dtype = None   # f32 IS the universal carriage
+        if self.feature_dtype is not None and fmt != "fold":
+            raise ValueError(
+                "feature_dtype is implemented for fmt='fold' (the "
+                "single-chip headline path); other formats carry f32")
         if routing not in ("gather", "a2a"):
             raise ValueError(f"unknown routing {routing!r}")
         if head_fmt == "gell" and mesh is not None:
@@ -518,7 +533,11 @@ class MultiLevelArrow:
         padded = np.zeros((self.total_rows, k), dtype=x_original.dtype)
         padded[:n] = x_original
         if self.folded:
-            return jnp.asarray(np.ascontiguousarray(padded[self.perm0].T))
+            feat = padded[self.perm0]
+            if self.feature_dtype is not None:
+                feat = feat.astype(self.feature_dtype)  # before the big
+                # transpose copy: half the bytes at 2^24-row scale
+            return jnp.asarray(np.ascontiguousarray(feat.T))
         return self.place_features(padded[self.perm0])
 
     def real_row_mask(self, dtype=np.float32) -> jax.Array:
@@ -539,7 +558,10 @@ class MultiLevelArrow:
         """Device result (level-0 order, flat) -> host (n, k) array in
         original row order (reference allgather_result analog)."""
         if self.folded:
-            return np.asarray(c).T[self.inv_perm0][:self.n]
+            # bf16-carried results come back as f32 numpy (downstream
+            # host math — goldens, norms — has no bf16 arithmetic).
+            return np.asarray(c, dtype=np.float32).T[
+                self.inv_perm0][:self.n]
         return fetch_replicated(c)[self.inv_perm0][:self.n]
 
     # -- iteration ---------------------------------------------------------

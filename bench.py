@@ -518,6 +518,10 @@ def run_bench(result: dict, platform: str, device_kind: str) -> None:
 # cheap one before the first expensive one.
 COMPARE_VARIANTS = {
     "fold": dict(fmt="fold"),             # composed single-operator SELL
+    # bf16-carried features (f32 accumulation): half the bytes per
+    # gathered row — the amortization lever where the gather turns
+    # bandwidth-bound (k=128); outside the f32 gate, diagnostics only.
+    "fold_featbf16": dict(fmt="fold", feature_dtype="bf16"),
     "hyb": dict(fmt="hyb"),
     "ell": dict(fmt="ell"),               # platform-aware auto head
     # Head-stack kernel isolation: flat-COO head = scatter-add (TPU

@@ -193,6 +193,34 @@ def test_fold_matches_golden_and_iterates():
                                rtol=1e-3, atol=1e-4)
 
 
+def test_fold_bf16_features():
+    """feature_dtype='bf16' halves the carried-feature bytes (the
+    k=128 amortization lever) with f32 accumulation: results track the
+    f32 path to bf16 rounding, and the carriage dtype is bf16."""
+    import ml_dtypes
+
+    n, width = 480, 32
+    a = barabasi_albert(n, 6, seed=19)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    x_host = random_dense(n, 8, seed=3)
+    want = decomposition_spmm(levels, x_host)
+
+    ml = MultiLevelArrow(levels, width, mesh=None, fmt="fold",
+                         feature_dtype="bf16")
+    xd = ml.set_features(x_host)
+    assert xd.dtype == ml_dtypes.bfloat16
+    out = ml.gather_result(ml.step(xd))
+    assert out.dtype == np.float32
+    rel = (np.linalg.norm(out - want) / np.linalg.norm(want))
+    assert rel < 2e-2, rel          # bf16 inputs: ~8-bit mantissa
+
+    # Other formats must refuse (carriage stays f32 there).
+    with pytest.raises(ValueError, match="feature_dtype"):
+        MultiLevelArrow(levels, width, mesh=None, fmt="hyb",
+                        feature_dtype="bf16")
+
+
 def test_fold_equals_per_level_paths():
     """fold and the per-level hyb/ell paths are the same operator."""
     n, width = 320, 32
