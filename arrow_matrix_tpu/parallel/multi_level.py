@@ -93,6 +93,18 @@ def resolve_block_dtype(dtype):
     return dtype
 
 
+def resolve_feature_dtype(feature_dtype):
+    """Carried-feature storage dtype (None = f32, the gate-exact
+    default — normalized so explicit "f32" behaves like None).  bf16
+    halves the bytes of every gathered row AND every inter-level
+    collective; kernels still accumulate in f32 (ops/ell.py), but
+    per-step rounding (~1e-3 rel) puts it outside the f32 gate."""
+    if feature_dtype is None:
+        return None
+    resolved = resolve_block_dtype(feature_dtype)
+    return None if resolved == np.float32 else resolved
+
+
 def resolve_levels_binary(levels, binary) -> bool:
     """Decomposition-wide binary decision (see MultiLevelArrow): "auto"
     resolves True iff every level is implicit-ones / all-ones; an
@@ -181,17 +193,10 @@ class MultiLevelArrow:
         if not levels:
             raise ValueError("empty decomposition")
         dtype = resolve_block_dtype(dtype)
-        # Carried-feature storage dtype (None keeps the caller's f32).
-        # bf16 halves the bytes every gathered row moves — the
-        # amortization lever at k=128, where the gather turns
-        # bandwidth-bound (PERFORMANCE.md cost model); accumulation
-        # stays f32 in the kernels, but iterated results round to bf16
-        # each step, so this is an opt-in accuracy trade (~1e-3 rel
-        # err/step) outside the f32 benchmark gate.
-        self.feature_dtype = (None if feature_dtype is None
-                              else resolve_block_dtype(feature_dtype))
-        if self.feature_dtype == np.float32:
-            self.feature_dtype = None   # f32 IS the universal carriage
+        # Carried-feature storage dtype — the k=128 amortization
+        # lever, where the gather turns bandwidth-bound
+        # (PERFORMANCE.md cost model).
+        self.feature_dtype = resolve_feature_dtype(feature_dtype)
         if self.feature_dtype is not None and fmt != "fold":
             raise ValueError(
                 "feature_dtype is implemented for fmt='fold' (the "

@@ -429,6 +429,16 @@ def _live(oop: np.ndarray, n: int) -> np.ndarray:
     return (oop >= 0) & (oop < n)
 
 
+def resolve_feature_dtype(feature_dtype):
+    """One normalization rule for every carried layout (see
+    multi_level.resolve_feature_dtype)."""
+    from arrow_matrix_tpu.parallel.multi_level import (
+        resolve_feature_dtype as _resolve,
+    )
+
+    return _resolve(feature_dtype)
+
+
 def _scatter_carried(x: np.ndarray, oop: np.ndarray, n: int) -> np.ndarray:
     """Host (n, k) original-order features -> (T, k) carried ordering
     (tier padding and rows past n stay zero)."""
@@ -649,13 +659,14 @@ class SellSlim:
 
     def __init__(self, matrix: CsrLike, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32,
-                 binary="auto"):
+                 binary="auto", feature_dtype=None):
         # The source canonicalizes (in-memory CSR up front, memmapped
         # triplets per slice): binary detection must see canonical
         # values — duplicate all-ones entries sum to non-unit weights
         # and must go weighted (triplet slices reject duplicates).
         src = _SliceSource(matrix, mesh.shape[axis], width)
         is_binary = src.resolve_binary(binary)
+        self.feature_dtype = resolve_feature_dtype(feature_dtype)
         self.n = src.n
         self.binary = is_binary
         self.mesh = mesh
@@ -687,6 +698,8 @@ class SellSlim:
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
         feat = _scatter_carried(x, self._oop, n)
+        if self.feature_dtype is not None:
+            feat = feat.astype(self.feature_dtype)
         return put_global(np.ascontiguousarray(feat.T),
                           self._feature_sharding())
 
@@ -698,7 +711,9 @@ class SellSlim:
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         """Device (k, total_out) -> host (n, k) in original row order."""
-        return _gather_carried(fetch_replicated(ct).T, self._oop, self.n)
+        return _gather_carried(
+            fetch_replicated(ct).astype(np.float32, copy=False).T,
+            self._oop, self.n)
 
 
 class SellMultiLevel:
@@ -719,7 +734,7 @@ class SellMultiLevel:
     def __init__(self, levels, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32, binary="auto",
                  routing: str = "a2a",
-                 feat_axis: Optional[str] = None):
+                 feat_axis: Optional[str] = None, feature_dtype=None):
         """``routing``: "a2a" (default) compiles the inter-level
         reorderings into explicit per-device send/recv tables over one
         fixed-shape all_to_all each (parallel/routing.py — tier-padding
@@ -737,6 +752,7 @@ class SellMultiLevel:
                 "a2a exchange shards the feature rows per device)")
         self.routing = routing
         self.feat_axis = feat_axis
+        self.feature_dtype = resolve_feature_dtype(feature_dtype)
 
         if not levels:
             raise ValueError("empty decomposition")
@@ -868,6 +884,8 @@ class SellMultiLevel:
         if n != self.n:
             raise ValueError(f"expected {self.n} rows, got {n}")
         feat = _scatter_carried(x, self._orig_of_pos0, n)
+        if self.feature_dtype is not None:
+            feat = feat.astype(self.feature_dtype)
         return put_global(
             np.ascontiguousarray(feat.T),
             NamedSharding(self.mesh, P(self.feat_axis, self.axis)))
@@ -892,8 +910,9 @@ class SellMultiLevel:
                           n=iterations)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
-        return _gather_carried(fetch_replicated(ct).T, self._orig_of_pos0,
-                               self.n)
+        return _gather_carried(
+            fetch_replicated(ct).astype(np.float32, copy=False).T,
+            self._orig_of_pos0, self.n)
 
     def carried_mask(self) -> jax.Array:
         """(1, total_out_0) f32 validity mask of the carried ordering:

@@ -64,7 +64,10 @@ def collective_stats(jitted_fn, *args, **kwargs) -> Dict[str, Any]:
     per-device-visible volume of one call, summed over ops.
     """
     compiled = jitted_fn.lower(*args, **kwargs).compile()
-    text = compiled.as_text()
+    return _parse_hlo_collectives(compiled.as_text())
+
+
+def _parse_hlo_collectives(text: str) -> Dict[str, Any]:
     stats: Dict[str, Any] = {k: {"count": 0, "bytes": 0}
                              for k in COLLECTIVE_OPS}
     for line in text.splitlines():
@@ -86,6 +89,22 @@ def collective_stats(jitted_fn, *args, **kwargs) -> Dict[str, Any]:
     stats["total_bytes"] = sum(v["bytes"] for k, v in stats.items()
                                if isinstance(v, dict))
     return stats
+
+
+def lowered_collective_stats(jitted_fn, *args, **kwargs) -> Dict[str, Any]:
+    """Like ``collective_stats`` but on the LOWERED (pre-backend) HLO,
+    where operand dtypes are still the program's own.
+
+    Needed for dtype accounting: the CPU backend's float normalization
+    pass upcasts bf16 collectives to f32 in the *compiled* HLO (a CPU
+    legalization artifact — TPUs execute bf16 collectives natively), so
+    a bf16-carriage program shows f32 volumes under ``collective_stats``
+    on the virtual CPU mesh.  Only explicit (shard_map) collectives
+    exist before partitioning — GSPMD-inserted ones don't appear, so
+    use this for the a2a/ppermute paths, not the "gather" lowering.
+    """
+    text = jitted_fn.lower(*args, **kwargs).as_text(dialect="hlo")
+    return _parse_hlo_collectives(text)
 
 
 def ideal_routing_bytes(perms, n_devices: int, k: int,

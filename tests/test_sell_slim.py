@@ -272,3 +272,47 @@ def test_directed_graph_through_fold_and_sell():
     np.testing.assert_allclose(
         sm.gather_result(sm.step(sm.set_features(x))), want,
         rtol=1e-4, atol=1e-4)
+
+
+def test_sell_bf16_feature_carriage():
+    """feature_dtype='bf16' on the mesh sell paths: results track f32
+    to bf16 rounding, the carriage dtype is bf16, and the LOWERED HLO
+    shows exactly half the collective bytes of the f32 twin (the CPU
+    backend upcasts compiled collectives, so the lowered module is the
+    honest dtype accounting — commstats.lowered_collective_stats)."""
+    import ml_dtypes
+
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+    from arrow_matrix_tpu.utils import commstats
+
+    n, width = 1024, 64
+    a = barabasi_albert(n, 4, seed=7)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=7)
+    x = random_dense(n, 8, seed=1)
+    want = decomposition_spmm(levels, x)
+    mesh = make_mesh((8,), ("blocks",))
+
+    sm16 = SellMultiLevel(levels, width, mesh, routing="a2a",
+                          feature_dtype="bf16")
+    xt = sm16.set_features(x)
+    assert xt.dtype == ml_dtypes.bfloat16
+    out = sm16.gather_result(sm16.step(xt))
+    assert out.dtype == np.float32
+    rel = np.linalg.norm(out - want) / np.linalg.norm(want)
+    assert rel < 2e-2, rel
+
+    smf = SellMultiLevel(levels, width, mesh, routing="a2a")
+    s16 = commstats.lowered_collective_stats(
+        sm16._step, xt, sm16._level_args, sm16.fwd, sm16.bwd)
+    sf = commstats.lowered_collective_stats(
+        smf._step, smf.set_features(x), smf._level_args, smf.fwd,
+        smf.bwd)
+    assert s16["total_bytes"] > 0
+    assert s16["total_bytes"] * 2 == sf["total_bytes"]
+
+    # feature_dtype='f32' (and None) stay the exact default.
+    assert smf.feature_dtype is None
+    assert SellMultiLevel(levels, width, mesh, routing="a2a",
+                          feature_dtype="f32").feature_dtype is None

@@ -95,6 +95,26 @@ def main() -> None:
             sm,
         )
 
+    # bf16 feature carriage: every collective moves feature rows, so
+    # halving the feature bytes halves the per-iteration volume.
+    # Accounted on the LOWERED module (the CPU backend upcasts bf16
+    # collectives to f32 in compiled HLO; TPUs run them natively), so
+    # the f32 twin (the loop's a2a instance) is re-accounted the same
+    # way for a fair pair.
+    reports["sell/a2a/f32 (lowered)"] = (
+        commstats.lowered_collective_stats(sm._step, xm, sm._level_args,
+                                           sm.fwd, sm.bwd),
+        sm,
+    )
+    sm16 = SellMultiLevel(levels, width, mesh, routing="a2a",
+                          feature_dtype="bf16")
+    xm16 = sm16.set_features(x_host)
+    reports["sell/a2a/featbf16 (lowered)"] = (
+        commstats.lowered_collective_stats(
+            sm16._step, xm16, sm16._level_args, sm16.fwd, sm16.bwd),
+        sm16,
+    )
+
     if n_dev % len(levels) == 0:
         from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared
 
