@@ -51,6 +51,7 @@ from scipy import sparse
 
 from arrow_matrix_tpu.io.graphio import CsrLike, num_rows
 from arrow_matrix_tpu.parallel.mesh import fetch_replicated, put_global
+from arrow_matrix_tpu.parallel.multi_level import resolve_feature_dtype
 from arrow_matrix_tpu.ops.ell import (
     SLOT_ALIGN,
     align_up,
@@ -427,16 +428,6 @@ def _live(oop: np.ndarray, n: int) -> np.ndarray:
     (< n): THE pad-sentinel definition — scatter, gather, and the
     reduction masks must all agree on it."""
     return (oop >= 0) & (oop < n)
-
-
-def resolve_feature_dtype(feature_dtype):
-    """One normalization rule for every carried layout (see
-    multi_level.resolve_feature_dtype)."""
-    from arrow_matrix_tpu.parallel.multi_level import (
-        resolve_feature_dtype as _resolve,
-    )
-
-    return _resolve(feature_dtype)
 
 
 def _scatter_carried(x: np.ndarray, oop: np.ndarray, n: int) -> np.ndarray:

@@ -54,6 +54,7 @@ from arrow_matrix_tpu.io.graphio import num_rows
 from arrow_matrix_tpu.ops.ell import align_up
 from arrow_matrix_tpu.parallel.mesh import (fetch_replicated, make_mesh,
                                              put_global)
+from arrow_matrix_tpu.parallel.multi_level import resolve_feature_dtype
 from arrow_matrix_tpu.parallel.sell_slim import (
     _banded_reach_hops,
     _carried_maps,
@@ -84,7 +85,7 @@ class SellSpaceShared:
     def __init__(self, levels, width: int, mesh: Optional[Mesh] = None,
                  lvl_axis: str = "lvl", axis: str = "blocks",
                  dtype=np.float32, binary="auto",
-                 feat_axis: Optional[str] = None):
+                 feat_axis: Optional[str] = None, feature_dtype=None):
         """``feat_axis`` additionally shards the feature rows (the
         k-dimension tiling axis, reference GPU feature blocking) — with
         ``lvl`` and ``blocks`` that makes a 3-axis sharding: levels x
@@ -92,6 +93,8 @@ class SellSpaceShared:
         nor the cross-group exchanges mix feature rows, so the axis
         composes transparently."""
         from arrow_matrix_tpu.parallel.multi_level import pad_permutation
+
+        self.feature_dtype = resolve_feature_dtype(feature_dtype)
 
         if not levels:
             raise ValueError("empty decomposition")
@@ -333,6 +336,8 @@ class SellSpaceShared:
         feat = np.concatenate(
             [_scatter_carried(x, self._orig_of_pos[g], n)
              for g in range(self.k_levels)])
+        if self.feature_dtype is not None:
+            feat = feat.astype(self.feature_dtype)
         return put_global(np.ascontiguousarray(feat.T),
                           self._feat_sharding)
 
@@ -346,7 +351,8 @@ class SellSpaceShared:
         """Device (k, K * total_out) -> host (n, k) original order
         (level 0's slice IS the canonical aggregate)."""
         return _gather_carried(
-            fetch_replicated(ct[:, :self.total_out]).T,
+            fetch_replicated(ct[:, :self.total_out])
+            .astype(np.float32, copy=False).T,
             self._orig_of_pos[0], self.n)
 
     def carried_mask(self) -> jax.Array:

@@ -255,6 +255,27 @@ def test_spmm_arrow_sell_mesh(tmp_path, monkeypatch):
     assert rc == 0
 
 
+def test_spmm_arrow_feature_dtype_bf16(tmp_path, monkeypatch):
+    """--feature_dtype bf16 on the sell mesh path validates under the
+    widened (bf16-epsilon) gate; on the stacked formats it is rejected
+    up front."""
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_arrow.main([
+        "--vertices", "400", "--width", "32", "--features", "4",
+        "--iterations", "2", "--validate", "true", "--device", "cpu",
+        "--devices", "4", "--fmt", "sell", "--feature_dtype", "bf16",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    with pytest.raises(SystemExit, match="fold or sell"):
+        spmm_arrow.main([
+            "--vertices", "400", "--width", "32", "--features", "4",
+            "--iterations", "1", "--device", "cpu", "--devices", "4",
+            "--fmt", "ell", "--feature_dtype", "bf16",
+            "--logdir", str(tmp_path / "logs"),
+        ])
+
+
 def test_spmm_arrow_sell_space_shared(tmp_path, monkeypatch):
     """--mode space --fmt sell = SellSpaceShared: levels concurrent on
     disjoint groups in the feature-major layouts, validated against the
