@@ -296,7 +296,9 @@ def main(argv=None) -> int:
             else:
                 if args.feature_dtype not in (None, "f32"):
                     raise SystemExit(
-                        "--feature_dtype bf16 needs --fmt fold or sell")
+                        "--feature_dtype bf16 under --mode space needs "
+                        "--fmt sell (the stacked space-shared layout "
+                        "carries f32)")
                 multi = SpaceSharedArrow(levels, width, fmt=args.fmt,
                                          mesh=space_mesh)
         else:

@@ -20,6 +20,8 @@ same jitted multi-level arrow SpMM:
 """
 
 from arrow_matrix_tpu.models.propagation import (
+    APPNPCarried,
+    APPNPModel,
     GCNCarried,
     GCNModel,
     SGCCarried,
@@ -29,6 +31,7 @@ from arrow_matrix_tpu.models.propagation import (
     gcn_init,
     label_propagation,
     label_propagation_carried,
+    make_appnp_train_step,
     make_gcn_train_step,
     make_train_step,
     pagerank,
@@ -37,6 +40,8 @@ from arrow_matrix_tpu.models.propagation import (
 )
 
 __all__ = [
+    "APPNPCarried",
+    "APPNPModel",
     "GCNCarried",
     "GCNModel",
     "SGCCarried",
@@ -46,6 +51,7 @@ __all__ = [
     "gcn_init",
     "label_propagation",
     "label_propagation_carried",
+    "make_appnp_train_step",
     "make_gcn_train_step",
     "make_train_step",
     "pagerank",

@@ -569,3 +569,134 @@ def label_propagation(multi: MultiLevelArrow, labels: np.ndarray,
         y = _label_prop_body(y, seeds, clamp, multi.fwd, multi.bwd,
                              multi.blocks, tuple(multi.widths), multi.chunk)
     return multi.gather_result(y)
+
+
+# ---------------------------------------------------------------------------
+# APPNP (Gasteiger et al., "Predict then Propagate", ICLR 2019): one
+# trainable prediction head, then personalized-PageRank propagation
+#   Z := (1 - alpha) * A_hat Z + alpha * H,   Z_0 = H = head(X)
+# which decouples model depth from propagation range — the propagation
+# IS the reference's iterated-step() workload with a teleport mix-in,
+# so it runs on every executor unchanged.
+
+
+def appnp_forward(params: SGCParams, x: jax.Array, fwd: jax.Array,
+                  bwd: jax.Array, blocks: Sequence[ArrowBlocks],
+                  widths: tuple, hops: int, alpha: float,
+                  chunk: Optional[int] = None) -> jax.Array:
+    """Flat (total_rows, k) APPNP forward: head first, then ``hops``
+    personalized-PageRank steps.  Pure and jittable like sgc_forward."""
+    h = x @ params.w + params.b[None, :]
+    z = h
+    for _ in range(hops):
+        z = (1.0 - alpha) * multi_level_spmm(z, fwd, bwd, blocks,
+                                             widths, chunk=chunk)
+        z = z + alpha * h
+    return z
+
+
+class APPNPModel:
+    """APPNP over the flat executors (mirrors :class:`SGCModel`)."""
+
+    def __init__(self, multi: MultiLevelArrow, k_in: int, k_out: int,
+                 hops: int = 10, alpha: float = 0.1, seed: int = 0,
+                 chunk: Optional[int] = None):
+        _check_not_folded(multi, "APPNPModel")
+        self.multi = multi
+        self.hops = hops
+        self.alpha = alpha
+        self.params = sgc_init(jax.random.key(seed), k_in, k_out)
+        self._forward = jax.jit(functools.partial(
+            appnp_forward, widths=tuple(multi.widths), hops=hops,
+            alpha=alpha, chunk=chunk))
+
+    def forward(self, x: jax.Array) -> jax.Array:
+        m = self.multi
+        return self._forward(self.params, x, m.fwd, m.bwd, m.blocks)
+
+    def predict(self, x_original: np.ndarray) -> np.ndarray:
+        m = self.multi
+        return m.gather_result(self.forward(m.set_features(x_original)))
+
+
+def make_appnp_train_step(widths: tuple, hops: int, alpha: float,
+                          optimizer: optax.GradientTransformation,
+                          chunk: Optional[int] = None) -> Callable:
+    """Jitted masked-MSE train step for the APPNP head; gradients flow
+    through the whole propagation (unlike SGC, the head sits UNDER the
+    hops, so dL/dW crosses every SpMM)."""
+
+    def loss_fn(params, x, y, mask, fwd, bwd, blocks):
+        z = appnp_forward(params, x, fwd, bwd, blocks, widths, hops,
+                          alpha, chunk=chunk)
+        per_row = jnp.sum((z - y) ** 2, axis=-1)
+        return jnp.sum(per_row * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y, mask, fwd, bwd, blocks):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, mask,
+                                                  fwd, bwd, blocks)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return train_step
+
+
+class APPNPCarried:
+    """APPNP on the feature-major executors (fold ``MultiLevelArrow``,
+    ``SellMultiLevel``, ``SellSpaceShared``): the head applies
+    feature-major, the propagation runs through the executor's jitted
+    step with gradients crossing the distributed collectives (the
+    :class:`GCNCarried` property), and ``carried_mask`` weights the
+    loss so tier pads / K-copy carriages count correctly."""
+
+    def __init__(self, multi, k_in: int, k_out: int, hops: int = 10,
+                 alpha: float = 0.1, seed: int = 0):
+        _check_carried(multi, "APPNPCarried")
+        self.multi = multi
+        self.params = sgc_init(jax.random.key(seed), k_in, k_out)
+        self._forward = _make_carried_appnp_forward(multi.step_fn, hops,
+                                                    alpha)
+        self._train_steps: dict = {}
+
+    def predict(self, x_original: np.ndarray) -> np.ndarray:
+        xt = self.multi.set_features(x_original.astype(np.float32))
+        return self.multi.gather_result(
+            self._forward(self.params, xt, self.multi.step_operands()))
+
+    def fit(self, x_host: np.ndarray, y_host: np.ndarray, *,
+            steps: int = 100,
+            optimizer: Optional[optax.GradientTransformation] = None
+            ) -> list[float]:
+        xt = self.multi.set_features(x_host.astype(np.float32))
+        yt = self.multi.set_features(y_host.astype(np.float32))
+        mask = _carried_mask_or_ones(self.multi, yt.shape[1])
+        opt = optimizer or _DEFAULT_CARRIED_OPT
+        opt_state = opt.init(self.params)
+        train_step = self._train_steps.get(opt)
+        if train_step is None:
+            train_step = _make_carried_gcn_train_step(self._forward, opt)
+            self._train_steps[opt] = train_step
+
+        operands = self.multi.step_operands()
+        losses = []
+        for _ in range(steps):
+            self.params, opt_state, loss = train_step(
+                self.params, opt_state, xt, yt, mask, operands)
+            losses.append(float(loss))
+        return losses
+
+
+def _make_carried_appnp_forward(step_fn, hops: int, alpha: float):
+    """Jitted carried-layout APPNP forward (same operand-threading rule
+    as the GCN forward: no baked-in device constants)."""
+
+    @jax.jit
+    def forward(params, xt, operands):
+        h = params.w.T @ xt + params.b[:, None]
+        z = h
+        for _ in range(hops):
+            z = (1.0 - alpha) * step_fn(z, *operands) + alpha * h
+        return z
+
+    return forward

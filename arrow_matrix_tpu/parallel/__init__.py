@@ -28,9 +28,11 @@ Modules:
 """
 
 from arrow_matrix_tpu.parallel.mesh import (
+    fetch_replicated,
     initialize_multihost,
     make_hybrid_mesh,
     make_mesh,
+    put_global,
     shard_blocked,
     blocks_sharding,
 )

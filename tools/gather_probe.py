@@ -58,12 +58,11 @@ def fold_step(k: int) -> None:
     jax.config.update("jax_default_matmul_precision", "highest")
     from bench import _cached_levels, _measure
 
-    from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
-    from arrow_matrix_tpu.utils.graphs import random_dense
-
     from arrow_matrix_tpu.parallel.multi_level import (
+        MultiLevelArrow,
         resolve_feature_dtype,
     )
+    from arrow_matrix_tpu.utils.graphs import random_dense
 
     n = 1 << 20
     levels = _cached_levels(n, 8, 2048, seed=7, max_levels=12)
