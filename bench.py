@@ -293,8 +293,23 @@ def run_one_candidate(fmt: str) -> None:
         # skipped here — the race already produced it.
         try:
             _progress(f"fmt={fmt}: k=128 measurement")
-            x128 = multi.set_features(random_dense(cfg["n"], 128, seed=4))
+            x128_host = random_dense(cfg["n"], 128, seed=4)
+            x128 = multi.set_features(x128_host)
             out["k128_ms"] = round(_measure(multi, x128, cfg["iters"]), 3)
+            if fmt == "fold":
+                # bf16 carriage at k=128 — the regime where gathered
+                # rows turn bandwidth-bound (PERFORMANCE.md cost
+                # model); feature_dtype only affects set_features, so
+                # the same build measures both.  Secondary diagnostic,
+                # never the gate.
+                from arrow_matrix_tpu.parallel.multi_level import (
+                    resolve_feature_dtype,
+                )
+
+                multi.feature_dtype = resolve_feature_dtype("bf16")
+                xb = multi.set_features(x128_host)
+                out["k128_bf16_ms"] = round(
+                    _measure(multi, xb, cfg["iters"]), 3)
         except Exception as e:   # secondary metric, never the gate
             out["k128_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     else:
@@ -501,6 +516,8 @@ def run_bench(result: dict, platform: str, device_kind: str) -> None:
                                  timeout_s=900.0)
         if "k128_ms" in rerun:
             result["k128_ms"] = rerun["k128_ms"]
+            if "k128_bf16_ms" in rerun:
+                result["k128_bf16_ms"] = rerun["k128_bf16_ms"]
         elif rerun.get("k128_error") or rerun.get("error"):
             result["k128_error"] = (rerun.get("k128_error")
                                     or rerun.get("error"))
