@@ -159,6 +159,11 @@ def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
             # coordinates, O(tier nnz) numpy work (a per-row Python
             # loop here would dominate protocol-scale builds).
             s = shares[d]
+            if getattr(s, "indices", None) is None:
+                continue   # _DegreesOnly: a remote shard of the
+                # per-host build — its stack slice stays zero pages
+                # (never read: put_global materializes only
+                # addressable shards)
             rows_b = order[d, lo:lo + n_t]
             live = np.flatnonzero(rows_b >= 0)
             if live.size == 0 or m_t == 0:
@@ -180,10 +185,13 @@ def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
             cols[d, slot, tloc] = s.indices[src]
             if not binary:
                 vals[d, slot, tloc] = s.data[src]
-        cols_t.append(jnp.asarray(cols))
-        deg_t.append(jnp.asarray(deg))
+        # Host (numpy) leaves: the callers place the stacks (put_global
+        # shards them); a jnp conversion here would upload every
+        # remote-shard zero page to the default device first.
+        cols_t.append(cols)
+        deg_t.append(deg)
         if not binary:
-            data_t.append(jnp.asarray(vals))
+            data_t.append(vals)
     stack = SellShardStack(cols=tuple(cols_t), deg=tuple(deg_t),
                            data=tuple(data_t) if not binary else None)
     return stack, order, rows_out
@@ -313,6 +321,21 @@ class _SliceSource:
 
         return resolve_binary(binary, self._binary_data, nnz=self.nnz)
 
+    def row_degrees(self, lo: int, hi: int) -> np.ndarray:
+        """Per-row nnz of padded rows [lo, hi) WITHOUT materializing
+        the slice — the remote-shard metadata of the per-host build
+        (O(rows) indptr reads; for a memmapped triplet only that range
+        of indptr pages in)."""
+        if self._csr is not None:
+            return np.diff(self._csr.indptr[lo:hi + 1]).astype(np.int64)
+        _, _, indptr = self._trip
+        out = np.zeros(hi - lo, dtype=np.int64)
+        top = min(hi, self.n)
+        if top > lo:
+            seg = np.asarray(indptr[lo:top + 1], dtype=np.int64)
+            out[:top - lo] = np.diff(seg)
+        return out
+
     def rows(self, lo: int, hi: int) -> sparse.csr_matrix:
         """Canonical CSR of padded rows [lo, hi) x [0, total)."""
         if self._csr is not None:
@@ -332,16 +355,21 @@ class _SliceSource:
         return out
 
 
-def _banded_reach_hops(src: _SliceSource, w: int) -> int:
+def _banded_reach_hops(src: _SliceSource, w: int,
+                       shard_ids=None) -> int:
     """Halo reach: how far body columns stray outside the owning shard
     (head-arm columns excluded), in whole-shard hops.  A converged
     block-diagonal level has reach 0 and pays no exchange; a grown
     banded last level gets exactly the hops it needs (reference
     neighbor exchange generalized, arrow_mpi.py:123-175).  Streams one
-    device row-slice at a time (O(slice nnz) host memory)."""
+    device row-slice at a time (O(slice nnz) host memory).
+
+    ``shard_ids`` restricts the scan (the per-host build scans only
+    local shards and cross-process-maxes the result — per-host IO
+    stays O(local nnz) end to end)."""
     L, n_dev = src.shard_len, src.n_dev
     reach = 0
-    for d in range(n_dev):
+    for d in (range(n_dev) if shard_ids is None else sorted(shard_ids)):
         lo = d * L
         coo = src.rows(lo, lo + L).tocoo()
         rows_g = coo.row + lo
@@ -355,20 +383,57 @@ def _banded_reach_hops(src: _SliceSource, w: int) -> int:
     return min(hops, n_dev - 1)
 
 
-def _slim_shares(src: _SliceSource, w: int, hops: int) -> tuple[list, list]:
+class _DegreesOnly:
+    """Row-degree stand-in for a REMOTE device's body share (per-host
+    multi-process build): enough for the global tier shapes/orderings
+    (which every process must agree on), no entry data.  For a
+    canonical source a body-share row's degree equals its full row nnz
+    — every entry lands in exactly one category or the OWNING process
+    raises — so the stand-in derives from indptr alone."""
+
+    __slots__ = ("indptr",)
+    indices = None      # the pack fill skips shares without entry data
+
+    def __init__(self, degrees: np.ndarray):
+        self.indptr = np.concatenate(
+            [[0], np.cumsum(degrees, dtype=np.int64)])
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indptr[-1])
+
+
+def _slim_shares(src: _SliceSource, w: int, hops: int,
+                 materialize: Optional[set] = None) -> tuple[list, list]:
     """Per-device (body, head) shares via prioritized column
     categorization (COO): local shard > head arm > halos; anything
     matching no category is out of pattern and raises.  Body share
     columns: [0, L) local, [L, L+w) head arm, then the lo/hi halo
     regions of width hops*L each.  Streams one device row-slice at a
-    time; the head block (w rows) materializes once."""
+    time; the head block (w rows) materializes once.
+
+    ``materialize`` (default: every shard) lists the device indices
+    whose body shares carry entry data; the rest become
+    :class:`_DegreesOnly` stand-ins — the per-host build, where each
+    process constructs and validates only its own shards' shares (the
+    reference's per-rank slice loading, spmm_petsc.py:421-440) and the
+    remote slots of the device stacks stay untouched zero pages.  Head
+    shares always materialize (w rows, column-sliced — cheap, and the
+    head operator is replicated work anyway)."""
     L, n_dev = src.shard_len, src.n_dev
     H = hops * L
     head_block = src.rows(0, w)
     body_shares, head_shares = [], []
-    captured = 0
     for d in range(n_dev):
         lo, hi = d * L, (d + 1) * L
+        head_shares.append(head_block[:, lo:hi].tocsr())
+        if materialize is not None and d not in materialize:
+            degrees = src.row_degrees(lo, hi)
+            if d == 0:
+                degrees = degrees.copy()
+                degrees[:w] = 0          # head rows live in the head op
+            body_shares.append(_DegreesOnly(degrees))
+            continue
         rows = src.rows(lo, hi).tocoo()
         r, g, v = rows.row, rows.col, rows.data
         if d == 0:
@@ -380,25 +445,21 @@ def _slim_shares(src: _SliceSource, w: int, hops: int) -> tuple[list, list]:
         lo_h = ~local & ~head_arm & (g >= lo - H) & (g < lo)
         hi_h = ~local & ~head_arm & (g >= hi) & (g < hi + H)
         cat = local | head_arm | lo_h | hi_h
-        captured += int(cat.sum())
+        if not cat.all():
+            raise ValueError(
+                f"shard {d} has {int((~cat).sum())} nonzeros outside "
+                f"the slim pattern at width {w} / {hops}-hop halos "
+                f"(head rows/arm + shard +- reach)")
         mapped = np.where(
             local, g - lo,
             np.where(head_arm, L + g,
                      np.where(lo_h, L + w + (g - (lo - H)),
                               L + w + H + (g - hi))))
         share = sparse.csr_matrix(
-            (v[cat], (r[cat], mapped[cat])), shape=(L, L + w + 2 * H))
+            (v, (r, mapped)), shape=(L, L + w + 2 * H))
         share.sum_duplicates()
         share.sort_indices()
         body_shares.append(share)
-        head = head_block[:, lo:hi].tocsr()
-        captured += head.nnz
-        head_shares.append(head)
-    if captured != src.nnz:
-        raise ValueError(
-            f"slim shares captured {captured} of {src.nnz} nonzeros: "
-            f"the matrix has entries outside the slim pattern at width "
-            f"{w} / {hops}-hop halos (head rows/arm + shard +- reach)")
     return body_shares, head_shares
 
 
@@ -467,7 +528,8 @@ def _local_operand_width(rows_out: int, w: int, hops: int, L: int) -> int:
 
 
 def _remap_body_cols(body: SellShardStack, inv: np.ndarray, L: int,
-                     rows_out: int, w: int, hops: int) -> SellShardStack:
+                     rows_out: int, w: int, hops: int,
+                     materialize: Optional[set] = None) -> SellShardStack:
     """Body column remap: share column c ->
       [0, L): local -> tiered position;   [L, L+w): head -> R + (c-L)
       [L+w, L+w+H): lo halo;              [L+w+H, L+w+2H): hi halo
@@ -481,25 +543,33 @@ def _remap_body_cols(body: SellShardStack, inv: np.ndarray, L: int,
     remapped = []
     for cols in body.cols:
         c = np.asarray(cols)
-        out = np.empty(c.shape, dtype=idx_dtype)
+        # np.zeros, not empty: remote shards of the per-host build are
+        # skipped below and their slices must stay untouched (virtual)
+        # zero pages, not garbage indices.
+        out = np.zeros(c.shape, dtype=idx_dtype)
         for d in range(c.shape[0]):
+            if materialize is not None and d not in materialize:
+                continue
             cd = c[d].astype(np.int64)
             local = inv[d, np.minimum(cd, L - 1)]
             out[d] = np.where(cd < L, local, R + (cd - L)).astype(idx_dtype)
-        remapped.append(jnp.asarray(out))
+        remapped.append(out)
     return body.replace(cols=tuple(remapped))
 
 
 def _remap_head_cols(head: SellShardStack, inv: np.ndarray, L: int,
-                     rows_out: int) -> SellShardStack:
+                     rows_out: int,
+                     materialize: Optional[set] = None) -> SellShardStack:
     idx_dtype = block_index_dtype(rows_out)
     remapped_head = []
     for cols in head.cols:
         c = np.asarray(cols)
-        out = np.empty(c.shape, dtype=idx_dtype)
+        out = np.zeros(c.shape, dtype=idx_dtype)
         for d in range(c.shape[0]):
+            if materialize is not None and d not in materialize:
+                continue
             out[d] = inv[d, np.minimum(c[d], L - 1)].astype(idx_dtype)
-        remapped_head.append(jnp.asarray(out))
+        remapped_head.append(out)
     return head.replace(cols=tuple(remapped_head))
 
 
@@ -519,8 +589,29 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
            else _SliceSource(matrix, n_dev, w, shard_len=shard_len))
     L = src.shard_len
 
-    hops = _banded_reach_hops(src, w)
-    body_shares, head_shares = _slim_shares(src, w, hops)
+    # Per-host build: when the mesh spans processes, scan, construct
+    # and validate only THIS process's shards (the global tier shapes/
+    # orderings come from degree metadata, identical on every
+    # process); remote slices of the device stacks stay untouched zero
+    # pages that put_global never reads.
+    materialize = None
+    if any(d.process_index != jax.process_index()
+           for d in mesh.devices.flat):
+        ax = list(mesh.axis_names).index(axis)
+        materialize = {
+            int(c[ax]) for c, dev in np.ndenumerate(mesh.devices)
+            if dev.process_index == jax.process_index()}
+    hops = _banded_reach_hops(src, w, shard_ids=materialize)
+    if materialize is not None:
+        # Every process must agree on the operand shapes hops implies:
+        # one tiny cross-process max (the only collective in the
+        # build).
+        from jax.experimental import multihost_utils
+
+        hops = int(np.max(multihost_utils.process_allgather(
+            np.asarray(hops, dtype=np.int32))))
+    body_shares, head_shares = _slim_shares(src, w, hops,
+                                            materialize=materialize)
 
     ladder_body = degree_ladder(
         max((int(np.diff(s.indptr).max()) if s.nnz else 0)
@@ -543,8 +634,10 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
             "(stable zero-tier sort invariant)")
 
     inv = _positions_inv(body_order, L)
-    body = _remap_body_cols(body, inv, L, rows_out, w, hops)
-    head = _remap_head_cols(head, inv, L, rows_out)
+    body = _remap_body_cols(body, inv, L, rows_out, w, hops,
+                            materialize=materialize)
+    head = _remap_head_cols(head, inv, L, rows_out,
+                            materialize=materialize)
 
     if not np.all(head_order[0] == head_order):
         raise AssertionError("head tier ordering must be "

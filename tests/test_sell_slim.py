@@ -316,3 +316,46 @@ def test_sell_bf16_feature_carriage():
     assert smf.feature_dtype is None
     assert SellMultiLevel(levels, width, mesh, routing="a2a",
                           feature_dtype="f32").feature_dtype is None
+
+
+def test_per_host_build_equivalence():
+    """The per-host build (_slim_shares materialize=subset) must agree
+    with the full build on every global decision — tier ladder, shared
+    tier shapes, orderings — and bit-match the full stacks on the
+    materialized shards (remote slices stay zero)."""
+    from arrow_matrix_tpu.parallel.sell_slim import (
+        _DegreesOnly,
+        _pack_shard_tiers,
+        _SliceSource,
+        _banded_reach_hops,
+        _slim_shares,
+        degree_ladder,
+    )
+
+    n, w, n_dev = 512, 32, 4
+    a = barabasi_albert(n, 4, seed=11).astype(np.float32)
+    src = _SliceSource(a, n_dev, w)
+    hops = _banded_reach_hops(src, w)
+
+    full_b, full_h = _slim_shares(src, w, hops)
+    part_b, part_h = _slim_shares(src, w, hops, materialize={0, 2})
+
+    for d in (1, 3):
+        assert isinstance(part_b[d], _DegreesOnly)
+        np.testing.assert_array_equal(np.diff(part_b[d].indptr),
+                                      np.diff(full_b[d].indptr))
+    for d in (0, 2):
+        assert (part_b[d] != full_b[d]).nnz == 0
+
+    ladder = degree_ladder(
+        max(int(np.diff(s.indptr).max()) if s.nnz else 0
+            for s in full_b))
+    sf, of, rf = _pack_shard_tiers(full_b, ladder, False, np.float32)
+    sp, op, rp = _pack_shard_tiers(part_b, ladder, False, np.float32)
+    assert rf == rp
+    np.testing.assert_array_equal(of, op)          # orderings identical
+    for cf, cp in zip(sf.cols, sp.cols):
+        np.testing.assert_array_equal(cf[[0, 2]], cp[[0, 2]])
+        assert not np.any(cp[[1, 3]])              # remote = zero pages
+    for df, dp in zip(sf.deg, sp.deg):
+        np.testing.assert_array_equal(df[[0, 2]], dp[[0, 2]])
