@@ -573,6 +573,33 @@ def _remap_head_cols(head: SellShardStack, inv: np.ndarray, L: int,
     return head.replace(cols=tuple(remapped_head))
 
 
+def local_shard_coords(mesh: Mesh, *axes: str):
+    """The multi-process build probe shared by build_slim_level and
+    SellSpaceShared: None when every mesh device is process-local
+    (single-process — materialize everything); otherwise the set of
+    this process's device coordinates along ``axes`` (1-tuples unpack
+    to ints)."""
+    if all(d.process_index == jax.process_index()
+           for d in mesh.devices.flat):
+        return None
+    ax = [list(mesh.axis_names).index(a) for a in axes]
+    coords = {
+        tuple(int(c[i]) for i in ax)
+        for c, dev in np.ndenumerate(mesh.devices)
+        if dev.process_index == jax.process_index()}
+    return ({c[0] for c in coords} if len(axes) == 1 else coords)
+
+
+def global_max_hops(hops: int) -> int:
+    """Cross-process max of a locally-scanned halo reach — every
+    process must agree on the operand shapes hops implies (the one
+    collective in a per-host build)."""
+    from jax.experimental import multihost_utils
+
+    return int(np.max(multihost_utils.process_allgather(
+        np.asarray(hops, dtype=np.int32))))
+
+
 def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
                      axis: str, dtype, binary: bool,
                      shard_len: Optional[int] = None) -> SlimLevelOps:
@@ -594,22 +621,10 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
     # orderings come from degree metadata, identical on every
     # process); remote slices of the device stacks stay untouched zero
     # pages that put_global never reads.
-    materialize = None
-    if any(d.process_index != jax.process_index()
-           for d in mesh.devices.flat):
-        ax = list(mesh.axis_names).index(axis)
-        materialize = {
-            int(c[ax]) for c, dev in np.ndenumerate(mesh.devices)
-            if dev.process_index == jax.process_index()}
+    materialize = local_shard_coords(mesh, axis)
     hops = _banded_reach_hops(src, w, shard_ids=materialize)
     if materialize is not None:
-        # Every process must agree on the operand shapes hops implies:
-        # one tiny cross-process max (the only collective in the
-        # build).
-        from jax.experimental import multihost_utils
-
-        hops = int(np.max(multihost_utils.process_allgather(
-            np.asarray(hops, dtype=np.int32))))
+        hops = global_max_hops(hops)
     body_shares, head_shares = _slim_shares(src, w, hops,
                                             materialize=materialize)
 

@@ -57,6 +57,8 @@ from arrow_matrix_tpu.parallel.mesh import (fetch_replicated, make_mesh,
 from arrow_matrix_tpu.parallel.multi_level import resolve_feature_dtype
 from arrow_matrix_tpu.parallel.sell_slim import (
     _banded_reach_hops,
+    global_max_hops,
+    local_shard_coords,
     _carried_maps,
     _gather_carried,
     _live,
@@ -138,12 +140,29 @@ class SellSpaceShared:
         else:
             self.binary = all(s.resolve_binary(binary) for s in srcs)
 
+        # Per-host build (see sell_slim.build_slim_level): when the
+        # mesh spans processes, each process scans/constructs/validates
+        # only the (level, device) shards its devices own; the
+        # flattened share index is g * n_dev + d, matching the
+        # P((lvl, blocks)) placement below.
+        local_pairs = local_shard_coords(mesh, lvl_axis, axis)
+
+        def level_mat(g):
+            return (None if local_pairs is None
+                    else {d for gg, d in local_pairs if gg == g})
+
         # One SPMD program runs every group, so all levels share the
         # max halo reach (see module docstring).
-        hops = max(_banded_reach_hops(s, w) for s in srcs)
-        shares = [_slim_shares(s, w, hops) for s in srcs]
+        hops = max(_banded_reach_hops(s, w, shard_ids=level_mat(g))
+                   for g, s in enumerate(srcs))
+        if local_pairs is not None:
+            hops = global_max_hops(hops)
+        shares = [_slim_shares(s, w, hops, materialize=level_mat(g))
+                  for g, s in enumerate(srcs)]
         body_flat = [s for body, _ in shares for s in body]
         head_flat = [s for _, head in shares for s in head]
+        flat_mat = (None if local_pairs is None
+                    else {g * n_dev + d for g, d in local_pairs})
 
         ladder_body = degree_ladder(max(
             (int(np.diff(s.indptr).max()) if s.nnz else 0)
@@ -179,8 +198,10 @@ class SellSpaceShared:
                     f"device-independent within the group")
 
         inv = _positions_inv(body_order, L)
-        body = _remap_body_cols(body, inv, L, rows_out, w, hops)
-        head = _remap_head_cols(head, inv, L, rows_out)
+        body = _remap_body_cols(body, inv, L, rows_out, w, hops,
+                                materialize=flat_mat)
+        head = _remap_head_cols(head, inv, L, rows_out,
+                                materialize=flat_mat)
         # head_unsort[g][j] = tiered head position of head row j.  The
         # cross-group tier unification maxes tier counts over ALL
         # groups, so a group whose bucket is smaller gets -1 padding

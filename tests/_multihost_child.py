@@ -74,6 +74,27 @@ def main() -> None:
         x2 = ml2.step(x2)
     errs["stacked_ell_a2a"] = relative_error(ml2.gather_result(x2), want)
 
+    # Space-shared sell: levels concurrent on disjoint groups of a
+    # (lvl, blocks) mesh spanning both processes (per-host build).  A
+    # SEPARATE 2-level decomposition fits the (2, n/2) grid without
+    # weakening the 3-level coverage of the time-shared checks above.
+    if n_global % 2 == 0:
+        from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared
+
+        levels2 = arrow_decomposition(a, arrow_width=width,
+                                      max_levels=2,
+                                      block_diagonal=True, seed=5)
+        assert len(levels2) == 2, len(levels2)
+        want2 = x
+        for _ in range(iters):
+            want2 = decomposition_spmm(levels2, want2)
+        sp = SellSpaceShared(
+            levels2, width,
+            make_mesh((2, n_global // 2), ("lvl", "blocks")))
+        xs = sp.set_features(x)
+        errs["sell_space"] = relative_error(
+            sp.gather_result(sp.run(xs, iters)), want2)
+
     # The two baseline layouts over the same multi-process mesh
     # (single-matrix semantics: one SpMM vs a @ x).
     from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D
