@@ -111,6 +111,28 @@ def main() -> None:
         errs["15d"] = relative_error(
             d15.gather_result(d15.spmm(d15.set_features(x))), want1)
 
+    # Checkpoint roundtrip across the process boundary: the save is a
+    # collective fetch + single-writer npz; restore re-places onto the
+    # (multi-process) sharding of the running executor.
+    import tempfile
+
+    from arrow_matrix_tpu.utils import checkpoint as ckpt
+
+    state = ml.run(xt, 1)
+    path = os.path.join(tempfile.gettempdir(), f"mh_ckpt_{port}")
+    ckpt._orbax = lambda: None   # force the npz single-writer path
+    ckpt.save_state(path, state, step=1)   # barrier lives in save_state
+    restored, step = ckpt.load_state(path, like=state)
+    assert step == 1
+    # The restore must land on the RUNNING executor's multi-process
+    # sharding, not a replicated/host fallback.
+    assert restored.sharding == state.sharding
+    assert not restored.is_fully_addressable
+    errs["ckpt"] = relative_error(ml.gather_result(restored),
+                                  ml.gather_result(state))
+    if pid == 0:
+        os.remove(path + ".npz")   # shared tempdir must not accumulate
+
     assert not any(np.isnan(v) for v in errs.values()), errs
     worst = max(errs.values())
     print(f"CHILD_OK pid={pid} devices={n_global} err={worst:.2e} "
