@@ -45,17 +45,22 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
     from arrow_matrix_tpu.parallel.mesh import fetch_replicated
 
     x_host = fetch_replicated(x)   # collective: every process joins
-    if jax.process_index() == 0:   # one writer (shared filesystem)
-        tmp = path + ".tmp.npz"
-        np.savez(tmp, x=x_host, step=np.int64(step))
-        os.replace(tmp, path + ".npz")
-    if jax.process_count() > 1:
-        # Completion barrier INSIDE the save: a caller on any process
-        # may load (or check for) the checkpoint right after save_state
-        # returns, and must not race process 0's replace.
-        from jax.experimental import multihost_utils
+    try:
+        if jax.process_index() == 0:   # one writer (shared filesystem)
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, x=x_host, step=np.int64(step))
+            os.replace(tmp, path + ".npz")
+    finally:
+        if jax.process_count() > 1:
+            # Completion barrier INSIDE the save: a caller on any
+            # process may load right after save_state returns and must
+            # not race process 0's replace.  In the finally block so a
+            # writer-side IO error (disk full) re-raises on process 0
+            # instead of deadlocking every other process at a barrier
+            # the writer never reaches.
+            from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("amt_ckpt_saved")
+            multihost_utils.sync_global_devices("amt_ckpt_saved")
 
 
 def load_state(path: str, like: Optional[jax.Array] = None

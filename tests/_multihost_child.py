@@ -122,16 +122,21 @@ def main() -> None:
     path = os.path.join(tempfile.gettempdir(), f"mh_ckpt_{port}")
     ckpt._orbax = lambda: None   # force the npz single-writer path
     ckpt.save_state(path, state, step=1)   # barrier lives in save_state
-    restored, step = ckpt.load_state(path, like=state)
-    assert step == 1
-    # The restore must land on the RUNNING executor's multi-process
-    # sharding, not a replicated/host fallback.
-    assert restored.sharding == state.sharding
-    assert not restored.is_fully_addressable
-    errs["ckpt"] = relative_error(ml.gather_result(restored),
-                                  ml.gather_result(state))
-    if pid == 0:
-        os.remove(path + ".npz")   # shared tempdir must not accumulate
+    try:
+        restored, step = ckpt.load_state(path, like=state)
+        assert step == 1
+        # The restore must land on the RUNNING executor's
+        # multi-process sharding, not a replicated/host fallback.
+        assert restored.sharding == state.sharding
+        assert not restored.is_fully_addressable
+        errs["ckpt"] = relative_error(ml.gather_result(restored),
+                                      ml.gather_result(state))
+    finally:
+        if pid == 0:   # shared tempdir must not accumulate
+            try:
+                os.remove(path + ".npz")
+            except OSError:
+                pass
 
     assert not any(np.isnan(v) for v in errs.values()), errs
     worst = max(errs.values())

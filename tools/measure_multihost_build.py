@@ -1,0 +1,109 @@
+"""Measure the per-host build: per-process CPU seconds (and wall) of
+SellMultiLevel construction, single-process vs 2-process at the same
+global device count, on one machine.
+
+The per-host build constructs/fills/validates only the shards a
+process's devices own (PERFORMANCE.md "Per-host builds"): the
+nnz-proportional work halves per process; the O(total rows) metadata
+every process must agree on does not.  CPU time is the honest
+single-box metric — two processes share the cores, so wall conflates
+them.
+
+Usage: python tools/measure_multihost_build.py [n] [width] [n_dev]
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHILD = r'''
+import json, os, resource, sys, time
+pid, nproc, port, n, width, n_dev = (int(sys.argv[1]), int(sys.argv[2]),
+                                     sys.argv[3], int(sys.argv[4]),
+                                     int(sys.argv[5]), int(sys.argv[6]))
+sys.path.insert(0, {repo!r})
+from arrow_matrix_tpu.parallel.mesh import initialize_multihost
+from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+per = n_dev // nproc
+if nproc > 1:
+    initialize_multihost(f"127.0.0.1:{{port}}", nproc, pid,
+                         cpu_devices=per)
+else:
+    force_cpu_devices(n_dev)
+
+import numpy as np
+from arrow_matrix_tpu.decomposition import arrow_decomposition
+from arrow_matrix_tpu.parallel import make_mesh
+from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+from arrow_matrix_tpu.utils.graphs import barabasi_albert
+
+a = barabasi_albert(n, 8, seed=7)
+levels = arrow_decomposition(a, width, max_levels=12,
+                             block_diagonal=True, seed=7)
+ru0 = resource.getrusage(resource.RUSAGE_SELF)
+t0 = time.perf_counter()
+ml = SellMultiLevel(levels, width, make_mesh((n_dev,), ("blocks",)),
+                    routing="a2a")
+build_s = time.perf_counter() - t0
+ru1 = resource.getrusage(resource.RUSAGE_SELF)
+# CPU seconds THIS PROCESS spent building — the per-host cost the
+# build scales down (wall time on one shared box conflates the two
+# processes; on separate hosts wall tracks cpu).
+cpu_s = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)
+print("RESULT " + json.dumps({{
+    "pid": pid, "nproc": nproc, "levels": len(levels),
+    "build_wall_s": round(build_s, 2),
+    "build_cpu_s": round(cpu_s, 2)}}), flush=True)
+'''
+
+
+def run(nproc: int, n: int, width: int, n_dev: int) -> list[dict]:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = CHILD.format(repo=REPO)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code, str(i), str(nproc), str(port),
+         str(n), str(width), str(n_dev)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(nproc)]
+    out = []
+    try:
+        for p in procs:
+            so, se = p.communicate(timeout=1800)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"child rc={p.returncode}: {se[-800:]}")
+            line = [ln for ln in so.splitlines()
+                    if ln.startswith("RESULT ")]
+            out.append(json.loads(line[-1][len("RESULT "):]))
+    finally:
+        for p in procs:   # a crashed child must not orphan its peer
+            if p.poll() is None:
+                p.kill()
+    return out
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    n_dev = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    print(f"n={n} width={width} global devices={n_dev}")
+    one = run(1, n, width, n_dev)
+    print(f"1 process : build cpu {one[0]['build_cpu_s']}s  "
+          f"wall {one[0]['build_wall_s']}s  "
+          f"({one[0]['levels']} levels)")
+    two = run(2, n, width, n_dev)
+    for r in sorted(two, key=lambda r: r["pid"]):
+        print(f"2 processes (proc {r['pid']}): build cpu "
+              f"{r['build_cpu_s']}s  wall {r['build_wall_s']}s")
+
+
+if __name__ == "__main__":
+    main()
