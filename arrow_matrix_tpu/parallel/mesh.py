@@ -133,7 +133,8 @@ def pad_to_multiple(nb: int, n_dev: int) -> int:
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None,
-                         cpu_devices: Optional[int] = None) -> int:
+                         cpu_devices: Optional[int] = None,
+                         heartbeat_timeout_seconds: int = 100) -> int:
     """Join a multi-host JAX runtime (the framework's scale-out story;
     the counterpart of the reference's MPI launch across nodes,
     reference README.md:10 Cray-MPICH).
@@ -150,6 +151,14 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     analog with real process boundaries, reference
     scripts/run_tests.sh), and the CPU-cluster path.  Must be the
     process's first backend touch.
+
+    ``heartbeat_timeout_seconds`` bounds failure-detection latency: a
+    crashed peer aborts EVERY process within roughly this window (the
+    coordination service's missed-heartbeat fatal, measured ~110 s at
+    the default — the whole-job abort of the reference's collective
+    failure flag, arrow_bench.py:128-134, detected by the runtime
+    instead of a per-iteration allreduce).  Lower it for faster abort
+    on flaky fleets; raise it to ride out long GC/compile pauses.
     """
     import jax
 
@@ -158,9 +167,11 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
 
         force_cpu_devices(cpu_devices)
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        heartbeat_timeout_seconds=heartbeat_timeout_seconds)
     return jax.process_index()
 
 

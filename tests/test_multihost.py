@@ -29,6 +29,72 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+FAIL_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+pid, port = int(sys.argv[1]), sys.argv[2]
+from arrow_matrix_tpu.parallel.mesh import initialize_multihost
+try:
+    initialize_multihost(f"127.0.0.1:{{port}}", 2, pid, cpu_devices=2,
+                         heartbeat_timeout_seconds=10)
+except Exception as e:
+    print(f"CHILD_SKIP {{type(e).__name__}}: {{e}}", flush=True)
+    sys.exit(0)
+import jax, numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+from arrow_matrix_tpu.parallel.mesh import make_mesh, put_global
+mesh = make_mesh((4,), ("blocks",))
+f = jax.jit(shard_map(lambda v: jax.lax.psum(v, "blocks"), mesh=mesh,
+            in_specs=P("blocks"), out_specs=P()))
+x = put_global(np.arange(8, dtype=np.float32),
+               NamedSharding(mesh, P("blocks")))
+for it in range(1000):
+    if pid == 1 and it == 3:
+        os._exit(17)              # simulated host crash mid-run
+    float(np.asarray(f(x).addressable_data(0))[0])
+    time.sleep(0.2)
+"""
+
+
+def test_peer_death_aborts_whole_job():
+    """Failure detection across processes: when one process dies
+    mid-iteration, the coordination service's missed-heartbeat fatal
+    aborts the survivor within ~2x the heartbeat timeout — the
+    whole-job abort of the reference's collective failure flag
+    (arrow_bench.py:128-134), provided by the runtime instead of a
+    per-iteration allreduce.  The survivor must EXIT (nonzero), never
+    hang."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-u", "-c", FAIL_CHILD.format(repo=repo),
+         str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(2)]
+    try:
+        out1, _ = procs[1].communicate(timeout=120)
+        if procs[1].returncode == 0 and "CHILD_SKIP" in out1:
+            pytest.skip(f"distributed runtime unavailable: "
+                        f"{out1.strip()}")
+        assert procs[1].returncode == 17      # the simulated crash
+        # communicate (not wait): the survivor's fatal pours JAX/gloo
+        # error output into the PIPEs, and an undrained pipe would
+        # block it in write() — a false "hang".
+        out0, _ = procs[0].communicate(timeout=120)
+        if procs[0].returncode == 0 and "CHILD_SKIP" in out0:
+            pytest.skip(f"distributed runtime unavailable: "
+                        f"{out0.strip()}")
+        assert procs[0].returncode != 0       # abort loudly, not hang
+    except subprocess.TimeoutExpired:
+        raise AssertionError(
+            "survivor hung after peer death (no failure detection)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 def test_two_process_sell_multilevel():
     port = _free_port()
     env = dict(os.environ)

@@ -75,8 +75,15 @@ def run(nproc: int, n: int, width: int, n_dev: int) -> list[dict]:
         for i in range(nproc)]
     out = []
     try:
-        for p in procs:
-            so, se = p.communicate(timeout=1800)
+        # Drain every child concurrently: the processes advance in
+        # lockstep through gloo collectives, so serially draining one
+        # while the other fills its PIPE would stall both.
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(len(procs)) as ex:
+            results = list(ex.map(
+                lambda p: p.communicate(timeout=1800), procs))
+        for p, (so, se) in zip(procs, results):
             if p.returncode != 0:
                 raise RuntimeError(
                     f"child rc={p.returncode}: {se[-800:]}")
@@ -94,6 +101,9 @@ def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
     width = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
     n_dev = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    if n_dev % 2 != 0:
+        raise SystemExit(f"n_dev={n_dev} must be even (the 2-process "
+                         f"run pins n_dev/2 devices per process)")
     print(f"n={n} width={width} global devices={n_dev}")
     one = run(1, n, width, n_dev)
     print(f"1 process : build cpu {one[0]['build_cpu_s']}s  "
