@@ -167,11 +167,18 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
 
         force_cpu_devices(cpu_devices)
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    kwargs = {}
+    import inspect
+
+    if ("heartbeat_timeout_seconds"
+            in inspect.signature(jax.distributed.initialize).parameters):
+        kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
+    # else: older jax without the knob — join with its default rather
+    # than failing every caller that never touched the parameter.
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
-        process_id=process_id,
-        heartbeat_timeout_seconds=heartbeat_timeout_seconds)
+        process_id=process_id, **kwargs)
     return jax.process_index()
 
 

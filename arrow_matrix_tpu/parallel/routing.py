@@ -205,11 +205,17 @@ def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
 
 
 def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
-                  axis: str = "blocks") -> jax.Array:
+                  axis: str = "blocks",
+                  feat_axis: Optional[str] = None) -> jax.Array:
     """Feature-major twin of ``routed_take``: ``out[:, j] =
     xt[:, table[j]]`` on a (k, total) array sharded on axis 1 — the
     exchange for the padding-free carried layouts
-    (parallel/sell_slim.py)."""
+    (parallel/sell_slim.py).
+
+    ``feat_axis`` additionally shards the feature rows (axis 0): the
+    tables are per-device along ``axis`` and independent of feature
+    rows, so each feature slice runs its own identical exchange — the
+    k-tiling axis composes with the explicit routing for free."""
     r_src, r_dst = route.rows_src, route.rows_dst
 
     def local_fn(xl, local_src, local_dst, send_idx, recv_dst):
@@ -230,8 +236,8 @@ def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
 
     spec = P(axis)
     fn = shard_map(local_fn, mesh=mesh,
-                   in_specs=(P(None, axis), spec, spec, spec, spec),
-                   out_specs=P(None, axis),
+                   in_specs=(P(feat_axis, axis), spec, spec, spec, spec),
+                   out_specs=P(feat_axis, axis),
                    check_vma=False)
     return fn(xt, route.local_src, route.local_dst, route.send_idx,
               route.recv_dst)

@@ -840,15 +840,15 @@ class SellMultiLevel:
         positions route from the local dummy and cost no cross-device
         slots; measured lowest comm volume AND fastest wall-clock of
         every execution mode); "gather" leaves them to the GSPMD
-        partitioner (may all-gather — kept for comparison)."""
+        partitioner (may all-gather — kept for comparison).
+        ``feat_axis`` (the k-tiling axis) composes with either routing:
+        the a2a tables are per-device and feature-row-independent, so
+        each feature slice runs its own identical exchange."""
         from arrow_matrix_tpu.parallel.multi_level import pad_permutation
 
         if routing not in ("gather", "a2a"):
             raise ValueError(f"unknown routing {routing!r}")
-        if feat_axis is not None and routing == "a2a":
-            raise ValueError(
-                "feat_axis composes with routing='gather' (the explicit "
-                "a2a exchange shards the feature rows per device)")
+
         self.routing = routing
         self.feat_axis = feat_axis
         self.feature_dtype = resolve_feature_dtype(feature_dtype)
@@ -931,7 +931,8 @@ class SellMultiLevel:
 
         def reorder(xt, table):
             if isinstance(table, RouteTables):
-                return routed_take_t(xt, table, mesh, axis)
+                return routed_take_t(xt, table, mesh, axis,
+                                     feat_axis=feat_axis)
             return lax.with_sharding_constraint(
                 jnp.take(xt, table, axis=1), feat_shard)
 

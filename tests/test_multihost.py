@@ -73,7 +73,17 @@ def test_peer_death_aborts_whole_job():
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for i in range(2)]
     try:
-        out1, _ = procs[1].communicate(timeout=120)
+        try:
+            out1, _ = procs[1].communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            # Proc 1 can be stuck in the 300s init barrier because the
+            # COORDINATOR failed to start (port TOCTOU etc.) — that is
+            # an environment skip, not a detection failure.
+            if (procs[0].poll() == 0
+                    and "CHILD_SKIP" in (procs[0].stdout.read() or "")):
+                pytest.skip("distributed runtime unavailable "
+                            "(coordinator failed to start)")
+            raise
         if procs[1].returncode == 0 and "CHILD_SKIP" in out1:
             pytest.skip(f"distributed runtime unavailable: "
                         f"{out1.strip()}")
@@ -106,9 +116,16 @@ def test_two_process_sell_multilevel():
         env=env) for i in range(2)]
     outs = []
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=420)
-            outs.append((p.returncode, out, err))
+        # Drain both children concurrently: they advance in lockstep
+        # through gloo collectives, so serially draining one while the
+        # other fills its pipe would stall both.
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(2) as ex:
+            pairs = list(ex.map(lambda p: p.communicate(timeout=420),
+                                procs))
+        outs = [(p.returncode, out, err)
+                for p, (out, err) in zip(procs, pairs)]
     finally:
         for p in procs:
             if p.poll() is None:

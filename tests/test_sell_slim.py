@@ -227,7 +227,9 @@ def test_sell_multi_level_from_artifact(tmp_path):
 
 def test_sell_multi_level_feat_axis():
     """k-dimension tiling: feature rows sharded over a second mesh axis
-    compose with the sell orchestration (gather routing)."""
+    compose with the sell orchestration under BOTH routings (the a2a
+    tables are per-device and feature-row-independent, so each feature
+    slice runs its own identical exchange)."""
     from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
     from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
 
@@ -236,15 +238,13 @@ def test_sell_multi_level_feat_axis():
     levels = arrow_decomposition(a, width, max_levels=2,
                                  block_diagonal=True, seed=1)
     mesh = make_mesh((4, 2), ("blocks", "feat"))
-    sm = SellMultiLevel(levels, width, mesh, routing="gather",
-                        feat_axis="feat")
     x = random_dense(n, 8, seed=2)
-    got = sm.gather_result(sm.step(sm.set_features(x)))
-    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
-                               rtol=1e-4, atol=1e-4)
-    with pytest.raises(ValueError, match="feat_axis"):
-        SellMultiLevel(levels, width, mesh, routing="a2a",
-                       feat_axis="feat")
+    want = decomposition_spmm(levels, x)
+    for routing in ("gather", "a2a"):
+        sm = SellMultiLevel(levels, width, mesh, routing=routing,
+                            feat_axis="feat")
+        got = sm.gather_result(sm.step(sm.set_features(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_directed_graph_through_fold_and_sell():
