@@ -111,6 +111,20 @@ def main() -> None:
         errs["15d"] = relative_error(
             d15.gather_result(d15.spmm(d15.set_features(x))), want1)
 
+    # Distributed training THROUGH the process boundary: GCN gradients
+    # cross the same multi-process collectives (psum / ppermute /
+    # routed all_to_all) the forward uses — the backprop property the
+    # single-process suite verifies, now with real process boundaries.
+    from arrow_matrix_tpu.models.propagation import GCNCarried
+
+    rngm = np.random.default_rng(9)
+    ym = rngm.standard_normal((n, 4)).astype(np.float32)
+    gcn = GCNCarried(ml, dims=(k, 6, 4), seed=0)
+    losses = gcn.fit(x, ym, steps=25)
+    assert np.isfinite(losses).all(), losses[:3]
+    assert losses[-1] < 0.9 * losses[0], (losses[0], losses[-1])
+    errs["gcn_fit"] = 0.0   # convergence asserted above
+
     # Checkpoint roundtrip across the process boundary: the save is a
     # collective fetch + single-writer npz; restore re-places onto the
     # (multi-process) sharding of the running executor.
