@@ -170,6 +170,10 @@ def main(argv=None) -> int:
         raise SystemExit("--checkpoint requires --carry (there is no "
                          "iteration state to resume when X is fresh "
                          "every iteration)")
+    if args.feature_dtype == "bf16" and args.fmt not in ("fold", "sell"):
+        ok = "sell" if args.mode == "space" else "fold or sell"
+        raise SystemExit(f"--feature_dtype bf16 needs --fmt {ok} "
+                         f"(the other formats carry f32)")
     if args.mode == "space":
         if args.fmt in ("hyb", "fold"):
             raise SystemExit(
@@ -294,11 +298,6 @@ def main(argv=None) -> int:
                 multi = SellSpaceShared(levels, width, mesh=space_mesh,
                                         feature_dtype=args.feature_dtype)
             else:
-                if args.feature_dtype not in (None, "f32"):
-                    raise SystemExit(
-                        "--feature_dtype bf16 under --mode space needs "
-                        "--fmt sell (the stacked space-shared layout "
-                        "carries f32)")
                 multi = SpaceSharedArrow(levels, width, fmt=args.fmt,
                                          mesh=space_mesh)
         else:
@@ -321,10 +320,6 @@ def main(argv=None) -> int:
                                        routing=args.routing,
                                        feature_dtype=args.feature_dtype)
             else:
-                if args.feature_dtype not in (None, "f32") \
-                        and args.fmt != "fold":
-                    raise SystemExit(
-                        "--feature_dtype bf16 needs --fmt fold or sell")
                 multi = MultiLevelArrow(
                     levels, width, mesh=mesh,
                     banded=not args.blocked, fmt=args.fmt,

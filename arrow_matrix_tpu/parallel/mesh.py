@@ -96,7 +96,7 @@ def fetch_replicated(arr) -> np.ndarray:
     return np.asarray(arr.addressable_data(0))
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _replicator(repl: NamedSharding):
     # One jitted identity per target sharding: a fresh lambda per fetch
     # would miss the jit cache and recompile the all-gather every call.
