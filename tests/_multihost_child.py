@@ -133,6 +133,24 @@ def main() -> None:
     from arrow_matrix_tpu.utils import checkpoint as ckpt
 
     state = ml.run(xt, 1)
+
+    # Orbax path first (it coordinates multi-process saves natively,
+    # writing each process's shards without a host gather).
+    if ckpt._orbax() is not None:
+        opath = os.path.join(tempfile.gettempdir(),
+                             f"mh_ckpt_orbax_{port}")
+        try:
+            ckpt.save_state(opath, state, step=2)
+            r2, s2 = ckpt.load_state(opath, like=state)
+            assert s2 == 2 and r2.sharding == state.sharding
+            errs["ckpt_orbax"] = relative_error(
+                ml.gather_result(r2), ml.gather_result(state))
+        finally:
+            if pid == 0:
+                import shutil
+
+                shutil.rmtree(opath, ignore_errors=True)
+
     path = os.path.join(tempfile.gettempdir(), f"mh_ckpt_{port}")
     ckpt._orbax = lambda: None   # force the npz single-writer path
     ckpt.save_state(path, state, step=1)   # barrier lives in save_state
