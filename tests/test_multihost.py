@@ -105,6 +105,59 @@ def test_peer_death_aborts_whole_job():
                 p.kill()
 
 
+def _run_cli_pair(args: list, cwd: str, timeout: float = 420):
+    """Launch the spmm_arrow CLI as 2 coordinated processes from the
+    same cwd, drain both concurrently, return [(rc, out+err), ...]."""
+    import concurrent.futures as cf
+
+    port = _free_port()
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join(
+                   [os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__)))]
+                   + os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+    cmd = [sys.executable, "-m", "arrow_matrix_tpu.cli.spmm_arrow",
+           *args, "--device", "cpu", "--devices", "2",
+           "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2"]
+    procs = [subprocess.Popen(cmd + ["--process-id", str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              env=env, cwd=cwd) for i in range(2)]
+    try:
+        with cf.ThreadPoolExecutor(2) as ex:
+            outs = list(ex.map(lambda p: p.communicate(timeout=timeout),
+                               procs))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return [(p.returncode, out) for p, (out, _) in zip(procs, outs)]
+
+
+def test_distributed_checkpoint_resume(tmp_path):
+    """Crash recovery across processes through the real CLI: a
+    2-process run checkpoints its carried state, 'crashes' (run ends),
+    and a fresh 2-process launch RESUMES from the checkpoint and
+    validates every remaining iteration — the reference has no runtime
+    recovery at all (detection only, SURVEY.md §5); this is the full
+    story the per-iteration validation + checkpoint/resume + multihost
+    placement add up to."""
+    base = ["--vertices", "1024", "--ba_neighbors", "3", "--width",
+            "64", "--features", "4", "--fmt", "sell", "--carry",
+            "--checkpoint", "ckpt", "--checkpoint_every", "1",
+            "--validate", "true"]
+    first = _run_cli_pair(base + ["--iterations", "2"], str(tmp_path))
+    for rc, out in first:
+        if "CHILD_SKIP" in out:
+            pytest.skip("distributed runtime unavailable")
+        assert rc == 0, out[-2000:]
+
+    second = _run_cli_pair(base + ["--iterations", "4"], str(tmp_path))
+    for rc, out in second:
+        assert rc == 0, out[-2000:]
+        assert "resumed from ckpt at iteration 2" in out, out[-2000:]
+
+
 def test_two_process_sell_multilevel():
     port = _free_port()
     env = dict(os.environ)
