@@ -1,0 +1,135 @@
+"""``amt_doctor`` — environment diagnosis for the framework.
+
+Packages the operational knowledge the other entry points depend on
+into one read-only command: which JAX backend is reachable (with a
+bounded subprocess probe — a wedged TPU tunnel hangs ``jax.devices()``
+indefinitely, the failure mode every CLI here defends against), how
+many devices a virtual CPU pool would give, whether the native C++
+decomposer builds, whether cross-process collectives are available,
+and the state of the benchmark caches.
+
+Prints one human-readable report and exits 0 when the core checks
+pass (accelerator reachability is reported but NOT required — the
+framework's CPU paths are first-class).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _check(label: str, ok, detail: str = "") -> bool:
+    mark = {True: "ok  ", False: "FAIL", None: "warn"}[ok]
+    print(f"[{mark}] {label}" + (f": {detail}" if detail else ""),
+          flush=True)
+    return ok is not False
+
+
+def probe_accelerator(timeout_s: float) -> tuple[bool, str]:
+    """Bounded real-data round-trip on the DEFAULT backend (the shared
+    probe contract, utils.platform.probe_default_backend)."""
+    from arrow_matrix_tpu.utils.platform import probe_default_backend
+
+    platform, kind, err = probe_default_backend(timeout_s=timeout_s,
+                                                retries=1)
+    if err is not None:
+        return False, (f"{err} — wedged tunnel / hung PJRT plugin? "
+                       f"(CLIs degrade to CPU; see --device cpu)")
+    return True, f"{platform} {kind}"
+
+
+def probe_cpu_pool(n: int) -> tuple[bool, str]:
+    code = (f"import sys; sys.argv=[]; "
+            f"from arrow_matrix_tpu.utils.platform import "
+            f"force_cpu_devices; force_cpu_devices({n}); import jax; "
+            f"print(len(jax.devices()), jax.devices()[0].platform)")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        return False, proc.stderr.strip()[-120:]
+    got = proc.stdout.split()
+    return got[:2] == [str(n), "cpu"], f"{got[0]} virtual cpu devices"
+
+
+def probe_gloo() -> tuple[bool | None, str]:
+    try:
+        import jax
+
+        impl = jax.config.jax_cpu_collectives_implementation
+        return True, (f"cpu collectives impl available "
+                      f"(current: {impl or 'default'})")
+    except (ImportError, AttributeError) as e:
+        return None, (f"cpu-collectives knob unavailable ({e}); "
+                      f"multi-process CPU runs may not work")
+
+
+def probe_native() -> tuple[bool | None, str]:
+    try:
+        from arrow_matrix_tpu.decomposition import native
+
+        if not native.available():
+            err = native.load_error()
+            return None, ("C++ decomposer unavailable"
+                          + (f" ({err})" if err else "")
+                          + " — the numpy backend will be used")
+        return True, "C++ decomposer built and loadable"
+    except Exception as e:
+        return None, f"{type(e).__name__}: {str(e)[:100]}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--probe-timeout", type=float, default=90.0,
+                    help="seconds to wait for the accelerator probe")
+    ap.add_argument("--devices", type=int, default=4,
+                    help="virtual CPU pool size to verify")
+    args = ap.parse_args(argv)
+
+    ok = True
+    print("arrow-matrix-tpu doctor\n")
+
+    import importlib
+
+    for mod in ("jax", "flax", "optax", "scipy", "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            _check(f"import {mod}", True,
+                   getattr(m, "__version__", "?"))
+        except ImportError as e:
+            ok &= _check(f"import {mod}", False, str(e)[:100])
+
+    acc_ok, detail = probe_accelerator(args.probe_timeout)
+    _check("accelerator (default backend, bounded probe)",
+           True if acc_ok else None, detail)
+
+    good, detail = probe_cpu_pool(args.devices)
+    ok &= _check(f"virtual CPU pool ({args.devices} devices)", good,
+                 detail)
+
+    g, detail = probe_gloo()
+    _check("multi-process collectives", g, detail)
+
+    n, detail = probe_native()
+    _check("native decomposer", n, detail)
+
+    cache = "bench_cache"
+    if os.path.isdir(cache):
+        done = [f for f in os.listdir(cache) if f.endswith(".complete")]
+        _check("bench decomposition caches", True if done else None,
+               f"{len(done)} cached" if done
+               else "none (first bench run decomposes from scratch)")
+    else:
+        _check("bench decomposition caches", None,
+               "no bench_cache/ (first bench run decomposes from "
+               "scratch)")
+
+    print()
+    print("core checks passed" if ok else "CORE CHECKS FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -94,3 +94,46 @@ def device_memory_budget(device=None, fraction: float = 0.5,
         except (ValueError, OSError, AttributeError):
             pass
     return default
+
+
+def probe_default_backend(timeout_s: float = 60.0, retries: int = 2
+                          ) -> tuple[str, str, str | None]:
+    """Initialize-check the DEFAULT JAX backend in a subprocess with a
+    real-data round-trip (device enumeration alone passes on a
+    half-healthy tunnel) and a hard timeout (a wedged PJRT plugin
+    hangs ``jax.devices()`` indefinitely).
+
+    Returns (platform, device_kind, error); on repeated failure
+    reports platform "cpu" with the last error so callers can degrade
+    instead of hanging.  Shared by bench.py and the doctor CLI — one
+    copy of the probe contract.
+    """
+    import subprocess
+    import sys
+    import time
+
+    code = ("import jax; d = jax.devices()[0]; "
+            "v = float(jax.numpy.ones((8, 8)).sum()); "
+            "print(d.platform); print(d.device_kind)")
+    err = None
+    for attempt in range(retries):
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=timeout_s)
+            # Anchor on the LAST two lines: a site plugin may print a
+            # banner to stdout before our prints.
+            lines = [ln.strip() for ln in proc.stdout.splitlines()
+                     if ln.strip()]
+            if proc.returncode == 0 and len(lines) >= 2:
+                return lines[-2], lines[-1], None
+            if proc.returncode == 0 and lines:
+                return lines[-1], "unknown", None
+            err = (f"backend probe rc={proc.returncode}: "
+                   f"{proc.stderr.strip()[-400:]}")
+        except subprocess.TimeoutExpired:
+            err = (f"backend probe timed out after {timeout_s:.0f}s "
+                   f"(PJRT plugin init hang)")
+        if attempt < retries - 1:
+            time.sleep(min(5.0 * 2 ** attempt, 30.0))
+    return "cpu", "host", err

@@ -67,48 +67,15 @@ def _peak_bw(device_kind: str) -> float | None:
 
 def probe_backend(timeout_s: float = 60.0, retries: int = 2
                   ) -> tuple[str, str, str | None]:
-    """Initialize-check the default JAX backend in a subprocess.
+    """Initialize-check the default JAX backend (see
+    utils.platform.probe_default_backend — one copy of the probe
+    contract, shared with the doctor CLI).  On repeated failure
+    reports platform "cpu" so the bench still produces a measurement,
+    flagged as degraded; the parent process itself never touches a
+    backend."""
+    from arrow_matrix_tpu.utils.platform import probe_default_backend
 
-    Returns (platform, device_kind, error).  On repeated failure
-    (nonzero rc *or hang* — the round-1 failure mode was
-    `jax.devices()` hanging inside the site-registered TPU tunnel
-    plugin) reports platform "cpu" and the last error so the bench
-    still produces a measurement, flagged as degraded.  The parent
-    process itself never touches a backend.
-
-    The probe round-trips a small computation, not just device
-    enumeration: a HALF-healthy tunnel (round-2 failure mode) passes
-    backend init but wedges on the first transfer — `jax.devices()`
-    alone would wave it through and every race candidate would then
-    burn its full timeout against a dead link.
-    """
-    code = ("import jax; d = jax.devices()[0]; "
-            "v = float(jax.numpy.ones((8, 8)).sum()); "
-            "print(d.platform); print(d.device_kind)")
-    err = None
-    for attempt in range(retries):
-        try:
-            proc = subprocess.run([sys.executable, "-c", code],
-                                  capture_output=True, text=True,
-                                  timeout=timeout_s)
-            # Anchor on the LAST two lines: a site plugin may print a
-            # banner to stdout before our prints, and a corrupted
-            # platform string would silently disable every
-            # platform-keyed guard (FORCECPU, degraded mode).
-            lines = [ln.strip() for ln in proc.stdout.splitlines()
-                     if ln.strip()]
-            if proc.returncode == 0 and len(lines) >= 2:
-                return lines[-2], lines[-1], None
-            if proc.returncode == 0 and lines:
-                return lines[-1], "unknown", None
-            err = (f"backend probe rc={proc.returncode}: "
-                   f"{proc.stderr.strip()[-400:]}")
-        except subprocess.TimeoutExpired:
-            err = (f"backend probe timed out after {timeout_s:.0f}s "
-                   f"(PJRT plugin init hang)")
-        if attempt < retries - 1:
-            time.sleep(min(5.0 * 2 ** attempt, 30.0))
-    return "cpu", "host", err
+    return probe_default_backend(timeout_s=timeout_s, retries=retries)
 
 
 def _maybe_force_cpu() -> None:

@@ -324,3 +324,13 @@ def test_spmm_arrow_memmap_streaming(tmp_path, monkeypatch):
             "--memmap", "true", "--logdir", str(tmp_path / "logs"),
         ] + extra)
         assert rc == 0, extra
+
+
+def test_doctor():
+    """Environment doctor: runs read-only checks and exits 0 in this
+    (known-good) environment; the accelerator probe is bounded and
+    never gates."""
+    from arrow_matrix_tpu.cli import doctor
+
+    rc = doctor.main(["--probe-timeout", "5", "--devices", "2"])
+    assert rc == 0
