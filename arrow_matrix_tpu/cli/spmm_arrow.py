@@ -150,6 +150,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "reference's only resume point is the "
                              "decomposition artifact.")
     parser.add_argument("--checkpoint_every", type=int, default=10)
+    parser.add_argument("--trace", type=str, default=None,
+                        help="Write a jax.profiler trace of the "
+                             "iteration loop to this directory "
+                             "(viewable in XProf/TensorBoard; the "
+                             "per-op device-time counterpart of the "
+                             "named-segment wall timing).")
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
@@ -349,60 +355,74 @@ def main(argv=None) -> int:
                 x, start_it = state
                 print(f"resumed from {args.checkpoint} at iteration "
                       f"{start_it}")
-    for it in range(start_it, args.iterations):
-        wb.set_iteration_data({"iteration": it})
-        if args.carry:
-            x_host = None
-        else:
-            # Fresh random X every iteration (arrow_bench.py:114-116).
-            x_host = graphs.random_dense(n, args.features,
-                                         seed=int(rng.integers(2**31)))
-            x = multi.set_features(x_host)
-        try:
-            if args.carry and args.validate:
-                # The golden compares one step from the CURRENT state.
-                x_host = multi.gather_result(x)
-            tic = time.perf_counter()
-            y = multi.step(x)
-            jax.block_until_ready(y)
-            wb.log({"spmm_time": time.perf_counter() - tic})
-            if args.carry:
-                x = y
-        except Exception as e:  # abort like the collective LOR flag
-            print(f"iteration {it} failed: {e}")
-            fail = True
-            break
-        if args.validate:
-            from arrow_matrix_tpu.utils import numerics
+    # --trace wraps the iteration loop; the finally below flushes the
+    # profiler even when an exception escapes the step's own
+    # try/except (validate block, save_state, Ctrl-C).
+    from contextlib import ExitStack
 
-            got = multi.gather_result(y)
-            want = decomposition_spmm(golden_levels, x_host)
-            err = numerics.relative_error(got, want)
-            # One step separates the compared states (X is fresh per
-            # iteration); tolerance per the documented accumulation-
-            # order policy (utils/numerics.py).  bf16 carriage rounds
-            # inputs and outputs to 8-bit mantissas: the bound becomes
-            # the bf16 epsilon, not the f32 accumulation model.
-            tol = numerics.relative_tolerance(
-                sum(l.matrix.nnz for l in golden_levels) / max(n, 1),
-                iters=1)
-            if args.feature_dtype == "bf16":
-                tol = max(tol, 2e-2)
-            wb.log({"frobenius_err": float(err)})
-            print(f"iteration {it}: rel err vs host {err:.3e} "
-                  f"(gate {tol:.1e})")
-            if not np.isfinite(err) or err > tol:
+    _trace_stack = ExitStack()
+    if args.trace:
+        _trace_stack.enter_context(wb.trace(args.trace))
+    try:
+        for it in range(start_it, args.iterations):
+            wb.set_iteration_data({"iteration": it})
+            if args.carry:
+                x_host = None
+            else:
+                # Fresh random X every iteration (arrow_bench.py:114-116).
+                x_host = graphs.random_dense(n, args.features,
+                                             seed=int(rng.integers(2**31)))
+                x = multi.set_features(x_host)
+            try:
+                if args.carry and args.validate:
+                    # The golden compares one step from the CURRENT state.
+                    x_host = multi.gather_result(x)
+                tic = time.perf_counter()
+                y = multi.step(x)
+                jax.block_until_ready(y)
+                wb.log({"spmm_time": time.perf_counter() - tic})
+                if args.carry:
+                    x = y
+            except Exception as e:  # abort like the collective LOR flag
+                print(f"iteration {it} failed: {e}")
                 fail = True
                 break
-        # Checkpoint only a state that passed this iteration's gates —
-        # persisting before validation would let a rerun resume past
-        # (and so mask) a numerically bad iteration.
-        if (args.carry and args.checkpoint
-                and (it + 1) % max(args.checkpoint_every, 1) == 0):
-            from arrow_matrix_tpu.utils.checkpoint import save_state
+            if args.validate:
+                from arrow_matrix_tpu.utils import numerics
 
-            save_state(args.checkpoint, x, it + 1)
+                got = multi.gather_result(y)
+                want = decomposition_spmm(golden_levels, x_host)
+                err = numerics.relative_error(got, want)
+                # One step separates the compared states (X is fresh per
+                # iteration); tolerance per the documented accumulation-
+                # order policy (utils/numerics.py).  bf16 carriage rounds
+                # inputs and outputs to 8-bit mantissas: the bound becomes
+                # the bf16 epsilon, not the f32 accumulation model.
+                tol = numerics.relative_tolerance(
+                    sum(l.matrix.nnz for l in golden_levels) / max(n, 1),
+                    iters=1)
+                if args.feature_dtype == "bf16":
+                    tol = max(tol, 2e-2)
+                wb.log({"frobenius_err": float(err)})
+                print(f"iteration {it}: rel err vs host {err:.3e} "
+                      f"(gate {tol:.1e})")
+                if not np.isfinite(err) or err > tol:
+                    fail = True
+                    break
+            # Checkpoint only a state that passed this iteration's gates —
+            # persisting before validation would let a rerun resume past
+            # (and so mask) a numerically bad iteration.
+            if (args.carry and args.checkpoint
+                    and (it + 1) % max(args.checkpoint_every, 1) == 0):
+                from arrow_matrix_tpu.utils.checkpoint import save_state
 
+                save_state(args.checkpoint, x, it + 1)
+
+    finally:
+        # The flush must survive exceptions outside the
+        # step's own try/except (validate block, save_state,
+        # Ctrl-C) — a requested trace must never be lost.
+        _trace_stack.close()
     summary = wb.get_log().summarize()
     if "spmm_time" in summary:
         s = summary["spmm_time"]

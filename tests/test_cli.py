@@ -334,3 +334,21 @@ def test_doctor():
 
     rc = doctor.main(["--probe-timeout", "5", "--devices", "2"])
     assert rc == 0
+
+
+def test_spmm_arrow_trace(tmp_path, monkeypatch):
+    """--trace writes a jax.profiler trace directory for the loop."""
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_arrow.main([
+        "--vertices", "300", "--width", "32", "--features", "4",
+        "--iterations", "1", "--device", "cpu",
+        "--trace", str(tmp_path / "trc"),
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    # The trace must be FLUSHED, not just the directory created on
+    # context entry: profiler output lands under plugins/profile.
+    found = []
+    for root, _, files in os.walk(tmp_path / "trc"):
+        found += [os.path.join(root, f) for f in files]
+    assert found, "trace directory contains no profiler output"
