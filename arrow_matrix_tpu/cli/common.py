@@ -76,6 +76,11 @@ def setup_platform(args: argparse.Namespace) -> None:
             force_cpu_devices(args.devices if args.devices > 0 else None)
             jax.config.update("jax_cpu_collectives_implementation",
                               "gloo")
+        elif args.device == "tpu":
+            # Same platform pin as the single-process path: with
+            # multiple registered PJRT plugins the default priority
+            # may initialize the wrong backend.
+            os.environ.setdefault("JAX_PLATFORMS", "tpu")
         initialize_multihost(coordinator, args.num_processes,
                              args.process_id)
         return

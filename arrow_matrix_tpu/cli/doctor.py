@@ -45,13 +45,21 @@ def probe_cpu_pool(n: int) -> tuple[bool, str]:
     code = (f"import sys; sys.argv=[]; "
             f"from arrow_matrix_tpu.utils.platform import "
             f"force_cpu_devices; force_cpu_devices({n}); import jax; "
-            f"print(len(jax.devices()), jax.devices()[0].platform)")
-    proc = subprocess.run([sys.executable, "-c", code],
-                          capture_output=True, text=True, timeout=120)
+            f"print('POOL', len(jax.devices()), "
+            f"jax.devices()[0].platform)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=120)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 120s"
     if proc.returncode != 0:
-        return False, proc.stderr.strip()[-120:]
-    got = proc.stdout.split()
-    return got[:2] == [str(n), "cpu"], f"{got[0]} virtual cpu devices"
+        return False, proc.stderr.strip()[-120:] or f"rc={proc.returncode}"
+    # Last-line anchoring: a site plugin may print a banner first.
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("POOL")]
+    got = lines[-1].split()[1:] if lines else []
+    return got == [str(n), "cpu"], (f"{got[0]} virtual cpu devices"
+                                    if got else "no probe output")
 
 
 def probe_gloo() -> tuple[bool | None, str]:

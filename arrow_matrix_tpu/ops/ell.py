@@ -268,9 +268,13 @@ def ell_spmm_t(cols: jax.Array, x_t: jax.Array,
         g = g.reshape(k, c, rows)
         # f32 accumulation whatever the carried feature dtype: bf16
         # features (half the gathered bytes — the k=128 bandwidth
-        # lever) must not also mean bf16 sums.  No-op for f32 inputs.
-        return (g * w_c[None].astype(g.dtype)).sum(
-            axis=1, dtype=jnp.float32)
+        # lever) must not also mean bf16 sums, and f32 matrix VALUES
+        # must not demote — jnp promotion makes bf16*f32 -> f32 (a
+        # bool binary mask promotes to g's dtype, exact either way).
+        # The carried result still rounds to x_t.dtype at tier/level
+        # boundaries — inherent to a bf16 carriage, documented in
+        # resolve_feature_dtype.
+        return (g * w_c[None]).sum(axis=1, dtype=jnp.float32)
 
     if n_chunks == 1:
         if data is not None:

@@ -97,8 +97,16 @@ def resolve_feature_dtype(feature_dtype):
     """Carried-feature storage dtype (None = f32, the gate-exact
     default — normalized so explicit "f32" behaves like None).  bf16
     halves the bytes of every gathered row AND every inter-level
-    collective; kernels still accumulate in f32 (ops/ell.py), but
-    per-step rounding (~1e-3 rel) puts it outside the f32 gate."""
+    collective; kernels accumulate each tier's slot sum in f32 with
+    full-precision matrix values (ops/ell.py), but the CARRIED value
+    rounds to bf16 at tier/level boundaries — ~1e-3 rel err/step,
+    outside the f32 gate.
+
+    Contract: executors consult ``self.feature_dtype`` only in
+    ``set_features`` (operators are dtype-independent), so retargeting
+    the attribute between calls measures both carriages against one
+    build — bench.py's k128 rerun and tools/gather_probe.py rely on
+    this."""
     if feature_dtype is None:
         return None
     resolved = resolve_block_dtype(feature_dtype)

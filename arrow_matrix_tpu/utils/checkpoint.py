@@ -61,6 +61,22 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("amt_ckpt_saved")
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # A failed writer must fail EVERY process, not leave peers
+        # believing a stale checkpoint is current: verify the write
+        # landed at the step just saved (npz members load lazily —
+        # this reads only the scalar).
+        try:
+            with np.load(path + ".npz") as z:
+                on_disk = int(z["step"])
+        except (OSError, KeyError, ValueError) as e:
+            raise RuntimeError(
+                f"checkpoint write failed on process 0 "
+                f"(unreadable {path}.npz: {e})") from e
+        if on_disk != step:
+            raise RuntimeError(
+                f"checkpoint write failed on process 0 (on-disk step "
+                f"{on_disk} != saved step {step})")
 
 
 def load_state(path: str, like: Optional[jax.Array] = None

@@ -40,22 +40,14 @@ def log(msg: str) -> None:
 
 
 def probe(timeout_s: float = 90.0) -> bool:
-    """True iff a subprocess can round-trip real data through the chip
-    on the default (site-registered) backend."""
-    code = ("import jax; d = jax.devices()[0]; "
-            "import numpy as np; "
-            "x = jax.device_put(np.arange(4096, dtype=np.float32), d); "
-            "print('PROBE_OK', d.platform, float(x.sum()))")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True,
-                              timeout=timeout_s, cwd=REPO)
-        ok = proc.returncode == 0 and "PROBE_OK" in proc.stdout
-        if ok and "cpu" in proc.stdout.split("PROBE_OK")[-1].lower():
-            return False   # healthy JAX but no accelerator registered
-        return ok
-    except subprocess.TimeoutExpired:
-        return False
+    """True iff the default backend is a healthy ACCELERATOR (one
+    shared probe contract: utils.platform.probe_default_backend)."""
+    sys.path.insert(0, REPO)
+    from arrow_matrix_tpu.utils.platform import probe_default_backend
+
+    platform, _, err = probe_default_backend(timeout_s=timeout_s,
+                                             retries=1)
+    return err is None and platform != "cpu"
 
 
 def run_stage(name: str, cmd: list[str], env: dict, timeout_s: float,
