@@ -150,6 +150,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "reference's only resume point is the "
                              "decomposition artifact.")
     parser.add_argument("--checkpoint_every", type=int, default=10)
+    parser.add_argument("--comm_report", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Account the per-iteration collective "
+                             "bytes of the compiled step from its HLO "
+                             "before running (communication volume is "
+                             "the reference paper's headline metric; "
+                             "utils/commstats).")
     parser.add_argument("--trace", type=str, default=None,
                         help="Write a jax.profiler trace of the "
                              "iteration loop to this directory "
@@ -340,6 +347,33 @@ def main(argv=None) -> int:
     warm = multi.set_features(
         graphs.random_dense(n, args.features, seed=args.seed))
     jax.block_until_ready(multi.step(warm))
+
+    if args.comm_report:
+        from arrow_matrix_tpu.utils import commstats
+
+        if getattr(multi, "mesh", None) is None:
+            print("comm report: single-chip execution — zero "
+                  "collective bytes by construction")
+        elif (getattr(multi, "feature_dtype", None) is not None
+                and getattr(multi, "routing", None) == "a2a"):
+            # bf16 carriage: the CPU backend upcasts compiled
+            # collectives to f32, so account the LOWERED module (all
+            # a2a-path collectives are explicit shard_map ops and
+            # appear there; commstats docstring).
+            stats = commstats.lowered_collective_stats(
+                multi.step_fn, warm, *multi.step_operands())
+            print("per-iteration collective bytes (lowered HLO — "
+                  "dtype-honest for the bf16 carriage):")
+            print(commstats.format_stats(stats))
+        else:
+            stats = commstats.collective_stats(
+                multi.step_fn, warm, *multi.step_operands())
+            print("per-iteration collective bytes (compiled HLO):")
+            if getattr(multi, "feature_dtype", None) is not None:
+                print("(note: on the CPU backend compiled collectives "
+                      "upcast bf16 to f32 — bytes shown are the f32 "
+                      "upper bound)")
+            print(commstats.format_stats(stats))
 
     rng = np.random.default_rng(args.seed)
     fail = False

@@ -261,6 +261,15 @@ class SpaceSharedArrow:
         return put_global(
             x_all, NamedSharding(self.mesh, P(self.lvl_axis, self.axis)))
 
+    @property
+    def step_fn(self):
+        """Jitted step callable: ``step(x) == step_fn(x,
+        *step_operands())`` (the executor-uniform pair)."""
+        return self._step
+
+    def step_operands(self):
+        return (self.bwd0, self.fwd0, self.blocks)
+
     def gather_result(self, x_all: jax.Array) -> np.ndarray:
         """(K, total, k) device result -> host (n, k) in original row
         order (level 0's slice IS the canonical aggregate)."""

@@ -112,8 +112,12 @@ def probe_default_backend(timeout_s: float = 60.0, retries: int = 2
     import sys
     import time
 
-    code = ("import jax; d = jax.devices()[0]; "
-            "v = float(jax.numpy.ones((8, 8)).sum()); "
+    # A non-trivial (64 KB) transfer: the observed tunnel wedge mode
+    # hangs MID-TRANSFER, so a few-byte round-trip can pass on a link
+    # that will hang the first real upload.
+    code = ("import jax, numpy as np; d = jax.devices()[0]; "
+            "x = jax.device_put(np.arange(16384, dtype=np.float32), d); "
+            "v = float(x.sum()); "
             "print(d.platform); print(d.device_kind)")
     err = None
     for attempt in range(retries):

@@ -352,3 +352,19 @@ def test_spmm_arrow_trace(tmp_path, monkeypatch):
     for root, _, files in os.walk(tmp_path / "trc"):
         found += [os.path.join(root, f) for f in files]
     assert found, "trace directory contains no profiler output"
+
+
+def test_spmm_arrow_comm_report(tmp_path, monkeypatch, capsys):
+    """--comm_report prints per-iteration collective bytes from the
+    compiled step's HLO (mesh) or the zero-by-construction note
+    (single chip)."""
+    monkeypatch.chdir(tmp_path)
+    rc = spmm_arrow.main([
+        "--vertices", "400", "--width", "32", "--features", "4",
+        "--iterations", "1", "--device", "cpu", "--devices", "4",
+        "--fmt", "sell", "--routing", "a2a", "--comm_report",
+        "--logdir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "collective" in out and "TOTAL" in out

@@ -42,7 +42,8 @@ def log(msg: str) -> None:
 def probe(timeout_s: float = 90.0) -> bool:
     """True iff the default backend is a healthy ACCELERATOR (one
     shared probe contract: utils.platform.probe_default_backend)."""
-    sys.path.insert(0, REPO)
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
     from arrow_matrix_tpu.utils.platform import probe_default_backend
 
     platform, _, err = probe_default_backend(timeout_s=timeout_s,
