@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "  <= 0 disables chunking.")
     parser.add_argument("-z", "--iterations", type=int, default=10)
     parser.add_argument("--logdir", type=str, default="./logs")
+    parser.add_argument("--comm_report", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Account the per-iteration collective "
+                             "bytes of the compiled step from its HLO "
+                             "(compare against spmm_arrow's modes — "
+                             "the reference paper's headline metric).")
     add_device_args(parser)
     add_distributed_args(parser)
     return parser
@@ -114,6 +120,12 @@ def main(argv=None) -> int:
 
     y = dist.spmm(x)  # compile + warmup
     jax.block_until_ready(y)
+    if args.comm_report:
+        from arrow_matrix_tpu.utils import commstats
+
+        stats = commstats.collective_stats(dist._step, dist.a_cols, dist.a_data, x)
+        print("per-iteration collective bytes (compiled HLO):")
+        print(commstats.format_stats(stats))
     for it in range(args.iterations):
         wb.set_iteration_data({"iteration": it})
         tic = time.perf_counter()

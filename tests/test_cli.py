@@ -368,3 +368,19 @@ def test_spmm_arrow_comm_report(tmp_path, monkeypatch, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "collective" in out and "TOTAL" in out
+
+
+def test_baseline_comm_reports(tmp_path, monkeypatch, capsys):
+    """--comm_report on both baseline CLIs (the paper's comparison:
+    arrow modes vs 1.5D vs PETSc comm volume, all CLI-printable)."""
+    monkeypatch.chdir(tmp_path)
+    for mod in (spmm_15d, spmm_petsc):
+        rc = mod.main([
+            "--vertices", "256", "--edges", "1024", "--columns", "4",
+            "--iterations", "1", "--validate", "true", "--device",
+            "cpu", "--devices", "4", "--comm_report",
+            "--logdir", str(tmp_path / "logs"),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "collective" in out and "TOTAL" in out
