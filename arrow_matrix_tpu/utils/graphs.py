@@ -129,3 +129,20 @@ def random_dense(rows: int, cols: int, seed: int | None = None,
     """Uniform [-1, 1) dense matrix (reference arrow/common/utils.py:90-99)."""
     rng = np.random.default_rng(seed)
     return rng.uniform(-1.0, 1.0, size=(rows, cols)).astype(dtype)
+
+
+def grid_graph(side: int, dtype=np.float32) -> sparse.csr_matrix:
+    """side x side 2-D lattice adjacency (4-neighbor), the canonical
+    planar graph — the class the reference paper's communication
+    advantage is proved for ("planar / minor-excluded", its README):
+    under a row-major linearization the adjacency is banded with
+    bandwidth `side`, so the arrow decomposition converges immediately
+    at width >= side and the distributed step routes almost nothing."""
+    eye = sparse.identity(side, dtype=dtype, format="csr")
+    line = sparse.diags([1, 1], [-1, 1], shape=(side, side),
+                        dtype=dtype, format="csr")
+    a = sparse.kron(eye, line) + sparse.kron(line, eye)
+    a = a.tocsr()
+    a.sum_duplicates()
+    a.sort_indices()
+    return a.astype(dtype)

@@ -116,3 +116,34 @@ def test_deterministic_fallback():
     x = random_dense(200, 8, seed=1)
     np.testing.assert_allclose(decomposition_spmm(l1, x), a @ x, rtol=1e-4,
                                atol=1e-4)
+
+
+def test_banded_input_fast_path():
+    """An already-banded matrix (the planar-graph class under its
+    natural order — e.g. a row-major 2-D grid) decomposes to ONE
+    identity-permutation level: zero inter-level routing where the
+    forest linearization would have scrambled it into several levels."""
+    from arrow_matrix_tpu.decomposition.decompose import (
+        arrow_decomposition,
+        decomposition_spmm,
+    )
+    from arrow_matrix_tpu.utils.graphs import grid_graph, random_dense
+
+    a = grid_graph(32)            # n=1024, bandwidth 32
+    levels = arrow_decomposition(a, 64, max_levels=8,
+                                 block_diagonal=True, seed=0)
+    assert len(levels) == 1
+    np.testing.assert_array_equal(levels[0].permutation,
+                                  np.arange(1024))
+    assert levels[0].arrow_width <= 64
+    x = random_dense(1024, 4, seed=1)
+    np.testing.assert_allclose(decomposition_spmm(levels, x),
+                               np.asarray(a @ x), rtol=1e-5, atol=1e-5)
+
+    # A hub graph must NOT take the fast path.
+    from arrow_matrix_tpu.utils.graphs import barabasi_albert
+
+    b = barabasi_albert(512, 3, seed=2)
+    lv = arrow_decomposition(b, 32, max_levels=4, block_diagonal=True,
+                             seed=0)
+    assert len(lv) > 1
