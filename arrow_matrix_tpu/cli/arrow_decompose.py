@@ -54,6 +54,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "(decomposition_main.py:157-162).")
     parser.add_argument("--out_dir", type=str, default=None,
                         help="Output directory (default: dataset_dir).")
+    parser.add_argument("--band_detect", type=str2bool, nargs="?",
+                        default=True,
+                        help="Detect banded/bandable inputs (identity "
+                             "or RCM order) and emit ONE level with "
+                             "zero routing; false restores the plain "
+                             "recursion (e.g. to regenerate legacy "
+                             "multi-level artifacts).")
     parser.add_argument("--backend", type=str, default="auto",
                         choices=["auto", "native", "numpy"],
                         help="Linearization backend: native C++ kernels "
@@ -107,7 +114,7 @@ def decompose_one(path: str, args: argparse.Namespace) -> None:
     levels = arrow_decomposition(
         a, arrow_width=args.width, max_levels=args.levels,
         block_diagonal=args.block_diagonal, seed=args.seed,
-        backend=args.backend)
+        backend=args.backend, band_detect=args.band_detect)
     print(f"decomposed into {len(levels)} levels in "
           f"{time.perf_counter() - tic:.1f}s; achieved widths "
           f"{[l.arrow_width for l in levels]}")

@@ -232,6 +232,8 @@ def main(argv=None) -> int:
                 a, arrow_width=width, max_levels=10,
                 block_diagonal=args.blocked, seed=args.seed,
                 backend=args.backend)
+            # (generated graphs are Barabasi-Albert — the band gate
+            # never fires on them, so no flag plumbed here)
             save_decomposition(levels, base, block_diagonal=args.blocked)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils

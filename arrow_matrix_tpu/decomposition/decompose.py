@@ -139,13 +139,33 @@ def _linear_order(a: sparse.csr_matrix, width: int, deterministic: bool,
     return order.astype(np.int64)
 
 
+def _single_banded_level(a: sparse.csr_matrix,
+                         perm: np.ndarray | None,
+                         arrow_width: int) -> ArrowLevel:
+    """One-level decomposition of an (optionally reordered) banded
+    matrix.  Reports the REQUESTED width — artifacts are saved/loaded
+    under the level-0 width, so the tighter achieved bound would break
+    the file-naming round-trip — and canonicalizes like every other
+    level construction (the tiling builders require it)."""
+    if perm is None:
+        b = a.copy()
+        perm = np.arange(a.shape[0], dtype=np.int64)
+    else:
+        b = a[perm][:, perm].tocsr()
+    b.sum_duplicates()
+    b.sort_indices()
+    return ArrowLevel(matrix=b, permutation=perm,
+                      arrow_width=arrow_width)
+
+
 def arrow_decomposition(a: sparse.spmatrix,
                         arrow_width: int = 512,
                         max_levels: int = 2,
                         block_diagonal: bool = False,
                         prune: bool = True,
                         seed: int | None = None,
-                        backend: str = "numpy") -> list[ArrowLevel]:
+                        backend: str = "numpy",
+                        band_detect: bool = True) -> list[ArrowLevel]:
     """Compute an arrow decomposition of a square sparse matrix.
 
     :param a: square sparse matrix (any scipy format; values preserved).
@@ -159,6 +179,11 @@ def arrow_decomposition(a: sparse.spmatrix,
     :param prune: place the ``arrow_width`` highest-degree vertices first;
         their rows/columns always belong to the level (the arrow head).
     :param seed: RNG seed for the random-spanning-forest linearization.
+    :param band_detect: detect banded/bandable inputs (identity or
+        reverse-Cuthill-McKee order within ``arrow_width`` of the
+        diagonal — the planar/mesh class) and return ONE level with
+        zero inter-level routing instead of linearizing.  On by
+        default; costs O(nnz) on graphs that fail the gate.
     :param backend: linearization implementation — "numpy" (scipy/
         csgraph; the default), "native" (C++ kernels, the reference's
         Julia-layer role; ~10x faster on large graphs), or "auto"
@@ -189,7 +214,7 @@ def arrow_decomposition(a: sparse.spmatrix,
     # inter-level routing that the natural order never needed.  O(nnz)
     # check; power-law graphs (hub rows reach everywhere) never take
     # it.
-    if a.nnz:
+    if a.nnz and band_detect:
         coo = a.tocoo()
         # achieved_width at width 0 = the full bandwidth max|r-c| (one
         # band-math implementation for the gate and the per-level
@@ -197,18 +222,24 @@ def arrow_decomposition(a: sparse.spmatrix,
         bw = achieved_width(coo.row.astype(np.int64),
                             coo.col.astype(np.int64), 0)
         if bw <= arrow_width:
-            # Report the REQUESTED width (also satisfied): artifacts
-            # are saved/loaded under the level-0 width, so the tighter
-            # achieved bound would break the file-naming round-trip.
-            # Canonicalized copy: every other level construction
-            # canonicalizes, and the tiling builders require it.
-            b = a.copy()
-            b.sum_duplicates()
-            b.sort_indices()
-            return [ArrowLevel(
-                matrix=b,
-                permutation=np.arange(a.shape[0], dtype=np.int64),
-                arrow_width=arrow_width)]
+            return [_single_banded_level(a, None, arrow_width)]
+        # Bandable under a reordering: reverse Cuthill-McKee (O(nnz),
+        # measured 0.9 s at 16.8M nnz) recovers the natural band of a
+        # planar/mesh graph in ANY input order.  Necessary-condition
+        # pre-gate: a band of half-width w holds <= 2w+1 entries per
+        # symmetric row, so hub graphs (the main workload) reject in
+        # O(n) without paying the RCM pass.
+        sym = symmetrize(a)
+        max_deg = int(np.diff(sym.indptr).max()) if sym.nnz else 0
+        if max_deg <= 2 * arrow_width + 1:
+            from scipy.sparse import csgraph
+
+            rcm = np.asarray(csgraph.reverse_cuthill_mckee(
+                sym, symmetric_mode=True), dtype=np.int64)
+            inv = np.argsort(rcm)
+            bw = achieved_width(inv[coo.row], inv[coo.col], 0)
+            if bw <= arrow_width:
+                return [_single_banded_level(a, rcm, arrow_width)]
 
     rng = np.random.default_rng(seed)
     levels: list[ArrowLevel] = []

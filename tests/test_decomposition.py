@@ -147,3 +147,35 @@ def test_banded_input_fast_path():
     lv = arrow_decomposition(b, 32, max_levels=4, block_diagonal=True,
                              seed=0)
     assert len(lv) > 1
+
+
+def test_bandable_input_rcm_fast_path():
+    """A SCRAMBLED grid (planar graph in arbitrary input order) is
+    recovered by the reverse-Cuthill-McKee gate: one level whose
+    permutation re-bands it, exact SpMM, no linearization."""
+    from arrow_matrix_tpu.decomposition.decompose import (
+        arrow_decomposition,
+        decomposition_spmm,
+    )
+    from arrow_matrix_tpu.utils.graphs import grid_graph, random_dense
+
+    g = grid_graph(32)
+    rng = np.random.default_rng(3)
+    shuf = rng.permutation(g.shape[0])
+    gs = g[shuf][:, shuf].tocsr()
+    levels = arrow_decomposition(gs, 64, max_levels=8,
+                                 block_diagonal=True, seed=0)
+    assert len(levels) == 1
+    lvl = levels[0]
+    # The level really is banded in its own coordinates.
+    coo = lvl.matrix.tocoo()
+    assert int(np.abs(coo.row.astype(np.int64) - coo.col).max()) <= 64
+    x = random_dense(gs.shape[0], 4, seed=1)
+    np.testing.assert_allclose(decomposition_spmm(levels, x),
+                               np.asarray(gs @ x), rtol=1e-5, atol=1e-5)
+
+    # band_detect=False restores the plain recursion.
+    lv2 = arrow_decomposition(gs, 64, max_levels=8,
+                              block_diagonal=True, seed=0,
+                              band_detect=False)
+    assert len(lv2) > 1
