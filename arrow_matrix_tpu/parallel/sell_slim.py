@@ -228,7 +228,8 @@ class SlimLevelOps:
     shard_len: int
     n_dev: int
     width: int
-    hops: int                     # halo reach in whole-shard hops
+    hops: int                     # halo exchange steps (whole shards)
+    rem: int                      # rows carried by the farthest hop
     binary: bool
 
     @property
@@ -355,10 +356,10 @@ class _SliceSource:
         return out
 
 
-def _banded_reach_hops(src: _SliceSource, w: int,
-                       shard_ids=None) -> int:
-    """Halo reach: how far body columns stray outside the owning shard
-    (head-arm columns excluded), in whole-shard hops.  A converged
+def _banded_reach(src: _SliceSource, w: int,
+                  shard_ids=None) -> int:
+    """Raw halo reach in ROWS: how far body columns stray outside the
+    owning shard (head-arm columns excluded).  A converged
     block-diagonal level has reach 0 and pays no exchange; a grown
     banded last level gets exactly the hops it needs (reference
     neighbor exchange generalized, arrow_mpi.py:123-175).  Streams one
@@ -379,8 +380,25 @@ def _banded_reach_hops(src: _SliceSource, w: int,
             go = g[outside]
             reach = max(reach,
                         int(np.maximum(lo - go, go - (lo + L) + 1).max()))
-    hops = -(-reach // L) if reach > 0 else 0
-    return min(hops, n_dev - 1)
+    return reach
+
+
+def _hops_rem(reach: int, L: int, n_dev: int) -> tuple[int, int]:
+    """(hops, rem) from a raw row reach: ``hops`` whole-shard exchange
+    steps, of which the FARTHEST carries only ``rem`` <= L rows — the
+    exact rows the halo region can reference (sublane-aligned).  A
+    banded level with reach << L then ppermutes L/rem-times fewer
+    bytes than a whole-shard chain; reach beyond the device ring caps
+    at full shards."""
+    if reach <= 0:
+        return 0, 0
+    hops_raw = -(-reach // L)
+    hops = min(hops_raw, n_dev - 1)
+    if hops_raw > n_dev - 1 or hops == 0:
+        return hops, L if hops else 0
+    rem = reach - (hops - 1) * L
+    rem = min(align_up(rem, SLOT_ALIGN), L)
+    return hops, rem
 
 
 class _DegreesOnly:
@@ -590,14 +608,14 @@ def local_shard_coords(mesh: Mesh, *axes: str):
     return ({c[0] for c in coords} if len(axes) == 1 else coords)
 
 
-def global_max_hops(hops: int) -> int:
-    """Cross-process max of a locally-scanned halo reach — every
-    process must agree on the operand shapes hops implies (the one
+def global_max_reach(reach: int) -> int:
+    """Cross-process max of a locally-scanned halo reach (in ROWS) —
+    every process must agree on the operand shapes it implies (the one
     collective in a per-host build)."""
     from jax.experimental import multihost_utils
 
     return int(np.max(multihost_utils.process_allgather(
-        np.asarray(hops, dtype=np.int32))))
+        np.asarray(reach, dtype=np.int32))))
 
 
 def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
@@ -622,9 +640,10 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
     # process); remote slices of the device stacks stay untouched zero
     # pages that put_global never reads.
     materialize = local_shard_coords(mesh, axis)
-    hops = _banded_reach_hops(src, w, shard_ids=materialize)
+    reach = _banded_reach(src, w, shard_ids=materialize)
     if materialize is not None:
-        hops = global_max_hops(hops)
+        reach = global_max_reach(reach)
+    hops, rem = _hops_rem(reach, L, n_dev)
     body_shares, head_shares = _slim_shares(src, w, hops,
                                             materialize=materialize)
 
@@ -670,11 +689,12 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
         head_unsort=put_global(head_unsort, repl),
         orig_pos=put_global(inv.astype(np.int32), shard_stack),
         body_order=body_order, rows_out=rows_out, shard_len=L,
-        n_dev=n_dev, width=w, hops=hops, binary=binary)
+        n_dev=n_dev, width=w, hops=hops, rem=rem, binary=binary)
 
 
 def _slim_local_step(axis: str, w: int, rows_out: int, hops: int,
-                     n_dev: int, body, head, head_unsort, orig_pos, xt):
+                     rem: int, n_dev: int, body, head, head_unsort,
+                     orig_pos, xt):
     """One device's slim step body, shared by the time-shared
     (make_sharded_step) and space-shared (sell_space) orchestrations —
     masked-psum X_0 broadcast, halo ppermute chains, tiered SpMM, head
@@ -688,20 +708,39 @@ def _slim_local_step(axis: str, w: int, rows_out: int, hops: int,
         axis)
     parts = [xt, x0]
     if hops:
-        # Whole-shard halo chains: my rows in ORIGINAL shard order,
-        # shifted j hops right feed the lo region, j hops left the
-        # hi region.  ppermute leaves chain ends zero — the
-        # boundary condition (reference arrow_mpi.py:150-162).
+        # Halo chains: my rows in ORIGINAL shard order, shifted j hops
+        # right feed the lo region, j hops left the hi region.
+        # ppermute leaves chain ends zero — the boundary condition
+        # (reference arrow_mpi.py:150-162).  Intermediate hops relay
+        # whole shards (those regions sit entirely within reach), but
+        # the FARTHEST hop carries only the ``rem`` rows the region
+        # can reference — a reach << L band ppermutes L/rem-times
+        # fewer bytes; the skipped rows are zero by the reach
+        # definition, so zero-padding the received slice is exact.
         mine = jnp.take(xt, orig_pos[0], axis=1)     # (k, L)
+        Ls = mine.shape[1]
         fwd = [(i, i + 1) for i in range(n_dev - 1)]
         bwd = [(i + 1, i) for i in range(n_dev - 1)]
         lo_chain, hi_chain = [], []
         cur_lo = cur_hi = mine
-        for _ in range(hops):
-            cur_lo = lax.ppermute(cur_lo, axis, perm=fwd)
-            cur_hi = lax.ppermute(cur_hi, axis, perm=bwd)
-            lo_chain.append(cur_lo)   # j hops left neighbor
-            hi_chain.append(cur_hi)   # j hops right neighbor
+        # rem == 0 means whole-shard (the pre-slicing behavior): a
+        # caller that never derived rem still gets a correct step.
+        rem_eff = rem if rem > 0 else Ls
+        for j in range(hops):
+            if j == hops - 1 and rem_eff < Ls:
+                got_lo = lax.ppermute(cur_lo[:, Ls - rem_eff:], axis,
+                                      perm=fwd)
+                got_hi = lax.ppermute(cur_hi[:, :rem_eff], axis,
+                                      perm=bwd)
+                zpad = jnp.zeros((mine.shape[0], Ls - rem_eff),
+                                 mine.dtype)
+                lo_chain.append(jnp.concatenate([zpad, got_lo], axis=1))
+                hi_chain.append(jnp.concatenate([got_hi, zpad], axis=1))
+            else:
+                cur_lo = lax.ppermute(cur_lo, axis, perm=fwd)
+                cur_hi = lax.ppermute(cur_hi, axis, perm=bwd)
+                lo_chain.append(cur_lo)   # j hops left neighbor
+                hi_chain.append(cur_hi)   # j hops right neighbor
         # lo region covers [lo - hops*L, lo): farthest first.
         parts += list(reversed(lo_chain)) + hi_chain
     z = jnp.concatenate(parts, axis=1)
@@ -716,7 +755,8 @@ def _slim_local_step(axis: str, w: int, rows_out: int, hops: int,
 
 
 def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
-                      hops: int = 0, feat_axis: Optional[str] = None):
+                      hops: int = 0, rem: int = 0,
+                      feat_axis: Optional[str] = None):
     """Raw (traceable) shard_map'd slim step for one level:
     ``step(body, head, head_unsort, orig_pos, xt) -> ct`` on
     feature-major (k, total_out) arrays.
@@ -732,7 +772,7 @@ def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
     n_dev = mesh.shape[axis]
 
     def local_step(body, head, head_unsort, orig_pos, xt):
-        return _slim_local_step(axis, w, rows_out, hops, n_dev,
+        return _slim_local_step(axis, w, rows_out, hops, rem, n_dev,
                                 body, head, head_unsort, orig_pos, xt)
 
     spec = lambda tree: jax.tree_util.tree_map(lambda _: P(axis), tree)
@@ -785,7 +825,8 @@ class SellSlim:
             self.shard_len, self.shard_len * self.n_dev)
         self._step = jax.jit(make_sharded_step(mesh, axis, width,
                                                ops.rows_out,
-                                               hops=ops.hops))
+                                               hops=ops.hops,
+                                               rem=ops.rem))
 
     def _feature_sharding(self):
         return NamedSharding(self.mesh, P(None, self.axis))
@@ -920,7 +961,8 @@ class SellMultiLevel:
                     for i in range(1, k_levels)]
 
         steps = [make_sharded_step(mesh, axis, width, ops.rows_out,
-                                   hops=ops.hops, feat_axis=feat_axis)
+                                   hops=ops.hops, rem=ops.rem,
+                                   feat_axis=feat_axis)
                  for ops in self.ops]
         feat_shard = NamedSharding(mesh, P(feat_axis, axis))
 

@@ -56,8 +56,9 @@ from arrow_matrix_tpu.parallel.mesh import (fetch_replicated, make_mesh,
                                              put_global)
 from arrow_matrix_tpu.parallel.multi_level import resolve_feature_dtype
 from arrow_matrix_tpu.parallel.sell_slim import (
-    _banded_reach_hops,
-    global_max_hops,
+    _banded_reach,
+    _hops_rem,
+    global_max_reach,
     local_shard_coords,
     _carried_maps,
     _gather_carried,
@@ -153,10 +154,11 @@ class SellSpaceShared:
 
         # One SPMD program runs every group, so all levels share the
         # max halo reach (see module docstring).
-        hops = max(_banded_reach_hops(s, w, shard_ids=level_mat(g))
-                   for g, s in enumerate(srcs))
+        reach = max(_banded_reach(s, w, shard_ids=level_mat(g))
+                    for g, s in enumerate(srcs))
         if local_pairs is not None:
-            hops = global_max_hops(hops)
+            reach = global_max_reach(reach)
+        hops, rem = _hops_rem(reach, L, n_dev)
         shares = [_slim_shares(s, w, hops, materialize=level_mat(g))
                   for g, s in enumerate(srcs)]
         body_flat = [s for body, _ in shares for s in body]
@@ -274,7 +276,8 @@ class SellSpaceShared:
         # communicators, for free).  head_unsort arrives (1, w) here
         # (its lvl slice); the shared body wants the resolved (w,).
         def local_step(body, head, head_unsort, orig_pos, xt):
-            return _slim_local_step(axis, w, rows_out, hops, n_dev,
+            return _slim_local_step(axis, w, rows_out, hops, rem,
+                                    n_dev,
                                     body, head, head_unsort[0],
                                     orig_pos, xt)
 

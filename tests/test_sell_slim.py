@@ -327,7 +327,8 @@ def test_per_host_build_equivalence():
         _DegreesOnly,
         _pack_shard_tiers,
         _SliceSource,
-        _banded_reach_hops,
+        _banded_reach,
+        _hops_rem,
         _slim_shares,
         degree_ladder,
     )
@@ -335,7 +336,8 @@ def test_per_host_build_equivalence():
     n, w, n_dev = 512, 32, 4
     a = barabasi_albert(n, 4, seed=11).astype(np.float32)
     src = _SliceSource(a, n_dev, w)
-    hops = _banded_reach_hops(src, w)
+    hops, _ = _hops_rem(_banded_reach(src, w), src.shard_len,
+                        n_dev)
 
     full_b, full_h = _slim_shares(src, w, hops)
     part_b, part_h = _slim_shares(src, w, hops, materialize={0, 2})

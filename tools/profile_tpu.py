@@ -94,7 +94,8 @@ def main():
             x = sm.set_features(x_host)
             print(f"full step: {timeit(sm.step, x):.1f} ms", flush=True)
             steps = [make_sharded_step(sm.mesh, sm.axis, width,
-                                       o.rows_out, hops=o.hops)
+                                       o.rows_out, hops=o.hops,
+                                       rem=o.rem)
                      for o in sm.ops]
             for i, (o, st) in enumerate(zip(sm.ops, steps)):
                 f = jax.jit(st)
