@@ -361,3 +361,43 @@ def test_per_host_build_equivalence():
         assert not np.any(cp[[1, 3]])              # remote = zero pages
     for df, dp in zip(sf.deg, sp.deg):
         np.testing.assert_array_equal(df[[0, 2]], dp[[0, 2]])
+
+
+def test_sliced_halo_exchange_fewer_bytes():
+    """The farthest halo hop carries only `reach` rows: versus a
+    whole-shard step (rem=0 compatibility mode) the collective-permute
+    bytes strictly drop while outputs stay identical."""
+    from arrow_matrix_tpu.parallel.sell_slim import (
+        SellSlim,
+        make_sharded_step,
+    )
+    from arrow_matrix_tpu.utils import commstats
+    from arrow_matrix_tpu.utils.graphs import grid_graph, random_dense
+
+    g = grid_graph(32).astype(np.float32)    # bandwidth 32 << shard
+    mesh = make_mesh((4,), ("blocks",))
+    sl = SellSlim(g, 32, mesh)
+    o = sl.ops
+    assert o.hops == 1 and 0 < o.rem < sl.shard_len
+
+    x = random_dense(g.shape[0], 4, seed=1)
+    xt = sl.set_features(x)
+    want = sl.gather_result(sl.spmm(xt))
+    np.testing.assert_allclose(want, np.asarray(g @ x), rtol=1e-5,
+                               atol=1e-5)
+
+    import jax
+
+    whole = jax.jit(make_sharded_step(mesh, sl.axis, sl.width,
+                                      o.rows_out, hops=o.hops, rem=0))
+    got_whole = whole(o.body, o.head, o.head_unsort, o.orig_pos, xt)
+    np.testing.assert_allclose(np.asarray(got_whole),
+                               np.asarray(sl.spmm(xt)), rtol=1e-6,
+                               atol=1e-6)
+
+    sliced_stats = commstats.collective_stats(
+        sl._step, o.body, o.head, o.head_unsort, o.orig_pos, xt)
+    whole_stats = commstats.collective_stats(
+        whole, o.body, o.head, o.head_unsort, o.orig_pos, xt)
+    assert (sliced_stats["collective-permute"]["bytes"]
+            < whole_stats["collective-permute"]["bytes"])
