@@ -71,12 +71,21 @@ def put_global(x, sharding: NamedSharding) -> jax.Array:
            for d in sharding.device_set):
         return jax.device_put(x, sharding)
     x = np.asarray(x)
-    # dtype explicitly: a process holding NO shard of this array (e.g.
-    # a replicated table on a sub-mesh owned by other processes) cannot
-    # infer it from its (empty) shard list.
+    # dtype explicitly when the installed jax accepts it (feature-
+    # detected like jax.distributed.initialize's kwargs in
+    # initialize_multihost — pyproject leaves jax unpinned): a process
+    # holding NO shard of this array (e.g. a replicated table on a
+    # sub-mesh owned by other processes) cannot infer it from its
+    # (empty) shard list.
+    import inspect
+
+    kwargs = {}
+    if "dtype" in inspect.signature(
+            jax.make_array_from_callback).parameters:
+        kwargs["dtype"] = x.dtype
     return jax.make_array_from_callback(
         x.shape, sharding, lambda idx: np.ascontiguousarray(x[idx]),
-        dtype=x.dtype)
+        **kwargs)
 
 
 def fetch_replicated(arr) -> np.ndarray:

@@ -45,38 +45,42 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
     from arrow_matrix_tpu.parallel.mesh import fetch_replicated
 
     x_host = fetch_replicated(x)   # collective: every process joins
-    try:
-        if jax.process_index() == 0:   # one writer (shared filesystem)
+    if jax.process_count() == 1:
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, x=x_host, step=np.int64(step))
+        os.replace(tmp, path + ".npz")
+        return
+    # Multi-process: one writer; its OUTCOME is broadcast, not
+    # re-verified by peers re-reading the file — a re-read assumes a
+    # shared filesystem and turns per-host local disks (or stale NFS
+    # attribute caches) into a hard, misleadingly-worded failure on
+    # every successful save.  NOTE the npz fallback still requires a
+    # shared filesystem for peers to *load* the checkpoint later
+    # (load_state reads path on each process); only the save-time
+    # verification is FS-independent.  The allgather doubles as the
+    # completion barrier: a caller loading right after save_state
+    # returns cannot race process 0's os.replace.
+    write_err: Exception | None = None
+    outcome_step = np.int64(step)
+    if jax.process_index() == 0:   # one writer
+        try:
             tmp = path + ".tmp.npz"
             np.savez(tmp, x=x_host, step=np.int64(step))
             os.replace(tmp, path + ".npz")
-    finally:
-        if jax.process_count() > 1:
-            # Completion barrier INSIDE the save: a caller on any
-            # process may load right after save_state returns and must
-            # not race process 0's replace.  In the finally block so a
-            # writer-side IO error (disk full) re-raises on process 0
-            # instead of deadlocking every other process at a barrier
-            # the writer never reaches.
-            from jax.experimental import multihost_utils
+        except OSError as e:
+            write_err = e
+            outcome_step = np.int64(-1)
+    from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("amt_ckpt_saved")
-    if jax.process_count() > 1 and jax.process_index() != 0:
+    outcome = np.asarray(
+        multihost_utils.process_allgather(outcome_step)).reshape(-1)
+    if int(outcome[0]) != step:
         # A failed writer must fail EVERY process, not leave peers
-        # believing a stale checkpoint is current: verify the write
-        # landed at the step just saved (npz members load lazily —
-        # this reads only the scalar).
-        try:
-            with np.load(path + ".npz") as z:
-                on_disk = int(z["step"])
-        except (OSError, KeyError, ValueError) as e:
-            raise RuntimeError(
-                f"checkpoint write failed on process 0 "
-                f"(unreadable {path}.npz: {e})") from e
-        if on_disk != step:
-            raise RuntimeError(
-                f"checkpoint write failed on process 0 (on-disk step "
-                f"{on_disk} != saved step {step})")
+        # believing a stale checkpoint is current.
+        raise RuntimeError(
+            f"checkpoint write failed on process 0 "
+            f"(write outcome {int(outcome[0])} != saved step {step})"
+        ) from write_err
 
 
 def load_state(path: str, like: Optional[jax.Array] = None

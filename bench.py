@@ -279,10 +279,16 @@ def run_one_candidate(fmt: str) -> None:
                     resolve_feature_dtype,
                 )
 
-                multi.feature_dtype = resolve_feature_dtype("bf16")
-                xb = multi.set_features(x128_host)
-                out["k128_bf16_ms"] = round(
-                    _measure(multi, xb, cfg["iters"]), 3)
+                prior_dtype = multi.feature_dtype
+                try:
+                    multi.feature_dtype = resolve_feature_dtype("bf16")
+                    xb = multi.set_features(x128_host)
+                    out["k128_bf16_ms"] = round(
+                        _measure(multi, xb, cfg["iters"]), 3)
+                finally:
+                    # a measurement added after this block must see
+                    # f32 carriage, not silently inherit bf16
+                    multi.feature_dtype = prior_dtype
         except Exception as e:   # secondary metric, never the gate
             out["k128_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     else:
