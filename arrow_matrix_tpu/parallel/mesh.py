@@ -16,6 +16,17 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+@functools.lru_cache(maxsize=1)
+def _callback_takes_dtype() -> bool:
+    """Whether this jax's make_array_from_callback accepts ``dtype=``
+    (newer jax only) — computed once; put_global runs per-leaf in
+    executor-construction tree_maps."""
+    import inspect
+
+    return "dtype" in inspect.signature(
+        jax.make_array_from_callback).parameters
+
+
 def make_mesh(shape: Optional[Sequence[int]] = None,
               axis_names: Sequence[str] = ("blocks",),
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -77,12 +88,7 @@ def put_global(x, sharding: NamedSharding) -> jax.Array:
     # holding NO shard of this array (e.g. a replicated table on a
     # sub-mesh owned by other processes) cannot infer it from its
     # (empty) shard list.
-    import inspect
-
-    kwargs = {}
-    if "dtype" in inspect.signature(
-            jax.make_array_from_callback).parameters:
-        kwargs["dtype"] = x.dtype
+    kwargs = {"dtype": x.dtype} if _callback_takes_dtype() else {}
     return jax.make_array_from_callback(
         x.shape, sharding, lambda idx: np.ascontiguousarray(x[idx]),
         **kwargs)

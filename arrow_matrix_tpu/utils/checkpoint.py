@@ -67,7 +67,10 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
             tmp = path + ".tmp.npz"
             np.savez(tmp, x=x_host, step=np.int64(step))
             os.replace(tmp, path + ".npz")
-        except OSError as e:
+        except Exception as e:   # noqa: BLE001 — ANY writer failure
+            # (OSError, MemoryError, zipfile errors...) must still
+            # reach the allgather below, or every peer deadlocks at a
+            # collective the writer never joins.
             write_err = e
             outcome_step = np.int64(-1)
     from jax.experimental import multihost_utils
