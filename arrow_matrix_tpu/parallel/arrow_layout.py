@@ -303,6 +303,19 @@ def make_wide_spmm(blocks: ArrowBlocks, mesh: Mesh, arm_axis: str = "arm",
     heavy degree pruning) and its reduce would otherwise serialize
     after the column compute.
     """
+    return jax.jit(wide_step_shard_map(blocks, mesh, arm_axis=arm_axis,
+                                       block_axis=block_axis, chunk=chunk))
+
+
+def wide_step_shard_map(blocks: ArrowBlocks, mesh: Mesh,
+                        arm_axis: str = "arm",
+                        block_axis: str = "blocks",
+                        chunk: Optional[int] = None):
+    """The raw (unjitted) shard_map wide step — the single construction
+    point shared by ``make_wide_spmm`` and the multi-level
+    orchestrator's per-level wide path (the reference composes the wide
+    layout into ArrowDecompositionMPI the same way,
+    arrow_dec_mpi.py:134,165)."""
     if mesh.shape[arm_axis] != 2:
         raise ValueError(
             f"wide layout needs arm axis of size 2, got "
@@ -312,7 +325,7 @@ def make_wide_spmm(blocks: ArrowBlocks, mesh: Mesh, arm_axis: str = "arm",
     # the spec (= replicated over it, the reference's A_0j copies on the
     # row arm).
     spec_blocks = jax.tree_util.tree_map(lambda _: P(block_axis), blocks)
-    step = shard_map(
+    return shard_map(
         functools.partial(_local_wide_step, arm_axis=arm_axis,
                           block_axis=block_axis,
                           n_block_dev=mesh.shape[block_axis], chunk=chunk),
@@ -321,4 +334,3 @@ def make_wide_spmm(blocks: ArrowBlocks, mesh: Mesh, arm_axis: str = "arm",
         out_specs=P(arm_axis, block_axis),
         check_vma=False,
     )
-    return jax.jit(step)

@@ -189,7 +189,8 @@ class MultiLevelArrow:
                  chunk="auto", fmt: str = "auto",
                  dense_budget: Optional[int] = None, kernel: str = "xla",
                  routing: str = "gather", head_fmt: str = "auto",
-                 binary="auto", feature_dtype=None):
+                 binary="auto", feature_dtype=None,
+                 layout: str = "slim", arm_axis: str = "arm"):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -225,6 +226,37 @@ class MultiLevelArrow:
                 "— sp2cp.py:6-16); use 'auto'/'dense'/'ell' on a mesh")
         if routing == "a2a" and mesh is None:
             raise ValueError("routing='a2a' requires a mesh")
+        # Wide layout: per-level SpMM on a (2, t) mesh with disjoint
+        # row-arm / column-arm device groups (the reference composes
+        # the wide ArrowMPI into ArrowDecompositionMPI the same way,
+        # arrow_dec_mpi.py:134,165).  Orchestration (routing gathers,
+        # backward aggregation) is unchanged: features stay sharded on
+        # the block axis, replicated over the arm axis.
+        if layout not in ("slim", "wide"):
+            raise ValueError(f"unknown layout {layout!r} "
+                             f"(expected 'slim' or 'wide')")
+        if layout == "wide":
+            if mesh is None:
+                raise ValueError(
+                    "layout='wide' needs a (arm=2, blocks) mesh — the "
+                    "reference's 2t-1-rank row/column split "
+                    "(arrow_mpi.py:31-69); on one chip use 'slim'")
+            if arm_axis not in mesh.axis_names \
+                    or mesh.shape[arm_axis] != 2:
+                raise ValueError(
+                    f"layout='wide' needs mesh axis {arm_axis!r} of "
+                    f"size 2, got axes {dict(mesh.shape)}")
+            if kernel == "pallas":
+                raise ValueError(
+                    "layout='wide' runs the XLA shard_map step; the "
+                    "fused pallas kernels cover the slim layout")
+            if routing == "a2a":
+                raise ValueError(
+                    "layout='wide' composes with routing='gather' (the "
+                    "a2a tables are built for the 1-axis slim feature "
+                    "sharding)")
+        self.layout = layout
+        self.arm_axis = arm_axis
         if dense_budget is None:
             # Budget from the actual target chip's free memory, not a
             # constant (VERDICT r1: 4GiB misformats on both v5e and v5p).
@@ -414,7 +446,7 @@ class MultiLevelArrow:
         self._step = jax.jit(functools.partial(
             multi_level_spmm, widths=tuple(widths), chunk=chunk,
             kernel=kernel, gather_budget=gather_budget,
-            mesh=mesh, axis=axis))
+            mesh=mesh, axis=axis, layout=layout, arm_axis=arm_axis))
 
         def scan_steps(x, fwd, bwd, blocks, n):
             def body(xc, _):
@@ -422,7 +454,8 @@ class MultiLevelArrow:
                                       widths=tuple(widths), chunk=chunk,
                                       kernel=kernel,
                                       gather_budget=gather_budget,
-                                      mesh=mesh, axis=axis)
+                                      mesh=mesh, axis=axis,
+                                      layout=layout, arm_axis=arm_axis)
                 return xc, None
 
             out, _ = jax.lax.scan(body, x, None, length=n)
@@ -646,7 +679,8 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
                      chunk="auto", kernel: str = "xla",
                      gather_budget: int = 1 << 30,
                      mesh: Optional[Mesh] = None,
-                     axis: str = "blocks") -> jax.Array:
+                     axis: str = "blocks", layout: str = "slim",
+                     arm_axis: str = "arm") -> jax.Array:
     """One decomposition-wide SpMM (jitted; K unrolled — K is small).
 
     Forward feature propagation (reference
@@ -688,7 +722,23 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
             # Oversized levels (grown last-level width) whose feature
             # operands exceed VMEM fall back to XLA per level.
             use_pallas = pallas_blocks.feasible(w, k, blocks[i].banded)
-        if use_pallas and mesh is not None:
+        if layout == "wide" and mesh is not None:
+            # Wide layout per level: row-arm devices compute the head
+            # row + reduce, column-arm devices the diag/col/banded
+            # blocks — disjoint groups overlapping in space (reference
+            # ArrowMPI composed into the orchestrator,
+            # arrow_dec_mpi.py:134).  Output slice 0 of the arm axis
+            # holds the product.
+            from arrow_matrix_tpu.parallel.arrow_layout import (
+                wide_step_shard_map,
+            )
+
+            wstep = wide_step_shard_map(
+                blocks[i], mesh, arm_axis=arm_axis, block_axis=axis,
+                chunk=resolve_chunk(chunk, blocks[i], total, k,
+                                    gather_budget))
+            c = wstep(blocks[i], xb)[0]
+        elif use_pallas and mesh is not None:
             # Pallas custom calls do not partition under GSPMD, but the
             # shard-local shapes under shard_map are static: run the
             # slim step body per shard with the fused kernels inside
