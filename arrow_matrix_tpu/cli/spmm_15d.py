@@ -34,7 +34,21 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-v", "--vertices", type=int, default=100_000)
     parser.add_argument("-e", "--edges", type=int, default=1_000_000)
     parser.add_argument("-f", "--file", type=str, default=None,
-                        help="Sparse matrix file (.npz/.mtx/.mat).")
+                        help="Sparse matrix file (.npz/.mtx/.mat), or "
+                             "with --memmap the BASE of an npy CSR "
+                             "triplet (BASE_indptr.npy, BASE_indices"
+                             ".npy, optional BASE_data.npy).")
+    parser.add_argument("--memmap", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Memory-map --file as an npy CSR triplet "
+                             "and build slab-by-slab, never holding "
+                             "the matrix in RAM (the reference's "
+                             "generate_15d_decomposition_new ingest, "
+                             "spmm_15d.py:158-309).  Skips the "
+                             "iterate-boundedness normalization (the "
+                             "reference does not normalize either); "
+                             "--validate computes the golden by "
+                             "streaming slabs.")
     parser.add_argument("-c", "--columns", type=int, default=128,
                         help="Feature columns of X.")
     parser.add_argument("-r", "--replication", type=int, default=0,
@@ -71,15 +85,33 @@ def main(argv=None) -> int:
     from arrow_matrix_tpu.utils import logging as wb
     from arrow_matrix_tpu.utils.graphs import random_dense
 
-    if args.dataset == "file" or args.file:
+    if args.memmap:
+        if not args.file:
+            raise SystemExit("--memmap requires --file BASE (npy "
+                             "triplet: BASE_indptr.npy, ...)")
+        import os
+
+        def _mm(suffix, required=True):
+            p = f"{args.file}_{suffix}.npy"
+            if not os.path.exists(p):
+                if required:
+                    raise SystemExit(f"missing triplet member {p}")
+                return None
+            return np.load(p, mmap_mode="r")
+
+        a = (_mm("data", required=False), _mm("indices"), _mm("indptr"))
+        name = os.path.basename(args.file)
+    elif args.dataset == "file" or args.file:
         if not args.file:
             raise SystemExit("--dataset file requires --file")
-        a = load_sparse_matrix(args.file)
-        name = args.file
+        a = normalize_scale(load_sparse_matrix(args.file))
+        import os
+
+        name = os.path.basename(args.file)
     else:
-        a = random_adjacency(args.vertices, args.edges, args.seed)
+        a = normalize_scale(
+            random_adjacency(args.vertices, args.edges, args.seed))
         name = f"random_{args.vertices}_{args.edges}"
-    a = normalize_scale(a)
 
     n_dev = len(jax.devices())
     c = args.replication or largest_replication(n_dev)
@@ -98,17 +130,31 @@ def main(argv=None) -> int:
             chunk="auto" if args.memory > 0 else None,
             memory_fraction=args.memory if args.memory > 0 else 0.5)
 
-    x_host = random_dense(a.shape[1], args.columns, seed=args.seed)
+    n = dist.shape[1]
+    x_host = random_dense(n, args.columns, seed=args.seed)
     x = dist.set_features(x_host)
 
     if args.validate:
         from arrow_matrix_tpu.utils import numerics
 
         got = dist.gather_result(dist.spmm(x))
-        want = np.asarray(a @ x_host)
+        if args.memmap:
+            # Streaming golden: the global matrix never exists in RAM.
+            from arrow_matrix_tpu.parallel.spmm_15d import _slab_source
+
+            _, _, slab_of = _slab_source(a, np.float32)
+            want = np.empty_like(x_host)
+            nnz = 0
+            step_rows = max(dist.l_ni, 1)
+            for lo in range(0, n, step_rows):
+                slab = slab_of(lo, min(n, lo + step_rows))
+                want[lo:lo + slab.shape[0]] = slab @ x_host
+                nnz += int(slab.nnz)
+        else:
+            want = np.asarray(a @ x_host)
+            nnz = a.nnz
         err = numerics.relative_error(got, want)
-        tol = numerics.relative_tolerance(a.nnz / max(a.shape[0], 1),
-                                          iters=1)
+        tol = numerics.relative_tolerance(nnz / max(n, 1), iters=1)
         ok = bool(np.isfinite(err) and err <= tol)
         print(f"validation: ok={ok} rel frobenius err={err:.3e} "
               f"(gate {tol:.1e}; spmm_15d_main.py:195-197 protocol, "

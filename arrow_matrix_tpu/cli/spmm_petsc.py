@@ -32,11 +32,17 @@ from arrow_matrix_tpu.cli.common import (
 )
 
 
+#: The reference's slice-file naming scheme (spmm_petsc.py:82-102) —
+#: ONE copy shared by the per-slice fast path and the reassembly
+#: fallback, so both always agree on what matches.
+SLICE_RE = re.compile(r"(.*)\.part\.(\d+)\.slice\.(\d+)\.npz$")
+
+
 def load_slices_or_matrix(path: str) -> sparse.csr_matrix:
     """Accept either one matrix file or any slice of the reference's
     ``{name}.part.{P}.slice.{r}.npz`` scheme (all slices are then
     reassembled; the partition itself is recomputed)."""
-    m = re.match(r"(.*)\.part\.(\d+)\.slice\.(\d+)\.npz$", path)
+    m = SLICE_RE.match(path)
     if not m:
         return load_sparse_matrix(path)
     base, p = m.group(1), int(m.group(2))
@@ -94,16 +100,58 @@ def main(argv=None) -> int:
     from arrow_matrix_tpu.utils import logging as wb
     from arrow_matrix_tpu.utils.graphs import random_dense
 
-    if args.file:
-        a = load_slices_or_matrix(args.file)
-        name = os.path.basename(args.file)
-    else:
-        a = random_adjacency(args.vertices, args.edges, args.seed)
-        name = f"random_{args.vertices}_{args.edges}"
-    a = normalize_scale(a)
-
     n_dev = len(jax.devices())
     mesh = make_mesh((n_dev,), ("slices",))
+
+    # Per-slice ingest (the reference's IO-parallel loading: each rank
+    # reads only its own slice file, spmm_petsc.py:421-440) whenever
+    # the slice count matches the device count; otherwise the slices
+    # are reassembled into one host view (the partition is recomputed).
+    slice_paths = None
+    owned_slabs: dict = {}
+    if args.file:
+        m = SLICE_RE.match(args.file)
+        if m and int(m.group(2)) == n_dev:
+            base, p = m.group(1), int(m.group(2))
+            slice_paths = [f"{base}.part.{p}.slice.{r}.npz"
+                           for r in range(p)]
+            missing = [q for q in slice_paths if not os.path.exists(q)]
+            if missing:
+                raise SystemExit(f"missing slice files: {missing[:3]}")
+        name = os.path.basename(args.file)
+    else:
+        name = f"random_{args.vertices}_{args.edges}"
+
+    if slice_paths is not None:
+        from arrow_matrix_tpu.parallel.spmm_1d import (
+            _exchange_sum,
+            _owned_slice_ids,
+            _primary_slice_ids,
+        )
+
+        mine = sorted(_owned_slice_ids(mesh, "slices"))
+        primary = _primary_slice_ids(mesh, "slices")
+        owned_slabs = {
+            d: sparse.load_npz(slice_paths[d]).tocsr().astype(np.float32)
+            for d in mine}
+        # Global normalize_scale from per-slice row sums (each process
+        # reads only its own slices; one host-side max exchange with
+        # one contributor per slice).
+        scales = np.zeros(n_dev)
+        for d, s in owned_slabs.items():
+            if s.nnz and d in primary:
+                scales[d] = float(abs(s).sum(axis=1).max())
+        scale = max(float(np.max(_exchange_sum(scales))), 1.0)
+        for d in mine:
+            owned_slabs[d] = (owned_slabs[d] / scale).tocsr()
+        a = [(lambda d=d: owned_slabs[d]) if d in owned_slabs
+             else slice_paths[d] for d in range(n_dev)]
+    elif args.file:
+        a = normalize_scale(load_slices_or_matrix(args.file))
+    else:
+        a = normalize_scale(
+            random_adjacency(args.vertices, args.edges, args.seed))
+
     wb.init("PETSc_TPU_v1", name, config=vars(args))
 
     with wb.segment("build_time"):
@@ -117,15 +165,32 @@ def main(argv=None) -> int:
         wb.finish(args.logdir)
         return 0
 
-    x_host = random_dense(a.shape[1], args.columns, seed=args.seed)
+    x_host = random_dense(dist.n, args.columns, seed=args.seed)
     x = dist.set_features(x_host)
 
     if args.validate:
         got = dist.gather_result(dist.spmm(x))
-        want = np.asarray(a @ x_host)
-        err = np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-30)
-        ok = np.allclose(got, want, rtol=1e-4, atol=1e-4)
-        print(f"validation: allclose={ok} rel frobenius err={err:.3e}")
+        if slice_paths is not None:
+            # Per-slice golden: each process validates the rows of the
+            # slices it loaded (the global matrix never exists here).
+            err_n = err_d = 0.0
+            for d, slab in owned_slabs.items():
+                lo, hi = dist.slices[d]
+                want_d = np.asarray(slab @ x_host)
+                err_n += float(np.linalg.norm(got[lo:hi] - want_d) ** 2)
+                err_d += float(np.linalg.norm(want_d) ** 2)
+            err = (err_n / max(err_d, 1e-30)) ** 0.5
+            ok = bool(err < 1e-4)
+            scope = (f"rows of slices {sorted(owned_slabs)}"
+                     if jax.process_count() > 1 else "all rows")
+            print(f"validation ({scope}): allclose={ok} "
+                  f"rel frobenius err={err:.3e}")
+        else:
+            want = np.asarray(a @ x_host)
+            err = np.linalg.norm(got - want) / max(np.linalg.norm(want),
+                                                   1e-30)
+            ok = np.allclose(got, want, rtol=1e-4, atol=1e-4)
+            print(f"validation: allclose={ok} rel frobenius err={err:.3e}")
         wb.log({"frobenius_err": float(err)})
         if not ok:
             wb.finish(args.logdir)

@@ -35,6 +35,8 @@ from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+
+from arrow_matrix_tpu.utils.transfer import chunked_asarray
 import numpy as np
 from flax import struct
 from scipy import sparse
@@ -161,11 +163,11 @@ def sell_from_csr(matrix: CsrLike, pad_rows_to: Optional[int] = None,
             cols[slot, tloc] = all_cols[src]
             if not is_binary:
                 vals[slot, tloc] = all_data[src]
-        cols_t.append(jnp.asarray(cols))
+        cols_t.append(chunked_asarray(cols))
         if is_binary:
             deg_t.append(jnp.asarray(degs.astype(np.int32)))
         else:
-            data_t.append(jnp.asarray(vals))
+            data_t.append(chunked_asarray(vals))
 
     sell = SellMatrix(
         cols=tuple(cols_t),

@@ -94,6 +94,57 @@ def put_global(x, sharding: NamedSharding) -> jax.Array:
         **kwargs)
 
 
+def build_global(global_shape, sharding: NamedSharding, builder,
+                 dtype) -> jax.Array:
+    """Construct a sharded array whose shards are BUILT on demand.
+
+    ``builder(index)`` receives the shard's global index (a tuple of
+    slices) and returns that shard's numpy block — called only for the
+    shards THIS process addresses.  This is how layouts whose blocks
+    are *derived* (packed ELL tables, exchange indices) get per-host
+    parallel construction: no process ever materializes the global
+    array, the per-host counterpart of the reference's per-rank slice
+    loading (reference arrow/baseline/spmm_petsc.py:421-440).  Peak
+    host memory is O(one shard) beyond the builder's own inputs.
+    """
+    dtype = np.dtype(dtype)
+    kwargs = {"dtype": dtype} if _callback_takes_dtype() else {}
+    return jax.make_array_from_callback(
+        tuple(global_shape), sharding,
+        lambda idx: np.ascontiguousarray(
+            np.asarray(builder(idx), dtype=dtype)),
+        **kwargs)
+
+
+def build_global_parts(global_shape, sharding: NamedSharding, builder,
+                       dtypes) -> list:
+    """``build_global`` for several same-shaped arrays built together.
+
+    ``builder(index)`` returns one numpy block PER PART (e.g. an ELL
+    pack's cols and data) — called exactly once per addressable shard,
+    with each part uploaded to its device before the next shard is
+    built.  This keeps host memory at O(one shard) AND builds each
+    shard once, where two independent ``build_global`` passes would
+    re-derive every shard per part (packing produces all parts at
+    once).
+    """
+    gshape = tuple(global_shape)
+    dtypes = [np.dtype(d) for d in dtypes]
+    part_bufs: list = [[] for _ in dtypes]
+    for dev, idx in sharding.addressable_devices_indices_map(
+            gshape).items():
+        blocks = builder(idx)
+        if len(blocks) != len(dtypes):
+            raise ValueError(f"builder returned {len(blocks)} parts, "
+                             f"expected {len(dtypes)}")
+        for p, (blk, dt) in enumerate(zip(blocks, dtypes)):
+            part_bufs[p].append(jax.device_put(
+                np.ascontiguousarray(np.asarray(blk, dtype=dt)), dev))
+    return [jax.make_array_from_single_device_arrays(gshape, sharding,
+                                                     bufs)
+            for bufs in part_bufs]
+
+
 def fetch_replicated(arr) -> np.ndarray:
     """Global (possibly multi-process) array -> host numpy, identical on
     every process.

@@ -64,6 +64,7 @@ from arrow_matrix_tpu.parallel.mesh import (
     put_global,
     shard_arrow_blocks,
 )
+from arrow_matrix_tpu.utils.transfer import chunked_asarray
 
 
 def gather_budget_for(dense_budget: int) -> int:
@@ -564,7 +565,7 @@ class MultiLevelArrow:
         """Host (total_rows, k) features *already in level-0 order* ->
         flat sharded device array."""
         if self.mesh is None:
-            return jnp.asarray(x_level0)
+            return chunked_asarray(x_level0)
         return put_global(x_level0, self._rows_sharding())
 
     def set_features(self, x_original: np.ndarray) -> jax.Array:
@@ -583,7 +584,7 @@ class MultiLevelArrow:
             if self.feature_dtype is not None:
                 feat = feat.astype(self.feature_dtype)  # before the big
                 # transpose copy: half the bytes at 2^24-row scale
-            return jnp.asarray(np.ascontiguousarray(feat.T))
+            return chunked_asarray(np.ascontiguousarray(feat.T))
         return self.place_features(padded[self.perm0])
 
     def real_row_mask(self, dtype=np.float32) -> jax.Array:

@@ -38,7 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from arrow_matrix_tpu.parallel.mesh import fetch_replicated, put_global
+from arrow_matrix_tpu.parallel.mesh import (
+    build_global_parts,
+    fetch_replicated,
+    put_global,
+)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scipy import sparse
 
@@ -48,6 +52,37 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from arrow_matrix_tpu.ops.ell import align_up, ell_pack, ell_spmm
+
+
+def _slab_source(a, dtype):
+    """``(ni, nk, slab)`` for an in-memory scipy matrix OR a CsrLike
+    memmapped triplet ``(data|None, indices, indptr)``.
+
+    ``slab(lo, hi)`` materializes rows ``[lo, hi)`` as CSR — O(slab
+    nnz) host memory from a triplet, so >RAM artifacts ingest slab by
+    slab (the reference's memmap-aware 1.5D build,
+    generate_15d_decomposition_new, spmm_15d.py:158-309).
+    """
+    if sparse.issparse(a):
+        a = a.tocsr().astype(dtype)
+        a.sum_duplicates()
+        ni, nk = a.shape
+        return ni, nk, lambda lo, hi: a[lo:hi]
+    data, indices, indptr = a
+    n = int(indptr.shape[0] - 1)
+
+    def slab(lo, hi):
+        s, e = int(indptr[lo]), int(indptr[hi])
+        d = (np.ones(e - s, dtype=dtype) if data is None
+             else np.asarray(data[s:e], dtype=dtype))
+        m = sparse.csr_matrix(
+            (d, np.asarray(indices[s:e]),
+             np.asarray(indptr[lo:hi + 1]) - s),
+            shape=(hi - lo, n))
+        m.sum_duplicates()
+        return m
+
+    return n, n, slab
 
 
 def largest_replication(n_dev: int) -> int:
@@ -96,8 +131,7 @@ class SpMM15D:
         self.p_div_c = p_div_c
         self.c = c
 
-        a = a.tocsr().astype(dtype)
-        ni, nk = a.shape
+        ni, nk, slab_of = _slab_source(a, dtype)
         self.shape = (ni, nk)
         # Row-slab height == X-chunk height for square inputs; both are
         # padded to one shared size (the reference rounds up and allows
@@ -109,34 +143,62 @@ class SpMM15D:
 
         # Pack every (grid row i, grid col j, round r) block as ELL with
         # one shared slot count: global arrays (p/c, c, rounds, l_ni, m)
-        # whose leading two axes shard over the mesh.
-        blocks = []
+        # whose leading two axes shard over the mesh.  Two streaming
+        # passes, O(one slab) host memory each: pass 1 finds the shared
+        # slot count (one bincount per slab instead of p/c column
+        # slices), pass 2 builds only THIS process's shards on demand
+        # (build_global) — no process materializes the global arrays.
         need = 0
         for i in range(p_div_c):
-            row_slab = a[i * self.l_ni: min(ni, (i + 1) * self.l_ni)]
-            for j in range(c):
-                for r in range(self.rounds):
-                    q = j * self.rounds + r
-                    blk = row_slab[:, q * self.l_nkb:
-                                   min(nk, (q + 1) * self.l_nkb)]
-                    blk.sum_duplicates()
-                    counts = np.diff(blk.indptr)
-                    if counts.size:
-                        need = max(need, int(counts.max()))
-                    blocks.append(blk)
+            slab = slab_of(i * self.l_ni, min(ni, (i + 1) * self.l_ni))
+            if slab.nnz:
+                rows = np.repeat(np.arange(slab.shape[0], dtype=np.int64),
+                                 np.diff(slab.indptr))
+                chunk_id = np.minimum(slab.indices // self.l_nkb,
+                                      p_div_c - 1).astype(np.int64)
+                per_cell = np.bincount(
+                    rows * p_div_c + chunk_id,
+                    minlength=slab.shape[0] * p_div_c)
+                need = max(need, int(per_cell.max()))
         m_slots = align_up(need, 8) if need else 0
-        cols = np.zeros((p_div_c, c, self.rounds, self.l_ni, m_slots),
-                        dtype=np.int32)
-        data = np.zeros((p_div_c, c, self.rounds, self.l_ni, m_slots),
-                        dtype=dtype)
-        it = iter(blocks)
-        for i in range(p_div_c):
-            for j in range(c):
-                for r in range(self.rounds):
-                    blk = next(it)
-                    bc, bd = ell_pack(blk, max_nnz=m_slots, dtype=dtype)
-                    cols[i, j, r, :bc.shape[0]] = bc
-                    data[i, j, r, :bd.shape[0]] = bd
+        gshape = (p_div_c, c, self.rounds, self.l_ni, m_slots)
+
+        l_ni, l_nkb, rounds_, nk_ = self.l_ni, self.l_nkb, self.rounds, nk
+        slab_cache: dict = {}
+
+        def _grid_cell(i: int, j: int):
+            """(cols, data) (rounds, l_ni, m) for grid cell (i, j);
+            slab re-materialized at most once per i (shards are
+            visited in device order)."""
+            if slab_cache.get("i") != i:
+                slab_cache.clear()
+                slab_cache["i"] = i
+                slab_cache["slab"] = slab_of(i * l_ni,
+                                             min(ni, (i + 1) * l_ni))
+            slab = slab_cache["slab"]
+            ccols = np.zeros((rounds_, l_ni, m_slots), dtype=np.int32)
+            cdata = np.zeros((rounds_, l_ni, m_slots), dtype=dtype)
+            for r in range(rounds_):
+                q = j * rounds_ + r
+                blk = slab[:, q * l_nkb: min(nk_, (q + 1) * l_nkb)]
+                bc, bd = ell_pack(blk, max_nnz=m_slots, dtype=dtype)
+                ccols[r, :bc.shape[0]] = bc
+                cdata[r, :bd.shape[0]] = bd
+            return ccols, cdata
+
+        def _shard(idx):
+            """(cols, data) for one shard — built ONCE, both parts
+            together (build_global_parts uploads them immediately)."""
+            i_sl, j_sl = idx[0], idx[1]
+            iis = range(i_sl.start or 0, i_sl.stop if i_sl.stop is not None
+                        else p_div_c)
+            jjs = range(j_sl.start or 0, j_sl.stop if j_sl.stop is not None
+                        else c)
+            cells = [[_grid_cell(i, j) for j in jjs] for i in iis]
+            return (np.stack([np.stack([cl[0] for cl in row])
+                              for row in cells]),
+                    np.stack([np.stack([cl[1] for cl in row])
+                              for row in cells]))
 
         if chunk == "auto":
             if not 0 < memory_fraction <= 1:
@@ -146,7 +208,7 @@ class SpMM15D:
             from arrow_matrix_tpu.utils.platform import device_memory_budget
 
             n_dev = p_div_c * c
-            block_bytes = cols.nbytes + data.nbytes
+            block_bytes = int(np.prod(gshape)) * (4 + np.dtype(dtype).itemsize)
             dev = mesh.devices.flat[0]
             budget = device_memory_budget(dev, fraction=memory_fraction)
             floor = 1 << 26
@@ -157,9 +219,9 @@ class SpMM15D:
             chunk = ("auto", int(per_dev))
 
         spec_a = NamedSharding(mesh, P(rows_axis, repl_axis))
-        self.a_cols = put_global(cols, spec_a)
-        self.a_data = put_global(data, spec_a)
-        del cols, data, blocks
+        self.a_cols, self.a_data = build_global_parts(
+            gshape, spec_a, _shard, (np.int32, dtype))
+        slab_cache.clear()
 
         rounds = self.rounds
         l_nkb = self.l_nkb

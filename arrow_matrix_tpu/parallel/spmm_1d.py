@@ -45,7 +45,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from arrow_matrix_tpu.parallel.mesh import fetch_replicated, put_global
+from arrow_matrix_tpu.parallel.mesh import (
+    build_global,
+    build_global_parts,
+    fetch_replicated,
+    put_global,
+)
 from scipy import sparse
 
 try:  # jax >= 0.8 promotes shard_map out of experimental
@@ -54,6 +59,77 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from arrow_matrix_tpu.ops.ell import align_up, ell_pack
+
+
+def _owned_slice_ids(mesh: Mesh, axis: str) -> set:
+    """Slice ids whose mesh-axis device group includes a device of THIS
+    process (single-process: all of them)."""
+    ax = list(mesh.axis_names).index(axis)
+    groups = np.moveaxis(mesh.devices, ax, 0).reshape(mesh.shape[axis], -1)
+    pid = jax.process_index()
+    return {d for d in range(groups.shape[0])
+            if any(dev.process_index == pid for dev in groups[d])}
+
+
+def _primary_slice_ids(mesh: Mesh, axis: str) -> set:
+    """Slice ids whose FIRST device belongs to this process — exactly
+    one primary per slice.  Metadata exchanged by summation
+    (_exchange_sum) must be contributed only by primaries: on a mesh
+    with extra axes a slice's device group can span processes, and a
+    per-owner contribution would multiply the sums."""
+    ax = list(mesh.axis_names).index(axis)
+    groups = np.moveaxis(mesh.devices, ax, 0).reshape(mesh.shape[axis], -1)
+    pid = jax.process_index()
+    return {d for d in range(groups.shape[0])
+            if groups[d][0].process_index == pid}
+
+
+def _load_slice(src, dtype) -> sparse.csr_matrix:
+    """One slice source -> canonical CSR: a scipy matrix, a ``.npz``
+    path (the reference's ``{name}.part.{P}.slice.{r}.npz`` files,
+    spmm_petsc.py:82-102), or a zero-arg callable returning either."""
+    if callable(src):
+        src = src()
+    if isinstance(src, str):
+        src = sparse.load_npz(src)
+    if not sparse.issparse(src):
+        raise TypeError(
+            f"slice source must be a scipy matrix, path, or callable, "
+            f"got {type(src).__name__}")
+    m = src.tocsr().astype(dtype)
+    m.sum_duplicates()
+    return m
+
+
+def _exchange_sum(arr: np.ndarray) -> np.ndarray:
+    """Combine per-process contributions (zeros at non-owned entries)
+    into the global array — the host-side counterpart of the
+    reference's Alltoall of counts (matrix_slice.py:233-248).
+    Identity in single-process runs."""
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    stacked = np.asarray(multihost_utils.process_allgather(arr))
+    return stacked.sum(axis=0)
+
+
+def _exchange_ragged(mine: dict, lens: np.ndarray, n_dev: int
+                     ) -> List[np.ndarray]:
+    """Owned ragged int64 arrays -> every slice's array on every
+    process (the reference's Alltoallv of indices,
+    matrix_slice.py:248-273), padded to the global max for the
+    fixed-shape allgather."""
+    lens = np.asarray(lens, dtype=np.int64)
+    if jax.process_count() == 1:
+        return [np.asarray(mine.get(d, np.zeros(0, np.int64)))
+                for d in range(n_dev)]
+    maxlen = int(lens.max()) if lens.size else 0
+    mat = np.zeros((n_dev, maxlen), dtype=np.int64)
+    for d, arr in mine.items():
+        mat[d, :arr.size] = arr
+    mat = _exchange_sum(mat)
+    return [mat[d, :lens[d]] for d in range(n_dev)]
 
 
 def equal_slices(n: int, n_dev: int) -> List[Tuple[int, int]]:
@@ -90,15 +166,58 @@ class MatrixSlice1D:
         n_dev = mesh.shape[axis]
         self.n_dev = n_dev
 
-        a = a.tocsr().astype(dtype)
-        a.sum_duplicates()
-        n, nc = a.shape
-        if n != nc:
-            raise ValueError("iterated SpMM needs a square matrix")
+        # -- slice sources.  A global view (scipy matrix) is cut into
+        # per-device slabs here; a SEQUENCE is per-slice sources —
+        # scipy matrices, ``.npz`` paths, or callables returning either
+        # — and each process loads ONLY the slices of devices it owns
+        # (the reference's per-rank slice files,
+        # spmm_petsc.py:421-440).  Cross-slice metadata (row counts,
+        # needed-row patterns, slot needs) is exchanged host-side (the
+        # reference's Alltoall of counts + Alltoallv of indices,
+        # matrix_slice.py:233-273).
+        mine = _owned_slice_ids(mesh, axis)
+        primary = _primary_slice_ids(mesh, axis)
+        if sparse.issparse(a):
+            a = a.tocsr().astype(dtype)
+            a.sum_duplicates()
+            n, nc = a.shape
+            if n != nc:
+                raise ValueError("iterated SpMM needs a square matrix")
+            self.slices = (list(slices) if slices is not None
+                           else equal_slices(n, n_dev))
+            if len(self.slices) != n_dev:
+                raise ValueError(
+                    f"{len(self.slices)} slices for {n_dev} devices")
+            slabs = {d: a[lo:hi].tocsr()
+                     for d, (lo, hi) in enumerate(self.slices)}
+            rows_per = np.asarray([hi - lo for lo, hi in self.slices],
+                                  dtype=np.int64)
+        else:
+            sources = list(a)
+            if len(sources) != n_dev:
+                raise ValueError(
+                    f"{len(sources)} slice sources for {n_dev} devices")
+            slabs = {d: _load_slice(sources[d], dtype) for d in mine}
+            widths = {m.shape[1] for m in slabs.values()}
+            if len(widths) > 1:
+                raise ValueError(f"slice widths disagree: {widths}")
+            rows_mine = np.zeros(n_dev, dtype=np.int64)
+            for d, m in slabs.items():
+                if d in primary:   # one contributor per slice
+                    rows_mine[d] = m.shape[0]
+            rows_per = _exchange_sum(rows_mine)
+            n = int(rows_per.sum())
+            if slabs and next(iter(slabs.values())).shape[1] != n:
+                raise ValueError(
+                    f"slice width {next(iter(slabs.values())).shape[1]} "
+                    f"!= total rows {n} (iterated SpMM needs square)")
+            bounds = np.concatenate([[0], np.cumsum(rows_per)])
+            self.slices = [(int(bounds[d]), int(bounds[d + 1]))
+                           for d in range(n_dev)]
+            if slices is not None and list(slices) != self.slices:
+                raise ValueError("explicit slices disagree with the "
+                                 "per-source row counts")
         self.n = n
-        self.slices = list(slices) if slices is not None else equal_slices(n, n_dev)
-        if len(self.slices) != n_dev:
-            raise ValueError(f"{len(self.slices)} slices for {n_dev} devices")
         starts = np.asarray([s for s, _ in self.slices], dtype=np.int64)
         stops = np.asarray([t for _, t in self.slices], dtype=np.int64)
         if starts[0] != 0 or stops[-1] != n or np.any(starts[1:] != stops[:-1]):
@@ -106,99 +225,120 @@ class MatrixSlice1D:
         self.l_rows = int((stops - starts).max()) if n_dev else 0
         self.l_rows = max(self.l_rows, 1)
 
-        owner_of = np.searchsorted(stops, np.arange(n), side="right")
-
-        # Row slabs are CSR-sliced once and reused by both table passes
-        # (the slot count must be known before columns can be renumbered,
-        # so two passes are inherent — the slicing is not).
-        slabs = [a[lo:hi].tocsr() for lo, hi in self.slices]
-
-        # -- receive tables: rows needed from each owner, sorted by
-        # (owner, row) — the gathered-nonlocal-column order
-        # (matrix_slice.py:184-227).
-        recv_rows: List[List[np.ndarray]] = []   # [dst][src] global rows
-        counts = np.zeros((n_dev, n_dev), dtype=np.int64)  # counts[src][dst]
-        for d in range(n_dev):
+        # -- receive patterns: the off-slice columns each OWNED slice
+        # needs, already sorted — and therefore already grouped by
+        # owner (owners are monotone over contiguous slices): the
+        # concatenated per-source order of the reference's gathered
+        # nonlocal columns (matrix_slice.py:184-227).  Per-slab ELL
+        # slot needs are collected in the same pass.
+        off_mine: dict = {}
+        cnt_mine = np.zeros((n_dev, n_dev), dtype=np.int64)  # [src, dst]
+        need_mine = np.zeros((2, n_dev), dtype=np.int64)     # local/nonlocal
+        for d, slab in slabs.items():
+            if d not in primary:   # metadata: one contributor per slice
+                continue
             lo, hi = self.slices[d]
-            slab = slabs[d]
-            off_cols = np.unique(slab.indices[
-                (slab.indices < lo) | (slab.indices >= hi)])
-            owners = owner_of[off_cols]
-            per_src = [off_cols[owners == s] for s in range(n_dev)]
-            recv_rows.append(per_src)
-            for s in range(n_dev):
-                counts[s, d] = per_src[s].size
+            is_local = (slab.indices >= lo) & (slab.indices < hi)
+            off_mine[d] = np.unique(slab.indices[~is_local]).astype(np.int64)
+            owners = np.searchsorted(stops, off_mine[d], side="right")
+            cnt_mine[:, d] = np.bincount(owners, minlength=n_dev)
+            if slab.nnz:
+                row_of = np.repeat(np.arange(slab.shape[0], dtype=np.int64),
+                                   np.diff(slab.indptr))
+                for part, mask in ((0, is_local), (1, ~is_local)):
+                    if mask.any():
+                        need_mine[part, d] = int(np.bincount(
+                            row_of[mask], minlength=slab.shape[0]).max())
+
+        if jax.process_count() == 1:
+            # Single process: the tables are already complete.  The
+            # guard must be on the PROCESS COUNT, not on "primary for
+            # every slice" — a process that happens to be primary
+            # everywhere (e.g. a ('repl', 'slices') mesh whose first
+            # devices all live on process 0) skipping the exchange
+            # would strand its peers at the collective.
+            counts, needs = cnt_mine, need_mine
+            off_all = [off_mine.get(d, np.zeros(0, np.int64))
+                       for d in range(n_dev)]
+        else:
+            counts = _exchange_sum(cnt_mine)
+            needs = _exchange_sum(need_mine)
+            off_all = _exchange_ragged(off_mine, counts.sum(axis=0), n_dev)
         # Fixed per-pair slot count: the Alltoallv's ragged counts
         # (matrix_slice.py:248-252) become one padded slot size.
         self.slot = int(counts.max()) if counts.size else 0
+        slot = self.slot
 
         # -- send tables: send_idx[s, d] = local row indices device s
-        # ships to device d (matrix_slice.py:233-273; here read off the
-        # same global view instead of an index Alltoallv).
-        send_idx = np.zeros((n_dev, n_dev, self.slot), dtype=np.int32)
-        for d in range(n_dev):
-            for s in range(n_dev):
-                rows = recv_rows[d][s]
-                send_idx[s, d, :rows.size] = rows - starts[s]
+        # ships to device d, read off the exchanged patterns.
+        cnt_cum = np.concatenate(
+            [np.zeros((1, n_dev), np.int64), np.cumsum(counts, axis=0)])
 
-        # -- per-device local/nonlocal ELL blocks with shared slot counts.
-        local_blocks, nonlocal_blocks = [], []
-        for d in range(n_dev):
+        def _build_send(idx):
+            (s_sl,) = idx[:1]
+            out = np.zeros((s_sl.stop - s_sl.start, 1, n_dev, slot),
+                           dtype=np.int32)
+            for row_i, s in enumerate(range(s_sl.start, s_sl.stop)):
+                for d in range(n_dev):
+                    rows = off_all[d][cnt_cum[s, d]:cnt_cum[s + 1, d]]
+                    out[row_i, 0, d, :rows.size] = rows - starts[s]
+            return out
+
+        # -- per-device local/nonlocal ELL blocks with shared slot
+        # counts, built ONLY for this process's shards (build_global).
+        m_l = align_up(int(needs[0].max()), 8) if needs[0].max() else 0
+        m_nl = align_up(int(needs[1].max()), 8) if needs[1].max() else 0
+
+        def _split(d: int, part: int):
+            slab = slabs[d]   # owned by construction of the sharding
             lo, hi = self.slices[d]
-            slab = slabs[d]
             in_range = (slab.indices >= lo) & (slab.indices < hi)
-            local = slab.copy()
-            local.data = np.where(in_range, slab.data, 0)
-            local.eliminate_zeros()
-            # Local column index == row index within the padded slice.
-            local = sparse.csr_matrix(
-                (local.data, local.indices - lo, local.indptr),
-                shape=(hi - lo, self.l_rows))
-            nonlocal_ = slab.copy()
-            nonlocal_.data = np.where(in_range, 0, slab.data)
-            nonlocal_.eliminate_zeros()
+            m = slab.copy()
+            m.data = np.where(in_range if part == 0 else ~in_range,
+                              slab.data, 0)
+            m.eliminate_zeros()
+            if part == 0:
+                # Local column index == row index within the padded slice.
+                return sparse.csr_matrix(
+                    (m.data, m.indices - lo, m.indptr),
+                    shape=(hi - lo, self.l_rows))
             # Renumber nonlocal columns into the (n_dev * slot) receive
             # buffer: global row g owned by s at position p within the
             # rows-from-s list lands at s * slot + p
-            # (matrix_slice.py:117-139 gathered-column renumbering).
-            # The per-source lists concatenate to a sorted array (owners
-            # are monotone over contiguous slices), so the remap is one
-            # searchsorted instead of a per-nnz Python dict.
-            needed = np.concatenate([recv_rows[d][s] for s in range(n_dev)]) \
-                if self.slot else np.zeros(0, dtype=np.int64)
-            buf_pos = np.concatenate(
-                [s * self.slot + np.arange(recv_rows[d][s].size)
-                 for s in range(n_dev)]) if self.slot \
-                else np.zeros(0, dtype=np.int64)
-            new_cols = (buf_pos[np.searchsorted(needed, nonlocal_.indices)]
-                        if nonlocal_.nnz else
-                        np.zeros(0, dtype=np.int64)).astype(np.int64)
-            nonlocal_ = sparse.csr_matrix(
-                (nonlocal_.data, new_cols, nonlocal_.indptr),
-                shape=(hi - lo, max(n_dev * self.slot, 1)))
-            local_blocks.append(local)
-            nonlocal_blocks.append(nonlocal_)
+            # (matrix_slice.py:117-139 gathered-column renumbering);
+            # off_all[d] is sorted, so the remap is one searchsorted.
+            needed = off_all[d]
+            owners = np.searchsorted(stops, needed, side="right")
+            within = (np.arange(needed.size)
+                      - cnt_cum[owners, d]) if needed.size else needed
+            buf_pos = owners * slot + within
+            new_cols = (buf_pos[np.searchsorted(needed, m.indices)]
+                        if m.nnz else np.zeros(0, dtype=np.int64))
+            return sparse.csr_matrix(
+                (m.data, new_cols.astype(np.int64), m.indptr),
+                shape=(hi - lo, max(n_dev * slot, 1)))
 
-        def pack_stack(mats):
-            need = 0
-            for m in mats:
-                c = np.diff(m.tocsr().indptr)
-                if c.size:
-                    need = max(need, int(c.max()))
-            m_slots = align_up(need, 8) if need else 0
-            ncols = mats[0].shape[1]
-            cols = np.zeros((n_dev, self.l_rows, m_slots), dtype=np.int32)
-            data = np.zeros((n_dev, self.l_rows, m_slots), dtype=dtype)
-            for i, m in enumerate(mats):
-                c, dd = ell_pack(m, max_nnz=m_slots, dtype=dtype)
-                cols[i, :c.shape[0]] = c
-                data[i, :dd.shape[0]] = dd
-            return cols, data, ncols
-
-        l_cols, l_data, _ = pack_stack(local_blocks)
-        nl_cols, nl_data, _ = pack_stack(nonlocal_blocks)
+        def _build_blocks(idx, part: int):
+            """One shard's (cols, data) pair for the local (part 0) or
+            nonlocal (part 1) stack — packed once per shard, both
+            parts together."""
+            (d_sl,) = idx[:1]
+            m_slots = m_l if part == 0 else m_nl
+            cols = np.zeros((d_sl.stop - d_sl.start, self.l_rows, m_slots),
+                            dtype=np.int32)
+            data = np.zeros_like(cols, dtype=dtype)
+            for row_i, d in enumerate(range(d_sl.start, d_sl.stop)):
+                c, dd = ell_pack(_split(d, part), max_nnz=m_slots,
+                                 dtype=dtype)
+                cols[row_i, :c.shape[0]] = c
+                data[row_i, :dd.shape[0]] = dd
+            return cols, data
 
         shard = NamedSharding(mesh, P(axis))
+        l_shape = (n_dev, self.l_rows, m_l)
+        nl_shape = (n_dev, self.l_rows, m_nl)
+        send_shape = (n_dev, 1, n_dev, slot)
+        itemsize = np.dtype(dtype).itemsize
         if chunk == "auto":
             if not 0 < memory_fraction <= 1:
                 raise ValueError(
@@ -206,8 +346,10 @@ class MatrixSlice1D:
                     f"{memory_fraction}")
             from arrow_matrix_tpu.utils.platform import device_memory_budget
 
-            block_bytes = (l_cols.nbytes + l_data.nbytes + nl_cols.nbytes
-                           + nl_data.nbytes + send_idx.nbytes)
+            block_bytes = int(
+                np.prod(l_shape) * (4 + itemsize)
+                + np.prod(nl_shape) * (4 + itemsize)
+                + np.prod(send_shape) * 4)
             dev = mesh.devices.flat[0]
             budget = device_memory_budget(dev, fraction=memory_fraction)
             floor = 1 << 26
@@ -219,13 +361,15 @@ class MatrixSlice1D:
                 per_dev = max(budget - block_bytes / max(n_dev, 1), floor)
             chunk = ("auto", int(per_dev))
 
-        self.l_cols = put_global(l_cols, shard)
-        self.l_data = put_global(l_data, shard)
-        self.nl_cols = put_global(nl_cols, shard)
-        self.nl_data = put_global(nl_data, shard)
-        self.send_idx = put_global(send_idx[:, None], shard)  # (n_dev,1,n_dev,slot)
+        self.l_cols, self.l_data = build_global_parts(
+            l_shape, shard, lambda i: _build_blocks(i, 0),
+            (np.int32, dtype))
+        self.nl_cols, self.nl_data = build_global_parts(
+            nl_shape, shard, lambda i: _build_blocks(i, 1),
+            (np.int32, dtype))
+        self.send_idx = build_global(send_shape, shard, _build_send,
+                                     np.int32)
 
-        slot = self.slot
         l_rows = self.l_rows
 
         def local_step(l_cols, l_data, nl_cols, nl_data, send_idx, x):

@@ -1,0 +1,38 @@
+"""Bounded host->device transfers.
+
+A tunneled TPU can wedge *mid-transfer* inside a native RPC wait that
+no signal interrupts (observed twice: a single ~1.3 GB block upload
+hanging the round-2 bench — SURVEY.md robustness postmortems).  Large
+single-array uploads therefore go up in bounded chunks: a wedge then
+costs one bounded RPC, and the process watchdog (subprocess timeout)
+regains control at the chunk boundary instead of never.
+
+The chunk size trades transfer count against exposure: 256 MiB keeps
+the v5e upload path (~1-2 GB/s through the tunnel) at a few seconds
+per chunk, and the on-device `concatenate` costs one extra pass over
+the array in HBM — negligible against the wire time it bounds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Per-RPC upload bound.  Arrays at or below this size transfer whole.
+MAX_TRANSFER_BYTES = 256 << 20
+
+
+def chunked_asarray(x, max_bytes: int = MAX_TRANSFER_BYTES):
+    """``jnp.asarray`` with the upload split into <= ``max_bytes``
+    slices along axis 0 (device-side concatenate restores the array).
+
+    Small arrays (the common case) take the plain one-RPC path; the
+    helper is safe as a drop-in everywhere.
+    """
+    import jax.numpy as jnp
+
+    x = np.asarray(x)
+    if x.nbytes <= max_bytes or x.ndim == 0 or x.shape[0] < 2:
+        return jnp.asarray(x)
+    n_chunks = min(-(-x.nbytes // max_bytes), x.shape[0])
+    parts = np.array_split(x, n_chunks, axis=0)
+    return jnp.concatenate([jnp.asarray(p) for p in parts], axis=0)

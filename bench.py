@@ -78,6 +78,24 @@ def probe_backend(timeout_s: float = 60.0, retries: int = 2
     return probe_default_backend(timeout_s=timeout_s, retries=retries)
 
 
+def probe_backend_laddered(schedule=(60.0, 120.0, 300.0)
+                           ) -> tuple[str, str, str | None]:
+    """Escalating probe timeouts (round-2 postmortem: a slow-to-wake
+    tunnel failed three 60s probes, degrading the whole round to CPU
+    — a single 300s rung would have caught it).  Returns on the first
+    rung that finds an accelerator; the ladder only costs time when
+    the backend is genuinely dead."""
+    platform = device_kind = "cpu"
+    err: str | None = None
+    for timeout_s in schedule:
+        platform, device_kind, err = probe_backend(
+            timeout_s=timeout_s, retries=1)
+        if platform != "cpu":
+            return platform, device_kind, None
+        _progress(f"probe rung {timeout_s:.0f}s failed: {err}")
+    return platform, device_kind, err
+
+
 def _maybe_force_cpu() -> None:
     """Pin this (child) process to the host CPU when either pin flag is
     set — ONE mechanism behind two accepted names (AMT_BENCH_FORCECPU
@@ -234,6 +252,10 @@ def run_one_candidate(fmt: str) -> None:
     # policy in utils/numerics.py); the default TPU bf16-pass matmul
     # costs ~1e-3 relative error for ~10% speed.
     jax.config.update("jax_default_matmul_precision", "highest")
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:   # explicit: env-var pickup varies across jax versions
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
 
     from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
     from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
@@ -258,17 +280,29 @@ def run_one_candidate(fmt: str) -> None:
         "dense_budget_gb": round(budget / 2**30, 2),
     }
     if cfg.get("k128_run"):
-        # Secondary feature width (the north-star metric names 16 AND
-        # 128 features), measured ONLY in this winner-rerun mode:
-        # inside the race it would triple the full-scale device work
-        # (a fresh n x 128 upload per candidate) and could time out a
-        # candidate whose k=16 number was valid.  The k=16 measure is
-        # skipped here — the race already produced it.
+        # Second headline feature width (the north-star metric names 16
+        # AND 128 features; BASELINE configs 3/5 are k=128), measured
+        # ONLY in this winner-rerun mode: inside the race it would
+        # triple the full-scale device work (a fresh n x 128 upload per
+        # candidate) and could time out a candidate whose k=16 number
+        # was valid.  The k=16 measure is skipped here — the race
+        # already produced it.  GATED like k=16 (VERDICT r2 item 2):
+        # one device step is compared against the host golden and the
+        # parent rejects the number if it misses.
         try:
             _progress(f"fmt={fmt}: k=128 measurement")
             x128_host = random_dense(cfg["n"], 128, seed=4)
             x128 = multi.set_features(x128_host)
             out["k128_ms"] = round(_measure(multi, x128, cfg["iters"]), 3)
+            # Golden on the first 16 of the 128 columns: SpMM is
+            # column-separable, so the slice fully validates the
+            # kernel at 1/8 the host-golden cost — the k=128 golden
+            # at n=2^20 otherwise costs minutes of scipy time and
+            # once pushed this child past its timeout (a SIGKILL
+            # mid-TPU-transfer wedges the tunnel).
+            out["k128_err"] = numerics.relative_error(
+                multi.gather_result(multi.step(x128))[:, :16],
+                decomposition_spmm(levels, x128_host[:, :16]))
             if fmt == "fold":
                 # bf16 carriage at k=128 — the regime where gathered
                 # rows turn bandwidth-bound (PERFORMANCE.md cost
@@ -298,7 +332,52 @@ def run_one_candidate(fmt: str) -> None:
         want = decomposition_spmm(levels, x_host)
         out["err"] = numerics.relative_error(
             multi.gather_result(multi.step(x)), want)
+        # Gather-roofline inputs: padded slots are the ELL-family cost
+        # model (PERFORMANCE.md "layout-padding law"), so the roofline
+        # is achieved slots/s against a pure-gather rate measured on
+        # THIS chip in THIS run — the MFU analog for a gather-bound
+        # kernel, and chip-honest unlike a hardcoded constant.
+        slots = sum(int(b.n_slots) for b in multi.blocks
+                    if hasattr(b, "n_slots"))
+        if slots:
+            out["gather_slots"] = slots
+            try:
+                out["peak_gather_rows_s"] = _peak_gather_rate(
+                    cfg["n"], cfg["k"])
+            except Exception as e:   # roofline is reporting, not gating
+                out["peak_gather_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out), flush=True)
+
+
+def _peak_gather_rate(n: int, k: int, m: int = 8, reps: int = 3) -> float:
+    """Reference gather rate (rows/s): a jitted MATERIALIZING take of
+    n*m uniform-random rows from an (n, k) f32 array.
+
+    Materializing deliberately: a fused ``take(...).sum()`` probe gets
+    algebraically rewritten by XLA (gather+reduce -> weighted matmul)
+    and reports impossible rates.  Uniform-random indices make this a
+    reproducible *reference point*, not a hard ceiling: a real
+    operator whose index distribution has locality (power-law graphs
+    gather hub rows repeatedly — HBM-cache hits) can legitimately
+    exceed it, so ``roofline_frac`` above 1.0 reads "beats the
+    random-gather reference by that factor via index locality"."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    idx = jnp.asarray(rng.integers(0, n, size=n * m, dtype=np.int32))
+    x = jnp.asarray(rng.standard_normal((n, k)).astype(np.float32))
+    f = jax.jit(lambda xx, ii: jnp.take(xx, ii, axis=0))
+    f(x, idx).block_until_ready()
+    best = min(_timed(lambda: f(x, idx).block_until_ready())
+               for _ in range(reps))
+    return n * m / best
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
@@ -313,6 +392,14 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
     env = dict(os.environ, AMT_BENCH_CFG=json.dumps(cfg))
     if cfg["platform"] == "cpu":
         env["AMT_BENCH_FORCECPU"] = "1"
+    # Persistent XLA compilation cache shared by every candidate/rerun
+    # subprocess: the ~20-40s TPU compiles happen once per program
+    # shape per round instead of once per subprocess (round-2
+    # postmortem item: make the bench fight for the chip with a warm
+    # cache).
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.abspath(os.path.join("bench_cache",
+                                                "xla_cache")))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
@@ -465,9 +552,28 @@ def run_bench(result: dict, platform: str, device_kind: str) -> None:
             "frobenius_gate": tol,
             "bytes_per_iter_gb": round(bytes_per_iter / 2**30, 3),
             "achieved_gbps": round(achieved_gbps, 1),
-            "roofline_frac": (round(achieved_gbps / peak, 3)
-                              if peak else None),
         })
+        # Roofline: gather-slots model when the winner reports one
+        # (padded slots ARE the cost of the SELL/fold kernels —
+        # PERFORMANCE.md; the achieved rate lands within ~7% of the
+        # pure-gather probe on chip), HBM-stream model otherwise.
+        if win.get("gather_slots") and win.get("peak_gather_rows_s"):
+            rate = win["gather_slots"] / (dev_ms * 1e-3)
+            result.update({
+                "roofline_model": "gather-slots vs uniform-random "
+                                  "materializing take (same chip, same "
+                                  "run; >1 = index-locality win)",
+                "gather_rows_per_s": round(rate),
+                "peak_gather_rows_s": round(win["peak_gather_rows_s"]),
+                "roofline_frac": round(
+                    rate / win["peak_gather_rows_s"], 3),
+            })
+        else:
+            result.update({
+                "roofline_model": "hbm-stream",
+                "roofline_frac": (round(achieved_gbps / peak, 3)
+                                  if peak else None),
+            })
 
     # --- Device path: race the candidate single-chip execution configs
     # at full scale (each in its own subprocess, see race_candidates)
@@ -490,13 +596,34 @@ def run_bench(result: dict, platform: str, device_kind: str) -> None:
     # whose k=16 number was valid.
     if cfg["k128"] and not result.get("accelerator_wedged"):
         _progress(f"k=128 rerun on winner fmt={result['fmt_used']}")
+        # 1500s: the rerun carries a 0.5 GB upload + two measures +
+        # the sliced host golden; a timeout here SIGKILLs a process
+        # mid-TPU-transfer, which wedges the tunnel — size the bound
+        # so only a genuine wedge can hit it.
         rerun = _spawn_candidate(result["fmt_used"],
                                  dict(cfg, k128_run=True),
-                                 timeout_s=900.0)
+                                 timeout_s=1500.0)
         if "k128_ms" in rerun:
-            result["k128_ms"] = rerun["k128_ms"]
-            if "k128_bf16_ms" in rerun:
-                result["k128_bf16_ms"] = rerun["k128_bf16_ms"]
+            # Gated like the k=16 headline (VERDICT r2 item 2: two
+            # gated numbers per round): the measurement is reported
+            # only when its one-step golden error passes.  Same gate
+            # value as the race (`tol`, already recorded as
+            # frobenius_gate) — one formula, one tuning point.
+            tol128 = tol
+            err128 = rerun.get("k128_err", float("inf"))
+            result["k128_err"] = err128
+            result["k128_gate"] = tol128
+            if np.isfinite(err128) and err128 <= tol128:
+                result["k128_ms"] = rerun["k128_ms"]
+                if "k128_bf16_ms" in rerun:
+                    # published only under the same gate — a timing
+                    # from a kernel that missed its golden is not a
+                    # result (the bf16 carriage shares the build the
+                    # gate just validated).
+                    result["k128_bf16_ms"] = rerun["k128_bf16_ms"]
+            else:
+                result["k128_error"] = (
+                    f"missed correctness gate: {err128} > {tol128}")
         elif rerun.get("k128_error") or rerun.get("error"):
             result["k128_error"] = (rerun.get("k128_error")
                                     or rerun.get("error"))
@@ -654,7 +781,7 @@ def main() -> None:
             platform, _, kind = forced.partition(":")
             device_kind, probe_err = kind or platform, None
         else:
-            platform, device_kind, probe_err = probe_backend()
+            platform, device_kind, probe_err = probe_backend_laddered()
         if probe_err:
             result["backend_probe_error"] = probe_err
         # The headline race runs FIRST — a tunneled accelerator is
@@ -667,6 +794,37 @@ def main() -> None:
             run_bench(result, platform, device_kind)
         except Exception as e:
             result["error"] = f"{type(e).__name__}: {e}"
+        # Mid-window re-probe (round-2 postmortem): a degraded start
+        # must not cost the round's accelerator number if the tunnel
+        # recovers while the CPU fallback ran.  The CPU result is kept
+        # as a diagnostic under "degraded_cpu_run"; the race re-runs
+        # fold-only (the CPU-run-validated winner) in the remaining
+        # window — finalize() folds numbers in incrementally, so even
+        # a deadline alarm mid-upgrade keeps whatever was earned.
+        remaining = (deadline - (time.perf_counter() - _T0)
+                     if deadline else 1e9)
+        if (result.get("degraded") and not forced and remaining > 600
+                and os.environ.get("AMT_BENCH_REPROBE", "1") == "1"):
+            platform2, kind2, _ = probe_backend(timeout_s=120.0, retries=1)
+            if platform2 != "cpu":
+                _progress("accelerator recovered mid-window; upgrading")
+                cpu_run = {k: result.get(k)
+                           for k in ("value", "vs_baseline",
+                                     "scipy_cpu_ms", "fmt_used",
+                                     "frobenius_err_vs_cpu")}
+                os.environ.setdefault("AMT_BENCH_FMT", "fold")
+                upgraded = {"metric": "spmm_iter_ms", "value": None,
+                            "unit": "ms", "vs_baseline": None,
+                            "degraded_cpu_run": cpu_run}
+                try:
+                    run_bench(upgraded, platform2, kind2)
+                except Exception as e:
+                    upgraded.setdefault(
+                        "error", f"{type(e).__name__}: {e}")
+                if upgraded.get("value") is not None:
+                    result.clear()
+                    result.update(upgraded)
+                    platform, device_kind = platform2, kind2
         _, small = _degraded_small(platform)
         remaining = deadline - (time.perf_counter() - _T0) if deadline else 1e9
         # "auto": compare only on a real accelerator — CPU variant

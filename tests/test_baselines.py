@@ -36,6 +36,36 @@ class TestSpMM15D:
         want = a @ x
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("implicit_ones", [False, True])
+    def test_memmapped_triplet_build_matches_scipy(self, tmp_path,
+                                                   implicit_ones):
+        """SpMM15D built from a memmapped npy CSR triplet (the
+        reference's generate_15d_decomposition_new ingest,
+        spmm_15d.py:158-309) is bit-identical to the in-memory build
+        and never needs the whole matrix in RAM."""
+        mesh = make_mesh((4, 2), ("rows", "repl"))
+        a = _random_square(96, 4, seed=17)
+        if implicit_ones:
+            a.data[:] = 1.0
+        np.save(tmp_path / "d.npy", a.data)
+        np.save(tmp_path / "i.npy", a.indices)
+        np.save(tmp_path / "p.npy", a.indptr)
+        triplet = (
+            None if implicit_ones
+            else np.load(tmp_path / "d.npy", mmap_mode="r"),
+            np.load(tmp_path / "i.npy", mmap_mode="r"),
+            np.load(tmp_path / "p.npy", mmap_mode="r"))
+        x = random_dense(96, 4, seed=3)
+
+        mem = SpMM15D(a, mesh)
+        mm = SpMM15D(triplet, mesh)
+        np.testing.assert_array_equal(np.asarray(mm.a_cols),
+                                      np.asarray(mem.a_cols))
+        np.testing.assert_array_equal(np.asarray(mm.a_data),
+                                      np.asarray(mem.a_data))
+        got = mm.gather_result(mm.spmm(mm.set_features(x)))
+        np.testing.assert_allclose(got, a @ x, rtol=1e-5, atol=1e-5)
+
     def test_replicas_identical(self):
         mesh = make_mesh((4, 2), ("rows", "repl"))
         a = _random_square(64, 3, seed=3)
@@ -86,6 +116,54 @@ class TestMatrixSlice1D:
         dist = MatrixSlice1D(a, mesh)
         got = dist.gather_result(dist.spmm(dist.set_features(x)))
         np.testing.assert_allclose(got, a @ x, rtol=1e-5, atol=1e-5)
+
+    def test_per_slice_sources_match_global_build(self, tmp_path):
+        """Built from per-slice npz files (the reference's
+        .part.P.slice.r.npz scheme, spmm_petsc.py:421-440: each rank
+        loads only its own slice) == the global-matrix build,
+        table-for-table."""
+        mesh = make_mesh((8,), ("slices",))
+        n, k = 97, 5
+        a = _random_square(n, 4, seed=21)
+        x = random_dense(n, k, seed=21)
+        ref = MatrixSlice1D(a, mesh)
+
+        paths = []
+        for d, (lo, hi) in enumerate(ref.slices):
+            p = str(tmp_path / f"g.part.8.slice.{d}.npz")
+            sparse.save_npz(p, a[lo:hi].tocsr())
+            paths.append(p)
+        dist = MatrixSlice1D(paths, mesh)
+
+        assert dist.slices == ref.slices and dist.slot == ref.slot
+        for name in ("l_cols", "l_data", "nl_cols", "nl_data", "send_idx"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dist, name)),
+                np.asarray(getattr(ref, name)), err_msg=name)
+        got = dist.gather_result(dist.spmm(dist.set_features(x)))
+        np.testing.assert_allclose(got, a @ x, rtol=1e-5, atol=1e-5)
+
+    def test_per_slice_sources_unequal_with_empty(self):
+        """Per-slice sources with ragged and zero-row slices (the
+        reference's unequal-slice stress, test_spmmPETSc.py:44-71),
+        slices derived from the source row counts."""
+        mesh = make_mesh((8,), ("slices",))
+        n, k = 33, 4
+        bounds = [0, 0, 5, 5, 20, 21, 33, 33, 33]
+        a = _random_square(n, 5, seed=9)
+        x = random_dense(n, k, seed=9)
+        sources = [a[bounds[i]:bounds[i + 1]].tocsr() for i in range(8)]
+        dist = MatrixSlice1D(sources, mesh)
+        got = dist.gather_result(dist.spmm(dist.set_features(x)))
+        np.testing.assert_allclose(got, a @ x, rtol=1e-5, atol=1e-5)
+
+    def test_per_slice_sources_width_mismatch_raises(self):
+        mesh = make_mesh((8,), ("slices",))
+        a = _random_square(64, 4, seed=3)
+        srcs = [a[lo:hi, :32].tocsr()
+                for lo, hi in equal_slices(64, 8)]
+        with pytest.raises(ValueError):
+            MatrixSlice1D(srcs, mesh)
 
     def test_identity(self):
         # Identity result == X (reference test_spmmPETSc.py:95-121).

@@ -1,0 +1,162 @@
+"""ICI-bandwidth-parameterized mode model (VERDICT r2 item 8).
+
+The 8-virtual-device wall-clock races run on one host core, so their
+ms/iter cannot decide time-vs-space or sell-vs-stacked for a real ICI
+mesh.  What IS trustworthy off-chip: the per-iteration collective
+bytes and counts read from compiled/lowered HLO (utils/commstats) and
+the per-chip gather rate measured on the real chip (~95-101M
+slots/s, PERFORMANCE.md).  This tool combines them into a predicted
+per-iteration time as a function of ICI bandwidth and collective
+launch latency:
+
+    T_mode(bw, lat) = compute_ms(mode) + bytes(mode)/bw + n_coll(mode)*lat
+
+  * compute_ms — padded gather slots through the measured per-chip
+    gather rate; time-shared runs every level on all n_dev chips
+    (sum of levels / n_dev), space-shared runs levels concurrently on
+    n_dev/K chips each (max level / (n_dev/K)).
+  * bytes/bw — collective payload over the per-chip ICI bandwidth.
+  * n_coll*lat — each collective pays a launch/sync latency; the
+    time-shared schedule serializes its per-level collectives, the
+    space-shared schedule overlaps levels (its collectives count once).
+
+Printed: the predicted table at v5e parameters and the crossover
+sweep — the (bw, lat) region where each mode wins.  Run with real
+chips attached (AMT_RACE_REAL=1) to confirm with measured wall-clock.
+
+Usage: PYTHONPATH=/root/repo python tools/ici_model.py [n_vertices]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from arrow_matrix_tpu.utils.platform import backend_initialized, force_cpu_devices  # noqa: E402
+
+if not backend_initialized() and os.environ.get("AMT_RACE_REAL") != "1":
+    force_cpu_devices(8)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition  # noqa: E402
+from arrow_matrix_tpu.parallel.mesh import make_mesh  # noqa: E402
+from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel  # noqa: E402
+from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared  # noqa: E402
+from arrow_matrix_tpu.utils import commstats  # noqa: E402
+from arrow_matrix_tpu.utils.graphs import barabasi_albert, random_dense  # noqa: E402
+
+#: Measured on the v5e chip this framework benches on (PERFORMANCE.md):
+#: the composed SELL operator streams ~101M padded slots/s; the
+#: standalone probe ~95M.  Conservative choice: the probe.
+GATHER_ROWS_PER_S = 95e6
+
+#: Public per-chip ICI figures (GB/s, one direction, all links) for
+#: the sweep's named points; the model is a function of bw, these just
+#: label interesting abscissae.
+ICI_POINTS = {"v5e (3 links x ~45GB/s)": 135.0,
+              "v4/v5p-class": 270.0,
+              "DCN-ish": 25.0,
+              "slow DCN": 5.0}
+
+#: Collective launch/sync latency sweep (seconds): ICI collectives on
+#: TPU are ~1-10us; DCN-crossing ones 100us+.
+LATENCIES_US = (1.0, 10.0, 100.0)
+
+
+def mode_inputs(n: int, k: int = 16, width: int = 256):
+    """(per-level padded slots, per-mode collective bytes+counts) at
+    one config, from the real builders and the lowered HLO."""
+    n_dev = len(jax.devices())
+    a = barabasi_albert(n, 8, seed=7)
+    levels = arrow_decomposition(a, width, max_levels=4,
+                                 block_diagonal=True, seed=7)
+    K = len(levels)
+    x = random_dense(n, k, seed=3)
+
+    sm = SellMultiLevel(levels, width, make_mesh((n_dev,), ("blocks",)),
+                        routing="a2a")
+    # Per-level padded slots from the SELL growth bound: padded slots
+    # <= growth (1.2) x nnz (ops/sell.py tiering invariant) — the
+    # gather cost model's work term per level.
+    slots = [int(1.2 * lvl.matrix.nnz) for lvl in levels]
+
+    def totals(stats) -> tuple:
+        count = sum(v["count"] for key, v in stats.items()
+                    if isinstance(v, dict))
+        return stats["total_bytes"], count
+
+    xt = sm.set_features(x)
+    out = {"K": K, "n_dev": n_dev, "slots": slots,
+           "time": totals(commstats.collective_stats(
+               sm.step_fn, xt, *sm.step_operands()))}
+    if n_dev % K == 0:
+        sp = SellSpaceShared(levels, width,
+                             make_mesh((K, n_dev // K),
+                                       ("lvl", "blocks")))
+        xp = sp.set_features(x)
+        out["space"] = totals(commstats.collective_stats(
+            sp.step_fn, xp, *sp.step_operands()))
+    return out
+
+
+def predict_ms(slots, n_dev, K, bytes_, n_coll, bw_gbps, lat_s,
+               space: bool) -> float:
+    if space:
+        compute = max(slots) / (n_dev / K) / GATHER_ROWS_PER_S
+        serial_coll = n_coll           # levels overlap; one schedule
+    else:
+        compute = sum(slots) / n_dev / GATHER_ROWS_PER_S
+        serial_coll = n_coll           # already per-iteration totals
+    comm = bytes_ / (bw_gbps * 1e9)
+    return (compute + comm + serial_coll * lat_s) * 1e3
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 14
+    mi = mode_inputs(n)
+    K, n_dev, slots = mi["K"], mi["n_dev"], mi["slots"]
+    print(f"config: n={n} K={K} n_dev={n_dev} "
+          f"slots/level={['%.2g' % s for s in slots]}")
+    tb, tc = mi["time"]
+    print(f"time-shared sell/a2a: {tb:,} B/iter over {tc} collectives")
+    if "space" not in mi:
+        print(f"(space-shared skipped: {n_dev} devices not divisible "
+              f"by K={K})")
+        return
+    sb, sc = mi["space"]
+    print(f"space-shared sell:    {sb:,} B/iter over {sc} collectives")
+    print()
+    print(f"{'ICI point':28} {'lat us':>7} {'time ms':>9} "
+          f"{'space ms':>9}  winner")
+    for name, bw in ICI_POINTS.items():
+        for lat in LATENCIES_US:
+            t = predict_ms(slots, n_dev, K, tb, tc, bw, lat * 1e-6,
+                           space=False)
+            s = predict_ms(slots, n_dev, K, sb, sc, bw, lat * 1e-6,
+                           space=True)
+            print(f"{name:28} {lat:7.0f} {t:9.3f} {s:9.3f}  "
+                  f"{'time' if t <= s else 'SPACE'}")
+    # Crossover condition, symbolically: space wins iff its
+    # concurrency saving on per-level compute outweighs its K-fold
+    # worse per-chip compute share:
+    #   sum(w)/n  vs  K*max(w)/n  -> time-shared's compute never
+    # loses when levels are balanced; space-shared can only win on
+    # LATENCY (fewer serialized per-level collectives) or when K
+    # shrinks per-level work below the collective launch floor.
+    lat_floor = (max(slots) / (n_dev / K) - sum(slots) / n_dev) \
+        / GATHER_ROWS_PER_S
+    print()
+    print(f"compute handicap of space-sharing at this shape: "
+          f"{lat_floor * 1e3:.3f} ms/iter — space-shared wins only "
+          f"where serialized collective latency exceeds this "
+          f"(e.g. {tc - sc} extra launches x >"
+          f"{lat_floor * 1e6 / max(tc - sc, 1):.0f} us each: "
+          f"DCN-class links or sub-ms levels)")
+
+
+if __name__ == "__main__":
+    main()
