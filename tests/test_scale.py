@@ -1,0 +1,76 @@
+"""Scale-ladder guard tests (VERDICT r2 item 3).
+
+The full rungs (2^24 decompose + streamed ingest, 2^26-row planar
+decompose) take tens of minutes on one host core, so they run via
+``tools/scale_ladder.py`` and are guarded here:
+
+* always: the ladder tool's registry and recorded results stay sane
+  (a recorded run must have passed its golden gate);
+* ``AMT_SLOW=1``: re-run the streamed-ingest rung end-to-end (needs
+  the 2^24 artifact in bench_cache — the decompose rung creates it).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LADDER = os.path.join(REPO, "tools", "scale_ladder.py")
+RESULTS = os.path.join(REPO, "bench_results", "scale_ladder.json")
+
+
+def _ladder_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("ladder", LADDER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ladder_registry_importable():
+    assert set(_ladder_module().RUNGS) == {
+        "decompose24", "ingest24", "decompose26_grid", "backend_race22"}
+
+
+def test_recorded_ladder_results_pass_their_gates():
+    """A committed scale_ladder.json must hold gate-passing numbers —
+    a recorded run that failed its golden is not a result."""
+    if not os.path.exists(RESULTS):
+        pytest.skip("no recorded ladder results yet")
+    with open(RESULTS) as f:
+        results = json.load(f)
+    for rung, r in results.items():
+        assert "error" not in r, f"{rung} recorded a failure: {r}"
+    ing = results.get("ingest24")
+    if ing:
+        assert ing["golden_err"] <= ing["golden_gate"]
+        # Build RSS bound (measured 31.6 GB at 2^24): blocks stream
+        # per-slice, but the 12 inter-level routing tables compose on
+        # the host at O(K * n) — the known non-streamed remainder
+        # (PERFORMANCE.md scale ladder).  The bound guards against
+        # regression to a fully-materialized build (~41 GB decompose
+        # RSS) while the table composition stays host-global.
+        assert ing["build_peak_rss_gb"] < 36.0
+    grid = results.get("decompose26_grid")
+    if grid:
+        assert grid["one_level_fast_path"] is True
+
+
+@pytest.mark.skipif(os.environ.get("AMT_SLOW") != "1",
+                    reason="2^24 streamed-ingest rung (minutes); "
+                           "set AMT_SLOW=1")
+def test_streamed_ingest_2_24_end_to_end():
+    artifact = _ladder_module()._artifact24() + ".complete"
+    if not os.path.exists(artifact):
+        pytest.skip("2^24 artifact missing; run "
+                    "tools/scale_ladder.py decompose24 first")
+    proc = subprocess.run(
+        [sys.executable, LADDER, "--rung", "ingest24"],
+        capture_output=True, text=True, timeout=3600, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["golden_err"] <= out["golden_gate"]

@@ -1,0 +1,37 @@
+"""Bounded host->device transfers (utils/transfer.py)."""
+
+import numpy as np
+
+from arrow_matrix_tpu.utils.transfer import chunked_asarray
+
+
+def test_chunked_equals_whole_upload():
+    rng = np.random.default_rng(0)
+    for shape in [(7,), (33, 5), (9, 4, 3)]:
+        x = rng.standard_normal(shape).astype(np.float32)
+        # max_bytes tiny: forces the multi-chunk path.
+        np.testing.assert_array_equal(
+            np.asarray(chunked_asarray(x, max_bytes=64)), x)
+        # default path (single RPC) unchanged.
+        np.testing.assert_array_equal(np.asarray(chunked_asarray(x)), x)
+
+
+def test_chunked_matches_jnp_asarray_semantics():
+    import jax.numpy as jnp
+
+    # Same dtype policy as a plain jnp.asarray (incl. the x64-mode
+    # int64 -> int32 downcast) — chunking must not change semantics.
+    for x in [np.arange(10, dtype=np.int16),
+              np.float32(3.5),
+              np.arange(6, dtype=np.int64).reshape(2, 3)]:
+        out = chunked_asarray(np.asarray(x), max_bytes=8)
+        ref = jnp.asarray(np.asarray(x))
+        assert out.dtype == ref.dtype
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_chunk_count_bounded_by_leading_dim():
+    # More required chunks than rows: clamps to one chunk per row.
+    x = np.arange(3 * 100, dtype=np.float32).reshape(3, 100)
+    np.testing.assert_array_equal(
+        np.asarray(chunked_asarray(x, max_bytes=1)), x)
