@@ -105,11 +105,45 @@ def main() -> None:
     d1 = MatrixSlice1D(af, mesh, axis="blocks")
     errs["petsc_1d"] = relative_error(
         d1.gather_result(d1.spmm(d1.set_features(x))), want1)
+
+    # Per-slice sources across the process boundary: each process
+    # loads ONLY the slices of devices it owns (the reference's
+    # per-rank slice files, spmm_petsc.py:421-440); the cross-slice
+    # metadata exchange (_exchange_sum / _exchange_ragged — the
+    # Alltoall/Alltoallv of counts/indices) runs its REAL
+    # process_allgather branch here, identity elsewhere in the suite.
+    from arrow_matrix_tpu.parallel.spmm_1d import (
+        _owned_slice_ids,
+        equal_slices,
+    )
+
+    slc = equal_slices(n, n_global)
+    mine = _owned_slice_ids(mesh, "blocks")
+    loaded_ids = []
+
+    def src(d, lo, hi):
+        def load():
+            loaded_ids.append(d)
+            return af[lo:hi].tocsr()
+        return load
+
+    d1s = MatrixSlice1D([src(d, lo, hi) for d, (lo, hi) in enumerate(slc)],
+                        mesh, axis="blocks")
+    assert set(loaded_ids) == mine, (sorted(loaded_ids), sorted(mine))
+    errs["petsc_1d_per_slice"] = relative_error(
+        d1s.gather_result(d1s.spmm(d1s.set_features(x))), want1)
+
     if n_global % 2 == 0:   # replication needs an even device grid
         m15 = make_mesh((n_global // 2, 2), ("rows", "repl"))
         d15 = SpMM15D(af, m15)
         errs["15d"] = relative_error(
             d15.gather_result(d15.spmm(d15.set_features(x))), want1)
+        # Triplet build: build_global_parts constructs only THIS
+        # process's shards from the (memmap-shaped) CSR triplet.
+        trip = (af.data, af.indices, af.indptr)
+        d15t = SpMM15D(trip, m15)
+        errs["15d_triplet"] = relative_error(
+            d15t.gather_result(d15t.spmm(d15t.set_features(x))), want1)
 
     # Distributed training THROUGH the process boundary: GCN gradients
     # cross the same multi-process collectives (psum / ppermute /

@@ -220,6 +220,10 @@ def main() -> None:
         print(json.dumps(RUNGS[sys.argv[2]]()), flush=True)
         return
     rungs = sys.argv[1:] or list(RUNGS)
+    unknown = [r for r in rungs if r not in RUNGS]
+    if unknown:
+        raise SystemExit(f"unknown rung(s) {unknown}; "
+                         f"valid: {sorted(RUNGS)}")
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     results = {}
     if os.path.exists(OUT):
@@ -235,13 +239,18 @@ def main() -> None:
         if proc.returncode == 0 and proc.stdout.strip():
             new = json.loads(proc.stdout.strip().splitlines()[-1])
             new["wall_s"] = wall
-            if new.get("cached") and rung in results \
-                    and "error" not in results[rung]:
-                # A cache hit must not overwrite the recorded measured
-                # numbers (they are the provenance PERFORMANCE.md
-                # cites) with a stub.
-                print(f"[ladder] {rung}: cached artifact; keeping "
-                      f"recorded numbers", flush=True)
+            if new.get("cached"):
+                # A cache hit never becomes the rung's RESULT: either
+                # the recorded measured numbers stay (they are the
+                # provenance PERFORMANCE.md cites), or — with no clean
+                # prior entry — the stub is reported but NOT recorded
+                # (delete the artifact to re-measure).
+                prior_ok = (rung in results
+                            and "error" not in results[rung]
+                            and not results[rung].get("cached"))
+                print(f"[ladder] {rung}: cached artifact; "
+                      f"{'keeping recorded numbers' if prior_ok else 'no recorded numbers — delete ' + str(new.get('base')) + '* to re-measure'}",
+                      flush=True)
                 continue
             results[rung] = new
             print(f"[ladder] {rung}: {results[rung]}", flush=True)
