@@ -490,13 +490,22 @@ def _carried_maps(perm: np.ndarray, body_order: np.ndarray, L: int,
     both -1 where undefined.  Shared by SellMultiLevel and
     SellSpaceShared."""
     n_dev, rows_out = body_order.shape
-    oop = np.full(rows_out * n_dev, -1, dtype=np.int64)
+    # int32: rows and positions stay far below 2^31 even at the 2^26
+    # scale rung — these maps are the largest host-resident metadata
+    # of a multi-level build (2 per level at O(total)).  Guarded: a
+    # silent wrap would corrupt every route (fail loudly at build
+    # time, the routing.py convention).
+    if max(total, rows_out * n_dev) >= 2**31:
+        raise ValueError(
+            f"carried maps exceed int32 range "
+            f"(total={total}, positions={rows_out * n_dev})")
+    oop = np.full(rows_out * n_dev, -1, dtype=np.int32)
     for d in range(n_dev):
         src = body_order[d]
         live = src >= 0
         oop[d * rows_out + np.flatnonzero(live)] = perm[
             d * L + src[live]]
-    poo = np.full(total, -1, dtype=np.int64)
+    poo = np.full(total, -1, dtype=np.int32)
     live = oop >= 0
     poo[oop[live]] = np.flatnonzero(live)
     return oop, poo
@@ -920,15 +929,18 @@ class SellMultiLevel:
         ]
 
         # Carried-position <-> original-row maps per level
-        # (_carried_maps: perm composed with the tiered ordering).
-        orig_of_pos, pos_of_orig = [], []
-        for lvl, ops in zip(levels, self.ops):
-            perm = pad_permutation(np.asarray(lvl.permutation), total)
-            oop, poo = _carried_maps(perm, ops.body_order, shard_len,
-                                     total)
-            orig_of_pos.append(oop)
-            pos_of_orig.append(poo)
-        self._orig_of_pos0 = orig_of_pos[0]
+        # (_carried_maps: perm composed with the tiered ordering),
+        # built LAZILY two levels at a time below: live host metadata
+        # stays O(2 levels), not O(K levels) — part of the streamed-
+        # build RSS bound (PERFORMANCE.md scale ladder note).
+        def maps_for(i: int):
+            perm = pad_permutation(np.asarray(levels[i].permutation),
+                                   total)
+            return _carried_maps(perm, self.ops[i].body_order,
+                                 shard_len, total)
+
+        oop_cur, poo_cur = maps_for(0)
+        self._orig_of_pos0 = oop_cur
 
         repl = NamedSharding(mesh, P())
 
@@ -953,12 +965,14 @@ class SellMultiLevel:
             return put_global(idx.astype(np.int32), repl)
 
         k_levels = len(levels)
-        self.fwd = [route(orig_of_pos[i], pos_of_orig[i - 1],
-                          self.ops[i - 1].total_out)
-                    for i in range(1, k_levels)]
-        self.bwd = [route(orig_of_pos[i - 1], pos_of_orig[i],
-                          self.ops[i].total_out)
-                    for i in range(1, k_levels)]
+        self.fwd, self.bwd = [], []
+        for i in range(1, k_levels):
+            oop_next, poo_next = maps_for(i)
+            self.fwd.append(route(oop_next, poo_cur,
+                                  self.ops[i - 1].total_out))
+            self.bwd.append(route(oop_cur, poo_next,
+                                  self.ops[i].total_out))
+            oop_cur, poo_cur = oop_next, poo_next
 
         steps = [make_sharded_step(mesh, axis, width, ops.rows_out,
                                    hops=ops.hops, rem=ops.rem,
