@@ -191,7 +191,9 @@ class MultiLevelArrow:
                  dense_budget: Optional[int] = None, kernel: str = "xla",
                  routing: str = "gather", head_fmt: str = "auto",
                  binary="auto", feature_dtype=None,
-                 layout: str = "slim", arm_axis: str = "arm"):
+                 layout: str = "slim", arm_axis: str = "arm",
+                 fold_growth: float = 1.2,
+                 fold_align: Optional[int] = None):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -329,7 +331,8 @@ class MultiLevelArrow:
         # (SGCCarried/GCNCarried vs the flat SGCModel/GCNModel).
         self.carries_feature_major = self.folded
         if self.folded:
-            self._init_folded(levels, chunk, gather_budget, dtype)
+            self._init_folded(levels, chunk, gather_budget, dtype,
+                              growth=fold_growth, slot_align=fold_align)
             return
 
         # Per-level block format.  "auto" densifies levels as long as the
@@ -466,7 +469,9 @@ class MultiLevelArrow:
 
     # -- folded single-chip execution --------------------------------------
 
-    def _init_folded(self, levels, chunk, gather_budget: int, dtype) -> None:
+    def _init_folded(self, levels, chunk, gather_budget: int, dtype,
+                     growth: float = 1.2,
+                     slot_align: Optional[int] = None) -> None:
         """Compose the whole decomposition into ONE operator.
 
         On a single chip the inter-level permutation exchanges buy
@@ -530,8 +535,12 @@ class MultiLevelArrow:
         # SELL packing in degree-sorted coordinates; the sort permutation
         # is composed into the carried ordering (set_features/
         # gather_result), so it is free at runtime.
+        if slot_align is None:   # follow the library-wide tile alignment
+            from arrow_matrix_tpu.ops.ell import SLOT_ALIGN
+            slot_align = SLOT_ALIGN
         sell, order = sell_from_csr(folded, pad_rows_to=total, dtype=dtype,
-                                    binary=self.binary)
+                                    binary=self.binary, growth=growth,
+                                    slot_align=slot_align)
         self.perm0 = self.perm0[order]
         self.inv_perm0 = np.argsort(self.perm0)
         self.blocks = [sell]

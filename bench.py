@@ -233,6 +233,17 @@ def _bench_config(platform: str) -> dict:
     return cfg
 
 
+#: Headline-race candidate name -> MultiLevelArrow build kwargs.
+#: "fold_tight" trades tile-friendly slot alignment for ~17% fewer
+#: LOGICAL slots (align 1 / growth 1.1 vs 8 / 1.2 — ops/sell.py
+#: measurement); slots are the gather cost, so on chip it should win
+#: iff slots/s holds across ~2x the tier count.
+CANDIDATE_KWARGS = {
+    "fold": dict(fmt="fold"),
+    "fold_tight": dict(fmt="fold", fold_growth=1.1, fold_align=1),
+}
+
+
 def run_one_candidate(fmt: str) -> None:
     """Build + measure ONE headline-race format candidate at the
     configured scale; prints one JSON line with its numbers.
@@ -268,8 +279,9 @@ def run_one_candidate(fmt: str) -> None:
     budget = device_memory_budget(jax.devices()[0])
 
     t0 = time.perf_counter()
-    multi = MultiLevelArrow(levels, cfg["width"], mesh=None, fmt=fmt,
-                            dense_budget=budget)
+    multi = MultiLevelArrow(levels, cfg["width"], mesh=None,
+                            dense_budget=budget,
+                            **CANDIDATE_KWARGS.get(fmt, dict(fmt=fmt)))
     build_s = time.perf_counter() - t0
     _progress(f"fmt={fmt} built in {build_s:.0f}s; compile+measure")
     out = {
@@ -303,7 +315,7 @@ def run_one_candidate(fmt: str) -> None:
             out["k128_err"] = numerics.relative_error(
                 multi.gather_result(multi.step(x128))[:, :16],
                 decomposition_spmm(levels, x128_host[:, :16]))
-            if fmt == "fold":
+            if fmt.startswith("fold"):
                 # bf16 carriage at k=128 — the regime where gathered
                 # rows turn bandwidth-bound (PERFORMANCE.md cost
                 # model); feature_dtype only affects set_features, so
@@ -453,8 +465,8 @@ def race_candidates(result: dict, cfg: dict, finalize,
     timeout the chip is re-probed and the race stops if it wedged
     (every later candidate would burn its timeout against a dead
     tunnel)."""
-    candidates = (["fold", "hyb", "auto"] if cfg["fmt"] == "auto"
-                  else [cfg["fmt"]])
+    candidates = (["fold", "fold_tight", "hyb", "auto"]
+                  if cfg["fmt"] == "auto" else [cfg["fmt"]])
     runs = {}
     for f in candidates:
         _progress(f"candidate fmt={f}")
@@ -641,6 +653,9 @@ def run_bench(result: dict, platform: str, device_kind: str) -> None:
 # cheap one before the first expensive one.
 COMPARE_VARIANTS = {
     "fold": dict(fmt="fold"),             # composed single-operator SELL
+    # Tight packing — SAME config as the headline-race candidate (one
+    # definition; the two sweeps must measure the same thing).
+    "fold_tight": None,   # filled from CANDIDATE_KWARGS below
     # bf16-carried features (f32 accumulation): half the bytes per
     # gathered row — the amortization lever where the gather turns
     # bandwidth-bound (k=128); outside the f32 gate, diagnostics only.
@@ -658,6 +673,7 @@ COMPARE_VARIANTS = {
     "pallas": dict(fmt="dense", kernel="pallas"),
     "pallas_bf16": dict(fmt="dense", kernel="pallas", dtype="bf16"),
 }
+COMPARE_VARIANTS["fold_tight"] = CANDIDATE_KWARGS["fold_tight"]
 COMPARE_CONFIG = dict(n=65536, m=8, width=2048, k=16, iters=10)
 
 

@@ -193,6 +193,27 @@ def test_fold_matches_golden_and_iterates():
                                rtol=1e-3, atol=1e-4)
 
 
+def test_fold_tight_packing_matches_golden():
+    """fold_align=1 / fold_growth=1.1 (the 'fold_tight' bench
+    candidate): fewer padded slots, BIT-equivalent math — tile
+    padding costs no gathers, logical slots do (ops/sell.py)."""
+    n, width = 480, 32
+    a = barabasi_albert(n, 6, seed=19)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    ml = MultiLevelArrow(levels, width, mesh=None, fmt="fold")
+    tight = MultiLevelArrow(levels, width, mesh=None, fmt="fold",
+                            fold_growth=1.1, fold_align=1)
+    assert tight.blocks[0].n_slots < ml.blocks[0].n_slots
+    x_host = random_dense(n, 8, seed=3)
+    out = tight.gather_result(tight.step(tight.set_features(x_host)))
+    np.testing.assert_allclose(out, decomposition_spmm(levels, x_host),
+                               rtol=1e-4, atol=1e-4)
+    # Same addends, different tiering: agree to f32 reassociation.
+    ref = ml.gather_result(ml.step(ml.set_features(x_host)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
 def test_fold_bf16_features():
     """feature_dtype='bf16' halves the carried-feature bytes (the
     k=128 amortization lever) with f32 accumulation: results track the
