@@ -255,9 +255,19 @@ def main() -> None:
             results[rung] = new
             print(f"[ladder] {rung}: {results[rung]}", flush=True)
         else:
-            results[rung] = {"error": proc.stderr.strip()[-500:],
-                             "wall_s": wall}
-            print(f"[ladder] {rung} FAILED: {results[rung]}", flush=True)
+            failure = {"error": proc.stderr.strip()[-500:],
+                       "wall_s": wall}
+            if rung in results and "error" not in results[rung]:
+                # A failed RE-run (e.g. resource exhaustion from
+                # concurrent host load) must not destroy recorded
+                # gate-passing provenance; park it alongside.
+                results[rung + "_retry_error"] = failure
+                print(f"[ladder] {rung} retry FAILED (recorded "
+                      f"numbers kept): {failure['error'][-160:]}",
+                      flush=True)
+            else:
+                results[rung] = failure
+                print(f"[ladder] {rung} FAILED: {failure}", flush=True)
         with open(OUT, "w") as f:
             json.dump(results, f, indent=1)
     print(json.dumps(results, indent=1))
