@@ -125,6 +125,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "measured comm-volume winner, 0.70 MB vs "
                              "1.79 MB/iter at the report config), "
                              "gather otherwise.")
+    parser.add_argument("--ladder", type=str, default="default",
+                        choices=["default", "tight"],
+                        help="Degree-ladder tiering for the sell mesh "
+                             "layouts: 'default' (growth 1.5, align 8 "
+                             "— few tiers, tile-friendly) or 'tight' "
+                             "(growth 1.3, align 1 — ~3.4x fewer "
+                             "padded gather slots on block-diagonal "
+                             "levels, ~2x the tiers; the gather cost "
+                             "model favors it, pending a real "
+                             "multi-chip race).")
     parser.add_argument("--memmap", type=str2bool, nargs="?",
                         default=False, const=True,
                         help="Memory-map the decomposition artifact and "
@@ -267,6 +277,9 @@ def main(argv=None) -> int:
             args.fmt = "fold"
         print(f"auto-selected --fmt {args.fmt} for {n_dev} device(s) "
               f"(measured-best; override with --fmt)")
+    if args.ladder != "default" and args.fmt != "sell":
+        print(f"warning: --ladder {args.ladder} applies only to the "
+              f"sell mesh layouts; --fmt {args.fmt} packs its own way")
     if args.routing is None:
         args.routing = ("a2a" if (args.fmt == "sell" and n_dev > 1
                                   and args.mode == "time")
@@ -368,7 +381,8 @@ def main(argv=None) -> int:
                 )
 
                 multi = SellSpaceShared(levels, width, mesh=space_mesh,
-                                        feature_dtype=args.feature_dtype)
+                                        feature_dtype=args.feature_dtype,
+                                        ladder=args.ladder)
             else:
                 multi = SpaceSharedArrow(levels, width, fmt=args.fmt,
                                          mesh=space_mesh)
@@ -395,7 +409,8 @@ def main(argv=None) -> int:
 
                 multi = SellMultiLevel(levels, width, mesh,
                                        routing=args.routing,
-                                       feature_dtype=args.feature_dtype)
+                                       feature_dtype=args.feature_dtype,
+                                       ladder=args.ladder)
             else:
                 multi = MultiLevelArrow(
                     levels, width, mesh=mesh,

@@ -77,6 +77,37 @@ def degree_ladder(max_deg: int, growth: float = 1.5,
     return ladder
 
 
+def resolve_ladder(ladder) -> tuple[float, int]:
+    """(growth, align) for the shared degree ladder.
+
+    "default" = (1.5, SLOT_ALIGN): few tiers, tile-friendly — but on
+    block-diagonal levels whose rows are mostly degree 1-4 the align-8
+    floor pads slots 3.45x nnz (measured, n=2^20 BA-8 over 10 levels).
+    "tight" = (1.3, 1): ~1.02x nnz LOGICAL slots over ~2x the tiers —
+    the gather cost model (gathers iterate logical slots) favors it.
+    Honesty note: tiers with m_t < 8 still physically re-pad to the
+    8-sublane tile in HBM, so STORAGE bytes shrink less than the slot
+    count — the win is compute (gather iterations), not footprint.
+    Kept opt-in until a real multi-chip race confirms, mirroring the
+    fold_tight candidate.  A (growth, align) tuple sets both
+    explicitly.
+    """
+    if ladder in (None, "default"):
+        return (1.5, SLOT_ALIGN)
+    if ladder == "tight":
+        return (1.3, 1)
+    if isinstance(ladder, str) or not hasattr(ladder, "__len__") \
+            or len(ladder) != 2:
+        raise ValueError(
+            f"unknown ladder {ladder!r}: expected 'default', 'tight', "
+            f"or a (growth, align) pair")
+    growth, align = ladder
+    if not float(growth) > 1.0 or int(align) < 1:
+        raise ValueError(f"bad ladder {ladder!r}: need growth > 1 "
+                         f"and align >= 1")
+    return (float(growth), int(align))
+
+
 @struct.dataclass
 class SellShardStack:
     """Per-device-stacked tiered SELL operators (leading device axis).
@@ -94,6 +125,12 @@ class SellShardStack:
     def device_nbytes(self) -> int:
         return sum(leaf.size * leaf.dtype.itemsize
                    for leaf in jax.tree_util.tree_leaves(self))
+
+    @property
+    def n_slots(self) -> int:
+        """Total padded gather slots across devices and tiers — the
+        kernel's cost model (same contract as SellMatrix.n_slots)."""
+        return sum(int(np.prod(c.shape)) for c in self.cols)
 
 
 def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
@@ -629,7 +666,8 @@ def global_max_reach(reach: int) -> int:
 
 def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
                      axis: str, dtype, binary: bool,
-                     shard_len: Optional[int] = None) -> SlimLevelOps:
+                     shard_len: Optional[int] = None,
+                     ladder=None) -> SlimLevelOps:
     """Build one level's per-device SELL operators (see module
     docstring).  Captures the banded slim pattern: body columns may
     fall in the shard, the head arm [0, w), or the two w-wide halo
@@ -656,14 +694,16 @@ def build_slim_level(matrix: CsrLike, width: int, mesh: Mesh,
     body_shares, head_shares = _slim_shares(src, w, hops,
                                             materialize=materialize)
 
+    growth, align = resolve_ladder(ladder)
     ladder_body = degree_ladder(
         max((int(np.diff(s.indptr).max()) if s.nnz else 0)
-            for s in body_shares))
+            for s in body_shares), growth, align)
     # Global head degrees from the shares (their columns partition
     # [0, total)) — no second head-block read on the streamed path.
     head_glob_deg = sum(np.diff(h.indptr) for h in head_shares)
     ladder_head = degree_ladder(
-        int(head_glob_deg.max()) if head_glob_deg.size else 0)
+        int(head_glob_deg.max()) if head_glob_deg.size else 0,
+        growth, align)
 
     body, body_order, rows_out = _pack_shard_tiers(
         body_shares, ladder_body, binary, dtype)
@@ -807,7 +847,7 @@ class SellSlim:
 
     def __init__(self, matrix: CsrLike, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32,
-                 binary="auto", feature_dtype=None):
+                 binary="auto", feature_dtype=None, ladder=None):
         # The source canonicalizes (in-memory CSR up front, memmapped
         # triplets per slice): binary detection must see canonical
         # values — duplicate all-ones entries sum to non-unit weights
@@ -820,7 +860,8 @@ class SellSlim:
         self.mesh = mesh
         self.axis = axis
         self.width = width
-        ops = build_slim_level(src, width, mesh, axis, dtype, is_binary)
+        ops = build_slim_level(src, width, mesh, axis, dtype, is_binary,
+                               ladder=ladder)
         self.ops = ops
         self.body, self.head = ops.body, ops.head
         self.body_order = ops.body_order
@@ -883,7 +924,8 @@ class SellMultiLevel:
     def __init__(self, levels, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32, binary="auto",
                  routing: str = "a2a",
-                 feat_axis: Optional[str] = None, feature_dtype=None):
+                 feat_axis: Optional[str] = None, feature_dtype=None,
+                 ladder=None):
         """``routing``: "a2a" (default) compiles the inter-level
         reorderings into explicit per-device send/recv tables over one
         fixed-shape all_to_all each (parallel/routing.py — tier-padding
@@ -924,7 +966,8 @@ class SellMultiLevel:
             self.binary = all(s.resolve_binary(binary) for s in srcs)
         self.ops: List[SlimLevelOps] = [
             build_slim_level(s, width, mesh, axis, dtype,
-                             self.binary, shard_len=shard_len)
+                             self.binary, shard_len=shard_len,
+                             ladder=ladder)
             for s in srcs
         ]
 

@@ -72,6 +72,7 @@ from arrow_matrix_tpu.parallel.sell_slim import (
     _slim_local_step,
     _slim_shares,
     degree_ladder,
+    resolve_ladder,
     shard_map,
 )
 
@@ -88,7 +89,8 @@ class SellSpaceShared:
     def __init__(self, levels, width: int, mesh: Optional[Mesh] = None,
                  lvl_axis: str = "lvl", axis: str = "blocks",
                  dtype=np.float32, binary="auto",
-                 feat_axis: Optional[str] = None, feature_dtype=None):
+                 feat_axis: Optional[str] = None, feature_dtype=None,
+                 ladder=None):
         """``feat_axis`` additionally shards the feature rows (the
         k-dimension tiling axis, reference GPU feature blocking) — with
         ``lvl`` and ``blocks`` that makes a 3-axis sharding: levels x
@@ -166,15 +168,17 @@ class SellSpaceShared:
         flat_mat = (None if local_pairs is None
                     else {g * n_dev + d for g, d in local_pairs})
 
+        growth, align = resolve_ladder(ladder)
         ladder_body = degree_ladder(max(
             (int(np.diff(s.indptr).max()) if s.nnz else 0)
-            for s in body_flat))
+            for s in body_flat), growth, align)
         # Per-level global head degrees from the shares (columns
         # partition [0, total)) — no second head-block read.
         head_degs = [sum(np.diff(h.indptr) for h in heads)
                      for _, heads in shares]
         ladder_head = degree_ladder(max(
-            (int(d.max()) if d.size else 0) for d in head_degs))
+            (int(d.max()) if d.size else 0) for d in head_degs),
+            growth, align)
 
         # ONE packing call over the flattened (level, device) share
         # list unifies tier shapes across everything; each level group

@@ -363,6 +363,65 @@ def test_per_host_build_equivalence():
         np.testing.assert_array_equal(df[[0, 2]], dp[[0, 2]])
 
 
+def test_tight_ladder_matches_default_with_fewer_slots():
+    """ladder='tight' (growth 1.3, align 1): same results to f32
+    reassociation, strictly fewer padded gather slots (the align-8
+    floor pads block-diagonal levels ~3.4x nnz — slots ARE the gather
+    cost, PERFORMANCE.md)."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+
+    n, width = 512, 32
+    a = barabasi_albert(n, 4, seed=23)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=3)
+    mesh = make_mesh((8,), ("blocks",))
+    x = random_dense(n, 8, seed=5)
+
+    base = SellMultiLevel(levels, width, mesh)
+    tight = SellMultiLevel(levels, width, mesh, ladder="tight")
+    slots = lambda sm: sum(o.body.n_slots + o.head.n_slots
+                           for o in sm.ops)
+    assert slots(tight) < slots(base)
+    got_t = tight.gather_result(tight.step(tight.set_features(x)))
+    np.testing.assert_allclose(got_t, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-4)
+    got_b = base.gather_result(base.step(base.set_features(x)))
+    np.testing.assert_allclose(got_t, got_b, rtol=1e-5, atol=1e-5)
+
+
+def test_tight_ladder_space_shared_matches():
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared
+
+    n, width = 384, 32
+    a = barabasi_albert(n, 3, seed=29)
+    levels = arrow_decomposition(a, width, max_levels=2,
+                                 block_diagonal=True, seed=4)
+    assert len(levels) == 2
+    mesh = make_mesh((2, 4), ("lvl", "blocks"))
+    x = random_dense(n, 4, seed=6)
+    sp = SellSpaceShared(levels, width, mesh=mesh, ladder="tight")
+    got = sp.gather_result(sp.step(sp.set_features(x)))
+    np.testing.assert_allclose(got, decomposition_spmm(levels, x),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resolve_ladder_validation():
+    from arrow_matrix_tpu.parallel.sell_slim import resolve_ladder
+
+    assert resolve_ladder(None) == resolve_ladder("default")
+    assert resolve_ladder("tight") == (1.3, 1)
+    assert resolve_ladder((1.2, 2)) == (1.2, 2)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        resolve_ladder((0.9, 2))
+    with _pytest.raises(ValueError):
+        resolve_ladder((1.2, 0))
+
+
 def test_sliced_halo_exchange_fewer_bytes():
     """The farthest halo hop carries only `reach` rows: versus a
     whole-shard step (rem=0 compatibility mode) the collective-permute
