@@ -33,7 +33,8 @@ def _ladder_module():
 
 def test_ladder_registry_importable():
     assert set(_ladder_module().RUNGS) == {
-        "decompose24", "ingest24", "decompose26_grid", "backend_race22"}
+        "decompose24", "ingest24", "decompose26_grid",
+        "backend_race22", "backend_race23"}
 
 
 def test_recorded_ladder_results_pass_their_gates():

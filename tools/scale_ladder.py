@@ -193,12 +193,12 @@ def rung_decompose26_grid() -> dict:
             "peak_rss_gb": round(_rss_gb(), 2)}
 
 
-def rung_backend_race22() -> dict:
+def _backend_race(n: int) -> dict:
     from arrow_matrix_tpu.decomposition.decompose import arrow_decomposition
     from arrow_matrix_tpu.utils.graphs import barabasi_albert
 
-    a = barabasi_albert(N22, 8, seed=7)
-    out = {"n": N22, "nnz": int(a.nnz)}
+    a = barabasi_albert(n, 8, seed=7)
+    out = {"n": n, "nnz": int(a.nnz)}
     for backend in ("native", "numpy"):
         t0 = time.perf_counter()
         levels = arrow_decomposition(a, arrow_width=WIDTH, max_levels=14,
@@ -210,9 +210,18 @@ def rung_backend_race22() -> dict:
     return out
 
 
+def rung_backend_race22() -> dict:
+    return _backend_race(N22)
+
+
+def rung_backend_race23() -> dict:
+    return _backend_race(1 << 23)
+
+
 RUNGS = {"decompose24": rung_decompose24, "ingest24": rung_ingest24,
          "decompose26_grid": rung_decompose26_grid,
-         "backend_race22": rung_backend_race22}
+         "backend_race22": rung_backend_race22,
+         "backend_race23": rung_backend_race23}
 
 
 def main() -> None:
