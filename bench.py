@@ -85,14 +85,33 @@ def probe_backend_laddered(schedule=(60.0, 120.0, 300.0)
     — a single 300s rung would have caught it).  Returns on the first
     rung that finds an accelerator; the ladder only costs time when
     the backend is genuinely dead."""
+    from arrow_matrix_tpu.utils.platform import (
+        classify_probe_error,
+        reset_tunnel_state,
+    )
+
     platform = device_kind = "cpu"
     err: str | None = None
-    for timeout_s in schedule:
+    for i, timeout_s in enumerate(schedule):
         platform, device_kind, err = probe_backend(
             timeout_s=timeout_s, retries=1)
         if platform != "cpu":
             return platform, device_kind, None
         _progress(f"probe rung {timeout_s:.0f}s failed: {err}")
+        # Recovery between rungs (round-3 postmortem: the system had
+        # avoidance but no recovery once wedged): an init-hang with a
+        # stale local plugin holder means a half-dead client's claim
+        # may be blocking ours server-side — clear it, then give the
+        # next rung a fresh chance.  A "no-device" failure skips the
+        # remaining rungs entirely (retrying cannot help).
+        cls = classify_probe_error(err)
+        if cls == "no-device":
+            break
+        if cls == "init-hang" and i < len(schedule) - 1:
+            cleared = reset_tunnel_state(log=_progress)
+            if cleared:
+                _progress(f"cleared stale plugin holders {cleared}; "
+                          f"re-probing")
     return platform, device_kind, err
 
 
@@ -192,10 +211,15 @@ def _progress(msg: str) -> None:
 _T0 = time.perf_counter()
 
 
-def _bench_config(platform: str) -> dict:
+def _bench_config(platform: str, fmt_override: str | None = None) -> dict:
     """One derivation of the benchmark shape from the probed platform,
     shared by the parent (baseline, roofline) and the candidate
-    subprocesses (build + measure) via AMT_BENCH_CFG."""
+    subprocesses (build + measure) via AMT_BENCH_CFG.
+
+    ``fmt_override`` beats the environment (the mid-window upgrade
+    passes its candidate list here instead of mutating os.environ,
+    which would leak into later _bench_config calls in the same run —
+    ADVICE r3)."""
     degraded, small = _degraded_small(platform)
     if small:
         # Quick diagnostic scale: large enough that the folded SELL
@@ -213,7 +237,8 @@ def _bench_config(platform: str) -> dict:
         # Protocol scale (BASELINE.md: >=1M rows, features 16, 10 iters).
         cfg = dict(n=1 << 20, m=8, width=2048, k=16, iters=10, fmt="auto")
     cfg["n"] = int(os.environ.get("AMT_BENCH_N", cfg["n"]))
-    cfg["fmt"] = os.environ.get("AMT_BENCH_FMT", cfg["fmt"])
+    cfg["fmt"] = fmt_override or os.environ.get("AMT_BENCH_FMT",
+                                                cfg["fmt"])
     # max_levels high enough to converge: a capped decomposition leaves
     # a grown last level holding half the nonzeros at near-full-matrix
     # width (measured 657k-wide at n=1M with the old cap of 4), which
@@ -486,12 +511,13 @@ def race_candidates(result: dict, cfg: dict, finalize,
     return runs
 
 
-def run_bench(result: dict, platform: str, device_kind: str) -> None:
+def run_bench(result: dict, platform: str, device_kind: str,
+              fmt_override: str | None = None) -> None:
     from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
     from arrow_matrix_tpu.utils import numerics
     from arrow_matrix_tpu.utils.graphs import random_dense
 
-    cfg = _bench_config(platform)
+    cfg = _bench_config(platform, fmt_override)
     n, k, iters = cfg["n"], cfg["k"], cfg["iters"]
     result["config"] = {"n": n, "width": cfg["width"], "features": k,
                         "iterations": iters, "ba_neighbors": cfg["m"]}
@@ -765,6 +791,61 @@ def kernel_compare(timeout_s: float = 300.0,
     return out
 
 
+def _last_onchip_evidence() -> dict | None:
+    """Compact summary of the newest committed on-chip artifact
+    (bench_results/onchip_*.json, written by mid-round healthy-tunnel
+    runs), embedded in the bench JSON line as ``last_onchip``.
+
+    VERDICT r3 item 1: when the driver-time capture degrades to CPU
+    because the tunnel wedged, the round artifact should still carry
+    the evidence trail of the most recent real-chip measurement —
+    clearly labeled as a prior capture, never substituted for the
+    live ``value``."""
+    import glob
+
+    paths = (glob.glob(os.path.join("bench_results", "onchip_*.json"))
+             + glob.glob(os.path.join("bench_cache", "onchip_*.json")))
+    by_mtime = []
+    for p in paths:
+        try:
+            by_mtime.append((os.path.getmtime(p), p))
+        except OSError:
+            continue
+    # Newest artifact whose metric matches the headline — the watcher
+    # also drops ladder/planar artifacts into the same namespace, and
+    # a ladder-race ms must not masquerade as the SpMM evidence trail.
+    newest = data = None
+    newest_mtime = -1.0
+    for mt, p in sorted(by_mtime, reverse=True):
+        try:
+            with open(p) as f:
+                d = json.loads(f.read().strip().splitlines()[-1])
+        except (OSError, json.JSONDecodeError, IndexError):
+            continue
+        if d.get("metric") == "spmm_iter_ms" and d.get("value"):
+            newest, newest_mtime, data = p, mt, d
+            break
+    if newest is None:
+        return None
+    keep = ("metric", "value", "unit", "vs_baseline", "platform",
+            "device_kind", "fmt_used", "k128_ms", "k128_bf16_ms",
+            "frobenius_err_vs_cpu", "frobenius_gate", "achieved_gbps",
+            "roofline_frac", "gather_rows_per_s", "config", "degraded")
+    summary = {k: data[k] for k in keep if k in data}
+    if "config" in summary and isinstance(summary["config"], dict):
+        summary["config"] = {k: summary["config"][k]
+                             for k in ("n", "width", "features",
+                                       "iterations", "levels")
+                             if k in summary["config"]}
+    return {
+        "note": ("most recent committed on-chip capture (prior run, "
+                 "NOT this invocation's measurement)"),
+        "path": newest,
+        "captured_unix": int(newest_mtime),
+        "summary": summary,
+    }
+
+
 def main() -> None:
     if len(sys.argv) == 3 and sys.argv[1] == "--variant":
         run_one_variant(sys.argv[2])
@@ -807,7 +888,13 @@ def main() -> None:
         else:
             platform, device_kind, probe_err = probe_backend_laddered()
         if probe_err:
+            from arrow_matrix_tpu.utils.platform import (
+                classify_probe_error,
+            )
+
             result["backend_probe_error"] = probe_err
+            result["backend_probe_class"] = classify_probe_error(
+                probe_err)
         # The headline race runs FIRST — a tunneled accelerator is
         # healthiest early, and a later wedge must not cost the
         # round's number.  The kernel comparison follows as
@@ -838,13 +925,17 @@ def main() -> None:
                            for k in ("value", "vs_baseline",
                                      "scipy_cpu_ms", "fmt_used",
                                      "frobenius_err_vs_cpu")}
-                os.environ.setdefault("AMT_BENCH_FMT",
-                                      "fold,fold_tight")
                 upgraded = {"metric": "spmm_iter_ms", "value": None,
                             "unit": "ms", "vs_baseline": None,
                             "degraded_cpu_run": cpu_run}
                 try:
-                    run_bench(upgraded, platform2, kind2)
+                    # Candidate list threaded through the cfg, NOT the
+                    # environment (ADVICE r3: a setdefault here leaked
+                    # into every later _bench_config in this run).  An
+                    # explicit AMT_BENCH_FMT from the caller still wins.
+                    run_bench(upgraded, platform2, kind2,
+                              fmt_override=os.environ.get(
+                                  "AMT_BENCH_FMT", "fold,fold_tight"))
                 except Exception as e:
                     upgraded.setdefault(
                         "error", f"{type(e).__name__}: {e}")
@@ -879,6 +970,15 @@ def main() -> None:
         result.setdefault("error", f"{type(e).__name__}: {e}")
     if deadline > 0 and hasattr(signal, "SIGALRM"):
         signal.alarm(0)   # the final print must not be interruptible
+    # Evidence trail: always embed the newest committed on-chip
+    # artifact (labeled as a PRIOR capture) — a degraded CPU round
+    # still points the reader at the real-chip numbers.
+    try:
+        evidence = _last_onchip_evidence()
+        if evidence is not None:
+            result["last_onchip"] = evidence
+    except Exception:
+        pass   # evidence is auxiliary; never block the JSON line
     print(json.dumps(result), flush=True)
     if result.get("value") is None:
         raise SystemExit(1)
