@@ -174,13 +174,20 @@ def main(argv=None) -> int:
             # Per-slice golden: each process validates the rows of the
             # slices it loaded (the global matrix never exists here).
             err_n = err_d = 0.0
+            ok = True
             for d, slab in owned_slabs.items():
                 lo, hi = dist.slices[d]
                 want_d = np.asarray(slab @ x_host)
                 err_n += float(np.linalg.norm(got[lo:hi] - want_d) ** 2)
                 err_d += float(np.linalg.norm(want_d) ** 2)
+                # Elementwise gate per owned slab: the reassembled path
+                # checks np.allclose, and a single bad row can hide
+                # inside a small Frobenius ratio — both --validate
+                # paths must enforce the same strictness (ADVICE r3).
+                ok &= bool(np.allclose(got[lo:hi], want_d,
+                                       rtol=1e-4, atol=1e-4))
             err = (err_n / max(err_d, 1e-30)) ** 0.5
-            ok = bool(err < 1e-4)
+            ok = ok and bool(err < 1e-4)
             scope = (f"rows of slices {sorted(owned_slabs)}"
                      if jax.process_count() > 1 else "all rows")
             print(f"validation ({scope}): allclose={ok} "

@@ -23,6 +23,9 @@ code.  The online runtime consumes its output via
 
 from __future__ import annotations
 
+import os
+import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 
 import numpy as np
@@ -30,6 +33,24 @@ from scipy import sparse
 
 from arrow_matrix_tpu.decomposition.linearize import bfs_order, random_forest_order
 from arrow_matrix_tpu.utils.graphs import symmetrize
+
+
+@contextmanager
+def _phase(label: str):
+    """Phase timer for the offline pipeline (AMT_DECOMP_PROFILE=1):
+    prints per-phase wall seconds to stderr so the scale-ladder rungs
+    can attribute decompose time between the native kernels and the
+    scipy host work (the optimization targeting data for the
+    reference's Julia-layer role)."""
+    if not os.environ.get("AMT_DECOMP_PROFILE"):
+        yield
+        return
+    import sys
+
+    t0 = time.perf_counter()
+    yield
+    print(f"[decomp] {label}: {time.perf_counter() - t0:.2f}s",
+          file=sys.stderr, flush=True)
 
 
 @dataclass
@@ -100,10 +121,29 @@ def _linear_order(a: sparse.csr_matrix, width: int, deterministic: bool,
     """Level ordering: width highest-degree vertices first, then the
     forest-linearized middle, then zero-degree singletons."""
     n = a.shape[0]
-    sym = symmetrize(a)
-    deg = np.diff(sym.indptr)
+    bfs_fn, forest_fn = _resolve_backend(backend)
+    from arrow_matrix_tpu.decomposition import native as _native
 
-    by_degree = np.argsort(-deg, kind="stable")
+    # All-native fast path: structure-only symmetrize (the largest
+    # single host phase of the v1 profile — scipy's A + A.T carries
+    # values the pipeline never reads) feeding the masked forest
+    # kernel, no scipy matrix ever built.  Same sorted/deduped
+    # structure as symmetrize(), so the resulting decomposition is
+    # bit-identical (the v1-vs-v2 parity test pins this).
+    native_path = (not deterministic
+                   and forest_fn is _native.random_forest_order
+                   and n < np.iinfo(np.int32).max)
+    if native_path:
+        with _phase("symmetrize"):
+            sym = _native.symmetrize_structure(a)   # (indptr, indices)
+        deg = np.diff(sym[0])
+    else:
+        with _phase("symmetrize"):
+            sym = symmetrize(a)
+        deg = np.diff(sym.indptr)
+
+    with _phase("degree-argsort"):
+        by_degree = np.argsort(-deg, kind="stable")
     head = by_degree[:width]
     tail = by_degree[width:]
     tail_deg = deg[tail]
@@ -111,18 +151,14 @@ def _linear_order(a: sparse.csr_matrix, width: int, deterministic: bool,
     singletons = tail[tail_deg == 0]
 
     if middle.size:
-        bfs_fn, forest_fn = _resolve_backend(backend)
-        from arrow_matrix_tpu.decomposition import native as _native
-
-        if not deterministic and forest_fn is _native.random_forest_order:
-            # Native fast path: the induced submatrix never
-            # materializes — one label-and-filter pass inside the C++
-            # replaces scipy's fancy-indexed sym[middle][:, middle]
-            # (saves a full per-level edge copy; ~5% end-to-end at
-            # n=2^22 — the forest pass itself dominates, PERFORMANCE.md
-            # decomposer profile).
-            sub_order = _native.random_forest_order_masked(
-                sym, middle, rng, base_size=min(width - 1, 16))
+        if native_path:
+            # The induced submatrix never materializes — one
+            # label-and-filter pass inside the C++ replaces scipy's
+            # fancy-indexed sym[middle][:, middle] (saves a full
+            # per-level edge copy; PERFORMANCE.md decomposer profile).
+            with _phase("forest-native"):
+                sub_order = _native.random_forest_order_masked(
+                    sym, middle, rng, base_size=min(width - 1, 16))
         else:
             sub = sym[middle][:, middle]
             if deterministic:
@@ -260,30 +296,66 @@ def _decompose(a: sparse.csr_matrix, width: int, levels: list[ArrowLevel],
     n = a.shape[0]
     last = len(levels) + 1 >= max_levels
 
-    order = _linear_order(a, width, deterministic=last, rng=rng,
-                          backend=backend)
-    inv = np.argsort(order)
-
-    coo = a.tocoo()
-    r = inv[coo.row]  # positions in the new order
-    c = inv[coo.col]
+    with _phase("linear-order-total"):
+        order = _linear_order(a, width, deterministic=last, rng=rng,
+                              backend=backend)
+    with _phase("inv-argsort"):
+        inv = np.argsort(order)
+        if n < np.iinfo(np.int32).max:
+            # int32 positions halve the permute/select traffic and save
+            # scipy the internal downcast copy its int32-index CSR
+            # builders would otherwise make.
+            inv = inv.astype(np.int32)
 
     if not last:
-        if block_diagonal:
-            in_level = (r // width) == (c // width)
-        else:
-            in_level = np.abs(r - c) <= width
-        if prune:
-            in_level |= (r < width) | (c < width)
+        # Fused native split: one C++ pass replaces the whole
+        # tocoo/gather/select/two-CSR-build chain below (~10 s of the
+        # 37 s v1 profile at n=2^21).  Bit-identical on duplicate-free
+        # inputs (canonical CSR is unique; with duplicate input
+        # entries only the f32 summation order can differ, inside the
+        # numerics tolerance).  achieved_width is statically `width`
+        # here: every non-head in-level edge satisfies |r-c| <= width
+        # by the band/block criterion.
+        from arrow_matrix_tpu.decomposition import native as _native
 
-        if not np.any(in_level):
-            in_level = np.ones(r.size, dtype=bool)
+        if (backend in ("auto", "native") and _native.available()
+                and n < np.iinfo(np.int32).max):
+            try:
+                with _phase("native-level-split"):
+                    b, rest_m = _native.level_split(
+                        a, inv, width, block_diagonal, prune)
+                levels.append(ArrowLevel(b, order, width))
+                if rest_m is not None:
+                    _decompose(rest_m, width, levels, max_levels,
+                               block_diagonal, prune, rng, backend)
+                return
+            except _native.LevelSplitUnsupported:
+                pass   # numpy path below handles the degenerate cases
 
-        rest = ~in_level
-        b = sparse.csr_matrix((coo.data[in_level], (r[in_level], c[in_level])),
-                              shape=(n, n))
-        b.sum_duplicates()
-        b.sort_indices()
+    with _phase("coo-permute"):
+        coo = a.tocoo()
+        r = inv[coo.row]  # positions in the new order
+        c = inv[coo.col]
+
+    if not last:
+        with _phase("edge-select"):
+            if block_diagonal:
+                in_level = (r // width) == (c // width)
+            else:
+                in_level = np.abs(r - c) <= width
+            if prune:
+                in_level |= (r < width) | (c < width)
+
+            if not np.any(in_level):
+                in_level = np.ones(r.size, dtype=bool)
+
+            rest = ~in_level
+        with _phase("level-csr-build"):
+            b = sparse.csr_matrix(
+                (coo.data[in_level], (r[in_level], c[in_level])),
+                shape=(n, n))
+            b.sum_duplicates()
+            b.sort_indices()
         # The all-False fallback above keeps every edge, so the level's
         # width bound is whatever those edges achieve, not the request.
         levels.append(ArrowLevel(b, order,
@@ -292,15 +364,18 @@ def _decompose(a: sparse.csr_matrix, width: int, levels: list[ArrowLevel],
 
         if np.any(rest):
             # Remainder keeps original indexing; recursion re-linearizes.
-            a_rest = sparse.csr_matrix(
-                (coo.data[rest], (coo.row[rest], coo.col[rest])), shape=(n, n))
+            with _phase("rest-csr-build"):
+                a_rest = sparse.csr_matrix(
+                    (coo.data[rest], (coo.row[rest], coo.col[rest])),
+                    shape=(n, n))
             _decompose(a_rest, width, levels, max_levels, block_diagonal,
                        prune, rng, backend)
     else:
         # Last level: keep everything, report the width actually achieved.
-        b = sparse.csr_matrix((coo.data, (r, c)), shape=(n, n))
-        b.sum_duplicates()
-        b.sort_indices()
+        with _phase("level-csr-build"):
+            b = sparse.csr_matrix((coo.data, (r, c)), shape=(n, n))
+            b.sum_duplicates()
+            b.sort_indices()
         levels.append(ArrowLevel(b, order, achieved_width(r, c, width)))
 
 

@@ -48,7 +48,8 @@ def _build() -> None:
     fd, tmp = tempfile.mkstemp(suffix=".so.tmp",
                                dir=os.path.dirname(_LIB_PATH))
     os.close(fd)
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
@@ -80,17 +81,35 @@ def _load() -> ctypes.CDLL | None:
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
             i64p = ctypes.POINTER(ctypes.c_int64)
-            lib.amt_random_forest_order.argtypes = [
-                ctypes.c_int64, i64p, i64p, ctypes.c_uint64,
-                ctypes.c_int64, i64p]
-            lib.amt_random_forest_order.restype = ctypes.c_int
-            lib.amt_random_forest_order_masked.argtypes = [
-                ctypes.c_int64, i64p, i64p, ctypes.c_uint64,
-                ctypes.c_int64, ctypes.c_int64, i64p, i64p]
-            lib.amt_random_forest_order_masked.restype = ctypes.c_int
-            lib.amt_bfs_order.argtypes = [
-                ctypes.c_int64, i64p, i64p, ctypes.c_int64, i64p]
-            lib.amt_bfs_order.restype = ctypes.c_int
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            for suffix, idxp in (("", i64p), ("_i32", i32p)):
+                f = getattr(lib, "amt_random_forest_order" + suffix)
+                f.argtypes = [ctypes.c_int64, i64p, idxp,
+                              ctypes.c_uint64, ctypes.c_int64, i64p]
+                f.restype = ctypes.c_int
+                f = getattr(lib,
+                            "amt_random_forest_order_masked" + suffix)
+                f.argtypes = [ctypes.c_int64, i64p, idxp,
+                              ctypes.c_uint64, ctypes.c_int64,
+                              ctypes.c_int64, i64p, i64p]
+                f.restype = ctypes.c_int
+                f = getattr(lib, "amt_bfs_order" + suffix)
+                f.argtypes = [ctypes.c_int64, i64p, idxp,
+                              ctypes.c_int64, i64p]
+                f.restype = ctypes.c_int
+                f = getattr(lib, "amt_symmetrize_structure" + suffix)
+                f.argtypes = [ctypes.c_int64, i64p, idxp, i64p, i32p]
+                f.restype = ctypes.c_int64
+            f32p = ctypes.POINTER(ctypes.c_float)
+            f64p = ctypes.POINTER(ctypes.c_double)
+            for isuf, idxp in (("i32", i32p), ("i64", i64p)):
+                for vsuf, valp in (("f32", f32p), ("f64", f64p)):
+                    f = getattr(lib, f"amt_level_split_{isuf}_{vsuf}")
+                    f.argtypes = [ctypes.c_int64, i64p, idxp, valp,
+                                  i32p, ctypes.c_int64, ctypes.c_int,
+                                  ctypes.c_int, i64p, i32p, valp, i64p,
+                                  i32p, valp, i64p]
+                    f.restype = ctypes.c_int
             _lib = lib
         except Exception as e:  # compiler missing, load failure, ...
             _load_error = e
@@ -108,13 +127,32 @@ def load_error() -> Exception | None:
     return _load_error
 
 
-def _csr_int64(adj: sparse.csr_matrix) -> tuple[np.ndarray, np.ndarray]:
-    indptr = np.ascontiguousarray(adj.indptr, dtype=np.int64)
-    indices = np.ascontiguousarray(adj.indices, dtype=np.int64)
+def _csr_native(adj_or_pair) -> tuple[np.ndarray, np.ndarray]:
+    """(indptr int64, indices int32-or-int64) for the native calls.
+
+    int32 indices (scipy's dtype below 2^31 nnz) pass through WITHOUT
+    the int64 conversion copy v1 forced — the ``_i32`` kernel entry
+    points read them directly (half the index traffic)."""
+    if isinstance(adj_or_pair, tuple):
+        indptr, indices = adj_or_pair
+    else:
+        indptr, indices = adj_or_pair.indptr, adj_or_pair.indices
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    if indices.dtype == np.int32:
+        indices = np.ascontiguousarray(indices)
+    else:
+        indices = np.ascontiguousarray(indices, dtype=np.int64)
     return indptr, indices
 
 
+def _idx_fn(lib, name: str, indices: np.ndarray):
+    return getattr(lib,
+                   name + ("_i32" if indices.dtype == np.int32 else ""))
+
+
 def _ptr(a: np.ndarray):
+    if a.dtype == np.int32:
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
@@ -130,18 +168,17 @@ def random_forest_order(adj_sym: sparse.csr_matrix,
     out = np.empty(n, dtype=np.int64)
     if n == 0:
         return out
-    indptr, indices = _csr_int64(adj_sym)
+    indptr, indices = _csr_native(adj_sym)
     seed = int(rng.integers(0, 2**63 - 1))
-    rc = lib.amt_random_forest_order(n, _ptr(indptr), _ptr(indices),
-                                     seed, int(base_size), _ptr(out))
+    rc = _idx_fn(lib, "amt_random_forest_order", indices)(
+        n, _ptr(indptr), _ptr(indices), seed, int(base_size), _ptr(out))
     if rc != 0:
         raise RuntimeError("native random_forest_order failed "
-                           "(emitted order is not a permutation)")
+                           f"(rc={rc})")
     return out
 
 
-def random_forest_order_masked(adj_sym: sparse.csr_matrix,
-                               active: np.ndarray,
+def random_forest_order_masked(adj_sym, active: np.ndarray,
                                rng: np.random.Generator,
                                base_size: int = 16) -> np.ndarray:
     """Forest order of the induced submatrix ``adj_sym[active][:,
@@ -150,19 +187,23 @@ def random_forest_order_masked(adj_sym: sparse.csr_matrix,
     into ``active``), one O(n + m) native pass instead of scipy's
     fancy-indexed row+column extraction — saves a full per-level edge
     copy (measured ~5% end-to-end at n=2^22; the forest pass itself
-    dominates)."""
+    dominates).
+
+    ``adj_sym`` may be a csr_matrix or a raw ``(indptr, indices)``
+    pair (the output of :func:`symmetrize_structure` — no scipy
+    wrapper needed on the all-native path)."""
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native decomposer unavailable: {_load_error}")
-    n = adj_sym.shape[0]
+    indptr, indices = _csr_native(adj_sym)
+    n = int(indptr.size - 1)
     k = int(active.size)
     out = np.empty(k, dtype=np.int64)
     if k == 0:
         return out
-    indptr, indices = _csr_int64(adj_sym)
     act = np.ascontiguousarray(active, dtype=np.int64)
     seed = int(rng.integers(0, 2**63 - 1))
-    rc = lib.amt_random_forest_order_masked(
+    rc = _idx_fn(lib, "amt_random_forest_order_masked", indices)(
         n, _ptr(indptr), _ptr(indices), seed, int(base_size), k,
         _ptr(act), _ptr(out))
     if rc != 0:
@@ -170,6 +211,107 @@ def random_forest_order_masked(adj_sym: sparse.csr_matrix,
             "native random_forest_order_masked failed "
             f"(rc={rc}: invalid subset or non-permutation output)")
     return out
+
+
+def symmetrize_structure(a: sparse.csr_matrix
+                         ) -> tuple[np.ndarray, np.ndarray]:
+    """Sorted deduped CSR STRUCTURE of ``A + A^T`` as a raw
+    ``(indptr int64, indices int32)`` pair.
+
+    The linear-order pipeline only ever consumes the symmetric
+    *pattern* (degrees + edges); scipy's value-carrying ``A + A.T``
+    was the largest single host phase of the v1 decompose profile
+    (7.4 s of 37 s at n=2^21).  Rows of ``a`` need not be canonical
+    (the kernel sorts/dedups per row).  Requires n < 2^31."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decomposer unavailable: {_load_error}")
+    n = a.shape[0]
+    if n >= np.iinfo(np.int32).max:
+        raise ValueError(f"native symmetrize requires n < 2^31, got {n}")
+    indptr, indices = _csr_native(a)
+    out_indptr = np.empty(n + 1, dtype=np.int64)
+    out_indices = np.empty(max(2 * int(indptr[-1]), 1), dtype=np.int32)
+    sym_nnz = _idx_fn(lib, "amt_symmetrize_structure", indices)(
+        n, _ptr(indptr), _ptr(indices), _ptr(out_indptr),
+        _ptr(out_indices))
+    if sym_nnz < 0:
+        raise RuntimeError(f"native symmetrize failed (rc={sym_nnz})")
+    return out_indptr, out_indices[:sym_nnz]
+
+
+class LevelSplitUnsupported(Exception):
+    """The fused native split cannot handle this input (dtype,
+    n >= 2^31, or the degenerate all-False selection) — the caller
+    falls back to the numpy path."""
+
+
+def level_split(a: sparse.csr_matrix, inv: np.ndarray, width: int,
+                block_diagonal: bool, prune: bool
+                ) -> tuple[sparse.csr_matrix, sparse.csr_matrix | None]:
+    """Fused per-level edge routing: one native pass replaces the
+    numpy chain (tocoo -> inv-gather -> boolean select -> two scipy
+    COO->CSR builds), ~10 s of the 37 s v1 profile at n=2^21.
+
+    Returns ``(level, rest)``: ``level`` is canonical CSR in permuted
+    coordinates; ``rest`` is CSR in ORIGINAL coordinates (non-canonical,
+    like the numpy path's coo build) or None when every edge fit the
+    level.  Raises LevelSplitUnsupported for inputs the kernel does not
+    cover (caller falls back)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decomposer unavailable: {_load_error}")
+    n = a.shape[0]
+    if n >= np.iinfo(np.int32).max:
+        raise LevelSplitUnsupported(f"n={n} >= 2^31")
+    if a.data.dtype == np.float32:
+        vsuf, vdt = "f32", np.float32
+    elif a.data.dtype == np.float64:
+        vsuf, vdt = "f64", np.float64
+    else:
+        raise LevelSplitUnsupported(f"dtype {a.data.dtype}")
+    indptr, indices = _csr_native(a)
+    isuf = "i32" if indices.dtype == np.int32 else "i64"
+    data = np.ascontiguousarray(a.data, dtype=vdt)
+    inv32 = np.ascontiguousarray(inv, dtype=np.int32)
+    nnz = int(indptr[-1])
+    lvl_indptr = np.empty(n + 1, dtype=np.int64)
+    lvl_indices = np.empty(max(nnz, 1), dtype=np.int32)
+    lvl_data = np.empty(max(nnz, 1), dtype=vdt)
+    rest_indptr = np.empty(n + 1, dtype=np.int64)
+    rest_indices = np.empty(max(nnz, 1), dtype=np.int32)
+    rest_data = np.empty(max(nnz, 1), dtype=vdt)
+    counts = np.zeros(2, dtype=np.int64)
+    valp = (ctypes.POINTER(ctypes.c_float) if vsuf == "f32"
+            else ctypes.POINTER(ctypes.c_double))
+    fn = getattr(lib, f"amt_level_split_{isuf}_{vsuf}")
+    rc = fn(n, _ptr(indptr), _ptr(indices),
+            data.ctypes.data_as(valp), _ptr(inv32), int(width),
+            int(bool(block_diagonal)), int(bool(prune)),
+            _ptr(lvl_indptr), _ptr(lvl_indices),
+            lvl_data.ctypes.data_as(valp), _ptr(rest_indptr),
+            _ptr(rest_indices), rest_data.ctypes.data_as(valp),
+            _ptr(counts))
+    if rc == 4:
+        raise LevelSplitUnsupported("all-False selection fallback")
+    if rc != 0:
+        raise RuntimeError(f"native level_split failed (rc={rc})")
+    ln, rn = int(counts[0]), int(counts[1])
+    # .copy() the trims: a slice would pin the full-nnz capacity
+    # buffers alive through the whole recursion.
+    lvl = sparse.csr_matrix(
+        (lvl_data[:ln].copy(), lvl_indices[:ln].copy(), lvl_indptr),
+        shape=(n, n))
+    # The kernel emits canonical rows (sorted, deduped); tell scipy so
+    # the decomposer's sum_duplicates/sort_indices are no-ops.
+    lvl.has_canonical_format = True
+    lvl.has_sorted_indices = True
+    if rn == 0:
+        return lvl, None
+    rest = sparse.csr_matrix(
+        (rest_data[:rn].copy(), rest_indices[:rn].copy(), rest_indptr),
+        shape=(n, n))
+    return lvl, rest
 
 
 def bfs_order(adj_sym: sparse.csr_matrix, base_size: int = 2) -> np.ndarray:
@@ -181,10 +323,10 @@ def bfs_order(adj_sym: sparse.csr_matrix, base_size: int = 2) -> np.ndarray:
     out = np.empty(n, dtype=np.int64)
     if n == 0:
         return out
-    indptr, indices = _csr_int64(adj_sym)
-    rc = lib.amt_bfs_order(n, _ptr(indptr), _ptr(indices), int(base_size),
-                           _ptr(out))
+    indptr, indices = _csr_native(adj_sym)
+    rc = _idx_fn(lib, "amt_bfs_order", indices)(
+        n, _ptr(indptr), _ptr(indices), int(base_size), _ptr(out))
     if rc != 0:
         raise RuntimeError("native bfs_order failed "
-                           "(emitted order is not a permutation)")
+                           f"(rc={rc})")
     return out

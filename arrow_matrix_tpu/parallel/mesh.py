@@ -130,9 +130,19 @@ def build_global_parts(global_shape, sharding: NamedSharding, builder,
     """
     gshape = tuple(global_shape)
     dtypes = [np.dtype(d) for d in dtypes]
+    addr = sharding.addressable_devices_indices_map(gshape)
+    if not addr:
+        # A process can legitimately address no shard of a sub-mesh /
+        # replicated sharding; make_array_from_single_device_arrays
+        # would crash on the empty buffer list with an opaque error
+        # (ADVICE r3).  build_global handles the case via the dtype
+        # kwarg — build each part through it (the builder is never
+        # called here, so the one-build-per-shard economy is moot).
+        return [build_global(gshape, sharding,
+                             lambda idx, p=p: builder(idx)[p], dt)
+                for p, dt in enumerate(dtypes)]
     part_bufs: list = [[] for _ in dtypes]
-    for dev, idx in sharding.addressable_devices_indices_map(
-            gshape).items():
+    for dev, idx in addr.items():
         blocks = builder(idx)
         if len(blocks) != len(dtypes):
             raise ValueError(f"builder returned {len(blocks)} parts, "

@@ -24,6 +24,7 @@ O(total rows) — the O(moved rows) ideal up to pair-count skew.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -88,6 +89,27 @@ def build_route(table: np.ndarray, n_dev: int,
     if total % n_dev != 0 or src_total % n_dev != 0:
         raise ValueError(f"{total}/{src_total} rows not divisible by "
                          f"{n_dev} devices")
+    # Host-global build guard (VERDICT r3 item 9): this composes ~13
+    # full-length int64 vectors on one host — measured linear at
+    # ~12 s / 2^26 rows and ~13 x 8 B x total peak incremental RSS
+    # (tools/measure_routing_build.py; ~10 GB at 10^8 rows).  Warn
+    # LOUDLY before an allocation that would swap/OOM rather than die
+    # opaquely inside numpy.
+    est_bytes = 13 * 8 * total
+    try:
+        avail = (os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE"))
+    except (ValueError, OSError, AttributeError):
+        avail = None
+    if avail is not None and est_bytes > 0.8 * avail:
+        import warnings
+
+        warnings.warn(
+            f"build_route at {total} rows needs ~{est_bytes / 2**30:.0f}"
+            f" GB of host scratch but only {avail / 2**30:.0f} GB is "
+            f"free — the host-global table composition is the known "
+            f"scale bound (PERFORMANCE.md routing-build row); shard "
+            f"the exchange (feat_axis / per-level meshes) or use a "
+            f"fatter build host")
     r_dst = total // n_dev
     r_src = src_total // n_dev
 

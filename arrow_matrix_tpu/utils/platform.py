@@ -162,7 +162,7 @@ def _cpu_ticks(pid: int) -> int | None:
         return None
 
 
-def reset_tunnel_state(log=None, min_flat_s: float = 180.0,
+def reset_tunnel_state(log=None, min_flat_s: float = 420.0,
                        lock_age_s: float = 7200.0) -> list[int]:
     """Best-effort local recovery from a wedged tunnel: terminate
     STALE processes still holding the PJRT plugin (their session can
@@ -176,9 +176,12 @@ def reset_tunnel_state(log=None, min_flat_s: float = 180.0,
       lock in a finally, so an old one means a crashed stage);
     - a holder is killed only if its host CPU time is FLAT for
       ``min_flat_s`` — the observed wedge mode is an indefinite RPC
-      wait with zero CPU, while a live bench child advances CPU (or
-      at worst idles in short ``block_until_ready`` waits well under
-      this window);
+      wait with zero CPU, while a live bench child advances CPU.  The
+      window (7 min) sits above the longest legitimate zero-CPU
+      transfer wait observed on the tunnel (multi-minute k=128
+      uploads) and far below the hours-long wedges recovery targets;
+      belt-and-braces, bench.py also holds tpu_busy.lock around its
+      device children;
     - SIGTERM first so the client can release its grant cleanly;
       SIGKILL only after a grace period (a SIGKILL mid-transfer is
       itself a wedge trigger — round-3 postmortem).

@@ -417,6 +417,39 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+class _device_busy:
+    """Hold ``bench_cache/tpu_busy.lock`` while a device child runs.
+
+    The lock is the cross-process contract with reset_tunnel_state
+    (utils/platform.py) and the watcher: a fresh lock means a
+    legitimate chip user exists, so staleness recovery must not
+    SIGTERM a child that is merely blocked in a long zero-CPU PJRT
+    transfer wait.  Refreshing on entry covers driver-launched
+    bench.py runs the watcher does not know about."""
+
+    def __init__(self, active: bool = True):
+        self.active = active
+        self.path = os.path.join("bench_cache", "tpu_busy.lock")
+
+    def __enter__(self):
+        if self.active:
+            try:
+                os.makedirs("bench_cache", exist_ok=True)
+                with open(self.path, "w") as f:
+                    f.write(f"bench pid {os.getpid()}\n")
+            except OSError:
+                pass
+        return self
+
+    def __exit__(self, *exc):
+        if self.active:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+        return False
+
+
 def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
     """One candidate subprocess -> its parsed JSON (or an error dict).
     Every failure shape — nonzero rc, hang, unparseable stdout — is
@@ -438,10 +471,12 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
                    os.path.abspath(os.path.join("bench_cache",
                                                 "xla_cache")))
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--candidate", fmt],
-            capture_output=True, text=True, timeout=timeout_s, env=env)
+        with _device_busy(active=cfg["platform"] != "cpu"):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--candidate", fmt],
+                capture_output=True, text=True, timeout=timeout_s,
+                env=env)
         if proc.returncode != 0 or not proc.stdout.strip():
             _progress(f"fmt={fmt} FAILED rc={proc.returncode}")
             return {"error": f"rc={proc.returncode}: "
@@ -661,6 +696,22 @@ def run_bench(result: dict, platform: str, device_kind: str,
             result["k128_gate"] = tol128
             if np.isfinite(err128) and err128 <= tol128:
                 result["k128_ms"] = rerun["k128_ms"]
+                # Co-equal headline (VERDICT r3 item 2i: BASELINE.md's
+                # metric is 16 AND 128 features): publish the same
+                # derived quantities as the k=16 headline.  The +~2%
+                # time for 8x the bytes is the amortization story —
+                # per-slot cost dominates, so k=128 bandwidth is ~8x.
+                nnz128 = result["config"].get("edges_nnz", 0)
+                n_lvl128 = result["config"].get("levels", 1)
+                ms128 = rerun["k128_ms"]
+                result["k128_gflops"] = round(
+                    2.0 * nnz128 * 128 / (ms128 * 1e-3) / 1e9, 2)
+                if rerun.get("total_rows"):
+                    fb = rerun["total_rows"] * 128 * 4
+                    by = rerun.get("block_bytes", 0) + fb * (
+                        2 * n_lvl128 + 2 * (n_lvl128 - 1))
+                    result["k128_achieved_gbps"] = round(
+                        by / (ms128 * 1e-3) / 1e9, 1)
                 if "k128_bf16_ms" in rerun:
                     # published only under the same gate — a timing
                     # from a kernel that missed its golden is not a
@@ -771,12 +822,13 @@ def kernel_compare(timeout_s: float = 300.0,
             continue
         _progress(f"kernel variant {name}")
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--variant", name],
-                capture_output=True, text=True,
-                timeout=min(timeout_s, left),
-                env=env)
+            with _device_busy(active=not cpu):
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--variant", name],
+                    capture_output=True, text=True,
+                    timeout=min(timeout_s, left),
+                    env=env)
             if proc.returncode == 0 and proc.stdout.strip():
                 out[name + "_ms"] = json.loads(
                     proc.stdout.strip().splitlines()[-1])["ms"]

@@ -65,6 +65,23 @@ def main() -> None:
     errs["sell_a2a"] = relative_error(ml.gather_result(ml.run(xt, iters)),
                                       want)
 
+    if nproc >= 4 and ml.fwd:
+        # The >2-peer coverage this fixture exists for (reference
+        # 4/6-rank PETSc tests, scripts/run_tests.sh): with many peers
+        # the a2a per-pair row counts are UNEQUAL (pair-count skew —
+        # padding slots route from the dummy row), so the padded
+        # fixed-shape all_to_all exercises its masking across real
+        # process boundaries.  Assert the skew is present, not
+        # incidental.
+        import numpy as _np
+
+        rt = ml.fwd[0]
+        send = fetch_replicated(rt.send_idx)   # sharded across processes
+        real = (send != rt.rows_src).sum(axis=2)
+        off_diag = real[~_np.eye(rt.n_dev, dtype=bool)]
+        assert off_diag.size and off_diag.max() > off_diag.min(), (
+            f"a2a pair counts unexpectedly uniform: {real.tolist()}")
+
     from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
 
     ml2 = MultiLevelArrow(levels, width, mesh=mesh, fmt="ell",

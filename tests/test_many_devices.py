@@ -4,6 +4,8 @@ the JAX device count is fixed per process, so a fresh interpreter pins
 a 30-device virtual CPU pool and drives the distributed paths there."""
 
 import os
+
+import pytest
 import subprocess
 import sys
 
@@ -53,6 +55,7 @@ print("OK30")
 """
 
 
+@pytest.mark.slow
 def test_thirty_virtual_devices():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)

@@ -57,6 +57,7 @@ for it in range(1000):
 """
 
 
+@pytest.mark.slow
 def test_peer_death_aborts_whole_job():
     """Failure detection across processes: when one process dies
     mid-iteration, the coordination service's missed-heartbeat fatal
@@ -172,6 +173,7 @@ def _run_cli_pair(args: list, cwd: str, timeout: float = 420):
     return [(p.returncode, out) for p, (out, _) in zip(procs, outs)]
 
 
+@pytest.mark.slow
 def test_distributed_checkpoint_resume(tmp_path):
     """Crash recovery across processes through the real CLI: a
     2-process run checkpoints its carried state, 'crashes' (run ends),
@@ -196,24 +198,24 @@ def test_distributed_checkpoint_resume(tmp_path):
         assert "resumed from ckpt at iteration 2" in out, out[-2000:]
 
 
-def test_two_process_sell_multilevel():
+def _run_children(nproc: int, timeout: float):
     port = _free_port()
     env = dict(os.environ)
     # The children pin their own platform/device count (the parent's
     # pytest pins 16 virtual devices; force_cpu_devices replaces it).
     procs = [subprocess.Popen(
-        [sys.executable, "-u", CHILD, str(i), "2", str(port)],
+        [sys.executable, "-u", CHILD, str(i), str(nproc), str(port)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env) for i in range(2)]
+        env=env) for i in range(nproc)]
     outs = []
     try:
-        # Drain both children concurrently: they advance in lockstep
+        # Drain all children concurrently: they advance in lockstep
         # through gloo collectives, so serially draining one while the
         # other fills its pipe would stall both.
         import concurrent.futures as cf
 
-        with cf.ThreadPoolExecutor(2) as ex:
-            pairs = list(ex.map(lambda p: p.communicate(timeout=420),
+        with cf.ThreadPoolExecutor(nproc) as ex:
+            pairs = list(ex.map(lambda p: p.communicate(timeout=timeout),
                                 procs))
         outs = [(p.returncode, out, err)
                 for p, (out, err) in zip(procs, pairs)]
@@ -228,3 +230,18 @@ def test_two_process_sell_multilevel():
         assert "CHILD_OK" in out, f"{out}\n{err[-2000:]}"
         errval = float(out.split("err=")[1].split()[0])
         assert errval < 1e-5, out
+
+
+@pytest.mark.slow
+def test_two_process_sell_multilevel():
+    _run_children(2, timeout=420)
+
+
+@pytest.mark.slow
+def test_four_process_skewed_a2a():
+    """4 REAL processes x 2 virtual devices = 8 global devices: the
+    >2-peer regime where a2a pair counts skew (the child asserts the
+    skew), per-slice 1D loads split 8 slices over 4 processes, and the
+    1.5D triplet build runs a (4, 2) grid — the reference's 4- and
+    6-rank PETSc coverage (reference scripts/run_tests.sh)."""
+    _run_children(4, timeout=600)

@@ -129,3 +129,133 @@ def test_masked_forest_order_matches_submatrix_contract():
     with pytest.raises(RuntimeError):
         native.random_forest_order_masked(
             a, np.array([-1], dtype=np.int64), rng)
+
+
+def test_symmetrize_structure_matches_scipy():
+    """Native structure-only symmetrize == scipy A + A.T pattern,
+    including non-canonical input rows (unsorted, duplicated)."""
+    if not native.available():
+        pytest.skip(f"native unavailable: {native.load_error()}")
+    rng = np.random.default_rng(11)
+    n = 4096
+    rows = rng.integers(0, n, 30000)
+    cols = rng.integers(0, n, 30000)
+    a = sparse.csr_matrix(
+        (np.ones(30000, np.float32), (rows, cols)), shape=(n, n))
+    # Genuinely non-canonical input: REVERSE every row's within-row
+    # order and append each row's first column a second time
+    # (duplicate entry) — the kernel's per-row sort + dedup paths must
+    # both fire.
+    mi, md = [], []
+    indptr_m = [0]
+    for r in range(n):
+        lo, hi = a.indptr[r], a.indptr[r + 1]
+        cols_r = a.indices[lo:hi][::-1].tolist()
+        if cols_r:
+            cols_r.append(cols_r[-1])   # duplicate
+        mi.extend(cols_r)
+        md.extend([1.0] * len(cols_r))
+        indptr_m.append(len(mi))
+    a_messy = sparse.csr_matrix(
+        (np.asarray(md, np.float32), np.asarray(mi, np.int32),
+         np.asarray(indptr_m)), shape=(n, n))
+    assert not a_messy.has_sorted_indices or n == 0
+    want = symmetrize(a)
+    indptr, indices = native.symmetrize_structure(a_messy)
+    assert np.array_equal(indptr, want.indptr.astype(np.int64))
+    assert np.array_equal(indices, want.indices.astype(np.int32))
+    # raw-pair input drives the masked forest identically to the
+    # scipy-matrix input (same seed -> same order)
+    deg = np.diff(indptr)
+    middle = np.argsort(-deg, kind="stable")[128:]
+    middle = middle[deg[middle] > 0]
+    o_pair = native.random_forest_order_masked(
+        (indptr, indices), middle, np.random.default_rng(7))
+    o_mat = native.random_forest_order_masked(
+        want, middle, np.random.default_rng(7))
+    assert np.array_equal(o_pair, o_mat)
+
+
+def test_threaded_native_parity():
+    """AMT_DECOMP_THREADS must not change any native output (per-range
+    buffers merge in deterministic order)."""
+    import os
+
+    if not native.available():
+        pytest.skip(f"native unavailable: {native.load_error()}")
+    a = symmetrize(barabasi_albert(1 << 15, 6, seed=9))
+    deg = np.diff(a.indptr)
+    middle = np.argsort(-deg, kind="stable")[256:]
+    middle = middle[deg[middle] > 0]
+    prior = os.environ.get("AMT_DECOMP_THREADS")
+    try:
+        os.environ["AMT_DECOMP_THREADS"] = "1"
+        o1 = native.random_forest_order_masked(
+            a, middle, np.random.default_rng(4))
+        s1 = native.symmetrize_structure(a)
+        os.environ["AMT_DECOMP_THREADS"] = "4"
+        o4 = native.random_forest_order_masked(
+            a, middle, np.random.default_rng(4))
+        s4 = native.symmetrize_structure(a)
+    finally:
+        if prior is None:
+            os.environ.pop("AMT_DECOMP_THREADS", None)
+        else:
+            os.environ["AMT_DECOMP_THREADS"] = prior
+    assert np.array_equal(o1, o4)
+    assert np.array_equal(s1[0], s4[0]) and np.array_equal(s1[1], s4[1])
+
+
+def test_level_split_matches_numpy_path():
+    """The fused native split must produce the same levels as the
+    numpy tocoo/select/build chain (canonical CSR is unique)."""
+    if not native.available():
+        pytest.skip(f"native unavailable: {native.load_error()}")
+    rng = np.random.default_rng(2)
+    n, width = 4096, 256
+    a = barabasi_albert(n, 6, seed=2).astype(np.float32)
+    inv = np.argsort(rng.permutation(n)).astype(np.int32)
+    for bd, prune in ((True, True), (False, True), (True, False)):
+        lvl, rest = native.level_split(a, inv, width, bd, prune)
+        # numpy reference
+        coo = a.tocoo()
+        r, c = inv[coo.row], inv[coo.col]
+        if bd:
+            in_level = (r // width) == (c // width)
+        else:
+            in_level = np.abs(r.astype(np.int64)
+                              - c.astype(np.int64)) <= width
+        if prune:
+            in_level |= (r < width) | (c < width)
+        b = sparse.csr_matrix(
+            (coo.data[in_level], (r[in_level], c[in_level])),
+            shape=(n, n))
+        b.sum_duplicates()
+        b.sort_indices()
+        assert (abs(lvl - b)).nnz == 0, (bd, prune)
+        rest_ref = sparse.csr_matrix(
+            (coo.data[~in_level],
+             (coo.row[~in_level], coo.col[~in_level])), shape=(n, n))
+        if rest is None:
+            assert rest_ref.nnz == 0
+        else:
+            d = rest.tocsr() - rest_ref
+            assert abs(d).nnz == 0 or abs(d).max() == 0, (bd, prune)
+
+
+def test_level_split_weighted_f64_and_duplicates():
+    if not native.available():
+        pytest.skip(f"native unavailable: {native.load_error()}")
+    rng = np.random.default_rng(4)
+    n = 2048
+    rows = rng.integers(0, n, 20000)
+    cols = rng.integers(0, n, 20000)
+    vals = rng.standard_normal(20000)
+    a = sparse.csr_matrix((vals, (rows, cols)), shape=(n, n))
+    lvl, rest = native.level_split(a, np.arange(n, dtype=np.int32),
+                                   256, True, True)
+    total = lvl + (rest if rest is not None else 0)
+    want = a.tocsr()
+    want.sum_duplicates()
+    err = abs(total - want)
+    assert err.nnz == 0 or err.max() < 1e-12

@@ -107,10 +107,16 @@ def predict_ms(slots, n_dev, K, bytes_, n_coll, bw_gbps, lat_s,
                space: bool) -> float:
     if space:
         compute = max(slots) / (n_dev / K) / GATHER_ROWS_PER_S
-        serial_coll = n_coll           # levels overlap; one schedule
+        # Levels run concurrently on disjoint sub-meshes, so their
+        # per-level collectives overlap ~K-way: the serialized-latency
+        # term charges the longest per-group chain, not the total
+        # (ADVICE r3: the old code charged the full count, biasing the
+        # crossover toward time-shared — the mode this tool is used to
+        # justify).
+        serial_coll = n_coll / max(K, 1)
     else:
         compute = sum(slots) / n_dev / GATHER_ROWS_PER_S
-        serial_coll = n_coll           # already per-iteration totals
+        serial_coll = n_coll           # per-level collectives serialize
     comm = bytes_ / (bw_gbps * 1e9)
     return (compute + comm + serial_coll * lat_s) * 1e3
 

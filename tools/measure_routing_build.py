@@ -1,0 +1,83 @@
+"""Measure the host-global routing-table build at scale (VERDICT r3
+item 9).
+
+``routing.build_route`` composes full-length int arrays per level pair
+on ONE host — the acknowledged host-global remainder of the otherwise
+streamed multi-level build.  This tool measures its wall time and peak
+RSS at total = 2^24..2^26 rows on a realistic table (a random
+permutation, the worst case for pair skew: every row moves), appends
+the numbers to ``bench_results/routing_build.json``, and prints them.
+
+The measured model (documented in PERFORMANCE.md): the build is
+~12 full-length vector passes, so time is linear in ``total`` and peak
+incremental memory is ~13 x 8 B x total.  At 10^8 rows that is ~10 GB
+and O(1 min) — within one fat host's budget, which is why the build is
+documented + guarded (parallel/routing.py warns loudly when the
+estimate exceeds available RAM) rather than streamed per shard.
+
+Usage: PYTHONPATH=/root/repo python tools/measure_routing_build.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from arrow_matrix_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices()
+
+import numpy as np  # noqa: E402
+
+from arrow_matrix_tpu.parallel.routing import build_route  # noqa: E402
+
+
+def _rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+
+
+def main() -> None:
+    n_dev = int(os.environ.get("AMT_ROUTE_DEVS", 8))
+    out = {"n_dev": n_dev, "rungs": {}}
+    for log2 in (24, 25, 26):
+        total = 1 << log2
+        rng = np.random.default_rng(log2)
+        table = rng.permutation(total)
+        rss0 = _rss_gb()
+        t0 = time.perf_counter()
+        route = build_route(table, n_dev)
+        dt = time.perf_counter() - t0
+        bytes_tables = sum(
+            int(np.asarray(a).nbytes)
+            for a in (route.local_src, route.local_dst,
+                      route.send_idx, route.recv_dst))
+        out["rungs"][f"2^{log2}"] = {
+            "total_rows": total,
+            "build_s": round(dt, 1),
+            "peak_rss_gb": round(_rss_gb(), 2),
+            "rss_before_gb": round(rss0, 2),
+            "table_bytes_gb": round(bytes_tables / 2**30, 3),
+        }
+        print(f"2^{log2}: build {dt:.1f}s, peak RSS {_rss_gb():.1f} GB, "
+              f"tables {bytes_tables / 2**30:.2f} GB", flush=True)
+        del route, table
+    path = os.path.join(REPO, "bench_results", "routing_build.json")
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        prior = {}
+    prior[f"devs{n_dev}"] = out
+    with open(path, "w") as f:
+        json.dump(prior, f, indent=1)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -183,6 +183,12 @@ def healthy_pass(skip_scale: bool) -> bool:
     run_stage("gather_probe",
               [sys.executable, "tools/gather_probe.py"],
               env={}, timeout_s=1800.0)
+    if os.path.exists(os.path.join(REPO, "tools",
+                                   "pallas_gather_probe.py")):
+        run_stage("pallas_gather",
+                  [sys.executable, "tools/pallas_gather_probe.py"],
+                  env={}, timeout_s=1200.0,
+                  json_name=f"onchip_pallas_gather_{ts}.json")
     return ok
 
 
