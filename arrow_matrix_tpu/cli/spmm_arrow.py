@@ -135,6 +135,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "levels, ~2x the tiers; the gather cost "
                              "model favors it, pending a real "
                              "multi-chip race).")
+    parser.add_argument("--fold_growth", type=float, default=1.2,
+                        help="fmt=fold tier growth factor: padded "
+                             "slots <= growth x nnz by construction. "
+                             "1.1 with --fold_align 1 is the "
+                             "'fold_tight' bench candidate (-17% "
+                             "logical slots at the protocol config).")
+    parser.add_argument("--fold_align", type=int, default=None,
+                        help="fmt=fold slot alignment (default: the "
+                             "8-sublane tile; 1 = no alignment — "
+                             "fewest logical gather slots, the bench's "
+                             "fold_tight packing).")
     parser.add_argument("--memmap", type=str2bool, nargs="?",
                         default=False, const=True,
                         help="Memory-map the decomposition artifact and "
@@ -420,7 +431,9 @@ def main(argv=None) -> int:
                                    if args.fmt == "fold" else None),
                     layout="slim" if args.slim else "wide",
                     routing=(args.routing if mesh is not None
-                             else "gather"))
+                             else "gather"),
+                    fold_growth=args.fold_growth,
+                    fold_align=args.fold_align)
 
     # Untimed warmup: trace + compile must not pollute iteration 0's
     # spmm_time (the sibling baseline CLIs warm up the same way).

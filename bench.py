@@ -429,12 +429,17 @@ class _device_busy:
 
     def __init__(self, active: bool = True):
         self.active = active
-        self.path = os.path.join("bench_cache", "tpu_busy.lock")
+        # Repo-anchored, NOT cwd-relative: reset_tunnel_state reads
+        # the absolute <repo>/bench_cache path, and the driver may
+        # launch bench.py from any directory.
+        self.path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "bench_cache", "tpu_busy.lock")
 
     def __enter__(self):
         if self.active:
             try:
-                os.makedirs("bench_cache", exist_ok=True)
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
                 with open(self.path, "w") as f:
                     f.write(f"bench pid {os.getpid()}\n")
             except OSError:
@@ -499,6 +504,17 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
     except (json.JSONDecodeError, IndexError) as e:
         return {"error": f"unusable child output: "
                          f"{type(e).__name__}: {str(e)[:200]}"}
+
+
+def _bytes_per_iter_model(block_bytes: int, total_rows: int, k: int,
+                          n_lvl: int) -> int:
+    """Bandwidth-floor bytes of one iteration: every resident block
+    array streamed once, the feature array read+written once per level
+    plus ~2 more feature passes per level beyond the first (the
+    routing gathers).  ONE definition for every feature width — the
+    k=16 and k=128 headlines must share the model."""
+    feat_bytes = total_rows * k * 4
+    return block_bytes + feat_bytes * (2 * n_lvl + 2 * (n_lvl - 1))
 
 
 def _check_wedged(result: dict, cfg: dict, label: str) -> bool:
@@ -612,16 +628,11 @@ def run_bench(result: dict, platform: str, device_kind: str,
         result["fmt_used"] = best
 
         flops = 2.0 * nnz * k
-        # Bandwidth roofline: one iteration streams every resident
-        # block array once and reads+writes the feature array once per
-        # level (+ the routing gathers, ~2 more feature passes per
-        # level beyond the first).  This is the memory floor;
+        # Bandwidth roofline: the memory floor (_bytes_per_iter_model);
         # achieved/floor bandwidth against the chip's peak is the MFU
         # analog for a bandwidth-bound kernel.
-        feat_bytes = win["total_rows"] * k * 4
-        n_lvl = len(levels)
-        bytes_per_iter = win["block_bytes"] + feat_bytes * (
-            2 * n_lvl + 2 * (n_lvl - 1))
+        bytes_per_iter = _bytes_per_iter_model(
+            win["block_bytes"], win["total_rows"], k, len(levels))
         achieved_gbps = bytes_per_iter / (dev_ms * 1e-3) / 1e9
         peak = _peak_bw(device_kind)
         result.update({
@@ -707,9 +718,9 @@ def run_bench(result: dict, platform: str, device_kind: str,
                 result["k128_gflops"] = round(
                     2.0 * nnz128 * 128 / (ms128 * 1e-3) / 1e9, 2)
                 if rerun.get("total_rows"):
-                    fb = rerun["total_rows"] * 128 * 4
-                    by = rerun.get("block_bytes", 0) + fb * (
-                        2 * n_lvl128 + 2 * (n_lvl128 - 1))
+                    by = _bytes_per_iter_model(
+                        rerun.get("block_bytes", 0),
+                        rerun["total_rows"], 128, n_lvl128)
                     result["k128_achieved_gbps"] = round(
                         by / (ms128 * 1e-3) / 1e9, 1)
                 if "k128_bf16_ms" in rerun:

@@ -16,9 +16,12 @@ launch latency:
     (sum of levels / n_dev), space-shared runs levels concurrently on
     n_dev/K chips each (max level / (n_dev/K)).
   * bytes/bw — collective payload over the per-chip ICI bandwidth.
-  * n_coll*lat — each collective pays a launch/sync latency; the
-    time-shared schedule serializes its per-level collectives, the
-    space-shared schedule overlaps levels (its collectives count once).
+  * n_coll*lat — each collective pays a launch/sync latency.  Both
+    modes charge their full HLO-accounted op count: the time-shared
+    program emits K sequential per-level collectives (K ops), while
+    the space-shared program emits ONE K-replica-group op per
+    exchange — the K-way overlap is already baked into its (smaller)
+    count, so no further overlap factor applies.
 
 Printed: the predicted table at v5e parameters and the crossover
 sweep — the (bw, lat) region where each mode wins.  Run with real
@@ -107,13 +110,15 @@ def predict_ms(slots, n_dev, K, bytes_, n_coll, bw_gbps, lat_s,
                space: bool) -> float:
     if space:
         compute = max(slots) / (n_dev / K) / GATHER_ROWS_PER_S
-        # Levels run concurrently on disjoint sub-meshes, so their
-        # per-level collectives overlap ~K-way: the serialized-latency
-        # term charges the longest per-group chain, not the total
-        # (ADVICE r3: the old code charged the full count, biasing the
-        # crossover toward time-shared — the mode this tool is used to
-        # justify).
-        serial_coll = n_coll / max(K, 1)
+        # The HLO count ALREADY embodies the K-way overlap: the
+        # space-shared shard_map lowers each cross-level exchange to
+        # ONE collective op with K replica groups (sell_space.py), so
+        # commstats counts it once — n_coll IS the per-device
+        # serialized chain length.  Dividing by K here would charge
+        # 1/K of the real launch latency (ADVICE r3 asked for either
+        # the division or a docstring fix; the division double-counts,
+        # so the docstring carries the model instead).
+        serial_coll = n_coll
     else:
         compute = sum(slots) / n_dev / GATHER_ROWS_PER_S
         serial_coll = n_coll           # per-level collectives serialize
