@@ -32,9 +32,15 @@ def _ladder_module():
 
 
 def test_ladder_registry_importable():
-    assert set(_ladder_module().RUNGS) == {
+    mod = _ladder_module()
+    assert set(mod.RUNGS) == {
         "decompose24", "ingest24", "decompose26_grid",
+        "decompose_1e8_grid", "decompose_1e8_ba",
         "backend_race22", "backend_race23"}
+    # The 1e8 rungs are opt-in: a bare `python tools/scale_ladder.py`
+    # must stay bounded (the BA 2^27 rung needs ~hours and tens of GB).
+    assert set(mod.DEFAULT_RUNGS) == set(mod.RUNGS) - {
+        "decompose_1e8_grid", "decompose_1e8_ba"}
 
 
 def test_recorded_ladder_results_pass_their_gates():

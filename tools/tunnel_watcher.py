@@ -151,9 +151,81 @@ def run_stage(name: str, cmd: list[str], env: dict, timeout_s: float,
     return proc.returncode == 0
 
 
+PREEMPT = os.path.join(REPO, "bench_cache", "preempt_on_heal.pids")
+
+
+def _proc_starttime(pid: int) -> str | None:
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split(")")[-1].split()[19]
+    except (OSError, IndexError):
+        return None
+
+
+def _preemptible_pids() -> list[int]:
+    """PIDs of long host-side jobs (scale-ladder rungs etc.) that
+    registered themselves as preemptible: they are SIGSTOPped for the
+    duration of the on-chip stages and SIGCONTed after.  Automates the
+    round-3 postmortem rule — host contention pushed a bench child
+    past its timeout and the SIGKILL mid-transfer wedged the tunnel;
+    pausing pure-host compute is free.
+
+    Tokens are ``pid:starttime`` (written by the jobs themselves —
+    scale_ladder._register_preemptible): the /proc start time is
+    verified before signaling, so a recycled pid is never touched.
+    Malformed tokens are skipped individually (a torn concurrent
+    append must not silently disable the whole list)."""
+    try:
+        with open(PREEMPT) as f:
+            raw = f.read().split()
+    except OSError:
+        return []
+    pids = []
+    for tok in raw:
+        try:
+            pid_s, _, start = tok.partition(":")
+            pid = int(pid_s)
+        except ValueError:
+            log(f"preempt list: skipping malformed token {tok!r}")
+            continue
+        if start and _proc_starttime(pid) == start:
+            pids.append(pid)
+    return pids
+
+
+class _pause_host_jobs:
+    def __enter__(self):
+        import signal
+
+        self.pids = _preemptible_pids()
+        for p in self.pids:
+            try:
+                os.kill(p, signal.SIGSTOP)
+                log(f"paused host job {p} for on-chip stages")
+            except OSError:
+                pass
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        for p in self.pids:
+            try:
+                os.kill(p, signal.SIGCONT)
+                log(f"resumed host job {p}")
+            except OSError:
+                pass
+        return False
+
+
 def healthy_pass(skip_scale: bool) -> bool:
     """Run the full on-chip stage list; True iff the headline landed."""
     ts = datetime.datetime.now().strftime("%m%d_%H%M")
+    with _pause_host_jobs():
+        return _healthy_pass_stages(skip_scale, ts)
+
+
+def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
     ok = run_stage(
         "bench_full", [sys.executable, "bench.py"],
         env={"AMT_BENCH_DEADLINE": "3300"},
@@ -204,6 +276,16 @@ def main() -> None:
     deadline = time.time() + args.max_hours * 3600
     log(f"watcher started (interval {args.interval:.0f}s, "
         f"max {args.max_hours:.1f}h, pid {os.getpid()})")
+    # Startup SIGCONT sweep: a previous watcher SIGKILLed mid-stage
+    # leaves registered jobs frozen — unfreeze anything still listed.
+    import signal as _signal
+
+    for p in _preemptible_pids():
+        try:
+            os.kill(p, _signal.SIGCONT)
+            log(f"startup sweep: SIGCONT {p} (possibly left paused)")
+        except OSError:
+            pass
     passed = False
     p = _platform_utils()
     while time.time() < deadline:
