@@ -294,42 +294,15 @@ DEFAULT_RUNGS = [r for r in RUNGS
                  if r not in ("decompose_1e8_grid", "decompose_1e8_ba")]
 
 
-def _register_preemptible() -> None:
-    """Register this pid (with its /proc start time, so a recycled pid
-    is never signaled) in bench_cache/preempt_on_heal.pids: the tunnel
-    watcher SIGSTOPs registered host jobs for the duration of on-chip
-    stages (the round-3 wedge trigger was host contention during a
-    bench).  Best-effort; removal happens via atexit."""
-    import atexit
-
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "bench_cache",
-        "preempt_on_heal.pids")
-    pid = os.getpid()
-    try:
-        with open(f"/proc/{pid}/stat") as f:
-            start = f.read().split(")")[-1].split()[19]   # starttime
-        token = f"{pid}:{start}"
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "a") as f:
-            f.write(token + "\n")
-    except OSError:
-        return
-
-    def _cleanup():
-        try:
-            with open(path) as f:
-                toks = [t for t in f.read().split() if t != token]
-            with open(path, "w") as f:
-                f.write("\n".join(toks) + ("\n" if toks else ""))
-        except OSError:
-            pass
-
-    atexit.register(_cleanup)
-
-
 def main() -> None:
-    _register_preemptible()
+    # Register as preemptible: the tunnel watcher SIGSTOPs registered
+    # host jobs (whole process groups) for the duration of on-chip
+    # stages — host contention during a TPU bench was the round-3
+    # wedge trigger.  One shared registry definition in
+    # utils.platform (writer and reader must never drift).
+    from arrow_matrix_tpu.utils.platform import register_preemptible
+
+    register_preemptible()
     if len(sys.argv) == 3 and sys.argv[1] == "--rung":
         print(json.dumps(RUNGS[sys.argv[2]]()), flush=True)
         return

@@ -151,46 +151,32 @@ def run_stage(name: str, cmd: list[str], env: dict, timeout_s: float,
     return proc.returncode == 0
 
 
-PREEMPT = os.path.join(REPO, "bench_cache", "preempt_on_heal.pids")
-
-
-def _proc_starttime(pid: int) -> str | None:
-    try:
-        with open(f"/proc/{pid}/stat") as f:
-            return f.read().split(")")[-1].split()[19]
-    except (OSError, IndexError):
-        return None
-
-
 def _preemptible_pids() -> list[int]:
-    """PIDs of long host-side jobs (scale-ladder rungs etc.) that
-    registered themselves as preemptible: they are SIGSTOPped for the
-    duration of the on-chip stages and SIGCONTed after.  Automates the
-    round-3 postmortem rule — host contention pushed a bench child
-    past its timeout and the SIGKILL mid-transfer wedged the tunnel;
-    pausing pure-host compute is free.
+    """Verified-live registered host jobs (shared registry contract:
+    utils.platform.register_preemptible / read_preemptible).  They are
+    SIGSTOPped — as whole process GROUPS, so a rung's freshly spawned
+    children pause too — for the duration of the on-chip stages and
+    SIGCONTed after.  Automates the round-3 postmortem rule: host
+    contention pushed a bench child past its timeout and the SIGKILL
+    mid-transfer wedged the tunnel; pausing pure-host compute is
+    free."""
+    p = _platform_utils()
+    return p.read_preemptible(log=log)
 
-    Tokens are ``pid:starttime`` (written by the jobs themselves —
-    scale_ladder._register_preemptible): the /proc start time is
-    verified before signaling, so a recycled pid is never touched.
-    Malformed tokens are skipped individually (a torn concurrent
-    append must not silently disable the whole list)."""
+
+def _signal_job(pid: int, sig) -> None:
+    """Signal the job's whole process group — its subprocess children
+    (rung workers spawned via ``--rung``) inherit the pgid and must
+    pause with it.  Never signals the watcher's own group (the only
+    group it could share with an unrelated live process)."""
     try:
-        with open(PREEMPT) as f:
-            raw = f.read().split()
+        pgid = os.getpgid(pid)
+        if pgid != os.getpgid(0):
+            os.killpg(pgid, sig)
+            return
     except OSError:
-        return []
-    pids = []
-    for tok in raw:
-        try:
-            pid_s, _, start = tok.partition(":")
-            pid = int(pid_s)
-        except ValueError:
-            log(f"preempt list: skipping malformed token {tok!r}")
-            continue
-        if start and _proc_starttime(pid) == start:
-            pids.append(pid)
-    return pids
+        pass
+    os.kill(pid, sig)
 
 
 class _pause_host_jobs:
@@ -200,8 +186,8 @@ class _pause_host_jobs:
         self.pids = _preemptible_pids()
         for p in self.pids:
             try:
-                os.kill(p, signal.SIGSTOP)
-                log(f"paused host job {p} for on-chip stages")
+                _signal_job(p, signal.SIGSTOP)
+                log(f"paused host job {p} (group) for on-chip stages")
             except OSError:
                 pass
         return self
@@ -211,7 +197,7 @@ class _pause_host_jobs:
 
         for p in self.pids:
             try:
-                os.kill(p, signal.SIGCONT)
+                _signal_job(p, signal.SIGCONT)
                 log(f"resumed host job {p}")
             except OSError:
                 pass
@@ -282,7 +268,7 @@ def main() -> None:
 
     for p in _preemptible_pids():
         try:
-            os.kill(p, _signal.SIGCONT)
+            _signal_job(p, _signal.SIGCONT)
             log(f"startup sweep: SIGCONT {p} (possibly left paused)")
         except OSError:
             pass
