@@ -164,19 +164,46 @@ def _preemptible_pids() -> list[int]:
     return p.read_preemptible(log=log)
 
 
+def _descendants(root: int) -> list[int]:
+    """``root`` plus its live descendant pids (one /proc pass building
+    the ppid tree)."""
+    kids: dict[int, list[int]] = {}
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                ppid = int(f.read().split(")")[-1].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        kids.setdefault(ppid, []).append(int(entry))
+    out, stack = [], [root]
+    while stack:
+        p = stack.pop()
+        out.append(p)
+        stack.extend(kids.get(p, []))
+    return out
+
+
 def _signal_job(pid: int, sig) -> None:
-    """Signal the job's whole process group — its subprocess children
-    (rung workers spawned via ``--rung``) inherit the pgid and must
-    pause with it.  Never signals the watcher's own group (the only
-    group it could share with an unrelated live process)."""
+    """Signal the job and its descendants INDIVIDUALLY — rung workers
+    spawned via ``--rung`` must pause with their parent, but a group
+    signal could hit unrelated processes sharing the pgid (a
+    no-job-control driver script runs its whole pipeline, including a
+    live bench, in ONE group).  For SIGSTOP the root goes first: a
+    stopped parent cannot spawn, so the descendant set enumerated
+    afterwards is frozen."""
     try:
-        pgid = os.getpgid(pid)
-        if pgid != os.getpgid(0):
-            os.killpg(pgid, sig)
-            return
+        os.kill(pid, sig)
     except OSError:
-        pass
-    os.kill(pid, sig)
+        return
+    for p in _descendants(pid):
+        if p == pid:
+            continue
+        try:
+            os.kill(p, sig)
+        except OSError:
+            pass
 
 
 class _pause_host_jobs:
@@ -234,10 +261,22 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
             timeout_s=5700.0,
             json_name=f"onchip_bench_2e24_{ts}.json")
     if os.path.exists(os.path.join(REPO, "tools", "planar_bench.py")):
-        run_stage(
+        planar_ok = run_stage(
             "planar", [sys.executable, "tools/planar_bench.py"],
             env={}, timeout_s=2400.0,
             json_name=f"onchip_planar_{ts}.json")
+        if planar_ok and not skip_scale:
+            # The flagship scale point: 10240^2 = 104.9M rows on ONE
+            # chip via bf16 feature carriage (~8.4 GB resident).  Only
+            # after the 4096^2 stage proves the path — a failure there
+            # would burn ~40 min of healthy-tunnel time for nothing.
+            run_stage(
+                "planar_1e8",
+                [sys.executable, "tools/planar_bench.py"],
+                env={"AMT_PLANAR_SIDE": "10240",
+                     "AMT_PLANAR_DTYPE": "bf16"},
+                timeout_s=4200.0,
+                json_name=f"onchip_planar_1e8_{ts}.json")
     run_stage("gather_probe",
               [sys.executable, "tools/gather_probe.py"],
               env={}, timeout_s=1800.0)
