@@ -294,13 +294,22 @@ def read_preemptible(log=None) -> list[int]:
     """Verified-live registered pids (start time must match /proc —
     see register_preemptible).  Malformed tokens are skipped
     individually: a torn write must not silently disable the list.
-    Takes the shared lock: a reader during _cleanup's truncate-and-
-    rewrite window must not observe an empty file."""
+    Takes the shared lock NON-blocking with a short retry (a reader
+    during _cleanup's truncate-and-rewrite window must not observe an
+    empty file — but a LOCK_EX holder that got SIGSTOPped mid-cleanup
+    must not block this reader forever either; after the retries the
+    unlocked read is accepted)."""
     import fcntl
+    import time as _time
 
     try:
         with open(preempt_registry_path()) as f:
-            fcntl.flock(f, fcntl.LOCK_SH)
+            for _ in range(10):
+                try:
+                    fcntl.flock(f, fcntl.LOCK_SH | fcntl.LOCK_NB)
+                    break
+                except OSError:
+                    _time.sleep(0.2)
             raw = f.read().split()
     except OSError:
         return []
@@ -369,11 +378,24 @@ def reset_tunnel_state(log=None, min_flat_s: float = 420.0,
             return []
     except OSError:
         pass
-    # Recovery candidates: ANY .so-mapping stranger (a wedged client
-    # can lose its relay socket while its server-side claim persists);
-    # the flat-CPU window + busy-lock above do the live-user
-    # narrowing, and registered host jobs are excluded at the source.
-    candidates = find_stale_plugin_holders(require_connection=False)
+    # Recovery candidates: holders WITH a relay connection, plus
+    # connectionless .so-mappers that are identifiably OUR orphaned
+    # probe children (the amt_probe cmdline marker) — a wedged client
+    # can lose its socket while its server-side claim persists, but an
+    # innocent idle jax process (interactive session, suspended
+    # script) also maps the .so with no socket and must never be
+    # killed.  The flat-CPU window + busy-lock still narrow further.
+    with_conn = set(find_stale_plugin_holders())
+    candidates = list(with_conn)
+    for pid in find_stale_plugin_holders(require_connection=False):
+        if pid in with_conn:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                if b"amt_probe" in f.read():
+                    candidates.append(pid)
+        except OSError:
+            continue
     if not candidates:
         return []
     # Flat-CPU watch: drop any holder whose CPU advances during the
@@ -438,8 +460,12 @@ def probe_default_backend(timeout_s: float = 60.0, retries: int = 2
 
     # A non-trivial (64 KB) transfer: the observed tunnel wedge mode
     # hangs MID-TRANSFER, so a few-byte round-trip can pass on a link
-    # that will hang the first real upload.
-    code = ("import jax, numpy as np; d = jax.devices()[0]; "
+    # that will hang the first real upload.  The amt_probe marker
+    # makes an orphaned hung probe identifiable from its cmdline —
+    # reset_tunnel_state may kill CONNECTIONLESS processes only when
+    # they carry it (an innocent idle jax process must never match).
+    code = ("amt_probe = 1; "
+            "import jax, numpy as np; d = jax.devices()[0]; "
             "x = jax.device_put(np.arange(16384, dtype=np.float32), d); "
             "v = float(x.sum()); "
             "print(d.platform); print(d.device_kind)")

@@ -96,6 +96,40 @@ def _host_busy_fresh(max_age_s: float = 3600.0) -> bool:
         return False
 
 
+def _foreign_bench_running() -> bool:
+    """True when a bench.py we did not spawn is running — e.g. the
+    driver's end-of-round run.  The watcher must then neither probe
+    (its probe child would race the bench's own probe for the single
+    chip's claim) nor start stages."""
+    me = os.getpid()
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                argv = f.read().decode("utf-8", "replace").split("\0")
+        except OSError:
+            continue
+        # Proper argv match: an interpreter arg that IS bench.py — a
+        # substring test would also hit processes whose embedded
+        # argument text merely MENTIONS bench.py (observed: the
+        # driver agent's prompt argument).
+        if not argv or "python" not in os.path.basename(argv[0]):
+            continue
+        if not any(a == "bench.py" or a.endswith("/bench.py")
+                   for a in argv[1:3]):
+            continue
+        # our own stages run bench.py too — skip our descendants
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                ppid = int(f.read().split(")")[-1].split()[1])
+        except (OSError, ValueError, IndexError):
+            ppid = -1
+        if ppid != me:
+            return True
+    return False
+
+
 def chip_in_use_elsewhere() -> bool:
     """True when another process (driver bench, interactive run) holds
     the PJRT plugin — probing would contend for the one chip."""
@@ -153,13 +187,12 @@ def run_stage(name: str, cmd: list[str], env: dict, timeout_s: float,
 
 def _preemptible_pids() -> list[int]:
     """Verified-live registered host jobs (shared registry contract:
-    utils.platform.register_preemptible / read_preemptible).  They are
-    SIGSTOPped — as whole process GROUPS, so a rung's freshly spawned
-    children pause too — for the duration of the on-chip stages and
-    SIGCONTed after.  Automates the round-3 postmortem rule: host
-    contention pushed a bench child past its timeout and the SIGKILL
-    mid-transfer wedged the tunnel; pausing pure-host compute is
-    free."""
+    utils.platform.register_preemptible / read_preemptible).  They and
+    their descendants are SIGSTOPped individually for the duration of
+    the on-chip stages and SIGCONTed after.  Automates the round-3
+    postmortem rule: host contention pushed a bench child past its
+    timeout and the SIGKILL mid-transfer wedged the tunnel; pausing
+    pure-host compute is free."""
     p = _platform_utils()
     return p.read_preemptible(log=log)
 
@@ -190,20 +223,27 @@ def _signal_job(pid: int, sig) -> None:
     spawned via ``--rung`` must pause with their parent, but a group
     signal could hit unrelated processes sharing the pgid (a
     no-job-control driver script runs its whole pipeline, including a
-    live bench, in ONE group).  For SIGSTOP the root goes first: a
-    stopped parent cannot spawn, so the descendant set enumerated
-    afterwards is frozen."""
-    try:
-        os.kill(pid, sig)
-    except OSError:
-        return
-    for p in _descendants(pid):
-        if p == pid:
-            continue
-        try:
-            os.kill(p, sig)
-        except OSError:
-            pass
+    live bench, in ONE group).
+
+    SIGSTOP runs to a FIXED POINT: after each sweep the tree is
+    re-enumerated, so a child that forked a grandchild while its own
+    stop was in flight gets caught on the next pass (a stopped
+    process cannot fork, so the set converges)."""
+    import signal as _s
+
+    signaled: set[int] = set()
+    for _ in range(8):   # bounded; converges in 1-2 passes in practice
+        targets = [p for p in _descendants(pid) if p not in signaled]
+        if not targets:
+            break
+        for p in targets:
+            try:
+                os.kill(p, sig)
+                signaled.add(p)
+            except OSError:
+                pass
+        if sig != _s.SIGSTOP:
+            break   # only the stop needs the fixed point
 
 
 class _pause_host_jobs:
@@ -214,7 +254,8 @@ class _pause_host_jobs:
         for p in self.pids:
             try:
                 _signal_job(p, signal.SIGSTOP)
-                log(f"paused host job {p} (group) for on-chip stages")
+                log(f"paused host job {p} (+descendants) for "
+                    f"on-chip stages")
             except OSError:
                 pass
         return self
@@ -313,13 +354,28 @@ def main() -> None:
             pass
     passed = False
     p = _platform_utils()
+    foreign_since: float | None = None
     while time.time() < deadline:
+        if _foreign_bench_running():
+            # Staleness escape: a normal driver bench finishes well
+            # inside 2 h; one present longer is itself wedged and must
+            # not shadow the recovery branches below forever.
+            foreign_since = foreign_since or time.time()
+            if time.time() - foreign_since < 7200:
+                log("probe: skipped (a foreign bench.py is running — "
+                    "its probe must win the chip)")
+                time.sleep(args.interval)
+                continue
+            log("foreign bench.py present >2h — treating as wedged, "
+                "resuming normal handling")
+        else:
+            foreign_since = None
         if chip_in_use_elsewhere():
             # Another process holds the plugin: a live user (driver
             # bench, interactive run) — don't contend.  But a
             # half-dead holder is exactly the round-3 wedge mode, so
             # attempt recovery: reset_tunnel_state kills ONLY holders
-            # whose CPU stays flat for 3 minutes (a live bench child
+            # whose CPU stays flat for 7 minutes (a live bench child
             # advances CPU) and no-ops under a fresh tpu_busy.lock.
             log("probe: plugin held by another process — checking "
                 "for staleness")
@@ -345,6 +401,20 @@ def main() -> None:
                 else:
                     log("bench failed on a healthy probe — retrying "
                         "next cycle")
+        else:
+            # Init-hang with NO connected holder in sight: recovery
+            # still applies — our own orphaned probe children (killed
+            # watchers leave them hanging in the init wedge, cmdline-
+            # marked amt_probe) can hold pending claims without a
+            # socket; reset_tunnel_state kills only those + flat-CPU
+            # connected holders, never innocent idle jax processes.
+            try:
+                cleared = p.reset_tunnel_state(log=log)
+                if cleared:
+                    log(f"recovery after failed probe: cleared "
+                        f"{cleared}")
+            except Exception as e:
+                log(f"recovery check failed: {type(e).__name__}: {e}")
         time.sleep(args.interval)
     log("watcher expired")
 
