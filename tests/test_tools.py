@@ -1,0 +1,58 @@
+"""Smoke tests for the round-4 perf tools' CPU fixtures: the watcher
+runs these scripts unattended on a healed tunnel, so their non-chip
+logic (decompose, golden gates, JSON contracts) must stay green in CI.
+Each runs in a subprocess exactly as the watcher invokes it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script: str, env: dict, timeout: float = 300):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script)],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, **env}, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_planar_bench_cpu_fixture():
+    out = _run("planar_bench.py",
+               {"AMT_PLANAR_CPU": "1", "AMT_PLANAR_SIDE": "96"})
+    assert out["levels"] == 1          # banded fast path engaged
+    assert out["gated"] and out["winner"] in ("fold", "fold_tight")
+    # the tight packing's planar slot story: exactly 1.0x nnz
+    assert out["runs"]["fold_tight"]["slots_over_nnz"] == 1.0
+    assert out["comm_8dev"]["levels"] == 1
+
+
+def test_planar_bench_bf16_fixture():
+    out = _run("planar_bench.py",
+               {"AMT_PLANAR_CPU": "1", "AMT_PLANAR_SIDE": "96",
+                "AMT_PLANAR_DTYPE": "bf16"})
+    assert out["feature_dtype"] == "bf16"
+    assert list(out["runs"]) == ["fold_tight"]   # single resident build
+    assert out["gated"] and out["err"] < 2e-2
+
+
+def test_pallas_gather_probe_cpu_fixture():
+    out = _run("pallas_gather_probe.py", {"AMT_PROBE_CPU": "1"})
+    for name in ("xla_take", "xla_granule", "pallas_granule"):
+        assert out["variants"][name].get("exact") is True, out
+
+
+@pytest.mark.slow
+def test_ladder_race_cpu_fixture():
+    out = _run("ladder_race.py",
+               {"AMT_LADDER_CPU": "1", "AMT_LADDER_N": "16384"},
+               timeout=600)
+    assert out["runs"]["default"]["gated"]
+    assert out["runs"]["tight"]["gated"]
+    assert (out["runs"]["tight"]["gather_slots"]
+            < out["runs"]["default"]["gather_slots"])
