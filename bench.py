@@ -877,21 +877,51 @@ def _last_onchip_evidence() -> dict | None:
     # Newest artifact whose metric matches the headline — the watcher
     # also drops ladder/planar artifacts into the same namespace, and
     # a ladder-race ms must not masquerade as the SpMM evidence trail.
+    def _cfg_key(d):
+        c = d.get("config") or {}
+        return (c.get("n"), c.get("width"), c.get("features"))
+
     newest = data = None
     newest_mtime = -1.0
+    k128_extra = None
+    scanned = 0
     for mt, p in sorted(by_mtime, reverse=True):
         try:
             with open(p) as f:
                 d = json.loads(f.read().strip().splitlines()[-1])
         except (OSError, json.JSONDecodeError, IndexError):
             continue
-        if d.get("metric") == "spmm_iter_ms" and d.get("value"):
+        scanned += 1
+        if d.get("metric") != "spmm_iter_ms" or not d.get("value"):
+            continue
+        if newest is None:
             newest, newest_mtime, data = p, mt, d
-            break
+        # The co-equal k=128 headline may live in an older artifact
+        # (e.g. a fold-only rerun postdates the full race): carry the
+        # newest k128 numbers alongside, labeled with their source —
+        # but ONLY from a capture of the SAME problem config (a k=128
+        # ms from a different n/width must not masquerade under this
+        # config's evidence).
+        if (d.get("k128_ms") is not None and k128_extra is None
+                and newest is not None
+                and _cfg_key(d) == _cfg_key(data)):
+            k128_extra = {"k128_ms": d["k128_ms"],
+                          "k128_err": d.get("k128_err"),
+                          "from": p}
+        if (newest is not None
+                and (k128_extra is not None or scanned >= 10)):
+            break   # bounded: stop chasing k128 through old artifacts
     if newest is None:
         return None
+    if k128_extra and data.get("k128_ms") is None:
+        merge = {"k128_ms": k128_extra["k128_ms"],
+                 "k128_from": k128_extra["from"]}
+        if k128_extra["k128_err"] is not None:
+            merge["k128_err"] = k128_extra["k128_err"]
+        data = dict(data, **merge)
     keep = ("metric", "value", "unit", "vs_baseline", "platform",
-            "device_kind", "fmt_used", "k128_ms", "k128_bf16_ms",
+            "device_kind", "fmt_used", "k128_ms", "k128_err",
+            "k128_from", "k128_bf16_ms",
             "frobenius_err_vs_cpu", "frobenius_gate", "achieved_gbps",
             "roofline_frac", "gather_rows_per_s", "config", "degraded")
     summary = {k: data[k] for k in keep if k in data}
