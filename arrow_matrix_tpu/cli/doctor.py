@@ -74,6 +74,48 @@ def probe_gloo() -> tuple[bool | None, str]:
                       f"multi-process CPU runs may not work")
 
 
+def probe_tunnel_infra() -> tuple[bool | None, str]:
+    """Relay-leg diagnosis for the axon tunnel (the round-4 root-cause
+    method, ROUND4.md): TCP-connect the relay port and the session/
+    stateless ports its redirects target.  A relay that accepts but
+    serves nothing (with the session ports closed) is the half-dead
+    infra wedge — unrecoverable client-side."""
+    import socket
+
+    relay = int(os.environ.get("AMT_AXON_RELAY_PORT", "2024"))
+    state = {}
+    for port in (relay, 8082, 8083):
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=3):
+                state[port] = "open"
+        except OSError:
+            state[port] = "closed"
+    detail = ", ".join(f"{p}:{s}" for p, s in state.items())
+    if state[relay] == "closed":
+        return None, f"relay port closed ({detail}) — no tunnel here"
+    if state[8082] == "closed" and state[8083] == "closed":
+        return None, (f"relay accepts but session ports are dead "
+                      f"({detail}) — the half-dead-relay wedge; "
+                      f"recovery is infra-side")
+    return True, detail
+
+
+def report_holders_and_registry() -> None:
+    from arrow_matrix_tpu.utils.platform import (
+        find_stale_plugin_holders,
+        read_preemptible,
+    )
+
+    holders = find_stale_plugin_holders()
+    _check("tunnel claim holders", True if not holders else None,
+           f"{holders} hold relay connections" if holders
+           else "none (no other process claims the chip)")
+    reg = read_preemptible()
+    _check("preemptible host jobs", True,
+           f"{reg} registered" if reg else "none registered")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -112,6 +154,10 @@ def main(argv=None) -> int:
     acc_ok, detail = probe_accelerator(args.probe_timeout)
     _check("accelerator (default backend, bounded probe)",
            True if acc_ok else None, detail)
+
+    t, detail = probe_tunnel_infra()
+    _check("tunnel relay/session ports", t, detail)
+    report_holders_and_registry()
 
     good, detail = probe_cpu_pool(args.devices)
     ok &= _check(f"virtual CPU pool ({args.devices} devices)", good,

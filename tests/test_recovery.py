@@ -1,0 +1,184 @@
+"""Unit tests for the tunnel-recovery machinery (utils.platform):
+probe-error classification, the stale-holder kill guards, the
+preemptible-job registry, and bench.py's on-chip evidence selection.
+This code only runs for real against a wedged accelerator, so the
+deterministic pieces must be pinned here."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from arrow_matrix_tpu.utils import platform as plat
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_classify_probe_error():
+    assert plat.classify_probe_error(None) is None
+    assert plat.classify_probe_error(
+        "backend probe timed out after 60s (PJRT plugin init hang)"
+    ) == "init-hang"
+    assert plat.classify_probe_error(
+        "rc=1: Backend 'axon' is not in the list of known backends"
+    ) == "no-device"
+    assert plat.classify_probe_error("rc=1: ImportError: boom") == "error"
+
+
+def test_reset_noop_under_fresh_busy_lock(tmp_path, monkeypatch):
+    """A fresh tpu_busy.lock means an on-chip stage is in flight:
+    recovery must refuse to touch anything."""
+    lock = os.path.join(REPO, "bench_cache", "tpu_busy.lock")
+    existed = os.path.exists(lock)
+    try:
+        with open(lock, "w") as f:
+            f.write("test\n")
+        assert plat.reset_tunnel_state(min_flat_s=0.1) == []
+    finally:
+        if not existed:
+            try:
+                os.remove(lock)
+            except OSError:
+                pass
+
+
+def test_preemptible_registry_roundtrip():
+    """register/read via a child process: the token self-cleans at
+    exit, a dead pid's stale token never matches, malformed tokens are
+    skipped individually."""
+    path = plat.preempt_registry_path()
+    code = (
+        "import os, sys, time; "
+        f"sys.path.insert(0, {REPO!r}); "
+        "from arrow_matrix_tpu.utils import platform as p; "
+        "p.register_preemptible(); "
+        "print(os.getpid(), flush=True); "
+        "time.sleep(10)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        child_pid = int(proc.stdout.readline().split()[0])
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if child_pid in plat.read_preemptible():
+                break
+            time.sleep(0.1)
+        assert child_pid in plat.read_preemptible()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    # stale token (if atexit didn't fire on terminate) must not match:
+    # the pid is dead, so starttime verification rejects it.
+    assert child_pid not in plat.read_preemptible()
+    # malformed tokens are skipped, valid ones survive
+    me = os.getpid()
+    start = plat.proc_starttime(me)
+    try:
+        with open(path, "a") as f:
+            f.write(f"garbage\n12x:34\n{me}:{start}\n")
+        assert me in plat.read_preemptible()
+    finally:
+        # remove our test tokens
+        with open(path) as f:
+            toks = [t for t in f.read().split()
+                    if t not in ("garbage", "12x:34", f"{me}:{start}")]
+        with open(path, "w") as f:
+            f.write("\n".join(toks) + ("\n" if toks else ""))
+
+
+def test_cpu_ticks_and_starttime():
+    assert plat._cpu_ticks(os.getpid()) >= 0
+    assert plat.proc_starttime(os.getpid()) is not None
+    assert plat._cpu_ticks(2**22 + 12345) is None   # unlikely pid
+
+
+def test_last_onchip_evidence_selection(tmp_path, monkeypatch):
+    """Newest spmm_iter_ms artifact wins; non-headline metrics are
+    skipped; same-config k128 merges in with provenance; a different
+    config's k128 does NOT."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    bdir = tmp_path / "bench_results"
+    bdir.mkdir()
+    (tmp_path / "bench_cache").mkdir()
+    cfg = {"n": 1024, "width": 64, "features": 16}
+    other_cfg = {"n": 2048, "width": 64, "features": 16}
+
+    def write(name, payload, age_s):
+        p = bdir / name
+        p.write_text(json.dumps(payload) + "\n")
+        t = time.time() - age_s
+        os.utime(p, (t, t))
+        return p
+
+    write("onchip_full.json",
+          {"metric": "spmm_iter_ms", "value": 100.0, "config": cfg,
+           "k128_ms": 110.0, "k128_err": 1e-7}, age_s=300)
+    write("onchip_ladder.json",
+          {"metric": "ladder_race", "value": 55.0}, age_s=100)
+    write("onchip_foldonly.json",
+          {"metric": "spmm_iter_ms", "value": 99.0, "config": cfg},
+          age_s=200)
+    monkeypatch.chdir(tmp_path)
+    ev = bench._last_onchip_evidence()
+    assert ev["path"].endswith("onchip_foldonly.json")   # newest headline
+    assert ev["summary"]["value"] == 99.0
+    assert ev["summary"]["k128_ms"] == 110.0             # merged
+    assert ev["summary"]["k128_from"].endswith("onchip_full.json")
+    # different-config k128 must not merge
+    write("onchip_other.json",
+          {"metric": "spmm_iter_ms", "value": 98.0,
+           "config": other_cfg}, age_s=50)
+    ev2 = bench._last_onchip_evidence()
+    assert ev2["path"].endswith("onchip_other.json")
+    assert "k128_ms" not in ev2["summary"]
+
+
+def test_signal_job_descendants():
+    """The watcher's _signal_job pauses a job's subprocess child too."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tw_test", os.path.join(REPO, "tools", "tunnel_watcher.py"))
+    tw = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tw)
+
+    code = ("import subprocess, sys, time; "
+            "c = subprocess.Popen([sys.executable, '-c', "
+            "'import time; time.sleep(30)']); "
+            "print(c.pid, flush=True); time.sleep(30)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        grandchild = int(proc.stdout.readline().split()[0])
+        tw._signal_job(proc.pid, signal.SIGSTOP)
+        time.sleep(0.3)
+
+        def state(pid):
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().split(")")[-1].split()[0]
+
+        assert state(proc.pid) == "T", "parent not stopped"
+        assert state(grandchild) == "T", "child not stopped"
+        tw._signal_job(proc.pid, signal.SIGCONT)
+        time.sleep(0.3)
+        assert state(proc.pid) in ("S", "R")
+        assert state(grandchild) in ("S", "R")
+    finally:
+        for p in (proc.pid, ):
+            try:
+                os.kill(p, signal.SIGCONT)
+            except OSError:
+                pass
+        proc.kill()
+        proc.wait(timeout=10)
+        try:
+            os.kill(grandchild, signal.SIGKILL)
+        except OSError:
+            pass
