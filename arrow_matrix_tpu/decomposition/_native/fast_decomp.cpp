@@ -464,15 +464,54 @@ int64_t symmetrize_structure_impl(int64_t n64, const int64_t *indptr,
     for (vid v = 0; v < n; ++v) t_ptr[v + 1] += t_ptr[v];
   }
 
-  // Transpose fill: row-major scan writes each column's bucket; the
-  // ascending row scan makes every transpose row sorted by construction.
+  // Transpose fill: the ascending row scan makes every transpose row
+  // sorted by construction.  Above a size cutoff the single-pass
+  // scatter (random writes across the whole t_idx span) is replaced
+  // by a BUCKETED two-pass fill: pass A streams (col, row) pairs into
+  // ~256 column-range buckets (sequential writes), pass B scatters
+  // within one bucket at a time (its fill span fits cache).  Each
+  // bucket receives entries in ascending row order, so the per-column
+  // order — and therefore the output — is bit-identical.
   std::vector<vid> t_idx(nnz);
   {
     PhaseTimer t("sym-transpose-fill");
-    std::vector<int64_t> fill(t_ptr.begin(), t_ptr.end() - 1);
-    for (vid u = 0; u < n; ++u) {
-      for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
-        t_idx[fill[indices[e]]++] = u;
+    if (nnz < (1 << 22)) {
+      std::vector<int64_t> fill(t_ptr.begin(), t_ptr.end() - 1);
+      for (vid u = 0; u < n; ++u) {
+        for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+          t_idx[fill[indices[e]]++] = u;
+        }
+      }
+    } else {
+      const int n_buckets = 256;
+      const int shift = [&] {
+        int s = 0;
+        while ((static_cast<int64_t>(n) >> s) > n_buckets) ++s;
+        return s;
+      }();
+      std::vector<int64_t> b_count(n_buckets + 1, 0);
+      for (vid u = 0; u < n; ++u) {
+        for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+          ++b_count[(indices[e] >> shift) + 1];
+        }
+      }
+      for (int b = 0; b < n_buckets; ++b) b_count[b + 1] += b_count[b];
+      std::vector<uint64_t> pairs(nnz);   // (col << 32) | row
+      {
+        std::vector<int64_t> bf(b_count.begin(), b_count.end() - 1);
+        for (vid u = 0; u < n; ++u) {
+          for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+            vid c = static_cast<vid>(indices[e]);
+            pairs[bf[c >> shift]++] = pack_edge(c, u);
+          }
+        }
+      }
+      std::vector<int64_t> fill(t_ptr.begin(), t_ptr.end() - 1);
+      for (int b = 0; b < n_buckets; ++b) {
+        for (int64_t i = b_count[b]; i < b_count[b + 1]; ++i) {
+          vid c = static_cast<vid>(pairs[i] >> 32);
+          t_idx[fill[c]++] = static_cast<vid>(pairs[i] & 0xffffffffu);
+        }
       }
     }
   }
