@@ -17,6 +17,11 @@ same jitted multi-level arrow SpMM:
     propagation on the same operator.
   * :func:`~arrow_matrix_tpu.models.propagation.label_propagation` —
     masked seed-clamped propagation for semi-supervised labeling.
+  * :func:`~arrow_matrix_tpu.models.propagation.conjugate_gradient` —
+    CG solver for ``(shift*I + A) x = b`` on the feature-major
+    executors (fold / sell / sell-space): the classic iterated-SpMM
+    linear-algebra consumer, one distributed SpMM + masked dots per
+    iteration.
 """
 
 from arrow_matrix_tpu.models.propagation import (
@@ -34,6 +39,7 @@ from arrow_matrix_tpu.models.propagation import (
     make_appnp_train_step,
     make_gcn_train_step,
     make_train_step,
+    conjugate_gradient,
     pagerank,
     pagerank_carried,
     power_iteration,
@@ -54,6 +60,7 @@ __all__ = [
     "make_appnp_train_step",
     "make_gcn_train_step",
     "make_train_step",
+    "conjugate_gradient",
     "pagerank",
     "pagerank_carried",
     "power_iteration",

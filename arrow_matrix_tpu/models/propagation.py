@@ -700,3 +700,76 @@ def _make_carried_appnp_forward(step_fn, hops: int, alpha: float):
         return z
 
     return forward
+
+
+# ---------------------------------------------------------------------
+# Conjugate gradient on the distributed SpMM operator.  The classic
+# iterated-SpMM consumer the reference's workload class feeds
+# (reference README.md:3: iterated X := A @ X for graph analytics):
+# solving (shift*I + A) x = b exercises exactly one distributed SpMM
+# plus axpy/dot per iteration.
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _cg_carried_iter(step_fn, x, r, p, rz, shift, mask, operands):
+    """One CG iteration in carried layout.  All reductions are masked
+    by ``carried_mask`` (pads hold routed filler after a step; the
+    space-shared carriage holds K copies of each row — the mask counts
+    every original row exactly once, so the dots equal their host
+    values)."""
+    ap = shift * p + step_fn(p, *operands)
+    denom = jnp.sum(p * ap * mask, dtype=jnp.float32)
+    alpha = rz / jnp.where(denom == 0, 1.0, denom)
+    x = x + alpha * p
+    r = r - alpha * ap
+    rz_new = jnp.sum(r * r * mask, dtype=jnp.float32)
+    beta = rz_new / jnp.where(rz == 0, 1.0, rz)
+    p = r + beta * p
+    return x, r, p, rz_new
+
+
+def conjugate_gradient(multi, b: np.ndarray, *, shift: float,
+                       iterations: int = 50,
+                       tol: float = 0.0) -> tuple[np.ndarray, float]:
+    """Solve ``(shift*I + A) x = b`` by CG on a feature-major executor
+    (fold / SellMultiLevel / SellSpaceShared).
+
+    ``A`` is the executor's (symmetric) operator; ``shift`` must make
+    ``shift*I + A`` positive definite — for a symmetric adjacency any
+    ``shift > max degree`` suffices (strict diagonal dominance).
+    ``b`` is (n, k); each feature column is an independent system (the
+    dots reduce over carried positions per column and sum — standard
+    block-CG-free multi-RHS treatment: one shared step, per-column
+    convergence not separated, matching the framework's feature-major
+    batching).  Returns ``(x, final_residual_norm)`` with ``x``
+    gathered to host order.
+
+    ``tol`` > 0 stops early when ||r|| / ||b|| drops below it (checked
+    on host once per iteration — one scalar fetch against a chained
+    device step; pass 0 to run a fixed count with no host syncs).
+    """
+    _check_carried(multi, "conjugate_gradient")
+    b = np.asarray(b, dtype=np.float32)
+    squeeze = b.ndim == 1
+    if squeeze:
+        b = b[:, None]
+    bt = multi.set_features(b)
+    mask = _carried_mask_or_ones(multi, bt.shape[1])
+    operands = multi.step_operands()
+    x = jnp.zeros_like(bt)
+    r = bt
+    p = bt
+    rz = jnp.sum(r * r * mask, dtype=jnp.float32)
+    # Host syncs only in tol mode: the fixed-count path stays fully
+    # async until the final gather.
+    b_norm = float(jnp.sqrt(rz)) if tol > 0.0 else None
+    sh = jnp.float32(shift)
+    for _ in range(iterations):
+        x, r, p, rz = _cg_carried_iter(multi.step_fn, x, r, p, rz, sh,
+                                       mask, operands)
+        if tol > 0.0 and float(jnp.sqrt(rz)) <= tol * max(b_norm, 1e-30):
+            break
+    out = multi.gather_result(x)
+    if squeeze:
+        out = out[:, 0]
+    return out, float(jnp.sqrt(rz))
