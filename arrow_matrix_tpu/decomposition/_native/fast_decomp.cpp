@@ -527,8 +527,13 @@ int64_t symmetrize_structure_impl(int64_t n64, const int64_t *indptr,
       const int64_t a_lo = indptr[u], a_hi = indptr[u + 1];
       arow.assign(indices + a_lo, indices + a_hi);
       // Input CSR rows are not guaranteed canonical (the decomposer
-      // accepts any tocsr()); sort+dedup the A row locally.
-      std::sort(arow.begin(), arow.end());
+      // accepts any tocsr()); sort+dedup the A row locally.  Most
+      // rows ARE already sorted (level_split emits canonical levels
+      // and row-ordered rests) — the linear is_sorted check skips the
+      // O(d log d) sort for them.
+      if (!std::is_sorted(arow.begin(), arow.end())) {
+        std::sort(arow.begin(), arow.end());
+      }
       arow.erase(std::unique(arow.begin(), arow.end()), arow.end());
       const vid *b = t_idx.data() + t_ptr[u];
       const vid *b_end = t_idx.data() + t_ptr[u + 1];
