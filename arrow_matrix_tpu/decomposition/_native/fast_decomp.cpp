@@ -484,9 +484,14 @@ int64_t symmetrize_structure_impl(int64_t n64, const int64_t *indptr,
       }
     } else {
       const int n_buckets = 256;
+      // Shift must be derived from the MAX ID (n-1), not n: bucket
+      // index is (id >> shift) and must stay < n_buckets for every
+      // id.  Deriving it from n left id n-1 mapping to bucket 256
+      // for any n in (256*2^s, 257*2^s] — an out-of-bounds b_count/
+      // bf write AND a bucket pass B never scattered (ADVICE r4).
       const int shift = [&] {
         int s = 0;
-        while ((static_cast<int64_t>(n) >> s) > n_buckets) ++s;
+        while ((static_cast<int64_t>(n - 1) >> s) >= n_buckets) ++s;
         return s;
       }();
       std::vector<int64_t> b_count(n_buckets + 1, 0);

@@ -171,8 +171,15 @@ def build_route(table: np.ndarray, n_dev: int,
         pair = (src_dev[cross].astype(np.int64) * n_dev
                 + dst_dev[cross])
         # keys are unique (j embedded), so the default sort is already
-        # deterministic — no stable mergesort needed.
-        order = np.argsort((pair << 32) | cross.astype(np.int64))
+        # deterministic — no stable mergesort needed.  The packing
+        # gives j the LOW 32 bits: past 2^32 entries j would spill
+        # into the pair bits and silently break the claimed lexsort
+        # equivalence, so fall back to the real lexsort there
+        # (ADVICE r4; the int64 idx_dt switch above survives to 2^63).
+        if cross[-1] < (1 << 32):
+            order = np.argsort((pair << 32) | cross.astype(np.int64))
+        else:
+            order = np.lexsort((cross, pair))
         cross = cross[order]
         s, d = src_dev[cross], dst_dev[cross]
         slot = slots_within_groups(s * n_dev + d)

@@ -176,6 +176,31 @@ def test_symmetrize_structure_matches_scipy():
     assert np.array_equal(o_pair, o_mat)
 
 
+@pytest.mark.slow
+def test_symmetrize_bucketed_fill_non_pow2_n():
+    """The bucketed transpose fill (input nnz >= 2^22) with a
+    NON-power-of-two n: the max column id n-1 must map to a valid
+    bucket.  Regression for ADVICE r4 (high): the bucket shift was
+    derived from n instead of n-1, so for any n in (256*2^s,
+    257*2^s] id n-1 landed in bucket 256 of a 256-bucket table —
+    out-of-bounds b_count/bf heap writes (observed SIGABRT at
+    n=2^22+1) and a 257th bucket pass B never scattered."""
+    rng = np.random.default_rng(13)
+    n = (1 << 22) + 1          # in (256*2^14, 257*2^14]
+    nnz = 1 << 23              # >= the 2^22 bucketed-path cutoff
+    rows = rng.integers(0, n, nnz)
+    cols = rng.integers(0, n, nnz)
+    # Ensure the overflowing ids actually occur in the index stream.
+    cols[:16] = n - 1
+    a = sparse.csr_matrix(
+        (np.ones(nnz, np.float32), (rows, cols)), shape=(n, n))
+    assert a.indptr[-1] >= (1 << 22)
+    want = symmetrize(a)
+    indptr, indices = native.symmetrize_structure(a)
+    assert np.array_equal(indptr, want.indptr.astype(np.int64))
+    assert np.array_equal(indices, want.indices.astype(np.int32))
+
+
 def test_threaded_native_parity():
     """AMT_DECOMP_THREADS must not change any native output (per-range
     buffers merge in deterministic order).  n must exceed
