@@ -21,10 +21,16 @@
 // identical decomposition — as v1.
 //
 // Threading: AMT_DECOMP_THREADS (default: hardware concurrency,
-// clamped to 16) parallelizes the edge-extraction and symmetrize
-// counting passes with deterministic output (per-range buffers merged
-// in order).  The Kruskal scan and tree DFS are inherently sequential
-// (one union-find; one giant component on power-law graphs).
+// clamped to 16) parallelizes edge extraction, symmetrize counting,
+// the Kruskal scan (filter-Kruskal: parallel read-only connectivity
+// filter between sequential unite passes — the unique-MSF argument
+// makes the forest bit-identical to the plain scan), the forest-
+// adjacency fill (destination-range partitioning), and large-
+// component linearization (level-synchronous sweeps reproducing the
+// DFS emit positions exactly — see linearize_tree_levelsync).  Every
+// output is thread-count-invariant and bit-identical to the
+// single-thread stream; only the Fisher-Yates shuffle is inherently
+// sequential (it IS the seed contract).
 //
 // Algorithms (matching arrow_matrix_tpu/decomposition/linearize.py):
 //   amt_random_forest_order[_i32]: uniformly random spanning forest by
@@ -83,9 +89,12 @@ int n_threads() {
 }
 
 // Run fn(t, lo, hi) over [0, n) split into T contiguous ranges.
+// min_n: below this the call runs inline sequential (spawn cost floor);
+// the level-synchronous sweeps pass a lower floor than the default —
+// their per-element work is an adjacency scan + sort, not a counter.
 template <typename F>
-void parallel_ranges(int64_t n, int T, F fn) {
-  if (T <= 1 || n < (1 << 16)) {
+void parallel_ranges(int64_t n, int T, F fn, int64_t min_n = 1 << 16) {
+  if (T <= 1 || n < min_n) {
     fn(0, 0, n);
     return;
   }
@@ -123,6 +132,15 @@ struct UnionFind {
       parent[x] = parent[parent[x]];
       x = parent[x];
     }
+    return x;
+  }
+
+  // Read-only find for CONCURRENT use (no path halving, no writes).
+  // Union-by-size bounds the chain at O(log n).  Only valid while no
+  // thread is mutating — the filter-Kruskal phases alternate strictly
+  // between parallel read-only filtering and sequential uniting.
+  vid find_ro(vid x) const {
+    while (parent[x] != x) x = parent[x];
     return x;
   }
 
@@ -198,6 +216,146 @@ void linearize_tree(vid root, const std::vector<int64_t> &adj_ptr,
   }
 }
 
+// Level-synchronous linearization of ONE large tree — emit-order-
+// IDENTICAL to linearize_tree, with every sweep parallel over a level:
+//
+// 1. parents by tree-BFS.  In a tree the parent of v is its unique
+//    neighbor on the path to the root, so the parent array is a
+//    property of (tree, root), not of traversal order — BFS and DFS
+//    produce the same parents.  Each unvisited vertex is adjacent to
+//    exactly ONE frontier vertex (its parent), so frontier expansion
+//    has no write conflicts and needs no atomics.
+// 2. subtree sizes bottom-up per level: subtree[v] = 1 + sum over
+//    children (one level deeper, already final).
+// 3. emit positions top-down per level.  The sequential DFS emits v at
+//    the start of its subtree block, then the children blocks in
+//    increasing (subtree, id) order — so pos[child] = pos[v] + 1 +
+//    total size of smaller siblings, a per-vertex computation once
+//    pos[v] is known.  Same comparator as linearize_tree's kids sort.
+// 4. scatter out[pos[v]] = v (positions are a permutation — disjoint).
+//
+// Within-level ORDER of the bfs array depends on the thread partition,
+// but nothing below derives from it (levels are sets); the OUTPUT is
+// thread-count-invariant and bit-identical to the sequential path.
+constexpr int64_t kLevelParMin = 1 << 13;
+
+void linearize_tree_levelsync(vid root, const std::vector<int64_t> &adj_ptr,
+                              const std::vector<vid> &adj,
+                              std::vector<vid> &parent,
+                              std::vector<vid> &subtree,
+                              std::vector<vid> &pos, std::vector<vid> &order,
+                              std::vector<int64_t> &level_lo, int T,
+                              int64_t *out, int64_t &out_pos) {
+  order.clear();
+  level_lo.clear();
+  order.push_back(root);
+  parent[root] = -1;
+  level_lo.push_back(0);
+  // Pass 1: BFS levels.
+  {
+    std::vector<std::vector<vid>> parts(std::max(T, 1));
+    size_t lo = 0;
+    while (lo < order.size()) {
+      size_t hi = order.size();
+      int64_t width = static_cast<int64_t>(hi - lo);
+      if (T <= 1 || width < kLevelParMin) {
+        for (size_t i = lo; i < hi; ++i) {
+          vid v = order[i];
+          for (int64_t e = adj_ptr[v]; e < adj_ptr[v + 1]; ++e) {
+            vid u = adj[e];
+            if (u != parent[v]) {
+              parent[u] = v;
+              order.push_back(u);
+            }
+          }
+        }
+      } else {
+        parallel_ranges(width, T, [&](int tid, int64_t a, int64_t b) {
+          auto &buf = parts[tid];
+          buf.clear();
+          for (int64_t i = a; i < b; ++i) {
+            vid v = order[lo + i];
+            for (int64_t e = adj_ptr[v]; e < adj_ptr[v + 1]; ++e) {
+              vid u = adj[e];
+              if (u != parent[v]) {
+                parent[u] = v;   // u's unique parent: conflict-free
+                buf.push_back(u);
+              }
+            }
+          }
+        }, kLevelParMin);
+        for (auto &p : parts) {
+          order.insert(order.end(), p.begin(), p.end());
+          p.clear();
+        }
+      }
+      lo = hi;
+      level_lo.push_back(static_cast<int64_t>(order.size()));
+    }
+  }
+  const int n_levels = static_cast<int>(level_lo.size()) - 1;
+  if (std::getenv("AMT_DECOMP_PROFILE") != nullptr) {
+    int64_t widest = 0;
+    for (int L = 0; L < n_levels; ++L) {
+      widest = std::max(widest, level_lo[L + 1] - level_lo[L]);
+    }
+    // widest >= kLevelParMin (2^13) means the per-level sweeps
+    // actually ran their parallel branch, not just the level-sync
+    // dispatch — the attribution the parity tests need.
+    std::fprintf(stderr,
+                 "[decomp-native] levelsync: %lld vertices, %d levels, "
+                 "widest %lld\n",
+                 static_cast<long long>(order.size()), n_levels,
+                 static_cast<long long>(widest));
+  }
+  // Pass 2: subtree sizes, deepest level first.
+  for (int L = n_levels - 1; L >= 0; --L) {
+    int64_t lo = level_lo[L], width = level_lo[L + 1] - level_lo[L];
+    parallel_ranges(width, T, [&](int, int64_t a, int64_t b) {
+      for (int64_t i = a; i < b; ++i) {
+        vid v = order[lo + i];
+        vid s = 1;
+        for (int64_t e = adj_ptr[v]; e < adj_ptr[v + 1]; ++e) {
+          vid u = adj[e];
+          if (parent[u] == v) s += subtree[u];
+        }
+        subtree[v] = s;
+      }
+    }, kLevelParMin);
+  }
+  // Pass 3: positions, top level first.
+  pos[root] = static_cast<vid>(out_pos);
+  for (int L = 0; L < n_levels; ++L) {
+    int64_t lo = level_lo[L], width = level_lo[L + 1] - level_lo[L];
+    parallel_ranges(width, T, [&](int, int64_t a, int64_t b) {
+      std::vector<std::pair<vid, vid>> kids;
+      for (int64_t i = a; i < b; ++i) {
+        vid v = order[lo + i];
+        kids.clear();
+        for (int64_t e = adj_ptr[v]; e < adj_ptr[v + 1]; ++e) {
+          vid u = adj[e];
+          if (parent[u] == v) kids.emplace_back(subtree[u], u);
+        }
+        std::sort(kids.begin(), kids.end());
+        vid p = pos[v] + 1;
+        for (auto &su : kids) {
+          pos[su.second] = p;
+          p += su.first;
+        }
+      }
+    }, kLevelParMin);
+  }
+  // Pass 4: scatter.
+  int64_t total = static_cast<int64_t>(order.size());
+  parallel_ranges(total, T, [&](int, int64_t a, int64_t b) {
+    for (int64_t i = a; i < b; ++i) {
+      vid v = order[i];
+      out[pos[v]] = v;
+    }
+  }, kLevelParMin);
+  out_pos += total;
+}
+
 // Core of the random-forest linearization once the unique undirected
 // edge list (u < v, packed, vertex ids in [0, n)) is in hand:
 // shuffled-edge Kruskal, forest adjacency, per-component emit.
@@ -222,47 +380,110 @@ int forest_order_from_edges(vid n, std::vector<uint64_t> &edges,
     }
   }
 
+  const int T = n_threads();
   UnionFind uf(n);
   std::vector<vid> tu, tv;
   {
-    PhaseTimer t("kruskal");
+    PhaseTimer t(T > 1 && m >= (1 << 19) ? "kruskal-filter" : "kruskal");
     tu.reserve(n);
     tv.reserve(n);
-    for (int64_t i = 0; i < m; ++i) {
+    auto unite_edge = [&](int64_t i) {
       vid a = static_cast<vid>(edges[i] >> 32);
       vid b = static_cast<vid>(edges[i] & 0xffffffffu);
       if (uf.unite(a, b)) {
         tu.push_back(a);
         tv.push_back(b);
       }
+    };
+    if (T <= 1 || m < (1 << 19)) {
+      for (int64_t i = 0; i < m; ++i) unite_edge(i);
+    } else {
+      // Filter-Kruskal over the shuffled stream (the shuffled position
+      // IS the random weight, so the MSF is unique): unite the first
+      // chunk sequentially, then for each subsequent (doubling) chunk
+      // first drop — in parallel, with the read-only find — every edge
+      // whose endpoints are already connected.  Filtering only removes
+      // edges that can never be tree edges at their position, so the
+      // tree-edge sequence (and the forest) is BIT-IDENTICAL to the
+      // plain scan for every thread count.  After the first ~2n edges
+      // the forest is nearly complete and the filter kills almost all
+      // of the remaining stream, leaving the sequential unite with
+      // O(n)-ish survivors.
+      int64_t done = std::min<int64_t>(
+          m, std::max<int64_t>(2 * static_cast<int64_t>(n), 1 << 19));
+      for (int64_t i = 0; i < done; ++i) unite_edge(i);
+      std::vector<char> keep;
+      int64_t chunk = done;
+      while (done < m) {
+        int64_t c = std::min(m - done, chunk);
+        keep.assign(c, 0);
+        parallel_ranges(c, T, [&](int, int64_t lo, int64_t hi) {
+          for (int64_t i = lo; i < hi; ++i) {
+            vid a = static_cast<vid>(edges[done + i] >> 32);
+            vid b = static_cast<vid>(edges[done + i] & 0xffffffffu);
+            keep[i] = uf.find_ro(a) != uf.find_ro(b);
+          }
+        });
+        for (int64_t i = 0; i < c; ++i) {
+          if (keep[i]) unite_edge(done + i);
+        }
+        done += c;
+        chunk *= 2;
+      }
     }
   }
   edges.clear();
   edges.shrink_to_fit();
 
-  // Forest adjacency (CSR, both directions).
+  // Forest adjacency (CSR, both directions).  Parallel mode partitions
+  // by DESTINATION vertex range (the sym-transpose-count recipe): each
+  // thread scans the whole tree-edge list but touches only its own
+  // disjoint adj_ptr/fill/adj slice, in the same scan order — output
+  // identical to the sequential fill, no atomics.
   std::vector<int64_t> adj_ptr(n + 1, 0);
   std::vector<vid> adj;
   {
     PhaseTimer t("forest-adjacency");
-    for (size_t i = 0; i < tu.size(); ++i) {
-      ++adj_ptr[tu[i] + 1];
-      ++adj_ptr[tv[i] + 1];
+    const int64_t nt = static_cast<int64_t>(tu.size());
+    if (T <= 1 || n < (1 << 18)) {
+      for (int64_t i = 0; i < nt; ++i) {
+        ++adj_ptr[tu[i] + 1];
+        ++adj_ptr[tv[i] + 1];
+      }
+    } else {
+      parallel_ranges(n, T, [&](int, int64_t v_lo, int64_t v_hi) {
+        for (int64_t i = 0; i < nt; ++i) {
+          if (tu[i] >= v_lo && tu[i] < v_hi) ++adj_ptr[tu[i] + 1];
+          if (tv[i] >= v_lo && tv[i] < v_hi) ++adj_ptr[tv[i] + 1];
+        }
+      });
     }
     for (vid v = 0; v < n; ++v) adj_ptr[v + 1] += adj_ptr[v];
     adj.resize(adj_ptr[n]);
     std::vector<int64_t> fill(adj_ptr.begin(), adj_ptr.end() - 1);
-    for (size_t i = 0; i < tu.size(); ++i) {
-      adj[fill[tu[i]]++] = tv[i];
-      adj[fill[tv[i]]++] = tu[i];
+    if (T <= 1 || n < (1 << 18)) {
+      for (int64_t i = 0; i < nt; ++i) {
+        adj[fill[tu[i]]++] = tv[i];
+        adj[fill[tv[i]]++] = tu[i];
+      }
+    } else {
+      parallel_ranges(n, T, [&](int, int64_t v_lo, int64_t v_hi) {
+        for (int64_t i = 0; i < nt; ++i) {
+          if (tu[i] >= v_lo && tu[i] < v_hi) adj[fill[tu[i]]++] = tv[i];
+          if (tv[i] >= v_lo && tv[i] < v_hi) adj[fill[tv[i]]++] = tu[i];
+        }
+      });
     }
   }
 
   // Emit components in order of smallest member (scipy's label order in
   // linearize.py).  parent doubles as the visited marker: -2 unvisited.
-  PhaseTimer t_emit("linearize-emit");
+  PhaseTimer t_emit(T > 1 ? "linearize-emit-par" : "linearize-emit");
   std::vector<vid> parent(n, -2), subtree(n, 0), preorder, stack;
   std::vector<vid> members;
+  // Scratch for the level-synchronous path, allocated on first use.
+  std::vector<vid> ls_pos, ls_order;
+  std::vector<int64_t> ls_levels;
   int64_t out_pos = 0;
   for (vid v = 0; v < n; ++v) {
     if (parent[v] != -2) continue;
@@ -286,6 +507,13 @@ int forest_order_from_edges(vid n, std::vector<uint64_t> &edges,
       }
       std::sort(members.begin(), members.end());
       for (vid w : members) out[out_pos++] = w;
+    } else if (T > 1 && comp_size >= (1 << 16)) {
+      if (ls_pos.empty()) {
+        ls_pos.resize(n);
+        ls_order.reserve(comp_size);
+      }
+      linearize_tree_levelsync(v, adj_ptr, adj, parent, subtree, ls_pos,
+                               ls_order, ls_levels, T, out, out_pos);
     } else {
       linearize_tree(v, adj_ptr, adj, parent, subtree, preorder, stack,
                      out, out_pos);

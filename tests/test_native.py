@@ -177,6 +177,49 @@ def test_symmetrize_structure_matches_scipy():
 
 
 @pytest.mark.slow
+def test_parallel_decomposer_thread_invariance_at_scale():
+    """The parallel MSF (filter-Kruskal), parallel forest-adjacency
+    fill, and level-synchronous linearization (VERDICT r4 item 3) must
+    be BIT-identical to the single-thread stream for every thread
+    count.  n=2^20 crosses every parallel threshold: m >= 2^19
+    (filter-Kruskal), n >= 2^18 (adjacency fill), comp >= 2^16 with
+    BFS levels >= 2^13 wide (level-sync sweeps' parallel branch —
+    widest level ~28k on this graph).  Covers both graph classes and
+    the masked path."""
+    import os
+
+    n = 1 << 20
+    prior = os.environ.get("AMT_DECOMP_THREADS")
+    try:
+        for gen, kw in ((barabasi_albert, dict(m=4)),
+                        (erdos_renyi, dict(p=8 / n))):
+            a = symmetrize(gen(n, seed=9, **kw))
+            outs = {}
+            for t in (1, 2, 8):
+                os.environ["AMT_DECOMP_THREADS"] = str(t)
+                outs[t] = native.random_forest_order(
+                    a, np.random.default_rng(4))
+            assert np.array_equal(np.sort(outs[1]), np.arange(n))
+            for t in (2, 8):
+                assert np.array_equal(outs[1], outs[t]), (gen.__name__, t)
+            deg = np.diff(a.indptr)
+            middle = np.argsort(-deg, kind="stable")[256:]
+            middle = middle[deg[middle] > 0]
+            os.environ["AMT_DECOMP_THREADS"] = "1"
+            m1 = native.random_forest_order_masked(
+                a, middle, np.random.default_rng(7))
+            os.environ["AMT_DECOMP_THREADS"] = "8"
+            m8 = native.random_forest_order_masked(
+                a, middle, np.random.default_rng(7))
+            assert np.array_equal(m1, m8)
+    finally:
+        if prior is None:
+            os.environ.pop("AMT_DECOMP_THREADS", None)
+        else:
+            os.environ["AMT_DECOMP_THREADS"] = prior
+
+
+@pytest.mark.slow
 def test_symmetrize_bucketed_fill_non_pow2_n():
     """The bucketed transpose fill (input nnz >= 2^22) with a
     NON-power-of-two n: the max column id n-1 must map to a valid

@@ -318,6 +318,42 @@ def test_sell_bf16_feature_carriage():
                           feature_dtype="f32").feature_dtype is None
 
 
+def test_sell_slim_bf16_halo_bytes_halved():
+    """bf16 carriage on the single-matrix SellSlim path: the halo
+    ppermute exchanges must CARRY bf16 (lowered HLO shows exactly half
+    the f32 twin's collective bytes — VERDICT r4 item 7: the bytes
+    must ride the exchanges, not just the resident features), and the
+    result stays within bf16 rounding of the golden."""
+    import ml_dtypes
+
+    from arrow_matrix_tpu.utils import commstats
+
+    n, w = 768, 32
+    a = barabasi_albert(n, 4, seed=13).astype(np.float32)
+    mesh = make_mesh((4,), ("blocks",))
+    d16 = SellSlim(a, w, mesh, feature_dtype="bf16")
+    df = SellSlim(a, w, mesh)
+    assert np.max(d16.ops.hops) > 0   # the halo exchange must exist
+    x = random_dense(n, 8, seed=2)
+    xt = d16.set_features(x)
+    assert xt.dtype == ml_dtypes.bfloat16
+    out = d16.gather_result(d16.spmm(xt))
+    assert out.dtype == np.float32
+    want = a @ x
+    rel = np.linalg.norm(out - want) / np.linalg.norm(want)
+    assert rel < 2e-2, rel
+
+    def stats(d, xt):
+        o = d.ops
+        return commstats.lowered_collective_stats(
+            d._step, o.body, o.head, o.head_unsort, o.orig_pos, xt)
+
+    s16 = stats(d16, xt)
+    sf = stats(df, df.set_features(x))
+    assert s16["total_bytes"] > 0
+    assert s16["total_bytes"] * 2 == sf["total_bytes"]
+
+
 def test_per_host_build_equivalence():
     """The per-host build (_slim_shares materialize=subset) must agree
     with the full build on every global decision — tier ladder, shared

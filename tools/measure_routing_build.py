@@ -1,19 +1,16 @@
 """Measure the host-global routing-table build at scale (VERDICT r3
-item 9).
+item 9; streamed two-pass build VERDICT r4 item 4).
 
-``routing.build_route`` composes full-length int arrays per level pair
-on ONE host — the acknowledged host-global remainder of the otherwise
-streamed multi-level build.  This tool measures its wall time and peak
-RSS at total = 2^24..2^26 rows on a realistic table (a random
-permutation, the worst case for pair skew: every row moves), appends
-the numbers to ``bench_results/routing_build.json``, and prints them.
-
-The measured model (documented in PERFORMANCE.md): the build is
-~12 full-length vector passes, so time is linear in ``total`` and peak
-incremental memory is ~13 x 8 B x total.  At 10^8 rows that is ~10 GB
-and O(1 min) — within one fat host's budget, which is why the build is
-documented + guarded (parallel/routing.py warns loudly when the
-estimate exceeds available RAM) rather than streamed per shard.
+``routing.build_route`` composes the exchange tables for one level
+pair on ONE host.  The in-memory build materializes ~13 full-length
+derived vectors plus a global sort (measured linear, ~13 x 8 B x total
+peak incremental RSS — ~10 GB at 10^8 rows).  Round 5 added the
+chunked two-pass streamed build (auto above 2^24 rows): scratch is
+bounded to O(chunk) and the peak becomes the OUTPUT tables plus one
+chunk.  This tool measures both modes in ISOLATED subprocesses (peak
+RSS is a per-process high-water mark), asserts the tables are
+byte-identical via sha256, and appends the numbers to
+``bench_results/routing_build.json``.
 
 Usage: PYTHONPATH=/root/repo python tools/measure_routing_build.py
 """
@@ -22,51 +19,79 @@ from __future__ import annotations
 
 import json
 import os
-import resource
+import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from arrow_matrix_tpu.utils.platform import force_cpu_devices  # noqa: E402
-
+CHILD = r"""
+import hashlib, json, os, resource, sys, time
+sys.path.insert(0, {repo!r})
+from arrow_matrix_tpu.utils.platform import force_cpu_devices
 force_cpu_devices()
+import numpy as np
+from arrow_matrix_tpu.parallel.routing import build_route
 
-import numpy as np  # noqa: E402
+log2, n_dev, mode = {log2}, {n_dev}, {mode!r}
+total = 1 << log2
+rng = np.random.default_rng(log2)
+table = rng.permutation(total)
+rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+t0 = time.perf_counter()
+route = build_route(table, n_dev,
+                    stream_chunk=(1 << 62) if mode == "memory" else None)
+dt = time.perf_counter() - t0
+h = hashlib.sha256()
+bytes_tables = 0
+for name in ("local_src", "local_dst", "send_idx", "recv_dst"):
+    a = np.asarray(getattr(route, name))
+    bytes_tables += a.nbytes
+    h.update(name.encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+print(json.dumps({{
+    "mode": mode, "build_s": round(dt, 1),
+    "peak_rss_gb": round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20, 2),
+    "rss_before_gb": round(rss0, 2),
+    "table_bytes_gb": round(bytes_tables / 2**30, 3),
+    "sha256": h.hexdigest(),
+}}))
+"""
 
-from arrow_matrix_tpu.parallel.routing import build_route  # noqa: E402
 
-
-def _rss_gb() -> float:
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+def run_child(log2: int, n_dev: int, mode: str) -> dict:
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         CHILD.format(repo=REPO, log2=log2, n_dev=n_dev, mode=mode)],
+        capture_output=True, text=True, timeout=3600)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{mode} child failed:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def main() -> None:
     n_dev = int(os.environ.get("AMT_ROUTE_DEVS", 8))
     out = {"n_dev": n_dev, "rungs": {}}
-    for log2 in (24, 25, 26):
-        total = 1 << log2
-        rng = np.random.default_rng(log2)
-        table = rng.permutation(total)
-        rss0 = _rss_gb()
-        t0 = time.perf_counter()
-        route = build_route(table, n_dev)
-        dt = time.perf_counter() - t0
-        bytes_tables = sum(
-            int(np.asarray(a).nbytes)
-            for a in (route.local_src, route.local_dst,
-                      route.send_idx, route.recv_dst))
-        out["rungs"][f"2^{log2}"] = {
-            "total_rows": total,
-            "build_s": round(dt, 1),
-            "peak_rss_gb": round(_rss_gb(), 2),
-            "rss_before_gb": round(rss0, 2),
-            "table_bytes_gb": round(bytes_tables / 2**30, 3),
-        }
-        print(f"2^{log2}: build {dt:.1f}s, peak RSS {_rss_gb():.1f} GB, "
-              f"tables {bytes_tables / 2**30:.2f} GB", flush=True)
-        del route, table
+    for log2 in (24, 26):
+        rung: dict = {"total_rows": 1 << log2}
+        for mode in ("memory", "streamed"):
+            r = run_child(log2, n_dev, mode)
+            rung[mode] = r
+            print(f"2^{log2} {mode}: build {r['build_s']}s, peak RSS "
+                  f"{r['peak_rss_gb']} GB (before {r['rss_before_gb']}), "
+                  f"tables {r['table_bytes_gb']} GB", flush=True)
+        rung["identical"] = (rung["memory"]["sha256"]
+                             == rung["streamed"]["sha256"])
+        assert rung["identical"], f"2^{log2}: streamed tables differ!"
+        rung["rss_cut"] = round(
+            (rung["memory"]["peak_rss_gb"] - rung["memory"]["rss_before_gb"])
+            / max(rung["streamed"]["peak_rss_gb"]
+                  - rung["streamed"]["rss_before_gb"], 1e-9), 2)
+        print(f"2^{log2}: identical tables, incremental-RSS cut "
+              f"{rung['rss_cut']}x", flush=True)
+        out["rungs"][f"2^{log2}"] = rung
     path = os.path.join(REPO, "bench_results", "routing_build.json")
     try:
         with open(path) as f:
