@@ -39,6 +39,7 @@ arrow_dec_mpi.py:703-749, becomes plain zero-row padding here).
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional, Sequence
 
 import jax
@@ -466,6 +467,9 @@ class MultiLevelArrow:
             return out
 
         self._scan_steps = jax.jit(scan_steps, static_argnames=("n",))
+        self._scan_steps_donated = jax.jit(scan_steps,
+                                           static_argnames=("n",),
+                                           donate_argnums=(0,))
 
     # -- folded single-chip execution --------------------------------------
 
@@ -543,6 +547,13 @@ class MultiLevelArrow:
                                     slot_align=slot_align)
         self.perm0 = self.perm0[order]
         self.inv_perm0 = np.argsort(self.perm0)
+        self._finalize_folded(sell, chunk, gather_budget)
+
+    def _finalize_folded(self, sell, chunk, gather_budget: int) -> None:
+        """Install a packed SELL operator as the fold execution state
+        (shared by the levels build and ``load_folded``)."""
+        from arrow_matrix_tpu.ops.sell import sell_spmm_t
+
         self.blocks = [sell]
         self.fmts = ["fold"]
         self.routing = "none"
@@ -564,6 +575,93 @@ class MultiLevelArrow:
             return out
 
         self._scan_steps = jax.jit(fold_scan, static_argnames=("n",))
+        self._scan_steps_donated = jax.jit(fold_scan,
+                                           static_argnames=("n",),
+                                           donate_argnums=(0,))
+
+    def export_folded(self, out_dir: str) -> None:
+        """Write the PACKED fold operator to ``out_dir`` (per-tier SELL
+        arrays + carried permutation + meta.json) so a later process —
+        in particular the on-chip bench stage at the 10^8-row scale —
+        can ``load_folded`` and step without redoing the decompose and
+        fold (hours of host work at 2^27).  The offline/online split of
+        the decomposition I/O scheme, applied at the operator level."""
+        import json
+
+        if not self.folded:
+            raise ValueError("export_folded requires fmt='fold'")
+        os.makedirs(out_dir, exist_ok=True)
+        sell = self.blocks[0]
+        np.save(os.path.join(out_dir, "perm0.npy"), self.perm0)
+        for t, cols in enumerate(sell.cols):
+            np.save(os.path.join(out_dir, f"cols_{t}.npy"),
+                    np.asarray(cols))
+            if sell.binary:
+                np.save(os.path.join(out_dir, f"deg_{t}.npy"),
+                        np.asarray(sell.deg[t]))
+            else:
+                np.save(os.path.join(out_dir, f"data_{t}.npy"),
+                        np.asarray(sell.data[t]))
+        meta = {"n": int(self.n), "total_rows": int(self.total_rows),
+                "binary": bool(sell.binary),
+                "n_tiers": len(sell.cols),
+                "row_starts": [int(s) for s in sell.row_starts],
+                "n_slots": int(sell.n_slots),
+                "feature_dtype": (np.dtype(self.feature_dtype).name
+                                  if self.feature_dtype is not None
+                                  else None)}
+        with open(os.path.join(out_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+
+    @classmethod
+    def load_folded(cls, in_dir: str, feature_dtype="keep",
+                    chunk="auto", gather_budget: int = 1 << 30,
+                    device_put: bool = True) -> "MultiLevelArrow":
+        """Rebuild a fold executor from an ``export_folded`` directory
+        without the source decomposition.  ``feature_dtype="keep"``
+        uses the exported carriage dtype; ``device_put=False`` keeps
+        the tier arrays as host memmaps (budget accounting / tests)."""
+        import json
+
+        from arrow_matrix_tpu.ops.sell import SellMatrix
+
+        with open(os.path.join(in_dir, "meta.json")) as f:
+            meta = json.load(f)
+        self = cls.__new__(cls)
+        self.n = meta["n"]
+        self.total_rows = meta["total_rows"]
+        self.binary = meta["binary"]
+        self.mesh = None
+        self.axis = "blocks"
+        self.folded = True
+        self.carries_feature_major = True
+        if feature_dtype == "keep":
+            feature_dtype = meta["feature_dtype"]
+        self.feature_dtype = resolve_feature_dtype(feature_dtype)
+        self.perm0 = np.load(os.path.join(in_dir, "perm0.npy"))
+        self.inv_perm0 = np.argsort(self.perm0)
+        put = chunked_asarray if device_put else \
+            (lambda a: np.asarray(a))
+        cols_t, deg_t, data_t = [], [], []
+        for t in range(meta["n_tiers"]):
+            arr = np.load(os.path.join(in_dir, f"cols_{t}.npy"),
+                          mmap_mode="r")
+            cols_t.append(put(arr))
+            if meta["binary"]:
+                deg_t.append(put(np.load(
+                    os.path.join(in_dir, f"deg_{t}.npy"))))
+            else:
+                data_t.append(put(np.load(
+                    os.path.join(in_dir, f"data_{t}.npy"),
+                    mmap_mode="r")))
+        sell = SellMatrix(
+            cols=tuple(cols_t),
+            data=None if meta["binary"] else tuple(data_t),
+            deg=tuple(deg_t) if meta["binary"] else None,
+            n_rows=meta["total_rows"],
+            row_starts=tuple(meta["row_starts"]))
+        self._finalize_folded(sell, chunk, gather_budget)
+        return self
 
     # -- feature placement -------------------------------------------------
 
@@ -654,15 +752,22 @@ class MultiLevelArrow:
         output are flat (total_rows, k) arrays in level-0 order."""
         return self._step(x, self.fwd, self.bwd, self.blocks)
 
-    def run(self, x: jax.Array, iterations: int) -> jax.Array:
+    def run(self, x: jax.Array, iterations: int,
+            donate: bool = False) -> jax.Array:
         """``iterations`` steps as ONE device program (`lax.scan` over
         the jitted step): a single dispatch regardless of iteration
         count — the iteration loop itself is compiler-friendly control
         flow on device, not a host loop of dispatches (which pays
         dispatch latency per step, badly over remote/tunneled devices).
+
+        ``donate=True`` donates the input buffer to the scan carry, so
+        only ONE carried feature buffer is resident during the loop
+        (the 2^27 single-chip HBM budget depends on it; the donated
+        ``x`` is dead afterwards — callers that reuse it must copy
+        first).  CPU ignores donation with a warning; TPU aliases.
         """
-        return self._scan_steps(x, self.fwd, self.bwd, self.blocks,
-                                n=iterations)
+        fn = self._scan_steps_donated if donate else self._scan_steps
+        return fn(x, self.fwd, self.bwd, self.blocks, n=iterations)
 
 
 def resolve_chunk(chunk, blk: ArrowBlocks, total_rows: int, k: int,

@@ -193,6 +193,40 @@ def test_fold_matches_golden_and_iterates():
                                rtol=1e-3, atol=1e-4)
 
 
+def test_fold_export_load_roundtrip(tmp_path):
+    """export_folded -> load_folded must rebuild a fold executor whose
+    step is BIT-identical (same packed arrays, same carried
+    permutation) without the source decomposition — the offline-pack /
+    online-load split the 2^27 on-chip stage depends on.  Covers the
+    binary, weighted, and bf16-carriage variants plus the donated-scan
+    run path."""
+    n, width = 480, 32
+    a = barabasi_albert(n, 6, seed=19)
+    levels = arrow_decomposition(a, width, max_levels=3,
+                                 block_diagonal=True, seed=2)
+    x_host = random_dense(n, 8, seed=3)
+    for tag, kw, mat in (("bin", {}, a),
+                         ("bf16", {"feature_dtype": "bf16"}, a),
+                         ("wgt", {}, (a / 8.0).tocsr().astype(np.float32))):
+        lv = levels if mat is a else arrow_decomposition(
+            mat, width, max_levels=3, block_diagonal=True, seed=2)
+        ml = MultiLevelArrow(lv, width, mesh=None, fmt="fold", **kw)
+        d = tmp_path / tag
+        ml.export_folded(str(d))
+        ml2 = MultiLevelArrow.load_folded(str(d))
+        assert ml2.feature_dtype == ml.feature_dtype
+        assert ml2.blocks[0].binary == ml.blocks[0].binary
+        np.testing.assert_array_equal(ml2.perm0, ml.perm0)
+        want = np.asarray(ml.step(ml.set_features(x_host)))
+        got = np.asarray(ml2.step(ml2.set_features(x_host)))
+        np.testing.assert_array_equal(got, want, err_msg=tag)
+        # donated scan run agrees with the plain run
+        r1 = np.asarray(ml.run(ml.set_features(x_host), 2))
+        r2 = np.asarray(ml2.run(ml2.set_features(x_host), 2,
+                                donate=True))
+        np.testing.assert_array_equal(r1, r2, err_msg=tag)
+
+
 def test_fold_tight_packing_matches_golden():
     """fold_align=1 / fold_growth=1.1 (the 'fold_tight' bench
     candidate): fewer padded slots, BIT-equivalent math — tile

@@ -318,6 +318,18 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
                      "AMT_PLANAR_DTYPE": "bf16"},
                 timeout_s=4200.0,
                 json_name=f"onchip_planar_1e8_{ts}.json")
+    if (not skip_scale
+            and os.path.exists(os.path.join(
+                REPO, "bench_cache", "ba27_fold", "rehearsal.json"))
+            and os.path.exists(os.path.join(REPO, "tools",
+                                            "ba27_bench.py"))):
+        # BA-2^27 on-chip iterate from the exported fold operator (the
+        # rehearse_1e8_ba_step rung is the offline half; the tool
+        # itself refuses a toy-sized export).  Budget ~14 GB of the
+        # 16 GB HBM — after the planar flagship, before the probes.
+        run_stage("ba27", [sys.executable, "tools/ba27_bench.py"],
+                  env={}, timeout_s=4800.0,
+                  json_name=f"onchip_ba27_{ts}.json")
     run_stage("gather_probe",
               [sys.executable, "tools/gather_probe.py"],
               env={}, timeout_s=1800.0)
