@@ -47,6 +47,63 @@ def test_pallas_gather_probe_cpu_fixture():
         assert out["variants"][name].get("exact") is True, out
 
 
+def test_ba27_bench_refuses_missing_and_toy_export(tmp_path):
+    """The watcher fires ba27_bench unattended: it must exit nonzero
+    (never bench garbage) when the export is absent, and refuse a
+    logic-test toy export unless explicitly allowed — a regression
+    here would let the watcher publish toy-scale numbers as the 2^27
+    scale point."""
+    def run_with(export_dir):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "ba27_bench.py")],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "AMT_BA27_EXPORT": str(export_dir)},
+            cwd=REPO)
+
+    missing = run_with(tmp_path / "nowhere")
+    assert missing.returncode == 2
+    assert "no export" in missing.stdout
+
+    toy = tmp_path / "toy"
+    toy.mkdir()
+    (toy / "meta.json").write_text("{}")
+    (toy / "rehearsal.json").write_text(
+        json.dumps({"n": 1 << 16, "k": 16, "x_seed": 5}))
+    refused = run_with(toy)
+    assert refused.returncode == 2
+    assert "logic-test toy" in refused.stdout
+
+
+@pytest.mark.slow
+def test_rehearse_rung_and_ba27_chain_cpu_fixture(tmp_path):
+    """The offline rung -> online bench chain at logic-test scale:
+    rung exports atomically, ba27_bench golden-gates from the export
+    (AMT_BA27_FORCE_CPU).  Both ends honor AMT_BA27_EXPORT, so the
+    chain runs entirely inside tmp_path — the live bench_cache export
+    (possibly the real multi-hour 2^27 one) is never touched."""
+    export = str(tmp_path / "ba27_fold")
+    env = {**os.environ, "AMT_BA27_EXPORT": export}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scale_ladder.py"),
+         "--rung", "rehearse_1e8_ba_step"],
+        capture_output=True, text=True, timeout=900,
+        env={**env, "AMT_BA27_LOGN": "16"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rung = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rung["hbm_budget"]["fits"]
+    assert rung["golden_sample_rel_err"] < 2e-2
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ba27_bench.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**env, "AMT_BA27_ALLOW_SMALL": "1",
+             "AMT_BA27_FORCE_CPU": "1", "AMT_BA27_ITERS": "2"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["golden_sample_rel_err"] < 2e-2
+    assert out["ms_per_iter"] > 0
+
+
 @pytest.mark.slow
 def test_ladder_race_cpu_fixture():
     out = _run("ladder_race.py",

@@ -27,7 +27,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-EXPORT = os.path.join(REPO, "bench_cache", "ba27_fold")
+EXPORT = os.environ.get(
+    "AMT_BA27_EXPORT", os.path.join(REPO, "bench_cache", "ba27_fold"))
 
 
 def main() -> None:

@@ -307,8 +307,11 @@ def rung_rehearse_1e8_ba_step() -> dict:
     out["fold_build_s"] = round(time.perf_counter() - t0, 1)
     # Write the export to a temp dir and swap it in at the END (the
     # tunnel watcher's ba27 stage gates on rehearsal.json — it must
-    # never see a half-written operator).
-    export_dir = os.path.join(CACHE, "ba27_fold")
+    # never see a half-written operator).  AMT_BA27_EXPORT: same
+    # override the consumer (tools/ba27_bench.py) honors — tests
+    # point both at a scratch dir and never touch the live path.
+    export_dir = os.environ.get("AMT_BA27_EXPORT",
+                                os.path.join(CACHE, "ba27_fold"))
     tmp_dir = export_dir + ".tmp"
     import shutil
 

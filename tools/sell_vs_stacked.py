@@ -1,0 +1,143 @@
+"""Sell-vs-stacked evidence at 16 and 32 virtual devices (VERDICT r4
+item 6): the host-side volume + scaling data behind the layout/routing
+default decision, at protocol scale (n=2^20, width 2048, k=16 — the
+bench's own problem, reloaded from its decomposition cache).
+
+Per device count (each in its own subprocess — force_cpu_devices is
+once-per-process), for each of {stacked, sell} x {gather, a2a}:
+
+  * per-iteration collective bytes + op count from the COMPILED HLO
+    (utils/commstats — the deterministic, core-count-independent
+    signal);
+  * ms/iter from a chained-run race (warm, RTT-subtracted).  On this
+    ONE-core host the absolute numbers are not chip predictions — the
+    trustworthy part is the ratio structure and how it MOVES from 16
+    to 32 devices (per-device compute halves, exchange volume does
+    not), which is exactly what the time-vs-space / sell-vs-stacked
+    flip needs alongside tools/ici_model.py's parameterized model.
+
+Results: bench_results/sell_vs_stacked.json + a printed table
+(PERFORMANCE.md carries the committed copy).
+
+Usage: PYTHONPATH=/root/repo python tools/sell_vs_stacked.py
+       [--n 1048576] [--devices 16,32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from arrow_matrix_tpu.utils.platform import force_cpu_devices
+force_cpu_devices({n_dev})
+import numpy as np
+from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+from arrow_matrix_tpu.utils import commstats
+from arrow_matrix_tpu.utils.graphs import random_dense
+
+n, width, k, n_dev = {n}, 2048, 16, {n_dev}
+# The bench's own decomposition cache (load-or-decompose-AND-SAVE with
+# a completion sentinel): one cold decompose serves every later child.
+os.chdir({repo!r})
+import bench
+levels = bench._cached_levels(n, 8, width, seed=7, max_levels=12)
+x_host = random_dense(n, k, seed=3)
+mesh = make_mesh((n_dev,), ("blocks",))
+
+def ms_per_iter(obj, x, iters=5):
+    def chain(c):
+        t0 = time.perf_counter()
+        xd = obj.run(x, c) if c else x
+        float(np.asarray(xd).ravel()[0])
+        return time.perf_counter() - t0
+    chain(iters)                       # compile + warm
+    rtt = min(chain(0) for _ in range(3))
+    return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
+
+out = {{"n_dev": n_dev, "n": n, "width": width, "k": k,
+        "levels": len(levels), "modes": {{}}}}
+for layout in ("stacked", "sell"):
+    for routing in ("gather", "a2a"):
+        t0 = time.perf_counter()
+        if layout == "stacked":
+            obj = MultiLevelArrow(levels, width, mesh=mesh,
+                                  routing=routing)
+            x = obj.set_features(x_host)
+            stats = commstats.collective_stats(
+                obj._step, x, obj.fwd, obj.bwd, obj.blocks)
+        else:
+            obj = SellMultiLevel(levels, width, mesh, routing=routing)
+            x = obj.set_features(x_host)
+            stats = commstats.collective_stats(
+                obj._step, x, obj._level_args, obj.fwd, obj.bwd)
+        build_s = round(time.perf_counter() - t0, 1)
+        ms = ms_per_iter(obj, x)
+        n_ops = sum(v["count"] for v in stats.values()
+                    if isinstance(v, dict))
+        out["modes"][f"{{layout}}/{{routing}}"] = {{
+            "bytes_per_iter": int(stats["total_bytes"]),
+            "collective_ops": int(n_ops),
+            "ms_per_iter_1core": round(ms, 1),
+            "build_s": build_s,
+        }}
+        print(f"[{{n_dev}}dev] {{layout}}/{{routing}}: "
+              f"{{stats['total_bytes']:,}} B/iter, {{ms:.1f}} ms/iter",
+              file=sys.stderr, flush=True)
+print(json.dumps(out))
+"""
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 20)
+    ap.add_argument("--devices", default="16,32")
+    args = ap.parse_args()
+
+    results = {}
+    for n_dev in (int(d) for d in args.devices.split(",")):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             CHILD.format(repo=REPO, n=args.n, n_dev=n_dev)],
+            capture_output=True, text=True, timeout=7200)
+        for ln in proc.stderr.strip().splitlines()[-8:]:
+            print(ln, flush=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{n_dev}-device child failed:\n{proc.stderr[-3000:]}")
+        results[f"devs{n_dev}"] = json.loads(
+            proc.stdout.strip().splitlines()[-1])
+
+    path = os.path.join(REPO, "bench_results", "sell_vs_stacked.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+
+    # Scaling table: bytes and wall-clock, 16 -> 32 devices.
+    print(f"\n{'mode':18s} " + " ".join(
+        f"{d.removeprefix('devs') + ':B/iter':>14s} "
+        f"{d.removeprefix('devs') + ':ms':>8s}"
+        for d in results))
+    first = next(iter(results.values()))
+    for mode in first["modes"]:
+        row = f"{mode:18s} "
+        for dkey in results:
+            m = results[dkey]["modes"][mode]
+            row += f"{m['bytes_per_iter']:>14,} " \
+                   f"{m['ms_per_iter_1core']:>8.1f} "
+        print(row)
+    print(json.dumps({"tool": "sell_vs_stacked",
+                      "json": "bench_results/sell_vs_stacked.json"}))
+
+
+if __name__ == "__main__":
+    main()
