@@ -73,14 +73,18 @@ for layout in ("stacked", "sell"):
             obj = MultiLevelArrow(levels, width, mesh=mesh,
                                   routing=routing)
             x = obj.set_features(x_host)
+            build_s = round(time.perf_counter() - t0, 1)
+            t0 = time.perf_counter()
             stats = commstats.collective_stats(
                 obj._step, x, obj.fwd, obj.bwd, obj.blocks)
         else:
             obj = SellMultiLevel(levels, width, mesh, routing=routing)
             x = obj.set_features(x_host)
+            build_s = round(time.perf_counter() - t0, 1)
+            t0 = time.perf_counter()
             stats = commstats.collective_stats(
                 obj._step, x, obj._level_args, obj.fwd, obj.bwd)
-        build_s = round(time.perf_counter() - t0, 1)
+        compile_s = round(time.perf_counter() - t0, 1)
         ms = ms_per_iter(obj, x)
         n_ops = sum(v["count"] for v in stats.values()
                     if isinstance(v, dict))
@@ -89,6 +93,7 @@ for layout in ("stacked", "sell"):
             "collective_ops": int(n_ops),
             "ms_per_iter_1core": round(ms, 1),
             "build_s": build_s,
+            "compile_s": compile_s,
         }}
         print(f"[{{n_dev}}dev] {{layout}}/{{routing}}: "
               f"{{stats['total_bytes']:,}} B/iter, {{ms:.1f}} ms/iter",
@@ -103,6 +108,15 @@ def main() -> None:
     ap.add_argument("--devices", default="16,32")
     args = ap.parse_args()
 
+    path = os.path.join(REPO, "bench_results", "sell_vs_stacked.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    def flush(res):
+        # Incremental: a later device-count child failing/timing out
+        # must not discard an earlier (possibly hour-long) result.
+        with open(path, "w") as f:
+            json.dump(res, f, indent=1)
+
     results = {}
     for n_dev in (int(d) for d in args.devices.split(",")):
         proc = subprocess.run(
@@ -116,11 +130,7 @@ def main() -> None:
                 f"{n_dev}-device child failed:\n{proc.stderr[-3000:]}")
         results[f"devs{n_dev}"] = json.loads(
             proc.stdout.strip().splitlines()[-1])
-
-    path = os.path.join(REPO, "bench_results", "sell_vs_stacked.json")
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(results, f, indent=1)
+        flush(results)
 
     # Scaling table: bytes and wall-clock, 16 -> 32 devices.
     print(f"\n{'mode':18s} " + " ".join(

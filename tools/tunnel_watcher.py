@@ -280,6 +280,11 @@ def healthy_pass(skip_scale: bool) -> bool:
 
 
 def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
+    # Order = value-per-healthy-minute under a possibly short heal
+    # window: the round-defining bench first, the defaults-deciding
+    # ladder race second, then the CHEAP measurement probes (VERDICT
+    # r4 item 5: the pallas-gather granule question must not die
+    # behind hours of scale stages again), then the long scale points.
     ok = run_stage(
         "bench_full", [sys.executable, "bench.py"],
         env={"AMT_BENCH_DEADLINE": "3300"},
@@ -290,6 +295,15 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
             [sys.executable, "tools/ladder_race.py"],
             env={}, timeout_s=2400.0,
             json_name=f"onchip_ladder_{ts}.json")
+    if os.path.exists(os.path.join(REPO, "tools",
+                                   "pallas_gather_probe.py")):
+        run_stage("pallas_gather",
+                  [sys.executable, "tools/pallas_gather_probe.py"],
+                  env={}, timeout_s=1200.0,
+                  json_name=f"onchip_pallas_gather_{ts}.json")
+    run_stage("gather_probe",
+              [sys.executable, "tools/gather_probe.py"],
+              env={}, timeout_s=1800.0)
     if not skip_scale:
         run_stage(
             "bench_2e24", [sys.executable, "bench.py"],
@@ -330,15 +344,6 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
         run_stage("ba27", [sys.executable, "tools/ba27_bench.py"],
                   env={}, timeout_s=4800.0,
                   json_name=f"onchip_ba27_{ts}.json")
-    run_stage("gather_probe",
-              [sys.executable, "tools/gather_probe.py"],
-              env={}, timeout_s=1800.0)
-    if os.path.exists(os.path.join(REPO, "tools",
-                                   "pallas_gather_probe.py")):
-        run_stage("pallas_gather",
-                  [sys.executable, "tools/pallas_gather_probe.py"],
-                  env={}, timeout_s=1200.0,
-                  json_name=f"onchip_pallas_gather_{ts}.json")
     return ok
 
 
