@@ -36,11 +36,13 @@ def test_ladder_registry_importable():
     assert set(mod.RUNGS) == {
         "decompose24", "ingest24", "decompose26_grid",
         "decompose_1e8_grid", "decompose_1e8_ba",
+        "rehearse_1e8_ba_step",
         "backend_race22", "backend_race23"}
     # The 1e8 rungs are opt-in: a bare `python tools/scale_ladder.py`
-    # must stay bounded (the BA 2^27 rung needs ~hours and tens of GB).
+    # must stay bounded (the BA 2^27 rungs need ~hours and tens of GB).
     assert set(mod.DEFAULT_RUNGS) == set(mod.RUNGS) - {
-        "decompose_1e8_grid", "decompose_1e8_ba"}
+        "decompose_1e8_grid", "decompose_1e8_ba",
+        "rehearse_1e8_ba_step"}
 
 
 def test_recorded_ladder_results_pass_their_gates():

@@ -340,7 +340,8 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
         # BA-2^27 on-chip iterate from the exported fold operator (the
         # rehearse_1e8_ba_step rung is the offline half; the tool
         # itself refuses a toy-sized export).  Budget ~14 GB of the
-        # 16 GB HBM — after the planar flagship, before the probes.
+        # 16 GB HBM — last in the list: the probes and planar stages
+        # above it are cheaper per healthy minute.
         run_stage("ba27", [sys.executable, "tools/ba27_bench.py"],
                   env={}, timeout_s=4800.0,
                   json_name=f"onchip_ba27_{ts}.json")
