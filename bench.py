@@ -894,6 +894,15 @@ def _last_onchip_evidence() -> dict | None:
         scanned += 1
         if d.get("metric") != "spmm_iter_ms" or not d.get("value"):
             continue
+        # On-chip evidence only: the watcher's stage runner writes its
+        # artifact on rc=0 even when the bench inside degraded to a
+        # CPU fallback (tunnel flapped mid-window) — a CPU number in
+        # the onchip_* namespace must never become the "most recent
+        # real-chip measurement".  An artifact with NO platform field
+        # (the pre-platform-label contract) still qualifies: only an
+        # explicit CPU/degraded label disqualifies.
+        if d.get("degraded") or d.get("platform") == "cpu":
+            continue
         if newest is None:
             newest, newest_mtime, data = p, mt, d
         # The co-equal k=128 headline may live in an older artifact

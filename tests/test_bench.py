@@ -67,6 +67,38 @@ def test_degraded_run_reports_roofline_inputs(bench_success):
     assert out["config"]["edges_nnz"] > 0
 
 
+def test_onchip_evidence_skips_degraded_artifacts(tmp_path,
+                                                  monkeypatch):
+    """A degraded CPU bench captured into the onchip_* namespace (the
+    watcher's stage runner writes its artifact on rc=0 even when the
+    bench inside fell back to CPU mid-window) must never be embedded
+    as the "most recent on-chip capture" — only platform=tpu,
+    non-degraded artifacts qualify."""
+    sys.path.insert(0, REPO)
+    import bench as bench_mod
+
+    cache = tmp_path / "bench_cache"
+    cache.mkdir()
+    older = {"metric": "spmm_iter_ms", "value": 200.0,
+             "platform": "tpu", "device_kind": "TPU v5 lite",
+             "config": {"n": 64, "width": 16, "features": 16}}
+    newer_degraded = {"metric": "spmm_iter_ms", "value": 1500.0,
+                      "platform": "cpu", "degraded": True,
+                      "config": {"n": 64, "width": 16, "features": 16}}
+    (cache / "onchip_bench_old.json").write_text(json.dumps(older))
+    os.utime(cache / "onchip_bench_old.json", (1000, 1000))
+    (cache / "onchip_bench_quick_new.json").write_text(
+        json.dumps(newer_degraded))
+    monkeypatch.chdir(tmp_path)
+    ev = bench_mod._last_onchip_evidence()
+    assert ev is not None
+    assert ev["summary"]["platform"] == "tpu"
+    assert ev["summary"]["value"] == 200.0
+    # nothing but degraded artifacts -> no evidence at all
+    os.remove(cache / "onchip_bench_old.json")
+    assert bench_mod._last_onchip_evidence() is None
+
+
 def test_failed_race_exits_nonzero_with_error_json(tmp_path):
     """An impossible format must produce the diagnosable error line and
     rc=1 — the round-1 postmortem contract (no silent rc without

@@ -216,9 +216,14 @@ def main() -> None:
         out["variants"]["pallas_granule"] = {
             "error": f"{type(e).__name__}: {str(e)[:400]}"}
     v = out["variants"]
-    if not cpu and all(("mslots_s" in v.get(k, {})
-                        and v[k].get("exact") is True)
-                       for k in ("xla_take", "pallas_granule")):
+    # Verdict gates on the MEASURED platform, not the env flag: a
+    # tunnel flap can silently fall back to host CPU with
+    # AMT_PROBE_CPU unset, and CPU timings must never write a
+    # "productionize" verdict into the onchip_* namespace.
+    if dev.platform != "cpu" and all(("mslots_s" in v.get(k, {})
+                                      and v[k].get("exact") is True)
+                                     for k in ("xla_take",
+                                               "pallas_granule")):
         # Verdict requires BOTH variants exact: a fast kernel that
         # returns wrong gathers must never read "productionize".
         # The committed confirm-or-falsify verdict (VERDICT r4 item

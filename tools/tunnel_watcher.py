@@ -279,16 +279,81 @@ def healthy_pass(skip_scale: bool) -> bool:
         return _healthy_pass_stages(skip_scale, ts)
 
 
+_quick_captured = False
+
+
+def _bench_stage(name: str, env: dict, timeout_s: float,
+                 json_name: str) -> str:
+    """Run a bench.py stage; 'onchip' | 'degraded' | 'failed'.
+    'degraded' means rc=0 but the artifact records a CPU fallback —
+    the tunnel is proven down again mid-window."""
+    if not run_stage(name, [sys.executable, "bench.py"], env,
+                     timeout_s, json_name=json_name):
+        return "failed"
+    if _artifact_is_onchip(json_name):
+        return "onchip"
+    log(f"stage {name}: completed but DEGRADED (CPU fallback) — "
+        f"bailing out of this pass; next probe cycle retries")
+    return "degraded"
+
+
+def _artifact_is_onchip(json_name: str) -> bool:
+    """True iff the captured bench JSON records a non-degraded
+    accelerator run (``platform`` != cpu and not flagged degraded)."""
+    import json as _json
+
+    try:
+        with open(os.path.join(REPO, "bench_cache", json_name)) as f:
+            d = _json.loads(f.read().strip().splitlines()[-1])
+        return d.get("platform") not in (None, "cpu") \
+            and not d.get("degraded")
+    except Exception as e:
+        log(f"onchip-artifact check failed for {json_name}: "
+            f"{type(e).__name__}: {e}")
+        return False
+
+
 def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
     # Order = value-per-healthy-minute under a possibly short heal
-    # window: the round-defining bench first, the defaults-deciding
-    # ladder race second, then the CHEAP measurement probes (VERDICT
-    # r4 item 5: the pallas-gather granule question must not die
-    # behind hours of scale stages again), then the long scale points.
-    ok = run_stage(
-        "bench_full", [sys.executable, "bench.py"],
-        env={"AMT_BENCH_DEADLINE": "3300"},
+    # window: a MINUTES-scale fold-only capture first (round-5
+    # observation: the first heal window of the round lasted <8 min —
+    # long enough for a platform=tpu headline at the protocol config,
+    # not for the full race), then the full race, the
+    # defaults-deciding ladder race, the CHEAP measurement probes
+    # (VERDICT r4 item 5: the pallas-gather granule question must not
+    # die behind hours of scale stages again), then the long scale
+    # points.  bench_quick reuses the bench decomposition cache and a
+    # single fold candidate with no scipy/k128 comparison.
+    # A quick success is recorded (module flag: re-running it in a
+    # later window would duplicate chip minutes) but does NOT complete
+    # the pass — only bench_full does, so a short window's capture
+    # never stops the full race from retrying in longer windows.
+    #
+    # Every bench.py-family stage runs through _bench_stage: bench.py
+    # exits 0 on a degraded CPU fallback too (the tunnel closing
+    # between our probe and the bench's own is exactly the flap mode
+    # this watcher exists for), and a CPU number must neither complete
+    # the pass nor justify running hours of further stages on a
+    # proven-dead tunnel — "degraded" bails the pass; the next probe
+    # cycle retries.
+    global _quick_captured
+    if not _quick_captured:
+        q = _bench_stage(
+            "bench_quick",
+            env={"AMT_BENCH_FMT": "fold",
+                 "AMT_BENCH_COMPARE": "0",
+                 "AMT_BENCH_K128": "0",
+                 "AMT_BENCH_DEADLINE": "540"},
+            timeout_s=720.0, json_name=f"onchip_bench_quick_{ts}.json")
+        if q == "degraded":
+            return False
+        _quick_captured = q == "onchip"
+    full = _bench_stage(
+        "bench_full", env={"AMT_BENCH_DEADLINE": "3300"},
         timeout_s=3600.0, json_name=f"onchip_bench_{ts}.json")
+    if full == "degraded":
+        return False
+    ok = full == "onchip"
     if os.path.exists(os.path.join(REPO, "tools", "ladder_race.py")):
         run_stage(
             "ladder_race",
@@ -305,16 +370,17 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
               [sys.executable, "tools/gather_probe.py"],
               env={}, timeout_s=1800.0)
     if not skip_scale:
-        run_stage(
-            "bench_2e24", [sys.executable, "bench.py"],
-            env={"AMT_BENCH_N": str(1 << 24),
-                 "AMT_BENCH_LEVELS": "14",
-                 "AMT_BENCH_FMT": "fold",
-                 "AMT_BENCH_K128": "0",
-                 "AMT_BENCH_COMPARE": "0",
-                 "AMT_BENCH_DEADLINE": "5400"},
-            timeout_s=5700.0,
-            json_name=f"onchip_bench_2e24_{ts}.json")
+        if _bench_stage(
+                "bench_2e24",
+                env={"AMT_BENCH_N": str(1 << 24),
+                     "AMT_BENCH_LEVELS": "14",
+                     "AMT_BENCH_FMT": "fold",
+                     "AMT_BENCH_K128": "0",
+                     "AMT_BENCH_COMPARE": "0",
+                     "AMT_BENCH_DEADLINE": "5400"},
+                timeout_s=5700.0,
+                json_name=f"onchip_bench_2e24_{ts}.json") == "degraded":
+            return ok
     if os.path.exists(os.path.join(REPO, "tools", "planar_bench.py")):
         planar_ok = run_stage(
             "planar", [sys.executable, "tools/planar_bench.py"],
