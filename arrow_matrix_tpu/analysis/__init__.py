@@ -1,0 +1,38 @@
+"""graft-lint: static analysis for the JAX/TPU hot paths.
+
+Two complementary engines guard the invariants the benches depend on
+(PERFORMANCE.md measurement discipline):
+
+* **AST pass** (`core` + `rules`): a visitor-based linter over the
+  package source with an extensible rule registry.  The shipped rules
+  (R1-R6) encode the recompilation, host-sync, and sharding hazards
+  that silently destroy TPU throughput — the class of bug an MPI code
+  never meets but a jit/shard_map code re-discovers one bench
+  regression at a time.
+* **Trace-time audit** (`audit`): jit-compiles the core SpMM entry
+  points on the host CPU mesh and asserts zero recompiles across two
+  same-shape calls, recording a compile-count manifest under
+  ``bench_cache/`` so compile-cache regressions diff in review.
+
+Run ``python -m arrow_matrix_tpu.analysis <paths>`` to lint and
+``python -m arrow_matrix_tpu.analysis audit`` for the trace audit;
+``graft_lint`` is the installed console script (tools/lint_gate.py is
+the CI wrapper).  Findings are suppressed inline with
+``# graft-lint: disable=R1`` (see core.WAIVER_RE).
+"""
+
+from arrow_matrix_tpu.analysis.core import (
+    Finding,
+    lint_file,
+    lint_paths,
+    lint_source,
+    rule_table,
+)
+
+__all__ = [
+    "Finding",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "rule_table",
+]

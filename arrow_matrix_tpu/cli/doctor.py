@@ -116,6 +116,27 @@ def report_holders_and_registry() -> None:
            f"{reg} registered" if reg else "none registered")
 
 
+def probe_lint() -> tuple[bool, str]:
+    """Run graft-lint (analysis/) over the installed package — a core
+    check: a finding means a hot-path hazard (host sync, recompile,
+    sharding mismatch) shipped past the gate."""
+    try:
+        import arrow_matrix_tpu
+        from arrow_matrix_tpu.analysis import lint_paths
+
+        pkg = os.path.dirname(os.path.abspath(arrow_matrix_tpu.__file__))
+        findings, waived = lint_paths([pkg])
+        if findings:
+            worst = findings[0]
+            return False, (f"{len(findings)} finding(s), e.g. "
+                           f"{worst.format()[:100]}")
+        return True, (f"clean ({len(waived)} waived) — "
+                      f"run `python -m arrow_matrix_tpu.analysis` "
+                      f"for details")
+    except Exception as e:  # the doctor must never crash on a probe
+        return False, f"{type(e).__name__}: {str(e)[:100]}"
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -168,6 +189,9 @@ def main(argv=None) -> int:
 
     n, detail = probe_native()
     _check("native decomposer", n, detail)
+
+    lint_ok, detail = probe_lint()
+    ok &= _check("graft-lint (static analysis, R1-R6)", lint_ok, detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

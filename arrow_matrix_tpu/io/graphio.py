@@ -367,6 +367,15 @@ def num_rows(matrix: CsrLike) -> int:
     return len(matrix[2]) - 1
 
 
+def num_nonzeros(matrix: CsrLike) -> int:
+    """Stored-entry count for either CsrLike form: scipy ``.nnz``, or
+    the size of the triplet's indices array (data may be None for
+    binary matrices, so the indices array is the one reliable count)."""
+    if sparse.issparse(matrix):
+        return int(matrix.nnz)
+    return int(np.asarray(matrix[1]).size)
+
+
 def nnz_per_row(matrix: CsrLike) -> np.ndarray:
     if sparse.issparse(matrix):
         return np.diff(matrix.tocsr().indptr)

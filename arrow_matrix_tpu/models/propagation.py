@@ -547,7 +547,10 @@ def pagerank(multi: MultiLevelArrow, damping: float = 0.85,
 @functools.partial(jax.jit, static_argnames=("widths", "chunk"))
 def _label_prop_body(y, seeds, clamp, fwd, bwd, blocks, widths, chunk):
     prop = multi_level_spmm(y, fwd, bwd, blocks, widths, chunk=chunk)
-    return clamp * seeds + (1.0 - clamp) * prop
+    # Typed scalar: a bare float literal would ride weak-type promotion
+    # (graft-lint R5) and silently widen a narrow feature dtype.
+    one = clamp.dtype.type(1)
+    return clamp * seeds + (one - clamp) * prop
 
 
 def label_propagation(multi: MultiLevelArrow, labels: np.ndarray,
@@ -588,10 +591,14 @@ def appnp_forward(params: SGCParams, x: jax.Array, fwd: jax.Array,
     personalized-PageRank steps.  Pure and jittable like sgc_forward."""
     h = x @ params.w + params.b[None, :]
     z = h
+    # Typed mix weights (graft-lint R5): alpha is a static python
+    # float; fold it into scalars of the activation dtype once.
+    keep = h.dtype.type(1 - alpha)
+    tele = h.dtype.type(alpha)
     for _ in range(hops):
-        z = (1.0 - alpha) * multi_level_spmm(z, fwd, bwd, blocks,
-                                             widths, chunk=chunk)
-        z = z + alpha * h
+        z = keep * multi_level_spmm(z, fwd, bwd, blocks,
+                                    widths, chunk=chunk)
+        z = z + tele * h
     return z
 
 
@@ -695,8 +702,10 @@ def _make_carried_appnp_forward(step_fn, hops: int, alpha: float):
     def forward(params, xt, operands):
         h = params.w.T @ xt + params.b[:, None]
         z = h
+        keep = h.dtype.type(1 - alpha)   # typed scalars, graft-lint R5
+        tele = h.dtype.type(alpha)
         for _ in range(hops):
-            z = (1.0 - alpha) * step_fn(z, *operands) + alpha * h
+            z = keep * step_fn(z, *operands) + tele * h
         return z
 
     return forward

@@ -38,6 +38,7 @@ from scipy import sparse
 from arrow_matrix_tpu.io.graphio import (
     CsrLike,
     load_block,
+    num_nonzeros,
     num_rows,
     number_of_blocks,
 )
@@ -236,10 +237,7 @@ def arrow_blocks_from_csr(matrix: CsrLike, width: int,
                   lo_deg=dev(lo_deg), hi_deg=dev(hi_deg))
 
     if check:
-        if isinstance(matrix, sparse.csr_matrix):
-            total = matrix.nnz
-        else:
-            total = int(np.asarray(matrix[1]).size)
+        total = num_nonzeros(matrix)
         if captured != total:
             raise ValueError(
                 f"arrow tiling captured {captured} of {total} nonzeros: the "
@@ -447,10 +445,7 @@ def arrow_blocks_streamed(matrix: CsrLike, width: int, mesh,
     head_budget = align_up(head_nnz_max, SLOT_ALIGN) if head_nnz_max else 0
 
     if check:
-        if isinstance(matrix, sparse.csr_matrix):
-            total = matrix.nnz
-        else:
-            total = int(np.asarray(matrix[1]).size)
+        total = num_nonzeros(matrix)
         if captured != total:
             raise ValueError(
                 f"arrow tiling captured {captured} of {total} nonzeros: "

@@ -52,7 +52,9 @@ from arrow_matrix_tpu.ops.arrow_blocks import (
     block_spmm_shared,
     head_block_spmm,
 )
-from arrow_matrix_tpu.parallel.mesh import blocks_sharding, shard_arrow_blocks
+from arrow_matrix_tpu.parallel.mesh import (blocks_sharding,
+                                             shard_arrow_blocks,
+                                             shard_map_check_kwargs)
 
 
 @functools.lru_cache(maxsize=None)
@@ -196,7 +198,7 @@ def slim_step_shard_map(blocks: ArrowBlocks, mesh: Mesh,
         mesh=mesh,
         in_specs=(spec_blocks, P(axis)),
         out_specs=P(axis),
-        check_vma=False,
+        **shard_map_check_kwargs(),
     )
 
 
@@ -332,5 +334,5 @@ def wide_step_shard_map(blocks: ArrowBlocks, mesh: Mesh,
         mesh=mesh,
         in_specs=(spec_blocks, P(block_axis)),
         out_specs=P(arm_axis, block_axis),
-        check_vma=False,
+        **shard_map_check_kwargs(),
     )

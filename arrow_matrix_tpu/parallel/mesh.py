@@ -27,6 +27,27 @@ def _callback_takes_dtype() -> bool:
         jax.make_array_from_callback).parameters
 
 
+@functools.lru_cache(maxsize=1)
+def _shard_map_check_kwarg() -> str:
+    """Name of shard_map's replication-check kwarg on this jax: it was
+    renamed ``check_rep`` -> ``check_vma`` and the installed jax is
+    unpinned (detect-once idiom, same as _callback_takes_dtype)."""
+    import inspect
+
+    try:
+        from jax import shard_map as sm  # jax >= 0.6
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    return "check_vma" if "check_vma" in params else "check_rep"
+
+
+def shard_map_check_kwargs(check: bool = False) -> dict:
+    """Portable kwargs dict for shard_map's replication check; splat
+    into any shard_map call instead of spelling check_vma/check_rep."""
+    return {_shard_map_check_kwarg(): check}
+
+
 def make_mesh(shape: Optional[Sequence[int]] = None,
               axis_names: Sequence[str] = ("blocks",),
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -41,6 +62,11 @@ def make_mesh(shape: Optional[Sequence[int]] = None,
     devs = list(devices if explicit else jax.devices())
     if shape is None:
         shape = (len(devs),)
+    if len(axis_names) != len(shape):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} has {len(shape)} dimension(s) "
+            f"but axis_names {tuple(axis_names)} names "
+            f"{len(axis_names)} — one name per mesh dimension required")
     n = int(np.prod(shape))
     if n > len(devs):
         raise ValueError(f"mesh shape {tuple(shape)} needs {n} devices, "

@@ -33,6 +33,8 @@ import numpy as np
 from flax import struct
 from jax.sharding import Mesh, PartitionSpec as P
 
+from arrow_matrix_tpu.parallel.mesh import shard_map_check_kwargs
+
 try:
     from jax import shard_map
 except ImportError:  # older jax
@@ -377,7 +379,7 @@ def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
     fn = shard_map(local_fn, mesh=mesh,
                    in_specs=(x_spec, spec, spec, spec, spec),
                    out_specs=x_spec,
-                   check_vma=False)
+                   **shard_map_check_kwargs())
     return fn(x, route.local_src, route.local_dst, route.send_idx,
               route.recv_dst)
 
@@ -416,7 +418,7 @@ def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
     fn = shard_map(local_fn, mesh=mesh,
                    in_specs=(P(feat_axis, axis), spec, spec, spec, spec),
                    out_specs=P(feat_axis, axis),
-                   check_vma=False)
+                   **shard_map_check_kwargs())
     return fn(xt, route.local_src, route.local_dst, route.send_idx,
               route.recv_dst)
 

@@ -50,7 +50,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scipy import sparse
 
 from arrow_matrix_tpu.io.graphio import CsrLike, num_rows
-from arrow_matrix_tpu.parallel.mesh import fetch_replicated, put_global
+from arrow_matrix_tpu.parallel.mesh import (fetch_replicated, put_global,
+                                             shard_map_check_kwargs)
 from arrow_matrix_tpu.parallel.multi_level import resolve_feature_dtype
 from arrow_matrix_tpu.ops.ell import (
     SLOT_ALIGN,
@@ -833,7 +834,7 @@ def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
             local_step, mesh=mesh,
             in_specs=(spec(body), spec(head), P(), P(axis), x_spec),
             out_specs=x_spec,
-            check_vma=False,
+            **shard_map_check_kwargs(),
         )(body, head, head_unsort, orig_pos, xt)
 
     return step
@@ -1076,6 +1077,8 @@ class SellMultiLevel:
             return out
 
         self._scan = jax.jit(scan_steps, static_argnames=("n",))
+        self._scan_donated = jax.jit(scan_steps, static_argnames=("n",),
+                                     donate_argnums=(0,))
 
     def set_features(self, x: np.ndarray) -> jax.Array:
         """Host (n, k) original order -> (k, total_out_0) carried."""
@@ -1104,9 +1107,15 @@ class SellMultiLevel:
     def step(self, xt: jax.Array) -> jax.Array:
         return self._step(xt, self._level_args, self.fwd, self.bwd)
 
-    def run(self, xt: jax.Array, iterations: int) -> jax.Array:
-        return self._scan(xt, self._level_args, self.fwd, self.bwd,
-                          n=iterations)
+    def run(self, xt: jax.Array, iterations: int,
+            donate: bool = False) -> jax.Array:
+        """``donate=True`` donates ``xt`` to the scan carry so the old
+        feature buffer is reused instead of doubling the footprint
+        (same contract as MultiLevelArrow.run; the donated input is
+        invalid afterwards)."""
+        fn = self._scan_donated if donate else self._scan
+        return fn(xt, self._level_args, self.fwd, self.bwd,
+                  n=iterations)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         return _gather_carried(

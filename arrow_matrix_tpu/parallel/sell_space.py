@@ -53,7 +53,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from arrow_matrix_tpu.io.graphio import num_rows
 from arrow_matrix_tpu.ops.ell import align_up
 from arrow_matrix_tpu.parallel.mesh import (fetch_replicated, make_mesh,
-                                             put_global)
+                                             put_global,
+                                             shard_map_check_kwargs)
 from arrow_matrix_tpu.parallel.multi_level import resolve_feature_dtype
 from arrow_matrix_tpu.parallel.sell_slim import (
     _banded_reach,
@@ -295,7 +296,7 @@ class SellSpaceShared:
                 in_specs=(spec(body), spec(head), P(lvl_axis),
                           P((lvl_axis, axis)), x_spec),
                 out_specs=x_spec,
-                check_vma=False,
+                **shard_map_check_kwargs(),
             )(body, head, head_unsort, orig_pos, xt)
 
         def space_step(xt, body, head, head_unsort, orig_pos,
@@ -334,6 +335,8 @@ class SellSpaceShared:
             return out
 
         self._scan = jax.jit(scan_steps, static_argnames=("n",))
+        self._scan_donated = jax.jit(scan_steps, static_argnames=("n",),
+                                     donate_argnums=(0,))
 
     def _args(self):
         return (self.body, self.head, self.head_unsort, self.orig_pos,
@@ -372,8 +375,12 @@ class SellSpaceShared:
     def step(self, xt: jax.Array) -> jax.Array:
         return self._step(xt, *self._args())
 
-    def run(self, xt: jax.Array, iterations: int) -> jax.Array:
-        return self._scan(xt, *self._args(), n=iterations)
+    def run(self, xt: jax.Array, iterations: int,
+            donate: bool = False) -> jax.Array:
+        """``donate=True`` donates ``xt`` to the scan carry (see
+        MultiLevelArrow.run; the donated input is invalid afterwards)."""
+        fn = self._scan_donated if donate else self._scan
+        return fn(xt, *self._args(), n=iterations)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
         """Device (k, K * total_out) -> host (n, k) original order

@@ -243,6 +243,9 @@ class SpaceSharedArrow:
             return out
 
         self._scan_steps = jax.jit(scan_steps, static_argnames=("n",))
+        self._scan_steps_donated = jax.jit(scan_steps,
+                                           static_argnames=("n",),
+                                           donate_argnums=(0,))
 
     # -- feature placement (MultiLevelArrow-compatible surface) ----------
 
@@ -278,9 +281,13 @@ class SpaceSharedArrow:
     def step(self, x_all: jax.Array) -> jax.Array:
         return self._step(x_all, self.bwd0, self.fwd0, self.blocks)
 
-    def run(self, x_all: jax.Array, iterations: int) -> jax.Array:
-        return self._scan_steps(x_all, self.bwd0, self.fwd0, self.blocks,
-                                n=iterations)
+    def run(self, x_all: jax.Array, iterations: int,
+            donate: bool = False) -> jax.Array:
+        """``donate=True`` donates ``x_all`` to the scan carry (see
+        MultiLevelArrow.run; the donated input is invalid afterwards)."""
+        fn = (self._scan_steps_donated if donate else self._scan_steps)
+        return fn(x_all, self.bwd0, self.fwd0, self.blocks,
+                  n=iterations)
 
 
 def space_shared_spmm(x_all: jax.Array, bwd0: jax.Array, fwd0: jax.Array,

@@ -42,6 +42,7 @@ from arrow_matrix_tpu.parallel.mesh import (
     build_global_parts,
     fetch_replicated,
     put_global,
+    shard_map_check_kwargs,
 )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scipy import sparse
@@ -263,7 +264,7 @@ class SpMM15D:
             in_specs=(P(rows_axis, repl_axis), P(rows_axis, repl_axis),
                       P(rows_axis)),
             out_specs=P(rows_axis, repl_axis),
-            check_vma=False,
+            **shard_map_check_kwargs(),
         ))
 
     # -- feature placement -------------------------------------------------

@@ -50,6 +50,7 @@ from arrow_matrix_tpu.parallel.mesh import (
     build_global_parts,
     fetch_replicated,
     put_global,
+    shard_map_check_kwargs,
 )
 from scipy import sparse
 
@@ -407,7 +408,7 @@ class MatrixSlice1D:
             local_step, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
             out_specs=P(axis),
-            check_vma=False,
+            **shard_map_check_kwargs(),
         ))
 
     # -- feature placement -------------------------------------------------

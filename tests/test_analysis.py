@@ -1,0 +1,263 @@
+"""graft-lint (arrow_matrix_tpu.analysis) — one positive and one
+negative fixture per rule R1-R6, the waiver machinery, the
+package-clean gate (the shipped tree must lint clean, the same
+invariant amt_doctor and tools/lint_gate.py enforce), and a
+reduced-scale run of the trace-time recompile audit."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import arrow_matrix_tpu
+from arrow_matrix_tpu.analysis import lint_paths, lint_source, rule_table
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(source: str, path: str = "case.py"):
+    findings, waived = lint_source(textwrap.dedent(source), path)
+    return [f.rule for f in findings], [w.rule for w in waived]
+
+
+# ---------------------------------------------------------------------------
+# One (positive, negative) fixture pair per rule.  Positives must fire
+# exactly the rule under test; negatives must be silent.
+# ---------------------------------------------------------------------------
+
+FIXTURES = {
+    "R1": (
+        # host sync inside a jitted function: float() forces a device
+        # round-trip per trace.
+        """
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x) + 1
+        """,
+        # static shape access is host-side metadata, not a sync.
+        """
+        import jax
+        @jax.jit
+        def f(x):
+            k = int(x.shape[-1])
+            return x * k
+        """,
+    ),
+    "R2": (
+        # fresh jit per call: nothing caches across invocations.
+        """
+        import jax
+        def g(f, x):
+            return jax.jit(f)(x)
+        """,
+        # jit factory memoized by lru_cache — the mesh.py _replicator
+        # idiom.
+        """
+        import jax, functools
+        @functools.lru_cache(maxsize=8)
+        def make(n):
+            return jax.jit(lambda x: x * n)
+        def g(x):
+            return make(3)(x)
+        """,
+    ),
+    "R3": (
+        # scan over a carried buffer jitted without donation: the old
+        # carry buffer doubles the footprint.
+        """
+        import jax
+        from jax import lax
+        def scan_steps(x, blocks, n):
+            def body(c, _):
+                return c @ blocks, None
+            out, _ = lax.scan(body, x, None, length=n)
+            return out
+        step = jax.jit(scan_steps, static_argnames=("n",))
+        """,
+        # donated sibling present — the multi_level/sell_slim pairing.
+        """
+        import jax
+        from jax import lax
+        def scan_steps(x, blocks, n):
+            def body(c, _):
+                return c @ blocks, None
+            out, _ = lax.scan(body, x, None, length=n)
+            return out
+        step = jax.jit(scan_steps, static_argnames=("n",))
+        step_d = jax.jit(scan_steps, static_argnames=("n",),
+                         donate_argnums=(0,))
+        """,
+    ),
+    "R4": (
+        # PartitionSpec names an axis no mesh in the module declares.
+        """
+        from jax.sharding import Mesh, PartitionSpec as P
+        import numpy as np, jax
+        mesh = Mesh(np.array(jax.devices()), ("blocks",))
+        spec = P("blocka")
+        """,
+        """
+        from jax.sharding import Mesh, PartitionSpec as P
+        import numpy as np, jax
+        mesh = Mesh(np.array(jax.devices()), ("blocks",))
+        spec = P("blocks")
+        """,
+    ),
+    "R5": (
+        # bare float literal in traced arithmetic: weak-type promotion
+        # can silently upcast bf16/f16 operands.
+        """
+        import jax
+        @jax.jit
+        def f(x):
+            return x * 0.5
+        """,
+        # typed scalar (and int literals, which promote safely).
+        """
+        import jax
+        @jax.jit
+        def f(x):
+            return x * x.dtype.type(0.5) + x * 2
+        """,
+    ),
+    "R6": (
+        # np.asarray on a device value outside any jit: an unguarded
+        # blocking device_get.
+        """
+        import jax.numpy as jnp
+        import numpy as np
+        def f(cols):
+            y = jnp.dot(cols, cols)
+            return np.asarray(y)
+        """,
+        # host-only numpy pipeline: no device value involved.
+        """
+        import numpy as np
+        def f(x):
+            y = np.dot(x, x)
+            return np.asarray(y)
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_positive_fires(rule):
+    fired, _ = _rules(FIXTURES[rule][0])
+    assert rule in fired, f"{rule} positive fixture did not fire: {fired}"
+    assert set(fired) == {rule}, (
+        f"{rule} positive fixture fired extra rules: {fired}")
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_negative_silent(rule):
+    fired, _ = _rules(FIXTURES[rule][1])
+    assert rule not in fired, (
+        f"{rule} negative fixture fired anyway: {fired}")
+
+
+def test_all_six_rules_registered():
+    ids = {spec.rule_id for spec in rule_table()}
+    assert ids >= {"R1", "R2", "R3", "R4", "R5", "R6"}
+
+
+def test_waiver_suppresses_and_records():
+    fired, waived = _rules("""
+        import jax.numpy as jnp
+        import numpy as np
+        def f(cols):
+            y = jnp.dot(cols, cols)
+            return np.asarray(y)  # graft-lint: disable=R6
+        """)
+    assert fired == [] and waived == ["R6"]
+
+
+def test_file_waiver_suppresses_all():
+    fired, waived = _rules("""
+        # graft-lint: disable-file=R6
+        import jax.numpy as jnp
+        import numpy as np
+        def f(cols):
+            y = jnp.dot(cols, cols)
+            return np.asarray(y)
+        """)
+    assert fired == [] and waived == ["R6"]
+
+
+def test_select_filters_rules():
+    findings, _ = lint_source(textwrap.dedent(FIXTURES["R5"][0]),
+                              "case.py", select=frozenset({"R1"}))
+    assert findings == []
+
+
+def test_finding_format_and_json():
+    findings, _ = lint_source(textwrap.dedent(FIXTURES["R1"][0]), "p.py")
+    assert findings
+    f = findings[0]
+    assert f.format().startswith(f"p.py:{f.line} R1 ")
+    rec = f.to_json()
+    assert rec["path"] == "p.py" and rec["rule"] == "R1"
+
+
+# ---------------------------------------------------------------------------
+# The package gate: the shipped tree must lint clean.
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_package_lints_clean():
+    pkg = os.path.dirname(os.path.abspath(arrow_matrix_tpu.__file__))
+    findings, _ = lint_paths([pkg])
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_cli_exits_nonzero_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(FIXTURES["R1"][0]))
+    proc = subprocess.run(
+        [sys.executable, "-m", "arrow_matrix_tpu.analysis",
+         str(bad), "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out["count"] == 1
+    assert out["findings"][0]["rule"] == "R1"
+
+
+def test_cli_exits_zero_on_clean(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "arrow_matrix_tpu.analysis", str(good)],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Trace-time audit (engine 2) at reduced scale: every core SpMM entry
+# point must compile once and reuse the cache on a same-shape call.
+# ---------------------------------------------------------------------------
+
+
+def test_audit_zero_recompiles_reduced_scale():
+    from arrow_matrix_tpu.analysis.audit import run_audit
+
+    manifest = run_audit(n=128, width=32, k=4, n_dev=4, write=False)
+    assert manifest["ok"], json.dumps(manifest["entries"], indent=2)
+    names = {e["entry"] for e in manifest["entries"]}
+    assert names == {"spmm_1d.MatrixSlice1D", "spmm_15d.SpMM15D",
+                     "sell_slim.SellSlim",
+                     "multi_level.MultiLevelArrow"}
+    for e in manifest["entries"]:
+        assert e["recompiles_second_call"] == 0
+
+
+def test_manifest_checked_in_and_ok():
+    path = os.path.join(REPO, "bench_cache", "compile_manifest.json")
+    with open(path, encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    assert manifest["ok"]
+    assert len(manifest["entries"]) == 4

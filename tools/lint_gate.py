@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Tier-1 lint gate: run graft-lint over the package and fail loudly.
+
+The pytest suite already gates on a clean lint
+(tests/test_analysis.py::test_shipped_package_lints_clean); this script
+is the same invariant as a standalone pre-push / CI step, matching the
+other tools/*.py entry points the watcher runs unattended.  It prints
+the findings (if any) and exits with graft-lint's status: 0 clean,
+1 findings.  ``--audit`` additionally runs the trace-time recompile
+audit and refreshes bench_cache/compile_manifest.json.
+
+Usage:
+  python tools/lint_gate.py [--audit] [paths...]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from arrow_matrix_tpu.analysis.__main__ import main as graft_lint_main
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    run_audit = "--audit" in argv
+    if run_audit:
+        argv.remove("--audit")
+    rc = graft_lint_main(argv)
+    if rc != 0:
+        print("lint gate: FAILED (fix the findings or waive them with "
+              "`# graft-lint: disable=<rule>` and a justification)",
+              file=sys.stderr)
+        return rc
+    if run_audit:
+        rc = graft_lint_main(["audit"])
+        if rc != 0:
+            print("lint gate: trace-time audit FAILED", file=sys.stderr)
+            return rc
+    print("lint gate: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
