@@ -5,7 +5,7 @@ Two complementary engines guard the invariants the benches depend on
 
 * **AST pass** (`core` + `rules`): a visitor-based linter over the
   package source with an extensible rule registry.  The shipped rules
-  (R1-R6) encode the recompilation, host-sync, and sharding hazards
+  (R1-R7) encode the recompilation, host-sync, and sharding hazards
   that silently destroy TPU throughput — the class of bug an MPI code
   never meets but a jit/shard_map code re-discovers one bench
   regression at a time.

@@ -1,4 +1,4 @@
-"""The shipped graft-lint rules (R1-R6).
+"""The shipped graft-lint rules (R1-R7).
 
 Each rule encodes a hazard this codebase has actually met (or defends
 against by convention), grounded at the call sites named in its
@@ -520,3 +520,97 @@ def check_device_get(ctx: ModuleContext) -> Iterable[Tuple[int, str]]:
                         f"through one unbounded RPC; route it through "
                         f"utils.transfer/fetch_replicated (bounded, "
                         f"wedge-safe) or waive if provably tiny")
+
+
+# ---------------------------------------------------------------------------
+# R7 — unsynced-timing
+# ---------------------------------------------------------------------------
+
+#: Host clocks used to time wall intervals.
+_TIMER_CALLS = frozenset({"time.perf_counter", "time.monotonic",
+                          "time.time"})
+
+
+def _is_timer_call(ctx: ModuleContext, node) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.resolve(node.func) in _TIMER_CALLS)
+
+
+def _is_block_call(ctx: ModuleContext, node) -> bool:
+    """Any spelling of a dispatch barrier: ``jax.block_until_ready(x)``,
+    ``x.block_until_ready()``, or the tolerant helper from
+    utils/logging.py imported as a bare name."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "block_until_ready":
+        return True
+    if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+        return True
+    full = ctx.resolve(func) or ""
+    return full.endswith("block_until_ready")
+
+
+@register("R7", "unsynced-timing",
+          "a perf_counter region that times a jitted callable without "
+          "block_until_ready measures async dispatch, not device "
+          "execution")
+def check_unsynced_timing(ctx: ModuleContext) -> Iterable[Tuple[int, str]]:
+    """Timing a jitted call without synchronising.
+
+    JAX dispatch is asynchronous: ``t0 = time.perf_counter(); y = f(x);
+    dt = time.perf_counter() - t0`` with a jitted ``f`` measures launch
+    overhead (microseconds) while the device is still computing — the
+    hazard the block-until-ready harness in obs/tracer.py exists to
+    close.  The rule tracks names assigned from ``jax.jit(...)``, finds
+    ``start = perf_counter()`` / ``... perf_counter() - start`` pairs in
+    the same function, and flags jitted-name calls inside the region
+    when no ``block_until_ready`` (any spelling) appears between start
+    and stop.
+    """
+    jit_names: set = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and ctx.resolve(node.value.func) in JIT_WRAPPERS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    jit_names.add(t.id)
+    if not jit_names:
+        return
+    for scope, nodes in _scope_nodes(ctx):
+        starts = {}
+        for node in nodes:
+            if (isinstance(node, ast.Assign)
+                    and _is_timer_call(ctx, node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        starts[t.id] = node.lineno
+        if not starts:
+            continue
+        body = ctx.tree if scope is None else scope
+        regions = []
+        for node in ast.walk(body):
+            if (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and _is_timer_call(ctx, node.left)
+                    and isinstance(node.right, ast.Name)
+                    and node.right.id in starts
+                    and node.lineno > starts[node.right.id]
+                    and ctx.enclosing_function(node) is scope):
+                regions.append((starts[node.right.id], node.lineno))
+        for lo, hi in regions:
+            in_region = [c for c in nodes
+                         if isinstance(c, ast.Call)
+                         and lo < c.lineno <= hi]
+            if any(_is_block_call(ctx, c) for c in in_region):
+                continue
+            for call in in_region:
+                if (isinstance(call.func, ast.Name)
+                        and call.func.id in jit_names):
+                    yield call.lineno, (
+                        f"{call.func.id!r} (a jitted callable) is timed "
+                        f"by a perf_counter region with no "
+                        f"block_until_ready; dispatch is asynchronous, "
+                        f"so this measures launch overhead, not device "
+                        f"time — block on the result inside the region")

@@ -137,6 +137,39 @@ def probe_lint() -> tuple[bool, str]:
         return False, f"{type(e).__name__}: {str(e)[:100]}"
 
 
+def probe_obs() -> tuple[bool, str]:
+    """graft-scope round-trip: the obs layer imports and a minimal
+    smoke trace (one algorithm, 2 devices) produces a valid run
+    directory — trace JSON, metrics.jsonl, summary.json.  Bounded
+    subprocess: the probe must not inherit this process's backend
+    state, and a wedged build must not hang the doctor."""
+    code = ("import sys, tempfile; sys.argv=[]; "
+            "from arrow_matrix_tpu.utils.platform import "
+            "force_cpu_devices; force_cpu_devices(2); "
+            "from arrow_matrix_tpu.obs.smoke import run_smoke, "
+            "validate_run_dir; d = tempfile.mkdtemp(prefix='obs_probe_'); "
+            "run_smoke(d, n=64, width=16, k=2, n_dev=2, iters=1, "
+            "algorithms=('spmm_1d',)); p = validate_run_dir(d, "
+            "algorithms=('spmm_1d',)); "
+            "print('OBS ok' if not p else 'OBS FAIL: ' + p[0])")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("OBS")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "OBS ok":
+        return False, lines[-1][:120]
+    return True, ("smoke trace round-trips — run "
+                  "`python -m arrow_matrix_tpu.obs smoke <dir>` for "
+                  "the full five-algorithm run")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -191,7 +224,10 @@ def main(argv=None) -> int:
     _check("native decomposer", n, detail)
 
     lint_ok, detail = probe_lint()
-    ok &= _check("graft-lint (static analysis, R1-R6)", lint_ok, detail)
+    ok &= _check("graft-lint (static analysis, R1-R7)", lint_ok, detail)
+
+    obs_ok, detail = probe_obs()
+    ok &= _check("graft-scope (obs smoke trace)", obs_ok, detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

@@ -2,7 +2,7 @@
 
 Thin wrapper exposing the analysis engines as a console entry point
 alongside ``amt_doctor``: lints the installed package (or explicit
-paths) with the R1-R6 rule set and can run the trace-time recompile
+paths) with the R1-R7 rule set and can run the trace-time recompile
 audit.  The real implementation lives in ``arrow_matrix_tpu.analysis``;
 this module exists so ``python -m arrow_matrix_tpu.cli.graft_lint``
 and the pyproject console script reach it the same way the other CLIs

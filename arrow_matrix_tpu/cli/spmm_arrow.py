@@ -195,6 +195,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "(viewable in XProf/TensorBoard; the "
                              "per-op device-time counterpart of the "
                              "named-segment wall timing).")
+    parser.add_argument("--obs_dir", type=str, default=None,
+                        help="Write graft-scope artifacts for this run "
+                             "to this directory: a Perfetto-loadable "
+                             "Chrome trace of the iteration loop plus "
+                             "metrics.jsonl (per-iteration step time, "
+                             "collective-bytes accounting); inspect "
+                             "with `graft_trace summarize <dir>`.")
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--logdir", type=str, default="./logs")
     add_device_args(parser)
@@ -441,32 +448,46 @@ def main(argv=None) -> int:
         graphs.random_dense(n, args.features, seed=args.seed))
     jax.block_until_ready(multi.step(warm))
 
+    from arrow_matrix_tpu import obs
+
+    obs_reg = obs.MetricsRegistry(run_dir=args.obs_dir)
+    obs_tracer = obs.Tracer("spmm_arrow", registry=obs_reg)
+
     if args.comm_report:
         from arrow_matrix_tpu.utils import commstats
 
         if getattr(multi, "mesh", None) is None:
             print("comm report: single-chip execution — zero "
                   "collective bytes by construction")
-        elif (getattr(multi, "feature_dtype", None) is not None
-                and getattr(multi, "routing", None) == "a2a"):
-            # bf16 carriage: the CPU backend upcasts compiled
-            # collectives to f32, so account the LOWERED module (all
-            # a2a-path collectives are explicit shard_map ops and
-            # appear there; commstats docstring).
-            stats = commstats.lowered_collective_stats(
-                multi.step_fn, warm, *multi.step_operands())
-            print("per-iteration collective bytes (lowered HLO — "
-                  "dtype-honest for the bf16 carriage):")
-            print(commstats.format_stats(stats))
         else:
-            stats = commstats.collective_stats(
-                multi.step_fn, warm, *multi.step_operands())
-            print("per-iteration collective bytes (compiled HLO):")
-            if getattr(multi, "feature_dtype", None) is not None:
+            # bf16 carriage: the CPU backend upcasts compiled
+            # collectives to f32, so pin the LOWERED module (all
+            # a2a-path collectives are explicit shard_map ops and
+            # appear there; commstats docstring).  Otherwise "auto"
+            # prefers the lowered module and falls back to compiled
+            # when the routing is GSPMD-inserted.
+            pinned = (getattr(multi, "feature_dtype", None) is not None
+                      and getattr(multi, "routing", None) == "a2a")
+            itemsize = 2 if args.feature_dtype == "bf16" else 4
+            rep = obs.account_collectives(
+                "spmm_arrow", multi.step_fn, warm,
+                *multi.step_operands(),
+                ideal_bytes=obs.ideal_bytes_for(multi, args.features,
+                                                itemsize=itemsize),
+                mode="lowered" if pinned else "auto",
+                registry=obs_reg)
+            print(f"per-iteration collective bytes "
+                  f"({rep['source']} HLO):")
+            if (rep["source"] == "compiled"
+                    and getattr(multi, "feature_dtype", None) is not None):
                 print("(note: on the CPU backend compiled collectives "
                       "upcast bf16 to f32 — bytes shown are the f32 "
                       "upper bound)")
-            print(commstats.format_stats(stats))
+            print(commstats.format_stats(rep["collectives"]))
+            if rep["ratio"] is not None:
+                print(f"measured vs paper-model ideal: "
+                      f"{rep['measured_bytes']} / {rep['ideal_bytes']} "
+                      f"bytes = {rep['ratio']:.2f}x")
 
     rng = np.random.default_rng(args.seed)
     fail = False
@@ -504,10 +525,14 @@ def main(argv=None) -> int:
                 if args.carry and args.validate:
                     # The golden compares one step from the CURRENT state.
                     x_host = multi.gather_result(x)
-                tic = time.perf_counter()
-                y = multi.step(x)
-                jax.block_until_ready(y)
-                wb.log({"spmm_time": time.perf_counter() - tic})
+                with obs_tracer.span("step", iteration=it):
+                    tic = time.perf_counter()
+                    y = multi.step(x)
+                    jax.block_until_ready(y)
+                    dt = time.perf_counter() - tic
+                wb.log({"spmm_time": dt})
+                obs_reg.record("iteration_time_ms", dt * 1e3,
+                               algorithm="spmm_arrow")
                 if args.carry:
                     x = y
             except Exception as e:  # abort like the collective LOR flag
@@ -555,6 +580,14 @@ def main(argv=None) -> int:
         s = summary["spmm_time"]
         print(f"spmm_time mean {s['mean'] * 1e3:.3f} ms over "
               f"{s['count']} iterations (min {s['min'] * 1e3:.3f})")
+    if args.obs_dir:
+        os.makedirs(args.obs_dir, exist_ok=True)
+        obs_reg.merge_segment_log(wb.get_log())
+        obs_tracer.save(os.path.join(args.obs_dir,
+                                     "spmm_arrow.trace.json"))
+        obs_reg.write_jsonl()
+        print(f"graft-scope artifacts in {args.obs_dir} "
+              f"(graft_trace summarize to inspect)")
     out = wb.finish(args.logdir)
     if out:
         print(f"log written to {out}.json")

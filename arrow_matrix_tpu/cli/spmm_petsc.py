@@ -206,11 +206,19 @@ def main(argv=None) -> int:
     y = dist.spmm(x)  # compile + warmup
     jax.block_until_ready(y)
     if args.comm_report:
+        from arrow_matrix_tpu import obs
         from arrow_matrix_tpu.utils import commstats
 
-        stats = commstats.collective_stats(dist._step, dist.l_cols, dist.l_data, dist.nl_cols, dist.nl_data, dist.send_idx, x)
-        print("per-iteration collective bytes (compiled HLO):")
-        print(commstats.format_stats(stats))
+        rep = obs.account_collectives(
+            "spmm_1d", dist._step, dist.l_cols, dist.l_data,
+            dist.nl_cols, dist.nl_data, dist.send_idx, x,
+            ideal_bytes=obs.ideal_bytes_for(dist, args.columns))
+        print(f"per-iteration collective bytes ({rep['source']} HLO):")
+        print(commstats.format_stats(rep["collectives"]))
+        if rep["ratio"] is not None:
+            print(f"measured vs paper-model ideal: "
+                  f"{rep['measured_bytes']} / {rep['ideal_bytes']} "
+                  f"bytes = {rep['ratio']:.2f}x")
     for it in range(args.iterations):
         wb.set_iteration_data({"iteration": it})
         tic = time.perf_counter()

@@ -1,0 +1,50 @@
+"""graft-scope: runtime observability for the SpMM paths.
+
+One layer every runtime entry point reports into, closing the loop on
+the paper's headline claim (communication volume) per run:
+
+  * :mod:`~arrow_matrix_tpu.obs.metrics` — process-level counters /
+    gauges / histograms with a JSONL sink (the quantitative record);
+  * :mod:`~arrow_matrix_tpu.obs.tracer` — host-side phase spans that
+    double as ``jax.named_scope`` + profiler annotations, emitted as
+    Chrome-trace / Perfetto JSON, plus the shared block-until-ready
+    timing harness (``bench.py``'s former private ``_timed`` /
+    ``_measure``);
+  * :mod:`~arrow_matrix_tpu.obs.comm` — trace-time collective-byte
+    accounting (utils/commstats) compared against each orchestration's
+    ``ideal_comm_bytes`` paper cost model;
+  * :mod:`~arrow_matrix_tpu.obs.smoke` — a reduced-scale CPU-mesh run
+    of all five parallel algorithms producing one inspectable run
+    directory (traces + metrics.jsonl + summary.json).
+
+CLI: ``python -m arrow_matrix_tpu.obs`` (``graft_trace``) summarizes a
+run directory, diffs two runs with regression flagging, exports merged
+traces, and drives the smoke harness.
+"""
+
+from arrow_matrix_tpu.obs.comm import account_collectives, ideal_bytes_for
+from arrow_matrix_tpu.obs.metrics import (
+    MetricsRegistry,
+    get_registry,
+    init_registry,
+    set_registry,
+)
+from arrow_matrix_tpu.obs.tracer import (
+    Tracer,
+    chained_iteration_ms,
+    iteration_time_ms,
+    timed,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "Tracer",
+    "account_collectives",
+    "chained_iteration_ms",
+    "get_registry",
+    "ideal_bytes_for",
+    "init_registry",
+    "iteration_time_ms",
+    "set_registry",
+    "timed",
+]

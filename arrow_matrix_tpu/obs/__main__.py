@@ -1,0 +1,227 @@
+"""graft_trace: inspect, diff, and produce graft-scope run directories.
+
+Subcommands:
+
+  smoke OUT          reduced-scale CPU-mesh run of the five parallel
+                     algorithms -> OUT/{<algo>.trace.json,
+                     metrics.jsonl, summary.json}
+  summarize RUN      per-algorithm table: phase ms, step ms, bytes vs
+                     ideal
+  diff A B           per-algorithm, per-phase deltas between two runs;
+                     exits 1 when any phase (or measured bytes)
+                     regresses beyond --threshold
+  export RUN --out   merge the per-algorithm traces into one
+                     Perfetto-loadable file (one pid per algorithm)
+
+Installed as ``graft_trace`` (pyproject) and runnable as
+``python -m arrow_matrix_tpu.obs``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _load_summary(run_dir: str) -> dict:
+    path = os.path.join(run_dir, "summary.json")
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _fmt_bytes(b) -> str:
+    return "-" if b is None else f"{int(b):,d}"
+
+
+def _fmt_ratio(r) -> str:
+    return "-" if r is None else f"{r:.2f}"
+
+
+def cmd_smoke(args) -> int:
+    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.devices)
+
+    from arrow_matrix_tpu.obs.smoke import (
+        ALGORITHMS,
+        run_smoke,
+        validate_run_dir,
+    )
+
+    algorithms = (tuple(args.algorithms.split(","))
+                  if args.algorithms else ALGORITHMS)
+    run_smoke(args.out, n=args.n, width=args.width, k=args.k,
+              n_dev=args.devices, iters=args.iters, algorithms=algorithms)
+    problems = validate_run_dir(args.out, algorithms=algorithms)
+    for p in problems:
+        print(f"INVALID: {p}", file=sys.stderr)
+    print(f"run dir: {args.out}")
+    _print_summary(_load_summary(args.out))
+    return 1 if problems else 0
+
+
+def _print_summary(summary: dict) -> None:
+    algos = summary.get("algorithms", {})
+    print(f"{'algorithm':12s} {'step ms':>9s} {'iterate ms':>11s} "
+          f"{'comm bytes':>12s} {'ideal':>12s} {'ratio':>6s}")
+    for name, rec in sorted(algos.items()):
+        iterate = rec.get("phase_ms", {}).get(f"{name}/iterate")
+        print(f"{name:12s} {rec.get('step_ms_mean', 0.0):9.2f} "
+              f"{(iterate or 0.0):11.1f} "
+              f"{_fmt_bytes(rec.get('measured_bytes')):>12s} "
+              f"{_fmt_bytes(rec.get('ideal_bytes')):>12s} "
+              f"{_fmt_ratio(rec.get('bytes_vs_ideal')):>6s}")
+
+
+def cmd_summarize(args) -> int:
+    summary = _load_summary(args.run)
+    scale = summary.get("scale", {})
+    if scale:
+        print("scale: " + ", ".join(f"{k}={v}"
+                                    for k, v in sorted(scale.items())))
+    _print_summary(summary)
+    return 0
+
+
+def _diff_records(a: dict, b: dict, threshold: float,
+                  min_delta_ms: float) -> List[dict]:
+    """Per-algorithm, per-quantity relative deltas b vs a.  A quantity
+    'regresses' when it grows by more than ``threshold`` (relative) —
+    time deltas additionally need ``min_delta_ms`` absolute growth so
+    scheduler noise on micro-phases doesn't flag."""
+    rows: List[dict] = []
+    for name in sorted(set(a) | set(b)):
+        ra, rb = a.get(name), b.get(name)
+        if ra is None or rb is None:
+            rows.append({"algorithm": name, "quantity": "presence",
+                         "a": ra is not None, "b": rb is not None,
+                         "delta": None,
+                         "regressed": ra is not None and rb is None})
+            continue
+
+        quantities: Dict[str, tuple] = {
+            "step_ms_mean": (ra.get("step_ms_mean"),
+                             rb.get("step_ms_mean"), True),
+            "measured_bytes": (ra.get("measured_bytes"),
+                               rb.get("measured_bytes"), False),
+        }
+        pa, pb = ra.get("phase_ms", {}), rb.get("phase_ms", {})
+        for phase in sorted(set(pa) | set(pb)):
+            quantities[f"phase:{phase}"] = (pa.get(phase), pb.get(phase),
+                                            True)
+
+        for qname, (va, vb, is_time) in quantities.items():
+            if va is None or vb is None:
+                continue
+            delta = None if va == 0 else (vb - va) / va
+            grew = (vb - va) > (min_delta_ms if is_time else 0)
+            regressed = (delta is not None and delta > threshold and grew)
+            rows.append({"algorithm": name, "quantity": qname,
+                         "a": va, "b": vb, "delta": delta,
+                         "regressed": regressed})
+    return rows
+
+
+def cmd_diff(args) -> int:
+    sa = _load_summary(args.run_a).get("algorithms", {})
+    sb = _load_summary(args.run_b).get("algorithms", {})
+    rows = _diff_records(sa, sb, args.threshold, args.min_delta_ms)
+
+    regressions = 0
+    print(f"{'algorithm':12s} {'quantity':28s} {'A':>12s} {'B':>12s} "
+          f"{'delta':>8s}")
+    for r in rows:
+        if r["quantity"] == "presence":
+            if r["regressed"]:
+                regressions += 1
+                print(f"{r['algorithm']:12s} {'presence':28s} "
+                      f"{'yes':>12s} {'MISSING':>12s} {'':>8s}  REGRESSED")
+            continue
+        delta = "-" if r["delta"] is None else f"{r['delta']:+.1%}"
+        flag = "  REGRESSED" if r["regressed"] else ""
+        if r["regressed"]:
+            regressions += 1
+        if args.all or r["regressed"]:
+            print(f"{r['algorithm']:12s} {r['quantity']:28s} "
+                  f"{r['a']:12.2f} {r['b']:12.2f} {delta:>8s}{flag}")
+    if regressions:
+        print(f"{regressions} regression(s) beyond "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print("no regressions")
+    return 0
+
+
+def cmd_export(args) -> int:
+    summary = _load_summary(args.run)
+    events: List[dict] = []
+    for pid, (name, rec) in enumerate(
+            sorted(summary.get("algorithms", {}).items()), start=1):
+        tpath = os.path.join(args.run, rec.get("trace",
+                                               f"{name}.trace.json"))
+        with open(tpath, encoding="utf-8") as fh:
+            trace = json.load(fh)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": name}})
+        for e in trace.get("traceEvents", ()):
+            if e.get("ph") == "M":
+                continue
+            e = dict(e)
+            e["pid"] = pid
+            events.append(e)
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {args.out} ({len(events)} events)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graft_trace", description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("smoke", help="reduced-scale CPU-mesh smoke run")
+    sp.add_argument("out", help="run directory to create")
+    sp.add_argument("--devices", type=int, default=4)
+    sp.add_argument("--n", type=int, default=256)
+    sp.add_argument("--width", type=int, default=32)
+    sp.add_argument("--k", type=int, default=4)
+    sp.add_argument("--iters", type=int, default=3)
+    sp.add_argument("--algorithms", default=None,
+                    help="comma-separated subset (default: all five)")
+    sp.set_defaults(fn=cmd_smoke)
+
+    ss = sub.add_parser("summarize", help="summarize a run directory")
+    ss.add_argument("run")
+    ss.set_defaults(fn=cmd_summarize)
+
+    sd = sub.add_parser("diff", help="diff run B against baseline A")
+    sd.add_argument("run_a")
+    sd.add_argument("run_b")
+    sd.add_argument("--threshold", type=float, default=0.2,
+                    help="relative growth beyond which a quantity "
+                         "counts as regressed (default 0.2 = +20%%)")
+    sd.add_argument("--min-delta-ms", type=float, default=0.1,
+                    help="absolute ms growth a time delta must also "
+                         "exceed (noise floor for micro-phases)")
+    sd.add_argument("--all", action="store_true",
+                    help="print every quantity, not just regressions")
+    sd.set_defaults(fn=cmd_diff)
+
+    se = sub.add_parser("export", help="merge per-algorithm traces into "
+                                       "one Perfetto file")
+    se.add_argument("run")
+    se.add_argument("--out", required=True)
+    se.set_defaults(fn=cmd_export)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

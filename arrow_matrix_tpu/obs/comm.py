@@ -1,0 +1,91 @@
+"""Trace-time communication accounting vs the paper's cost model.
+
+``account_collectives`` lowers a jitted entry point (no execution
+needed), reads its HLO collective bytes via utils/commstats, and —
+when the orchestration exposes an ``ideal_comm_bytes(k)`` model —
+records the measured/ideal ratio as a first-class metric.  The ratio
+is the run-level statement of the paper's headline claim: 1.0 means
+the compiled program moves exactly the bytes the arrow cost model
+predicts; large ratios mean the lowering (or a regression) is paying
+for communication the algorithm doesn't require.
+
+Two HLO sources, selected by ``mode``:
+
+  * ``"lowered"`` — pre-partitioning HLO: dtype-honest (the CPU
+    backend upcasts bf16 collectives to f32 in compiled HLO) but blind
+    to GSPMD-inserted collectives;
+  * ``"compiled"`` — post-partitioning HLO: sees compiler-inserted
+    collectives (the "gather" routing lowerings) but is subject to CPU
+    dtype legalization;
+  * ``"auto"`` (default) — lowered first, falling back to compiled
+    when the lowered program shows zero collective bytes (i.e. the
+    collectives only exist post-GSPMD).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from arrow_matrix_tpu.utils import commstats
+
+
+def ideal_bytes_for(obj, k: int, itemsize: int = 4) -> Optional[int]:
+    """The orchestration's own paper-model byte count for one
+    iteration at feature width ``k``, or None when it has no model."""
+    fn = getattr(obj, "ideal_comm_bytes", None)
+    if fn is None:
+        return None
+    return int(fn(k, itemsize=itemsize))
+
+
+def account_collectives(algorithm: str, jitted_fn, *args,
+                        ideal_bytes: Optional[int] = None,
+                        mode: str = "auto",
+                        registry=None, **kwargs) -> Dict[str, Any]:
+    """Account one jitted entry point's collective bytes at trace time.
+
+    Returns ``{"algorithm", "collectives" (full commstats dict, usable
+    with format_stats), "measured_bytes", "ideal_bytes", "ratio",
+    "source"}``.  ``ratio`` is None when no ideal model was supplied or
+    the ideal is zero (single-device meshes legitimately move nothing).
+    """
+    if mode not in ("auto", "lowered", "compiled"):
+        raise ValueError(f"unknown mode {mode!r}")
+
+    source = mode
+    if mode == "compiled":
+        stats = commstats.collective_stats(jitted_fn, *args, **kwargs)
+    else:
+        stats = commstats.lowered_collective_stats(jitted_fn, *args,
+                                                   **kwargs)
+        source = "lowered"
+        if mode == "auto" and stats["total_bytes"] == 0:
+            # No explicit collectives in the traced program — the
+            # routing (if any) is GSPMD-inserted, visible only after
+            # partitioning.
+            stats = commstats.collective_stats(jitted_fn, *args, **kwargs)
+            source = "compiled"
+
+    measured = int(stats["total_bytes"])
+    ratio = None
+    if ideal_bytes:
+        ratio = measured / ideal_bytes
+
+    if registry is not None:
+        registry.gauge("comm_measured_bytes", algorithm=algorithm).set(
+            measured)
+        if ideal_bytes is not None:
+            registry.gauge("comm_ideal_bytes", algorithm=algorithm).set(
+                ideal_bytes)
+        if ratio is not None:
+            registry.gauge("comm_vs_ideal_ratio", algorithm=algorithm).set(
+                ratio)
+
+    return {
+        "algorithm": algorithm,
+        "collectives": stats,
+        "measured_bytes": measured,
+        "ideal_bytes": ideal_bytes,
+        "ratio": ratio,
+        "source": source,
+    }

@@ -418,6 +418,21 @@ class MultiLevelArrow:
                                      self.total_rows)
         self.inv_perm0 = np.argsort(self.perm0)
 
+        # Paper cost model of the inter-level routing in row-units
+        # (k=1, itemsize=1): only rows whose adjacent-level positions
+        # land on different devices move (the reference Alltoallv
+        # payload).  Single chip: no routing exchange at all.
+        if mesh is not None:
+            from arrow_matrix_tpu.utils import commstats
+
+            padded = [pad_permutation(np.asarray(lvl.permutation),
+                                      self.total_rows)
+                      for lvl in levels]
+            self._ideal_route_units = commstats.ideal_routing_bytes(
+                padded, mesh.shape[axis], 1, itemsize=1)
+        else:
+            self._ideal_route_units = 0
+
         self.routing = routing
         if mesh is not None:
             self.blocks = [shard_arrow_blocks(b, mesh, axis)
@@ -558,6 +573,7 @@ class MultiLevelArrow:
         self.fmts = ["fold"]
         self.routing = "none"
         self.fwd = self.bwd = ()
+        self._ideal_route_units = 0  # single-chip fold: zero routing
 
         def fold_step(xt, fwd, bwd, blocks):
             if chunk == "auto":
@@ -752,6 +768,13 @@ class MultiLevelArrow:
         output are flat (total_rows, k) arrays in level-0 order."""
         return self._step(x, self.fwd, self.bwd, self.blocks)
 
+    def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Paper cost model for one step at feature width ``k``:
+        inter-level permutation routing counts only rows that change
+        device (zero on a single chip or under fmt='fold') — the bound
+        obs/comm judges the compiled collective bytes against."""
+        return self._ideal_route_units * k * itemsize
+
     def run(self, x: jax.Array, iterations: int,
             donate: bool = False) -> jax.Array:
         """``iterations`` steps as ONE device program (`lax.scan` over
@@ -815,65 +838,74 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
     x_cur = x
     for i in range(k_levels):
         if i > 0:
-            x_cur = routed_or_take(x_cur, fwd[i - 1], mesh, axis)
-        if isinstance(blocks[i], HybLevel):
-            # Whole-level split-ELL on flat features (single chip; no
-            # blocking — see ops/hyb.py).
-            from arrow_matrix_tpu.ops.ell import auto_chunk
-            from arrow_matrix_tpu.ops.hyb import hyb_spmm
+            with jax.named_scope(f"route_forward_{i}"):
+                x_cur = routed_or_take(x_cur, fwd[i - 1], mesh, axis)
+        with jax.named_scope(f"level_{i}_spmm"):
+            if isinstance(blocks[i], HybLevel):
+                # Whole-level split-ELL on flat features (single chip;
+                # no blocking — see ops/hyb.py).
+                from arrow_matrix_tpu.ops.ell import auto_chunk
+                from arrow_matrix_tpu.ops.hyb import hyb_spmm
 
-            m0 = blocks[i].light_cols.shape[0]   # slot-major (m0, rows)
-            hyb_chunk = (auto_chunk(total, k, m0, gather_budget)
-                         if chunk == "auto" else chunk)
-            partials.append(hyb_spmm(blocks[i], x_cur, chunk=hyb_chunk,
-                                     heavy_chunk=hyb_chunk))
-            continue
-        w = widths[i]
-        xb = x_cur.reshape(total // w, w, k)
-        use_pallas = False
-        if kernel == "pallas" and blocks[i].fmt == "dense":
-            from arrow_matrix_tpu.ops import pallas_blocks
+                m0 = blocks[i].light_cols.shape[0]  # slot-major (m0, rows)
+                hyb_chunk = (auto_chunk(total, k, m0, gather_budget)
+                             if chunk == "auto" else chunk)
+                partials.append(hyb_spmm(blocks[i], x_cur,
+                                         chunk=hyb_chunk,
+                                         heavy_chunk=hyb_chunk))
+                continue
+            w = widths[i]
+            xb = x_cur.reshape(total // w, w, k)
+            use_pallas = False
+            if kernel == "pallas" and blocks[i].fmt == "dense":
+                from arrow_matrix_tpu.ops import pallas_blocks
 
-            # Oversized levels (grown last-level width) whose feature
-            # operands exceed VMEM fall back to XLA per level.
-            use_pallas = pallas_blocks.feasible(w, k, blocks[i].banded)
-        if layout == "wide" and mesh is not None:
-            # Wide layout per level: row-arm devices compute the head
-            # row + reduce, column-arm devices the diag/col/banded
-            # blocks — disjoint groups overlapping in space (reference
-            # ArrowMPI composed into the orchestrator,
-            # arrow_dec_mpi.py:134).  Output slice 0 of the arm axis
-            # holds the product.
-            from arrow_matrix_tpu.parallel.arrow_layout import (
-                wide_step_shard_map,
-            )
+                # Oversized levels (grown last-level width) whose
+                # feature operands exceed VMEM fall back to XLA per
+                # level.
+                use_pallas = pallas_blocks.feasible(w, k,
+                                                    blocks[i].banded)
+            if layout == "wide" and mesh is not None:
+                # Wide layout per level: row-arm devices compute the
+                # head row + reduce, column-arm devices the diag/col/
+                # banded blocks — disjoint groups overlapping in space
+                # (reference ArrowMPI composed into the orchestrator,
+                # arrow_dec_mpi.py:134).  Output slice 0 of the arm
+                # axis holds the product.
+                from arrow_matrix_tpu.parallel.arrow_layout import (
+                    wide_step_shard_map,
+                )
 
-            wstep = wide_step_shard_map(
-                blocks[i], mesh, arm_axis=arm_axis, block_axis=axis,
-                chunk=resolve_chunk(chunk, blocks[i], total, k,
-                                    gather_budget))
-            c = wstep(blocks[i], xb)[0]
-        elif use_pallas and mesh is not None:
-            # Pallas custom calls do not partition under GSPMD, but the
-            # shard-local shapes under shard_map are static: run the
-            # slim step body per shard with the fused kernels inside
-            # and the usual psum/ppermute collectives around them.
-            from arrow_matrix_tpu.parallel.arrow_layout import (
-                slim_step_shard_map,
-            )
+                wstep = wide_step_shard_map(
+                    blocks[i], mesh, arm_axis=arm_axis, block_axis=axis,
+                    chunk=resolve_chunk(chunk, blocks[i], total, k,
+                                        gather_budget))
+                c = wstep(blocks[i], xb)[0]
+            elif use_pallas and mesh is not None:
+                # Pallas custom calls do not partition under GSPMD, but
+                # the shard-local shapes under shard_map are static:
+                # run the slim step body per shard with the fused
+                # kernels inside and the usual psum/ppermute
+                # collectives around them.
+                from arrow_matrix_tpu.parallel.arrow_layout import (
+                    slim_step_shard_map,
+                )
 
-            step = slim_step_shard_map(blocks[i], mesh, axis=axis,
-                                       kernel="pallas")
-            c = step(blocks[i], xb)
-        elif use_pallas:
-            c = pallas_blocks.arrow_spmm_pallas(blocks[i], xb)
-        else:
-            c = arrow_spmm(blocks[i], xb,
-                           chunk=resolve_chunk(chunk, blocks[i], total, k,
-                                               gather_budget))
-        partials.append(c.reshape(total, k))
+                step = slim_step_shard_map(blocks[i], mesh, axis=axis,
+                                           kernel="pallas")
+                c = step(blocks[i], xb)
+            elif use_pallas:
+                c = pallas_blocks.arrow_spmm_pallas(blocks[i], xb)
+            else:
+                c = arrow_spmm(blocks[i], xb,
+                               chunk=resolve_chunk(chunk, blocks[i],
+                                                   total, k,
+                                                   gather_budget))
+            partials.append(c.reshape(total, k))
 
-    agg = partials[-1]
-    for i in range(k_levels - 1, 0, -1):
-        agg = partials[i - 1] + routed_or_take(agg, bwd[i - 1], mesh, axis)
+    with jax.named_scope("aggregate_backward"):
+        agg = partials[-1]
+        for i in range(k_levels - 1, 0, -1):
+            agg = partials[i - 1] + routed_or_take(agg, bwd[i - 1],
+                                                   mesh, axis)
     return agg

@@ -753,9 +753,10 @@ def _slim_local_step(axis: str, w: int, rows_out: int, hops: int,
     group by construction.  ``head_unsort``: (w,) tiered head position
     of each head row, already resolved by the caller."""
     dev = lax.axis_index(axis)
-    x0 = lax.psum(
-        jnp.where(dev == 0, xt[:, :w], jnp.zeros_like(xt[:, :w])),
-        axis)
+    with jax.named_scope("bcast_head"):
+        x0 = lax.psum(
+            jnp.where(dev == 0, xt[:, :w], jnp.zeros_like(xt[:, :w])),
+            axis)
     parts = [xt, x0]
     if hops:
         # Halo chains: my rows in ORIGINAL shard order, shifted j hops
@@ -767,40 +768,45 @@ def _slim_local_step(axis: str, w: int, rows_out: int, hops: int,
         # can reference — a reach << L band ppermutes L/rem-times
         # fewer bytes; the skipped rows are zero by the reach
         # definition, so zero-padding the received slice is exact.
-        mine = jnp.take(xt, orig_pos[0], axis=1)     # (k, L)
-        Ls = mine.shape[1]
-        fwd = [(i, i + 1) for i in range(n_dev - 1)]
-        bwd = [(i + 1, i) for i in range(n_dev - 1)]
-        lo_chain, hi_chain = [], []
-        cur_lo = cur_hi = mine
-        # rem == 0 means whole-shard (the pre-slicing behavior): a
-        # caller that never derived rem still gets a correct step.
-        rem_eff = rem if rem > 0 else Ls
-        for j in range(hops):
-            if j == hops - 1 and rem_eff < Ls:
-                got_lo = lax.ppermute(cur_lo[:, Ls - rem_eff:], axis,
-                                      perm=fwd)
-                got_hi = lax.ppermute(cur_hi[:, :rem_eff], axis,
-                                      perm=bwd)
-                zpad = jnp.zeros((mine.shape[0], Ls - rem_eff),
-                                 mine.dtype)
-                lo_chain.append(jnp.concatenate([zpad, got_lo], axis=1))
-                hi_chain.append(jnp.concatenate([got_hi, zpad], axis=1))
-            else:
-                cur_lo = lax.ppermute(cur_lo, axis, perm=fwd)
-                cur_hi = lax.ppermute(cur_hi, axis, perm=bwd)
-                lo_chain.append(cur_lo)   # j hops left neighbor
-                hi_chain.append(cur_hi)   # j hops right neighbor
-        # lo region covers [lo - hops*L, lo): farthest first.
-        parts += list(reversed(lo_chain)) + hi_chain
-    z = jnp.concatenate(parts, axis=1)
-    out = _stack_spmm_t(body, z)                 # (k, rows_out)
-    head_part = _stack_spmm_t(head, xt)
-    c0 = lax.psum(head_part, axis)
-    c0w = jnp.take(c0, head_unsort, axis=1)[:, :w]
-    out = jnp.where(
-        (dev == 0) & (jnp.arange(rows_out)[None, :] < w),
-        jnp.pad(c0w, ((0, 0), (0, rows_out - w))), out)
+        with jax.named_scope("halo_exchange"):
+            mine = jnp.take(xt, orig_pos[0], axis=1)     # (k, L)
+            Ls = mine.shape[1]
+            fwd = [(i, i + 1) for i in range(n_dev - 1)]
+            bwd = [(i + 1, i) for i in range(n_dev - 1)]
+            lo_chain, hi_chain = [], []
+            cur_lo = cur_hi = mine
+            # rem == 0 means whole-shard (the pre-slicing behavior): a
+            # caller that never derived rem still gets a correct step.
+            rem_eff = rem if rem > 0 else Ls
+            for j in range(hops):
+                if j == hops - 1 and rem_eff < Ls:
+                    got_lo = lax.ppermute(cur_lo[:, Ls - rem_eff:], axis,
+                                          perm=fwd)
+                    got_hi = lax.ppermute(cur_hi[:, :rem_eff], axis,
+                                          perm=bwd)
+                    zpad = jnp.zeros((mine.shape[0], Ls - rem_eff),
+                                     mine.dtype)
+                    lo_chain.append(jnp.concatenate([zpad, got_lo],
+                                                    axis=1))
+                    hi_chain.append(jnp.concatenate([got_hi, zpad],
+                                                    axis=1))
+                else:
+                    cur_lo = lax.ppermute(cur_lo, axis, perm=fwd)
+                    cur_hi = lax.ppermute(cur_hi, axis, perm=bwd)
+                    lo_chain.append(cur_lo)   # j hops left neighbor
+                    hi_chain.append(cur_hi)   # j hops right neighbor
+            # lo region covers [lo - hops*L, lo): farthest first.
+            parts += list(reversed(lo_chain)) + hi_chain
+    with jax.named_scope("body_spmm"):
+        z = jnp.concatenate(parts, axis=1)
+        out = _stack_spmm_t(body, z)                 # (k, rows_out)
+    with jax.named_scope("head_reduce"):
+        head_part = _stack_spmm_t(head, xt)
+        c0 = lax.psum(head_part, axis)
+        c0w = jnp.take(c0, head_unsort, axis=1)[:, :w]
+        out = jnp.where(
+            (dev == 0) & (jnp.arange(rows_out)[None, :] < w),
+            jnp.pad(c0w, ((0, 0), (0, rows_out - w))), out)
     return out
 
 
@@ -905,6 +911,14 @@ class SellSlim:
         return _gather_carried(
             fetch_replicated(ct).astype(np.float32, copy=False).T,
             self._oop, self.n)
+
+    def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Paper cost model for one slim step at feature width ``k``:
+        the arrow bound is O(width) rows exchanged per device — the
+        head-partial reduction every non-root device contributes
+        (paper Thm: communication O(n_dev * width) per iteration,
+        independent of n)."""
+        return max(self.n_dev - 1, 0) * self.width * k * itemsize
 
 
 class SellMultiLevel:
@@ -1018,6 +1032,18 @@ class SellMultiLevel:
                                   self.ops[i].total_out))
             oop_cur, poo_cur = oop_next, poo_next
 
+        # Paper cost model of the inter-level routing, in row-units
+        # (k=1, itemsize=1): rows whose adjacent-level positions land
+        # on different devices (commstats.ideal_routing_bytes, the
+        # reference Alltoallv payload).  obs/comm scales this by the
+        # feature width to judge the compiled collectives.
+        from arrow_matrix_tpu.utils import commstats
+
+        padded = [pad_permutation(np.asarray(lvl.permutation), total)
+                  for lvl in levels]
+        self._ideal_route_units = commstats.ideal_routing_bytes(
+            padded, n_dev, 1, itemsize=1)
+
         steps = [make_sharded_step(mesh, axis, width, ops.rows_out,
                                    hops=ops.hops, rem=ops.rem,
                                    feat_axis=feat_axis)
@@ -1041,13 +1067,17 @@ class SellMultiLevel:
             partials = []
             for i in range(k_levels):
                 if i > 0:
-                    x_cur = reorder(x_cur, fwd[i - 1])
+                    with jax.named_scope(f"route_forward_{i}"):
+                        x_cur = reorder(x_cur, fwd[i - 1])
                 o = level_ops[i]
-                partials.append(steps[i](o.body, o.head, o.head_unsort,
-                                         o.orig_pos, x_cur))
-            agg = partials[-1]
-            for i in range(k_levels - 1, 0, -1):
-                agg = partials[i - 1] + reorder(agg, bwd[i - 1])
+                with jax.named_scope(f"level_{i}_spmm"):
+                    partials.append(steps[i](o.body, o.head,
+                                             o.head_unsort,
+                                             o.orig_pos, x_cur))
+            with jax.named_scope("aggregate_backward"):
+                agg = partials[-1]
+                for i in range(k_levels - 1, 0, -1):
+                    agg = partials[i - 1] + reorder(agg, bwd[i - 1])
             return agg
 
         # Levels as pytree args would be natural, but SlimLevelOps is a
@@ -1121,6 +1151,17 @@ class SellMultiLevel:
         return _gather_carried(
             fetch_replicated(ct).astype(np.float32, copy=False).T,
             self._orig_of_pos0, self.n)
+
+    def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Paper cost model for one multi-level step at feature width
+        ``k``: inter-level permutation routing (only rows that change
+        device, both directions) plus each level's O(width) head
+        exchange — the bound the measured collective bytes are judged
+        against."""
+        n_dev = self.mesh.shape[self.axis]
+        per_level_head = max(n_dev - 1, 0) * self.width
+        return (self._ideal_route_units
+                + len(self.ops) * per_level_head) * k * itemsize
 
     def carried_mask(self) -> jax.Array:
         """(1, total_out_0) f32 validity mask of the carried ordering:

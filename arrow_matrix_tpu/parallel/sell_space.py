@@ -274,6 +274,20 @@ class SellSpaceShared:
         self.bwd0 = put_global(bwd0.astype(np.int32), lvl_only)
         self.fwd0 = put_global(fwd0.astype(np.int32), lvl_only)
 
+        # Paper cost model of the cross-group routing in row-units
+        # (k=1, itemsize=1): the exchanges are star-shaped (every group
+        # reorders against level 0), so sum the pairwise moved-row
+        # counts (commstats.ideal_routing_bytes already counts both
+        # directions).  obs/comm scales by feature width.
+        from arrow_matrix_tpu.utils import commstats
+
+        padded = [pad_permutation(np.asarray(lvl.permutation), total)
+                  for lvl in levels]
+        self._ideal_route_units = sum(
+            commstats.ideal_routing_bytes([padded[0], padded[g]],
+                                          n_dev, 1, itemsize=1)
+            for g in range(1, k_levels))
+
         # Concurrent slim step over BOTH mesh axes: the per-group body
         # IS sell_slim's shared step body — its collectives name only
         # the "blocks" axis, so psum/ppermute stay within each level
@@ -301,7 +315,9 @@ class SellSpaceShared:
 
         def space_step(xt, body, head, head_unsort, orig_pos,
                        bwd0, fwd0):
-            ct = sharded_compute(body, head, head_unsort, orig_pos, xt)
+            with jax.named_scope("level_spmm"):
+                ct = sharded_compute(body, head, head_unsort, orig_pos,
+                                     xt)
             # Collapsed backward chain: per-level composed gather into
             # level-0 order + sum over groups (cross-group reduce);
             # forward chain: the aggregate gathered into every group's
@@ -315,13 +331,15 @@ class SellSpaceShared:
             # forward redistribution reads each group's copy of the
             # reduced aggregate in its own ordering (group-local
             # again).
-            c0 = jnp.take_along_axis(ctk, bwd0[None], axis=2)
-            agg = c0.sum(axis=1)
-            nxt = jnp.take_along_axis(
-                jnp.broadcast_to(agg[:, None, :], (k, k_levels, T)),
-                fwd0[None], axis=2)
-            return lax.with_sharding_constraint(
-                nxt.reshape(k, k_levels * T), self._feat_sharding)
+            with jax.named_scope("aggregate_backward"):
+                c0 = jnp.take_along_axis(ctk, bwd0[None], axis=2)
+                agg = c0.sum(axis=1)
+            with jax.named_scope("redistribute_forward"):
+                nxt = jnp.take_along_axis(
+                    jnp.broadcast_to(agg[:, None, :], (k, k_levels, T)),
+                    fwd0[None], axis=2)
+                return lax.with_sharding_constraint(
+                    nxt.reshape(k, k_levels * T), self._feat_sharding)
 
         self._step = jax.jit(space_step)
 
@@ -357,6 +375,15 @@ class SellSpaceShared:
     def device_nbytes(self) -> int:
         return (self.body.device_nbytes() + self.head.device_nbytes()
                 + self.orig_pos.size * self.orig_pos.dtype.itemsize)
+
+    def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Paper cost model for one space-shared step at feature width
+        ``k``: the star-shaped cross-group routing (rows changing
+        device against level-0 order, both directions) plus each level
+        group's O(width) head exchange."""
+        per_level_head = max(self.n_dev - 1, 0) * self.width
+        return (self._ideal_route_units
+                + self.k_levels * per_level_head) * k * itemsize
 
     def set_features(self, x: np.ndarray) -> jax.Array:
         """Host (n, k) original order -> (k, K * total_out), level g's

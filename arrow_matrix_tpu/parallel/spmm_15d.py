@@ -246,17 +246,20 @@ class SpMM15D:
             def round_body(y, r):
                 q = j * rounds + r
                 # Bcast root q over the grid column = masked psum.
-                buf = lax.psum(
-                    jnp.where(my_row == q, x_loc,
-                              jnp.zeros_like(x_loc)), rows_axis)
-                y = y + ell_spmm(a_cols[0, 0, r], a_data[0, 0, r], buf,
-                                 chunk=c_r).astype(jnp.float32)
+                with jax.named_scope("bcast_x"):
+                    buf = lax.psum(
+                        jnp.where(my_row == q, x_loc,
+                                  jnp.zeros_like(x_loc)), rows_axis)
+                with jax.named_scope("local_spmm"):
+                    y = y + ell_spmm(a_cols[0, 0, r], a_data[0, 0, r], buf,
+                                     chunk=c_r).astype(jnp.float32)
                 return y, None
 
             y0 = jnp.zeros((a_cols.shape[3], k), dtype=jnp.float32)
             y, _ = lax.scan(round_body, y0, jnp.arange(rounds))
             # Allreduce over the replication axis (spmm_15d.py:354-361).
-            y = lax.psum(y, repl_axis)
+            with jax.named_scope("reduce_partials"):
+                y = lax.psum(y, repl_axis)
             return y[None, None].astype(x.dtype)
 
         self._step = jax.jit(shard_map(
@@ -287,6 +290,19 @@ class SpMM15D:
         """One distributed SpMM: blocked X (p/c, l_nkb, k) ->
         blocked Y (p/c, c, l_ni, k); the c replica copies are identical."""
         return self._step(self.a_cols, self.a_data, x)
+
+    def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """1.5D cost model for one step at feature width ``k``: every
+        device receives each of its ``rounds`` broadcast blocks
+        (l_nkb rows), plus the replica allreduce over the c copies of
+        the l_ni result rows when c > 1 (reference spmm_15d.py round
+        loop + reduce) — the asymptotically larger baseline volume the
+        arrow paths are measured against."""
+        n_dev = self.p_div_c * self.c
+        per_dev = self.rounds * self.l_nkb
+        if self.c > 1:
+            per_dev += self.l_ni
+        return n_dev * per_dev * k * itemsize
 
     def as_features(self, y: jax.Array) -> jax.Array:
         """Reuse a blocked result as the next iteration's features

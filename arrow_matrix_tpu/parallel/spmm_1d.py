@@ -269,6 +269,11 @@ class MatrixSlice1D:
         # (matrix_slice.py:248-252) become one padded slot size.
         self.slot = int(counts.max()) if counts.size else 0
         slot = self.slot
+        # Paper cost model (reference Alltoallv payload): rows actually
+        # needed across devices, before the fixed-slot padding the
+        # all_to_all ships — obs/comm compares compiled HLO bytes
+        # against ideal_comm_bytes built on this.
+        self._ideal_route_rows = int(counts.sum()) if counts.size else 0
 
         # -- send tables: send_idx[s, d] = local row indices device s
         # ships to device d, read off the exchanged patterns.
@@ -389,19 +394,22 @@ class MatrixSlice1D:
             # Local SpMM first: in the reference it overlaps with the
             # in-flight row exchange (spmm_petsc.py:193-199); under XLA
             # the scheduler overlaps the independent all_to_all for us.
-            y = ell_spmm(l_cols[0], l_data[0], x_loc,
-                         chunk=c_l).astype(jnp.float32)
+            with jax.named_scope("local_spmm"):
+                y = ell_spmm(l_cols[0], l_data[0], x_loc,
+                             chunk=c_l).astype(jnp.float32)
 
             if slot > 0:
                 # Ship exactly the requested rows to every peer: one
                 # fused all_to_all replaces the per-pair Isend/Irecv
                 # (spmm_petsc.py:105-144).
-                send = jnp.take(x_loc, send_idx[0, 0], axis=0)  # (n_dev, slot, k)
-                recv = lax.all_to_all(send, axis, split_axis=0,
-                                      concat_axis=0, tiled=True)
-                x_nonlocal = recv.reshape(slot * send.shape[0], k)
-                y = y + ell_spmm(nl_cols[0], nl_data[0], x_nonlocal,
-                                 chunk=c_nl).astype(jnp.float32)
+                with jax.named_scope("route_rows"):
+                    send = jnp.take(x_loc, send_idx[0, 0], axis=0)  # (n_dev, slot, k)
+                    recv = lax.all_to_all(send, axis, split_axis=0,
+                                          concat_axis=0, tiled=True)
+                    x_nonlocal = recv.reshape(slot * send.shape[0], k)
+                with jax.named_scope("nonlocal_spmm"):
+                    y = y + ell_spmm(nl_cols[0], nl_data[0], x_nonlocal,
+                                     chunk=c_nl).astype(jnp.float32)
             return y[None].astype(x.dtype)
 
         self._step = jax.jit(shard_map(
@@ -429,6 +437,13 @@ class MatrixSlice1D:
         """One distributed SpMM preserving the blocked layout."""
         return self._step(self.l_cols, self.l_data, self.nl_cols,
                           self.nl_data, self.send_idx, x)
+
+    def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Paper cost model for one step at feature width ``k``: only
+        the rows peers actually request move (the reference Alltoallv
+        payload) — the all_to_all's fixed-slot padding is overhead the
+        measured/ideal ratio exposes."""
+        return self._ideal_route_rows * k * itemsize
 
     def gather_result(self, y: jax.Array) -> np.ndarray:
         """Blocked (n_dev, l_rows, k) device result -> host (n, k)."""

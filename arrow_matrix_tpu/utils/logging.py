@@ -45,10 +45,14 @@ class SegmentLog:
 
     @contextlib.contextmanager
     def segment(self, name: str):
-        """Context manager timing a named host-side segment in seconds."""
+        """Context manager timing a named host-side segment in seconds.
+        Logs in ``finally`` so a raising body still records the
+        measurement (the time-to-failure is part of the run record)."""
         tic = time.perf_counter()
-        yield
-        self.log({name: time.perf_counter() - tic})
+        try:
+            yield
+        finally:
+            self.log({name: time.perf_counter() - tic})
 
     def finish(self, log_dir: str = "./logs") -> str | None:
         if not self.entries and not self.config:

@@ -131,16 +131,11 @@ def _measure(multi, x, iters: int) -> float:
     """ms/iter via chained on-device iteration (`lax.scan`) ending in a
     scalar host fetch, with the dispatch+fetch round-trip subtracted —
     block_until_ready alone can return early over remote/tunneled
-    devices, a host fetch cannot."""
-    def chain(n: int) -> float:
-        t0 = time.perf_counter()
-        xd = multi.run(x, n) if n else x
-        float(np.asarray(xd[0, 0]))
-        return time.perf_counter() - t0
+    devices, a host fetch cannot.  The implementation lives in
+    arrow_matrix_tpu.obs (shared with the graft-scope smoke harness)."""
+    from arrow_matrix_tpu.obs import chained_iteration_ms
 
-    chain(iters)  # compile + warmup at the benchmark length
-    rtt = min(chain(0) for _ in range(3))
-    return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
+    return chained_iteration_ms(multi.run, x, iters)
 
 
 def _degraded_small(platform: str) -> tuple[bool, bool]:
@@ -404,17 +399,12 @@ def _peak_gather_rate(n: int, k: int, m: int = 8, reps: int = 3) -> float:
     rng = np.random.default_rng(11)
     idx = jnp.asarray(rng.integers(0, n, size=n * m, dtype=np.int32))
     x = jnp.asarray(rng.standard_normal((n, k)).astype(np.float32))
+    from arrow_matrix_tpu.obs import timed
+
     f = jax.jit(lambda xx, ii: jnp.take(xx, ii, axis=0))
     f(x, idx).block_until_ready()
-    best = min(_timed(lambda: f(x, idx).block_until_ready())
-               for _ in range(reps))
+    best = min(timed(lambda: f(x, idx)) for _ in range(reps))
     return n * m / best
-
-
-def _timed(fn) -> float:
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
 
 
 class _device_busy:
@@ -565,6 +555,7 @@ def race_candidates(result: dict, cfg: dict, finalize,
 def run_bench(result: dict, platform: str, device_kind: str,
               fmt_override: str | None = None) -> None:
     from arrow_matrix_tpu.decomposition.decompose import decomposition_spmm
+    from arrow_matrix_tpu.utils import logging as wb
     from arrow_matrix_tpu.utils import numerics
     from arrow_matrix_tpu.utils.graphs import random_dense
 
@@ -579,10 +570,12 @@ def run_bench(result: dict, platform: str, device_kind: str,
 
     _progress(f"platform={platform} kind={device_kind} n={n} "
               f"fmt={cfg['fmt']}")
-    t0 = time.perf_counter()
-    levels = _cached_levels(n, cfg["m"], cfg["width"], seed=7,
-                            max_levels=cfg["max_levels"])
-    result["config"]["decompose_s"] = round(time.perf_counter() - t0, 2)
+    seg = wb.init("bench", f"ba_n{n}", config=dict(result["config"]))
+    with seg.segment("decompose_s"):
+        levels = _cached_levels(n, cfg["m"], cfg["width"], seed=7,
+                                max_levels=cfg["max_levels"])
+    result["config"]["decompose_s"] = round(
+        seg.entries[-1]["decompose_s"], 2)
     result["config"]["levels"] = len(levels)
     nnz = sum(int(l.matrix.nnz) for l in levels)
     result["config"]["edges_nnz"] = nnz
@@ -596,10 +589,10 @@ def run_bench(result: dict, platform: str, device_kind: str,
     _progress(f"decomposed in {result['config']['decompose_s']}s; "
               f"scipy baseline")
     xb = x_host.copy()
-    t0 = time.perf_counter()
-    for _ in range(base_iters):
-        xb = decomposition_spmm(levels, xb)
-    scipy_ms = (time.perf_counter() - t0) / base_iters * 1e3
+    with seg.segment("scipy_baseline_s"):
+        for _ in range(base_iters):
+            xb = decomposition_spmm(levels, xb)
+    scipy_ms = seg.entries[-1]["scipy_baseline_s"] / base_iters * 1e3
     tol = numerics.relative_tolerance(nnz / max(n, 1), iters=1)
     _progress(f"scipy baseline {scipy_ms:.0f} ms/iter; racing candidates")
 

@@ -1,5 +1,5 @@
 """graft-lint (arrow_matrix_tpu.analysis) — one positive and one
-negative fixture per rule R1-R6, the waiver machinery, the
+negative fixture per rule R1-R7, the waiver machinery, the
 package-clean gate (the shipped tree must lint clean, the same
 invariant amt_doctor and tools/lint_gate.py enforce), and a
 reduced-scale run of the trace-time recompile audit."""
@@ -142,6 +142,32 @@ FIXTURES = {
             return np.asarray(y)
         """,
     ),
+    "R7": (
+        # perf_counter around a jitted call without block_until_ready:
+        # dispatch is async, so this times the launch, not the device.
+        """
+        import time
+        import jax
+        def bench(f0, x):
+            f = jax.jit(f0)
+            t0 = time.perf_counter()
+            y = f(x)
+            dt = time.perf_counter() - t0
+            return y, dt
+        """,
+        # blocking on the result inside the region synchronises the
+        # measurement — the obs/tracer.py harness idiom.
+        """
+        import time
+        import jax
+        def bench(f0, x):
+            f = jax.jit(f0)
+            t0 = time.perf_counter()
+            y = jax.block_until_ready(f(x))
+            dt = time.perf_counter() - t0
+            return y, dt
+        """,
+    ),
 }
 
 
@@ -160,9 +186,9 @@ def test_rule_negative_silent(rule):
         f"{rule} negative fixture fired anyway: {fired}")
 
 
-def test_all_six_rules_registered():
+def test_all_shipped_rules_registered():
     ids = {spec.rule_id for spec in rule_table()}
-    assert ids >= {"R1", "R2", "R3", "R4", "R5", "R6"}
+    assert ids >= {"R1", "R2", "R3", "R4", "R5", "R6", "R7"}
 
 
 def test_waiver_suppresses_and_records():

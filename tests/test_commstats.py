@@ -1,0 +1,89 @@
+"""utils/commstats — the HLO shape-byte accountant and collective
+parser, exercised on literal shape strings and a checked-in HLO
+fixture (tests/fixtures/collectives.hlo) so the parsing contract is
+pinned without compiling anything, plus the paper cost model's
+moved-row count (``ideal_routing_bytes``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu.utils import commstats
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "collectives.hlo")
+
+
+# ---------------------------------------------------------------------------
+# _shape_bytes: dtype x element-count over every bracketed shape in the
+# string (tuples sum), unknown dtypes and unranked shapes count zero.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape_str,expect", [
+    ("f32[]", 4),                       # scalar: empty dims, one element
+    ("f32[2,3]", 24),
+    ("bf16[2,3]", 12),
+    ("pred[8]", 8),
+    ("(f32[8,16], s32[8,16])", 1024),   # tuple: elements sum
+    ("f32[*]", 0),                      # unranked: no match, no bytes
+    ("c64[4]", 0),                      # unknown dtype: skipped
+    ("token[]", 0),
+])
+def test_shape_bytes(shape_str, expect):
+    assert commstats._shape_bytes(shape_str) == expect
+
+
+# ---------------------------------------------------------------------------
+# _parse_hlo_collectives on the checked-in fixture: one all-gather
+# (f32[32,16] output = 2048 B), one tuple-shaped all-to-all (2 x
+# f32[8,16] = 1024 B), one async collective-permute whose -start
+# carries the bytes (512 B) and whose -done is NOT double-counted.
+# ---------------------------------------------------------------------------
+
+
+def test_parse_hlo_fixture():
+    with open(FIXTURE, encoding="utf-8") as fh:
+        text = fh.read()
+    stats = commstats._parse_hlo_collectives(text)
+
+    assert stats["all-gather"] == {"count": 1, "bytes": 2048}
+    assert stats["all-to-all"] == {"count": 1, "bytes": 1024}
+    assert stats["collective-permute"] == {"count": 1, "bytes": 512}
+    assert stats["all-reduce"] == {"count": 0, "bytes": 0}
+    assert stats["reduce-scatter"] == {"count": 0, "bytes": 0}
+    assert stats["total_bytes"] == 2048 + 1024 + 512
+
+
+def test_format_stats_lists_only_nonzero_kinds():
+    with open(FIXTURE, encoding="utf-8") as fh:
+        stats = commstats._parse_hlo_collectives(fh.read())
+    out = commstats.format_stats(stats)
+    assert "all-gather" in out and "all-to-all" in out
+    assert "all-reduce" not in out           # zero-count kinds elided
+    assert "3,584" in out                    # TOTAL row
+
+
+# ---------------------------------------------------------------------------
+# ideal_routing_bytes: the paper model counts a row iff the adjacent-
+# level position lands on a different device, both directions.
+# ---------------------------------------------------------------------------
+
+
+def test_ideal_routing_bytes_identity_is_zero():
+    p = np.arange(8)
+    assert commstats.ideal_routing_bytes([p, p], n_devices=2, k=4) == 0
+
+
+def test_ideal_routing_bytes_counts_cross_device_rows():
+    # 8 rows on 2 devices (4 rows each).  Swapping the two halves moves
+    # every row across the boundary: 8 moved rows x 2 directions x k=1
+    # x itemsize=1.
+    p0 = np.arange(8)
+    p1 = np.concatenate([np.arange(4, 8), np.arange(4)])
+    assert commstats.ideal_routing_bytes(
+        [p0, p1], n_devices=2, k=1, itemsize=1) == 16
+    # Scales linearly in k and itemsize.
+    assert commstats.ideal_routing_bytes(
+        [p0, p1], n_devices=2, k=4, itemsize=4) == 16 * 16

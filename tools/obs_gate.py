@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Tier-1 obs gate: reduced-scale smoke trace on the CPU mesh.
+
+Counterpart of tools/lint_gate.py for the observability layer: runs
+all five parallel algorithms through arrow_matrix_tpu.obs.smoke on a
+4-device virtual CPU pool, then validates the run directory (named
+spans present per phase, trace JSON well-formed, per-iteration device
+time and collective-byte metrics recorded).  Exits 0 on a valid run,
+1 otherwise — the unattended pre-push / CI form of the same invariant
+amt_doctor's OBS probe checks interactively.
+
+Usage:
+  python tools/obs_gate.py [run_dir]
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(4)
+
+    from arrow_matrix_tpu.obs.smoke import run_smoke, validate_run_dir
+
+    out = argv[0] if argv else tempfile.mkdtemp(prefix="obs_gate_")
+    run_smoke(out, n=128, width=32, k=4, n_dev=4, iters=2)
+    problems = validate_run_dir(out)
+    if problems:
+        for p in problems:
+            print(f"obs gate: {p}", file=sys.stderr)
+        print("obs gate: FAILED", file=sys.stderr)
+        return 1
+    print(f"obs gate: ok ({out})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
