@@ -69,6 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "bytes of the compiled step from its HLO "
                              "(compare against spmm_arrow's modes — "
                              "the reference paper's headline metric).")
+    parser.add_argument("--mem_report", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Report the compiled step's per-device "
+                             "memory breakdown against the format-"
+                             "metadata prediction, plus the per-shard "
+                             "load-imbalance report.")
     add_device_args(parser)
     add_distributed_args(parser)
     return parser
@@ -179,6 +185,16 @@ def main(argv=None) -> int:
             print(f"measured vs paper-model ideal: "
                   f"{rep['measured_bytes']} / {rep['ideal_bytes']} "
                   f"bytes = {rep['ratio']:.2f}x")
+    if args.mem_report:
+        from arrow_matrix_tpu import obs
+
+        mem = obs.account_memory(
+            "spmm_15d", dist._step, dist.a_cols, dist.a_data, x,
+            predicted_bytes=obs.predicted_bytes_for(dist, args.columns))
+        print(obs.format_memory_report(mem))
+        imb = obs.account_imbalance("spmm_15d", dist)
+        if imb is not None:
+            print(obs.format_imbalance_report(imb))
     for it in range(args.iterations):
         wb.set_iteration_data({"iteration": it})
         tic = time.perf_counter()

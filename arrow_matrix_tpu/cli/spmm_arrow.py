@@ -139,7 +139,7 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fmt=fold tier growth factor: padded "
                              "slots <= growth x nnz by construction. "
                              "1.1 with --fold_align 1 is the "
-                             "'fold_tight' bench candidate (-17% "
+                             "'fold_tight' bench candidate (-17%% "
                              "logical slots at the protocol config).")
     parser.add_argument("--fold_align", type=int, default=None,
                         help="fmt=fold slot alignment (default: the "
@@ -189,6 +189,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "before running (communication volume is "
                              "the reference paper's headline metric; "
                              "utils/commstats).")
+    parser.add_argument("--mem_report", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Report the compiled step's per-device "
+                             "memory breakdown (argument/output/temp "
+                             "bytes via memory_analysis) against the "
+                             "format-metadata prediction, plus the "
+                             "per-shard load-imbalance report "
+                             "(obs/memview, obs/imbalance).")
     parser.add_argument("--trace", type=str, default=None,
                         help="Write a jax.profiler trace of the "
                              "iteration loop to this directory "
@@ -488,6 +496,19 @@ def main(argv=None) -> int:
                 print(f"measured vs paper-model ideal: "
                       f"{rep['measured_bytes']} / {rep['ideal_bytes']} "
                       f"bytes = {rep['ratio']:.2f}x")
+
+    if args.mem_report:
+        itemsize = 2 if args.feature_dtype == "bf16" else 4
+        mem = obs.account_memory(
+            "spmm_arrow", multi.step_fn, warm, *multi.step_operands(),
+            predicted_bytes=obs.predicted_bytes_for(
+                multi, args.features, itemsize=itemsize),
+            registry=obs_reg)
+        print(obs.format_memory_report(mem))
+        imb = obs.account_imbalance("spmm_arrow", multi,
+                                    registry=obs_reg)
+        if imb is not None:
+            print(obs.format_imbalance_report(imb))
 
     rng = np.random.default_rng(args.seed)
     fail = False

@@ -13,16 +13,39 @@ the paper's headline claim (communication volume) per run:
   * :mod:`~arrow_matrix_tpu.obs.comm` — trace-time collective-byte
     accounting (utils/commstats) compared against each orchestration's
     ``ideal_comm_bytes`` paper cost model;
+  * :mod:`~arrow_matrix_tpu.obs.memview` — per-executable HBM
+    accounting (``compiled.memory_analysis()``) compared against each
+    orchestration's ``predicted_hbm_bytes`` format-metadata model;
+  * :mod:`~arrow_matrix_tpu.obs.imbalance` — per-shard nnz / padding /
+    row-skew reports from the packed format metadata (the paper's
+    max/mean imbalance bound as a measured gauge);
+  * :mod:`~arrow_matrix_tpu.obs.flight` — graft-flight, a bounded ring
+    of recent obs events eagerly flushed to disk so a wedged or killed
+    run leaves a diagnosable blackbox artifact;
   * :mod:`~arrow_matrix_tpu.obs.smoke` — a reduced-scale CPU-mesh run
     of all five parallel algorithms producing one inspectable run
     directory (traces + metrics.jsonl + summary.json).
 
 CLI: ``python -m arrow_matrix_tpu.obs`` (``graft_trace``) summarizes a
 run directory, diffs two runs with regression flagging, exports merged
-traces, and drives the smoke harness.
+traces, prints memory reports (``memreport``), inspects flight
+artifacts (``blackbox``), and drives the smoke harness.
 """
 
 from arrow_matrix_tpu.obs.comm import account_collectives, ideal_bytes_for
+from arrow_matrix_tpu.obs.flight import FlightRecorder
+from arrow_matrix_tpu.obs.imbalance import (
+    account_imbalance,
+    format_imbalance_report,
+    shard_report_for,
+)
+from arrow_matrix_tpu.obs.memview import (
+    account_memory,
+    format_memory_report,
+    memory_report,
+    predicted_bytes_for,
+    tree_device_bytes,
+)
 from arrow_matrix_tpu.obs.metrics import (
     MetricsRegistry,
     get_registry,
@@ -37,14 +60,23 @@ from arrow_matrix_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "FlightRecorder",
     "MetricsRegistry",
     "Tracer",
     "account_collectives",
+    "account_imbalance",
+    "account_memory",
     "chained_iteration_ms",
+    "format_imbalance_report",
+    "format_memory_report",
     "get_registry",
     "ideal_bytes_for",
     "init_registry",
     "iteration_time_ms",
+    "memory_report",
+    "predicted_bytes_for",
     "set_registry",
+    "shard_report_for",
     "timed",
+    "tree_device_bytes",
 ]

@@ -6,12 +6,18 @@ Subcommands:
                      algorithms -> OUT/{<algo>.trace.json,
                      metrics.jsonl, summary.json}
   summarize RUN      per-algorithm table: phase ms, step ms, bytes vs
-                     ideal
+                     ideal, HBM vs predicted
   diff A B           per-algorithm, per-phase deltas between two runs;
                      exits 1 when any phase (or measured bytes)
                      regresses beyond --threshold
   export RUN --out   merge the per-algorithm traces into one
                      Perfetto-loadable file (one pid per algorithm)
+  memreport RUN      per-algorithm executable memory breakdown
+                     (argument/output/temp bytes, measured vs the
+                     format model) + shard imbalance report
+  blackbox PATH      print a flight-recorder artifact (or the newest
+                     one under a directory): last events before a
+                     wedge/kill, seal reason, last memory report
 
 Installed as ``graft_trace`` (pyproject) and runnable as
 ``python -m arrow_matrix_tpu.obs``.
@@ -74,6 +80,19 @@ def _print_summary(summary: dict) -> None:
               f"{_fmt_bytes(rec.get('measured_bytes')):>12s} "
               f"{_fmt_bytes(rec.get('ideal_bytes')):>12s} "
               f"{_fmt_ratio(rec.get('bytes_vs_ideal')):>6s}")
+    if not any(rec.get("hbm_measured_bytes") is not None
+               for rec in algos.values()):
+        return
+    print(f"{'algorithm':12s} {'hbm bytes':>12s} {'predicted':>12s} "
+          f"{'ratio':>6s} {'nnz max/mean':>13s} {'waste':>6s}")
+    for name, rec in sorted(algos.items()):
+        imb = rec.get("imbalance") or {}
+        print(f"{name:12s} "
+              f"{_fmt_bytes(rec.get('hbm_measured_bytes')):>12s} "
+              f"{_fmt_bytes(rec.get('hbm_predicted_bytes')):>12s} "
+              f"{_fmt_ratio(rec.get('hbm_vs_predicted')):>6s} "
+              f"{_fmt_ratio(imb.get('nnz_max_over_mean')):>13s} "
+              f"{_fmt_ratio(imb.get('padded_slot_waste')):>6s}")
 
 
 def cmd_summarize(args) -> int:
@@ -180,6 +199,52 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_memreport(args) -> int:
+    from arrow_matrix_tpu.obs.imbalance import format_imbalance_report
+    from arrow_matrix_tpu.obs.memview import format_memory_report
+
+    summary = _load_summary(args.run)
+    algos = summary.get("algorithms", {})
+    missing = 0
+    for name, rec in sorted(algos.items()):
+        print(f"== {name} ==")
+        if rec.get("memory") is None:
+            print("  no memory report in this run")
+            missing += 1
+        else:
+            rep = {"report": rec["memory"],
+                   "measured_bytes": rec.get("hbm_measured_bytes"),
+                   "predicted_bytes": rec.get("hbm_predicted_bytes"),
+                   "ratio": rec.get("hbm_vs_predicted"),
+                   "source": rec.get("hbm_source", "unknown")}
+            print(format_memory_report(rep))
+        imb = rec.get("imbalance")
+        if imb is not None:
+            print(format_imbalance_report(imb))
+    return 1 if missing else 0
+
+
+def cmd_blackbox(args) -> int:
+    from arrow_matrix_tpu.obs import flight
+
+    path = args.path
+    if os.path.isdir(path):
+        found = flight.newest_artifact(path)
+        if found is None:
+            print(f"no flight artifacts under {path}", file=sys.stderr)
+            return 1
+        path = found
+    try:
+        snapshot = flight.load(path)
+    except (OSError, ValueError) as e:
+        print(f"unreadable flight artifact {path}: {e}", file=sys.stderr)
+        return 1
+    print(f"artifact: {path}")
+    for line in flight.format_events(snapshot, last=args.last):
+        print(line)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graft_trace", description=__doc__.splitlines()[0])
@@ -218,6 +283,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     se.add_argument("run")
     se.add_argument("--out", required=True)
     se.set_defaults(fn=cmd_export)
+
+    sm = sub.add_parser("memreport", help="per-algorithm executable "
+                                          "memory + imbalance report")
+    sm.add_argument("run")
+    sm.set_defaults(fn=cmd_memreport)
+
+    sb = sub.add_parser("blackbox", help="print a flight-recorder "
+                                         "artifact")
+    sb.add_argument("path", help="artifact file, or a directory to "
+                                 "pick the newest artifact from")
+    sb.add_argument("--last", type=int, default=None,
+                    help="only the last N events")
+    sb.set_defaults(fn=cmd_blackbox)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,203 @@
+"""graft-flight: a bounded ring of recent obs events, flushed to disk
+so a wedged run leaves a diagnosable artifact.
+
+bench.py's candidate subprocesses die by SIGKILL when their timeout
+expires (a wedged PJRT transfer is uninterruptible by signals), so
+nothing in-process runs at the moment of death.  The recorder therefore
+flushes EAGERLY: every ``record`` rewrites the artifact via an atomic
+tmp+rename (the ring is bounded, so a flush is one small JSON write).
+The on-disk state is at most one event behind the process when the
+kill lands — a "blackbox" in the avionics sense, not a log.
+
+Wiring: ``install()`` sets the process-global recorder; the existing
+Tracer (span completion) and MetricsRegistry (every counter/gauge/
+histogram event) feed it automatically through the module-level
+``record`` hook, which is a no-op until a recorder is installed.  The
+last compiled-executable memory report (obs/memview) is kept whole —
+it is exactly what diagnoses an upload wedging mid-transfer.
+
+Inspect artifacts with ``graft_trace blackbox <path-or-dir>``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+#: Default ring capacity: enough for every phase span + per-iteration
+#: metric of a bench candidate with room to spare, small enough that
+#: the eager per-event flush stays a one-page write.
+DEFAULT_CAPACITY = 256
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of obs events with eager disk flush."""
+
+    def __init__(self, path: Optional[str] = None,
+                 capacity: int = DEFAULT_CAPACITY,
+                 autoflush: bool = True):
+        self.path = path
+        self.capacity = capacity
+        self.events: collections.deque = collections.deque(maxlen=capacity)
+        self.autoflush = autoflush and path is not None
+        self.sealed: Optional[str] = None
+        self.last_memory_report: Optional[Dict[str, Any]] = None
+        self.dropped = 0
+        self.meta = {
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "created_unix": time.time(),
+        }
+
+    def record(self, kind: str, name: str, **data) -> None:
+        """Append one event (and flush, when a path is configured)."""
+        if len(self.events) == self.capacity:
+            self.dropped += 1
+        ev: Dict[str, Any] = {"ts": time.time(), "kind": kind,
+                              "name": name}
+        if data:
+            ev["data"] = data
+        self.events.append(ev)
+        if self.autoflush:
+            self.flush()
+
+    def note_memory_report(self, report: Dict[str, Any]) -> None:
+        """Keep the latest per-executable memory report whole (the ring
+        holds it as an event too, but a wedge postmortem wants the full
+        breakdown, not whatever survived the ring)."""
+        self.last_memory_report = dict(report)
+        self.record("memreport", report.get("algorithm", "unknown"),
+                    measured_bytes=report.get("measured_bytes"),
+                    ratio=report.get("ratio"))
+
+    def seal(self, reason: str) -> None:
+        """Final flush with the termination reason.  Idempotent — the
+        first seal wins (an excepthook seal must not be overwritten by
+        the atexit seal that follows it)."""
+        if self.sealed is None:
+            self.sealed = reason
+            self.flush()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "meta": self.meta,
+            "sealed": self.sealed,
+            "dropped": self.dropped,
+            "last_memory_report": self.last_memory_report,
+            "events": list(self.events),
+        }
+
+    def flush(self) -> Optional[str]:
+        """Atomically rewrite the artifact; returns its path (None when
+        no path is configured).  Write failures are swallowed — the
+        recorder must never take down the run it is observing."""
+        if self.path is None:
+            return None
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.snapshot(), fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+        return self.path
+
+
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def set_recorder(rec: Optional[FlightRecorder]) -> None:
+    global _RECORDER
+    _RECORDER = rec
+
+
+def record(kind: str, name: str, **data) -> None:
+    """Module-level hook used by Tracer/MetricsRegistry: no-op until a
+    recorder is installed, so the obs layer pays nothing by default."""
+    if _RECORDER is not None:
+        _RECORDER.record(kind, name, **data)
+
+
+def install(path: str, capacity: int = DEFAULT_CAPACITY
+            ) -> FlightRecorder:
+    """Install the process-global recorder writing to ``path`` and hook
+    process termination: unhandled exceptions seal with the error,
+    normal interpreter exit seals as "exit".  (A SIGKILL runs neither —
+    that is what the eager per-event flush is for.)"""
+    rec = FlightRecorder(path, capacity=capacity)
+    set_recorder(rec)
+    prev_hook = sys.excepthook
+
+    def _seal_on_exception(exc_type, exc, tb):
+        rec.seal(f"exception: {exc_type.__name__}: {exc}")
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _seal_on_exception
+    atexit.register(rec.seal, "exit")
+    rec.flush()
+    return rec
+
+
+def load(path: str) -> Dict[str, Any]:
+    """Read one flight artifact back."""
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def newest_artifact(directory: str) -> Optional[str]:
+    """The most recently written ``*.json`` artifact under
+    ``directory`` (non-recursive), or None."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    best: Optional[str] = None
+    best_mt = -1.0
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        p = os.path.join(directory, name)
+        try:
+            mt = os.path.getmtime(p)
+        except OSError:
+            continue
+        if mt > best_mt:
+            best, best_mt = p, mt
+    return best
+
+
+def format_events(snapshot: Dict[str, Any],
+                  last: Optional[int] = None) -> List[str]:
+    """Human-readable lines for ``graft_trace blackbox``."""
+    events = snapshot.get("events", [])
+    if last is not None:
+        events = events[-last:]
+    meta = snapshot.get("meta", {})
+    sealed = (snapshot.get("sealed")
+              or "NO (process killed or still running)")
+    lines = [f"flight recorder: pid={meta.get('pid')} "
+             f"argv={' '.join(meta.get('argv', []))[:120]}",
+             f"sealed: {sealed}; dropped={snapshot.get('dropped', 0)}"]
+    t0 = events[0]["ts"] if events else 0.0
+    for ev in events:
+        data = ev.get("data")
+        extra = (" " + " ".join(f"{k}={v}" for k, v in data.items())
+                 if data else "")
+        lines.append(f"  +{ev['ts'] - t0:9.3f}s [{ev['kind']:>8}] "
+                     f"{ev['name']}{extra}")
+    rep = snapshot.get("last_memory_report")
+    if rep:
+        lines.append(f"last memory report: {json.dumps(rep)}")
+    return lines

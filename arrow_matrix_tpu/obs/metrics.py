@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from arrow_matrix_tpu.obs import flight
+
 
 def _label_key(labels: Dict[str, Any]) -> Tuple:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -150,6 +152,14 @@ class MetricsRegistry:
             self.events.append({"ts": time.time(), "kind": kind,
                                 "name": name, "value": value,
                                 "labels": dict(labels)})
+        # Mirror into the flight recorder ring (no-op unless installed):
+        # metric samples are the blackbox's record of what the run was
+        # doing when a wedge killed it.  span_ms is skipped — the
+        # Tracer mirrors spans itself with better context.
+        if name != "span_ms":
+            data = dict(labels)
+            data["value"] = value
+            flight.record(kind, name, **data)
 
     def merge_segment_log(self, seg) -> int:
         """Import a SegmentLog's numeric entries as events/observations

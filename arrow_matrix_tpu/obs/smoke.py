@@ -3,13 +3,15 @@
 One call produces a complete, inspectable run directory:
 
   * ``<algo>.trace.json`` — Perfetto-loadable phase trace per
-    algorithm (build / comm_account / warmup / iterate (per-step
-    spans) / gather_result);
+    algorithm (build / comm_account / mem_account / warmup / iterate
+    (per-step spans) / gather_result);
   * ``metrics.jsonl`` — the registry event log, including
-    per-iteration device time (``iteration_time_ms``) and
-    measured-vs-ideal collective bytes;
-  * ``summary.json`` — per-algorithm phase totals, step stats, and
-    the bytes-vs-ideal ratio — the machine-readable record
+    per-iteration device time (``iteration_time_ms``),
+    measured-vs-ideal collective bytes, measured-vs-predicted HBM
+    bytes, and per-shard imbalance gauges;
+  * ``summary.json`` — per-algorithm phase totals, step stats, the
+    bytes-vs-ideal ratio, the executable memory breakdown, and the
+    shard imbalance report — the machine-readable record
     ``graft_trace summarize`` / ``diff`` consume.
 
 Construction mirrors the recompile audit (analysis/audit.py:_entries):
@@ -29,6 +31,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 from arrow_matrix_tpu.obs.comm import account_collectives, ideal_bytes_for
+from arrow_matrix_tpu.obs.imbalance import account_imbalance
+from arrow_matrix_tpu.obs.memview import account_memory, predicted_bytes_for
 from arrow_matrix_tpu.obs.metrics import MetricsRegistry
 from arrow_matrix_tpu.obs.tracer import Tracer
 from arrow_matrix_tpu.utils.logging import block_until_ready
@@ -179,6 +183,15 @@ def run_smoke(run_dir: str, n: int = 256, width: int = 32, k: int = 4,
             span_args["measured_bytes"] = rep["measured_bytes"]
             span_args["source"] = rep["source"]
 
+        with tracer.span(f"{name}/mem_account") as span_args:
+            mem = account_memory(
+                name, jit_fn, *jit_args,
+                predicted_bytes=predicted_bytes_for(obj, k),
+                registry=reg)
+            span_args["measured_bytes"] = mem["measured_bytes"]
+            span_args["source"] = mem["source"]
+            imb = account_imbalance(name, obj, registry=reg)
+
         with tracer.span(f"{name}/warmup"):
             # Two calls: the second exercises the result-feedback path,
             # which can compile separately (spmm_15d's as_features
@@ -212,6 +225,16 @@ def run_smoke(run_dir: str, n: int = 256, width: int = 32, k: int = 4,
             "ideal_bytes": rep["ideal_bytes"],
             "bytes_vs_ideal": rep["ratio"],
             "comm_source": rep["source"],
+            "hbm_measured_bytes": mem["measured_bytes"],
+            "hbm_predicted_bytes": mem["predicted_bytes"],
+            "hbm_vs_predicted": mem["ratio"],
+            "hbm_source": mem["source"],
+            "memory": mem["report"],
+            "imbalance": None if imb is None else {
+                key: imb[key] for key in (
+                    "units", "n_units", "rows_total", "nnz_total",
+                    "slots_total", "nnz_max_over_mean",
+                    "rows_max_over_mean", "padded_slot_waste")},
         }
 
     out = {
@@ -266,7 +289,8 @@ def validate_run_dir(run_dir: str,
                         break
                 names = {e["name"] for e in events}
                 for phase in ("build", "warmup", "iterate", "step",
-                              "gather_result", "comm_account"):
+                              "gather_result", "comm_account",
+                              "mem_account"):
                     if f"{name}/{phase}" not in names:
                         problems.append(
                             f"{tpath}: missing span {name}/{phase}")
@@ -274,6 +298,13 @@ def validate_run_dir(run_dir: str,
                 problems.append(f"malformed trace JSON {tpath}: {e}")
         if not rec.get("steps_ms"):
             problems.append(f"summary.json: {name} has no steps_ms")
+        if rec.get("hbm_measured_bytes") is None:
+            problems.append(
+                f"summary.json: {name} has no memory report "
+                f"(hbm_measured_bytes)")
+        if rec.get("imbalance") is None:
+            problems.append(
+                f"summary.json: {name} has no imbalance report")
 
     mpath = os.path.join(run_dir, "metrics.jsonl")
     if not os.path.isfile(mpath):
@@ -293,7 +324,8 @@ def validate_run_dir(run_dir: str,
             problems.append(f"malformed metrics.jsonl: {e}")
         else:
             for name in algorithms:
-                for metric in ("iteration_time_ms", "comm_measured_bytes"):
+                for metric in ("iteration_time_ms", "comm_measured_bytes",
+                               "hbm_measured_bytes"):
                     if not seen.get((metric, name)):
                         problems.append(
                             f"metrics.jsonl: no {metric} events for {name}")

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from arrow_matrix_tpu.obs import flight
 from arrow_matrix_tpu.utils.logging import block_until_ready
 
 
@@ -99,6 +100,12 @@ class Tracer:
             if self.registry is not None:
                 self.registry.record("span_ms", (toc - tic) * 1e3,
                                      run=self.name, span=name)
+            # Mirror into the flight recorder ring (no-op unless
+            # installed): the last completed spans name the phase a
+            # wedge killed.
+            flight.record("span", name, ms=(toc - tic) * 1e3,
+                          **({"error": args["error"]}
+                             if "error" in args else {}))
 
     def phase_ms(self) -> Dict[str, float]:
         """Total host ms per span name."""

@@ -661,3 +661,79 @@ def unblock_features(x: jax.Array | np.ndarray, n: int) -> np.ndarray:
     """Inverse of block_features: (nb, w, k) -> (n, k)."""
     arr = np.asarray(x)
     return arr.reshape(-1, arr.shape[-1])[:n]
+
+
+def block_row_stats(blocks: ArrowBlocks) -> dict:
+    """Per-block-row (rows, nnz, slots) over the padded block grid — the
+    arrow layout's compute units, which the obs layer summarizes into
+    the paper's max/mean imbalance bound (obs/imbalance.py).
+
+    Every off-head stack entry i lives on block row i (``_stack_coords``:
+    diag (i,i), col (i,0), lo (i,i-1), hi (i,i+1)); structurally-empty
+    slots are zero-filled and contribute nothing.  All head blocks land
+    on block row 0, whatever the head packing (ELL stack / flat-COO /
+    global-row ELL).
+    """
+    from arrow_matrix_tpu.ops.ell import ell_slot_stats, flat_slot_stats
+
+    nb = blocks.n_blocks
+    nnz = np.zeros(nb, dtype=np.int64)
+    slots = np.zeros(nb, dtype=np.int64)
+
+    def stack_stats(cols, data, deg):
+        if cols is None and data is None:
+            return None
+        # Dense blocks carry EMPTY cols arrays (not None) next to the
+        # (nb, w, w) value stacks; detect them by the value stack being
+        # the larger array.
+        dense = (cols is None
+                 or (data is not None
+                     and np.asarray(cols).size < getattr(data, "size", 0)))
+        if dense:
+            # Dense (nb, w, w) blocks: resident slots are every value.
+            d = np.asarray(data)
+            e_nnz = np.count_nonzero(
+                d.reshape(d.shape[0], -1), axis=1).astype(np.int64)
+            e_slots = np.full(
+                d.shape[0],
+                int(np.prod(d.shape[1:], dtype=np.int64)),
+                dtype=np.int64)
+            return e_nnz, e_slots
+        return ell_slot_stats(cols, data, deg)
+
+    for name in ("diag", "col", "lo", "hi"):
+        st = stack_stats(getattr(blocks, f"{name}_cols"),
+                         getattr(blocks, f"{name}_data"),
+                         getattr(blocks, f"{name}_deg"))
+        if st is None:
+            continue
+        e_nnz, e_slots = st
+        n = min(len(e_nnz), nb)
+        nnz[:n] += e_nnz[:n]
+        slots[:n] += e_slots[:n]
+
+    if blocks.head_flat:
+        # Flat-COO head: padding entries point at the dummy row
+        # == width.
+        h_nnz, h_slots = flat_slot_stats(blocks.head_rows, blocks.width)
+        nnz[0] += int(h_nnz.sum())
+        slots[0] += int(h_slots.sum())
+    elif blocks.head_gell:
+        cols = np.asarray(blocks.head_cols)
+        slots[0] += int(cols.size)
+        if blocks.head_deg is not None:
+            nnz[0] += int(np.asarray(blocks.head_deg).sum())
+        elif blocks.head_data is not None:
+            nnz[0] += int(np.count_nonzero(np.asarray(blocks.head_data)))
+        else:
+            nnz[0] += int(cols.size)
+    else:
+        st = stack_stats(blocks.head_cols, blocks.head_data,
+                         blocks.head_deg)
+        if st is not None:
+            e_nnz, e_slots = st
+            nnz[0] += int(e_nnz.sum())
+            slots[0] += int(e_slots.sum())
+
+    rows = np.full(nb, blocks.width, dtype=np.int64)
+    return {"rows": rows, "nnz": nnz, "slots": slots}

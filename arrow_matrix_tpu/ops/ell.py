@@ -447,3 +447,39 @@ def ell_pack_stack_binary(mats: list[sparse.spmatrix],
                               index_dtype=index_dtype)
         deg[i] = np.diff(csr.indptr).astype(np.int32)
     return cols, deg
+
+
+def ell_slot_stats(cols, data=None, deg=None
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-entry (nnz, slots) over the leading axis of a stacked ELL
+    packing — the raw material of the obs layer's imbalance report
+    (obs/imbalance.py).  ``deg`` (binary stacks) counts exactly; with
+    only ``data`` padding slots are the zero values; with neither the
+    stack is assumed full (indices alone cannot distinguish a real
+    column-0 entry from padding).
+    """
+    cols = np.asarray(cols)
+    nb = cols.shape[0]
+    slots = np.full(nb, int(np.prod(cols.shape[1:], dtype=np.int64)),
+                    dtype=np.int64)
+    if deg is not None:
+        nnz = np.asarray(deg).reshape(nb, -1).sum(
+            axis=1, dtype=np.int64)
+    elif data is not None:
+        nnz = np.count_nonzero(
+            np.asarray(data).reshape(nb, -1), axis=1).astype(np.int64)
+    else:
+        nnz = slots.copy()
+    return nnz, slots
+
+
+def flat_slot_stats(rows, n_rows: int) -> tuple[np.ndarray, np.ndarray]:
+    """Per-entry (nnz, slots) over the leading axis of a flat-COO stack
+    (``flat_pack_stack``): padding entries point at the dummy row
+    ``n_rows``, so real nonzeros are exactly the in-range rows."""
+    rows = np.asarray(rows)
+    if rows.ndim == 1:
+        rows = rows[None]
+    nnz = (rows < n_rows).sum(axis=1, dtype=np.int64)
+    slots = np.full(rows.shape[0], rows.shape[1], dtype=np.int64)
+    return nnz, slots

@@ -238,3 +238,30 @@ def hyb_spmm(level: HybLevel, x: jax.Array,
     loops carry features feature-major and call ``hyb_spmm_t`` (or the
     sell kernel) directly."""
     return hyb_spmm_t(level, x.T, chunk=chunk, heavy_chunk=heavy_chunk).T
+
+
+def hyb_stats(h: HybLevel) -> dict:
+    """(rows, nnz, slots) of the light and heavy partitions of one
+    HybLevel — the two gather kernels the layout actually launches, and
+    the units obs/imbalance.py summarizes for the hyb format."""
+    def part(cols, data, deg, rows):
+        slots = int(np.asarray(cols.shape).prod())
+        if deg is not None:
+            nnz = int(np.asarray(deg).sum())
+        elif data is not None:
+            nnz = int(np.count_nonzero(np.asarray(data)))
+        else:
+            nnz = slots
+        return {"rows": int(rows), "nnz": nnz, "slots": slots}
+
+    light = part(h.light_cols, h.light_data, h.light_deg,
+                 h.light_cols.shape[1])
+    heavy = part(h.heavy_cols, h.heavy_data, h.heavy_deg,
+                 h.heavy_idx.shape[0])
+    return {
+        "rows": [light["rows"], heavy["rows"]],
+        "nnz": [light["nnz"], heavy["nnz"]],
+        "slots": [light["slots"], heavy["slots"]],
+        "light": light,
+        "heavy": heavy,
+    }

@@ -218,3 +218,26 @@ def sell_spmm_t(m: SellMatrix, x_t: jax.Array,
             deg=None if m.deg is None else m.deg[t],
             chunk=c))
     return jnp.concatenate(outs, axis=1)
+
+
+def sell_stats(m: SellMatrix) -> dict:
+    """Per-tier (rows, nnz, slots) of one SellMatrix — the tiers are the
+    layout's compute units (each tier is one gather kernel launch), so
+    tier skew and padding waste are what obs/imbalance.py summarizes."""
+    per_tier = []
+    for t, c in enumerate(m.cols):
+        m_t, n_t = int(c.shape[0]), int(c.shape[1])
+        slots = m_t * n_t
+        if m.deg is not None:
+            nnz = int(np.asarray(m.deg[t]).sum())
+        elif m.data is not None:
+            nnz = int(np.count_nonzero(np.asarray(m.data[t])))
+        else:
+            nnz = slots
+        per_tier.append({"rows": n_t, "nnz": nnz, "slots": slots})
+    return {
+        "n_tiers": len(per_tier),
+        "rows": [t["rows"] for t in per_tier],
+        "nnz": [t["nnz"] for t in per_tier],
+        "slots": [t["slots"] for t in per_tier],
+    }

@@ -336,3 +336,36 @@ def wide_step_shard_map(blocks: ArrowBlocks, mesh: Mesh,
         out_specs=P(arm_axis, block_axis),
         **shard_map_check_kwargs(),
     )
+
+
+def arrow_blocks_shard_report(blocks: ArrowBlocks,
+                              n_dev: Optional[int] = None) -> dict:
+    """Per-shard load report for one arrow matrix under this module's
+    contiguous block-row sharding (obs/imbalance.py schema).
+
+    With ``n_dev`` the block-row units aggregate into the equal
+    contiguous chunks the ``P(block_axis)`` specs actually place, so
+    the max/mean ratio is the real per-device compute skew; without it
+    the units stay per block-row — the paper's imbalance bound (block
+    width caps every unit).
+    """
+    import numpy as np
+
+    from arrow_matrix_tpu.obs.imbalance import summarize_units
+    from arrow_matrix_tpu.ops.arrow_blocks import block_row_stats
+
+    st = block_row_stats(blocks)
+    rows, nnz, slots = st["rows"], st["nnz"], st["slots"]
+    units = "block-row"
+    if n_dev and n_dev > 1:
+        nb = len(nnz)
+        per = -(-nb // n_dev)
+
+        def agg(a):
+            a = np.asarray(a, dtype=np.int64)
+            return [int(a[d * per:(d + 1) * per].sum())
+                    for d in range(n_dev)]
+
+        rows, nnz, slots = agg(rows), agg(nnz), agg(slots)
+        units = "device"
+    return summarize_units(rows, nnz, slots, units=units)

@@ -775,6 +775,38 @@ class MultiLevelArrow:
         obs/comm judges the compiled collective bytes against."""
         return self._ideal_route_units * k * itemsize
 
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Static per-shard HBM model for one step at feature width
+        ``k``: this device's slice of every level's block stacks and
+        route tables, plus the carried feature input and output
+        (total_rows / n_dev rows each).  obs/memview judges the
+        compiled executable against this."""
+        from arrow_matrix_tpu.obs.memview import tree_device_bytes
+
+        n_dev = self.mesh.shape[self.axis] if self.mesh is not None else 1
+        ops_bytes = sum(b.device_nbytes() for b in self.blocks)
+        ops_bytes += tree_device_bytes(self.fwd, self.bwd)
+        return (ops_bytes // n_dev
+                + 2 * (self.total_rows // n_dev) * k * itemsize)
+
+    def shard_report(self) -> dict:
+        """Load report over the layout's compute units — block rows for
+        arrow levels (contiguous runs of which form the device shards,
+        so block-row skew bounds device skew), tiers under fmt='fold'
+        (obs/imbalance.py schema)."""
+        from arrow_matrix_tpu.obs.imbalance import summarize_units
+
+        rows: list = []
+        nnz: list = []
+        slots: list = []
+        for blk in self.blocks:
+            st = _block_unit_stats(blk)
+            rows.extend(int(v) for v in st["rows"])
+            nnz.extend(int(v) for v in st["nnz"])
+            slots.extend(int(v) for v in st["slots"])
+        units = "tier" if self.folded else "block-row"
+        return summarize_units(rows, nnz, slots, units=units)
+
     def run(self, x: jax.Array, iterations: int,
             donate: bool = False) -> jax.Array:
         """``iterations`` steps as ONE device program (`lax.scan` over
@@ -791,6 +823,24 @@ class MultiLevelArrow:
         """
         fn = self._scan_steps_donated if donate else self._scan_steps
         return fn(x, self.fwd, self.bwd, self.blocks, n=iterations)
+
+
+def _block_unit_stats(blk) -> dict:
+    """Per-unit (rows, nnz, slots) of one level's packed operator,
+    dispatched on its layout type (arrow block grid / SELL tiers / hyb
+    split) — shared by ``MultiLevelArrow.shard_report`` and
+    ``arrow_layout.arrow_blocks_shard_report``."""
+    from arrow_matrix_tpu.ops.arrow_blocks import block_row_stats
+    from arrow_matrix_tpu.ops.hyb import HybLevel, hyb_stats
+    from arrow_matrix_tpu.ops.sell import SellMatrix, sell_stats
+
+    if isinstance(blk, ArrowBlocks):
+        return block_row_stats(blk)
+    if isinstance(blk, SellMatrix):
+        return sell_stats(blk)
+    if isinstance(blk, HybLevel):
+        return hyb_stats(blk)
+    raise TypeError(f"no unit stats for {type(blk).__name__}")
 
 
 def resolve_chunk(chunk, blk: ArrowBlocks, total_rows: int, k: int,

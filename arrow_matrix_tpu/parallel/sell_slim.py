@@ -133,6 +133,19 @@ class SellShardStack:
         kernel's cost model (same contract as SellMatrix.n_slots)."""
         return sum(int(np.prod(c.shape)) for c in self.cols)
 
+    def shard_stats(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-device-shard (nnz, slots) summed over tiers, from the
+        always-present degree masks — the raw material of the obs
+        layer's imbalance report (obs/imbalance.py).  Fetches only the
+        small (n_dev, n_t) degree arrays."""
+        n_dev = int(self.cols[0].shape[0]) if self.cols else 0
+        nnz = np.zeros(n_dev, dtype=np.int64)
+        slots = np.zeros(n_dev, dtype=np.int64)
+        for t, c in enumerate(self.cols):
+            slots += int(np.prod(c.shape[1:], dtype=np.int64))
+            nnz += np.asarray(self.deg[t]).sum(axis=1, dtype=np.int64)
+        return nnz, slots
+
 
 def _pack_shard_tiers(shares: list[sparse.csr_matrix], ladder: list[int],
                       binary: bool, dtype,
@@ -920,6 +933,26 @@ class SellSlim:
         independent of n)."""
         return max(self.n_dev - 1, 0) * self.width * k * itemsize
 
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Static per-shard HBM model for one slim step at feature
+        width ``k``: this device's slice of the tier stacks (every
+        stack carries a leading device axis) plus the carried feature
+        input and output (rows_out positions each).  obs/memview
+        judges the compiled executable against this."""
+        return (self.ops.device_nbytes() // self.n_dev
+                + 2 * self.rows_out * k * itemsize)
+
+    def shard_report(self) -> dict:
+        """Per-device load report from the packed tier metadata
+        (obs/imbalance.py schema)."""
+        from arrow_matrix_tpu.obs.imbalance import summarize_units
+
+        b_nnz, b_slots = self.body.shard_stats()
+        h_nnz, h_slots = self.head.shard_stats()
+        rows = np.full(self.n_dev, self.rows_out, dtype=np.int64)
+        return summarize_units(rows, b_nnz + h_nnz, b_slots + h_slots,
+                               units="device")
+
 
 class SellMultiLevel:
     """K decomposition levels on the padding-free layouts: per-level
@@ -1162,6 +1195,37 @@ class SellMultiLevel:
         per_level_head = max(n_dev - 1, 0) * self.width
         return (self._ideal_route_units
                 + len(self.ops) * per_level_head) * k * itemsize
+
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Static per-shard HBM model for one multi-level step at
+        feature width ``k``: this device's slice of every level's tier
+        stacks and the inter-level route tables, plus the carried
+        feature input and output (level-0 ordering)."""
+        from arrow_matrix_tpu.obs.memview import tree_device_bytes
+
+        n_dev = self.mesh.shape[self.axis]
+        ops_bytes = sum(o.device_nbytes() for o in self.ops)
+        ops_bytes += tree_device_bytes(self.fwd, self.bwd)
+        return (ops_bytes // n_dev
+                + 2 * self.ops[0].rows_out * k * itemsize)
+
+    def shard_report(self) -> dict:
+        """Per-device load report summed over the decomposition levels
+        (every level's shard runs on the same device, so a device's
+        compute is the sum of its per-level tiers)."""
+        from arrow_matrix_tpu.obs.imbalance import summarize_units
+
+        n_dev = self.mesh.shape[self.axis]
+        nnz = np.zeros(n_dev, dtype=np.int64)
+        slots = np.zeros(n_dev, dtype=np.int64)
+        rows = np.zeros(n_dev, dtype=np.int64)
+        for o in self.ops:
+            for stack in (o.body, o.head):
+                s_nnz, s_slots = stack.shard_stats()
+                nnz += s_nnz
+                slots += s_slots
+            rows += o.rows_out
+        return summarize_units(rows, nnz, slots, units="device")
 
     def carried_mask(self) -> jax.Array:
         """(1, total_out_0) f32 validity mask of the carried ordering:

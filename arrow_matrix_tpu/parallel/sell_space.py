@@ -385,6 +385,31 @@ class SellSpaceShared:
         return (self._ideal_route_units
                 + self.k_levels * per_level_head) * k * itemsize
 
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Static per-shard HBM model for one space-shared step at
+        feature width ``k``: this device's slice of the flattened
+        (level, device) tier stacks and route tables, plus the carried
+        feature input and output (rows_out positions each)."""
+        from arrow_matrix_tpu.obs.memview import tree_device_bytes
+
+        total_dev = self.k_levels * self.n_dev
+        ops_bytes = (self.device_nbytes()
+                     + tree_device_bytes((self.bwd0, self.fwd0)))
+        return (ops_bytes // total_dev
+                + 2 * self.rows_out * k * itemsize)
+
+    def shard_report(self) -> dict:
+        """Per-(level, device) load report from the flattened tier
+        stacks (obs/imbalance.py schema) — each entry is one level
+        group's device shard, the unit the concurrent step computes."""
+        from arrow_matrix_tpu.obs.imbalance import summarize_units
+
+        b_nnz, b_slots = self.body.shard_stats()
+        h_nnz, h_slots = self.head.shard_stats()
+        rows = np.full(b_nnz.shape[0], self.rows_out, dtype=np.int64)
+        return summarize_units(rows, b_nnz + h_nnz, b_slots + h_slots,
+                               units="level-shard")
+
     def set_features(self, x: np.ndarray) -> jax.Array:
         """Host (n, k) original order -> (k, K * total_out), level g's
         slice in level-g carried order."""

@@ -304,6 +304,35 @@ class SpMM15D:
             per_dev += self.l_ni
         return n_dev * per_dev * k * itemsize
 
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Static per-shard HBM model for one 1.5D step at feature
+        width ``k``: this device's slice of the round-blocked ELL
+        stacks plus the blocked feature input (l_nkb rows) and result
+        (l_ni rows)."""
+        from arrow_matrix_tpu.obs.memview import tree_device_bytes
+
+        n_dev = self.p_div_c * self.c
+        ops_bytes = tree_device_bytes((self.a_cols, self.a_data))
+        return (ops_bytes // n_dev
+                + (self.l_nkb + self.l_ni) * k * itemsize)
+
+    def shard_report(self) -> dict:
+        """Per-device load report over the (p/c, c) grid
+        (obs/imbalance.py schema): each device owns ``rounds`` ELL
+        blocks of l_ni rows."""
+        from arrow_matrix_tpu.obs.imbalance import summarize_units
+        from arrow_matrix_tpu.ops.ell import ell_slot_stats
+
+        n_dev = self.p_div_c * self.c
+        cols = np.asarray(self.a_cols)
+        data = None if self.a_data is None else np.asarray(self.a_data)
+        nnz, slots = ell_slot_stats(
+            cols.reshape((n_dev,) + cols.shape[2:]),
+            None if data is None
+            else data.reshape((n_dev,) + data.shape[2:]))
+        rows = np.full(n_dev, self.l_ni, dtype=np.int64)
+        return summarize_units(rows, nnz, slots, units="device")
+
     def as_features(self, y: jax.Array) -> jax.Array:
         """Reuse a blocked result as the next iteration's features
         (square matrices only: l_ni == l_nkb)."""

@@ -445,6 +445,32 @@ class MatrixSlice1D:
         measured/ideal ratio exposes."""
         return self._ideal_route_rows * k * itemsize
 
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Static per-shard HBM model for one step at feature width
+        ``k``: this device's slice of the ELL stacks and exchange
+        tables (all carry a leading device axis) plus the blocked
+        feature input and output (l_rows each).  obs/memview judges
+        the compiled executable against this."""
+        from arrow_matrix_tpu.obs.memview import tree_device_bytes
+
+        ops_bytes = tree_device_bytes(
+            (self.l_cols, self.l_data, self.nl_cols, self.nl_data,
+             self.send_idx))
+        return ops_bytes // self.n_dev + 2 * self.l_rows * k * itemsize
+
+    def shard_report(self) -> dict:
+        """Per-device load report from the packed slice metadata
+        (obs/imbalance.py schema): rows actually owned per slice, local
+        + nonlocal nonzeros vs padded ELL slots."""
+        from arrow_matrix_tpu.obs.imbalance import summarize_units
+        from arrow_matrix_tpu.ops.ell import ell_slot_stats
+
+        l_nnz, l_slots = ell_slot_stats(self.l_cols, self.l_data)
+        nl_nnz, nl_slots = ell_slot_stats(self.nl_cols, self.nl_data)
+        rows = [hi - lo for lo, hi in self.slices]
+        return summarize_units(rows, l_nnz + nl_nnz, l_slots + nl_slots,
+                               units="device")
+
     def gather_result(self, y: jax.Array) -> np.ndarray:
         """Blocked (n_dev, l_rows, k) device result -> host (n, k)."""
         arr = fetch_replicated(y)

@@ -1,0 +1,63 @@
+"""Shared predicates over bench/watcher JSON artifacts.
+
+``bench.py`` (``_last_onchip_evidence``) and
+``tools/tunnel_watcher.py`` (``_artifact_is_onchip``) both decide
+whether a committed ``onchip_*.json`` artifact really records an
+accelerator run — and they used to disagree on the edge cases: the
+bench accepted an artifact with NO platform label (the
+pre-platform-label contract), while the watcher rejected it; the
+watcher also folded "file missing/unreadable" into the same ``False``
+as "explicitly degraded", so a stage whose artifact never landed was
+treated as a proven CPU fallback.  This module is the ONE definition
+both sides import.
+
+The contract:
+
+* an artifact is on-chip evidence unless it is EXPLICITLY
+  disqualified — ``degraded`` truthy or ``platform == "cpu"``.  A
+  missing ``platform`` field qualifies (old artifacts predate the
+  label and were all real-chip captures);
+* a missing or unreadable artifact is its own third state
+  (``"missing"``), never conflated with "proven degraded": absence
+  means the stage should be retried, an explicit CPU label means the
+  tunnel is proven down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+def record_is_onchip(d: dict) -> bool:
+    """True unless the record EXPLICITLY disqualifies itself: a truthy
+    ``degraded`` flag or ``platform == "cpu"``.  Unlabeled records
+    qualify (pre-platform-label artifacts were all real-chip)."""
+    return not d.get("degraded") and d.get("platform") != "cpu"
+
+
+def load_last_json_line(path: str) -> Optional[dict]:
+    """Parse the LAST line of ``path`` as JSON (bench artifacts are
+    JSON-lines; only the final line is the committed record).  None on
+    any read/parse failure — the caller decides what absence means."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            d = json.loads(fh.read().strip().splitlines()[-1])
+    except (OSError, json.JSONDecodeError, IndexError,
+            UnicodeDecodeError):
+        return None
+    return d if isinstance(d, dict) else None
+
+
+def classify_artifact(path: str) -> str:
+    """Three-way artifact verdict: ``"onchip"`` (readable record, not
+    disqualified), ``"degraded"`` (readable record with an explicit
+    CPU/degraded label), or ``"missing"`` (no file / unreadable /
+    unparseable — retriable, NOT evidence of a dead tunnel)."""
+    if not os.path.exists(path):
+        return "missing"
+    d = load_last_json_line(path)
+    if d is None:
+        return "missing"
+    return "onchip" if record_is_onchip(d) else "degraded"

@@ -201,9 +201,43 @@ def _progress(msg: str) -> None:
     killed/timed-out run must be diagnosable from its partial output."""
     print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}",
           file=sys.stderr, flush=True)
+    # Mirror into the flight recorder when one is installed (candidate
+    # children): the on-disk ring survives the SIGKILL that erases the
+    # stderr pipe's tail.  sys.modules peek, not an import — the parent
+    # process never pays for (or triggers) the obs package.
+    mod = sys.modules.get("arrow_matrix_tpu.obs.flight")
+    if mod is not None:
+        mod.record("progress", msg)
 
 
 _T0 = time.perf_counter()
+
+
+def _flight_path(name: str) -> str:
+    """On-disk flight-recorder artifact for one bench child.  One
+    well-known location (override: AMT_FLIGHT_DIR) shared by the child
+    that writes it and the parent that points at it on timeout."""
+    return os.path.join(
+        os.environ.get("AMT_FLIGHT_DIR",
+                       os.path.join("bench_cache", "flight")),
+        f"{name}.json")
+
+
+def _install_flight(name: str):
+    """Install the black-box recorder in a candidate/variant child: a
+    bounded ring of progress events eagerly flushed to disk, so a child
+    the parent SIGKILLs on timeout (the observed wedge mode — a native
+    RPC wait no signal reaches) still leaves its last-known state
+    behind.  Best-effort: a read-only disk or a broken obs install must
+    never cost the measurement."""
+    try:
+        from arrow_matrix_tpu.obs import flight
+
+        return flight.install(_flight_path(name))
+    except Exception as e:
+        print(f"[bench] flight recorder unavailable: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return None
 
 
 def _bench_config(platform: str, fmt_override: str | None = None) -> dict:
@@ -276,6 +310,11 @@ def run_one_candidate(fmt: str) -> None:
     host CPU for degraded mode."""
     cfg = json.loads(os.environ["AMT_BENCH_CFG"])
     _maybe_force_cpu()
+    _install_flight(f"candidate_{fmt}_k128" if cfg.get("k128_run")
+                    else f"candidate_{fmt}")
+    _progress(f"fmt={fmt} candidate start: n={cfg['n']} "
+              f"width={cfg['width']} k={cfg['k']} "
+              f"platform={cfg['platform']}")
     import jax
 
     # Full-f32 matmul passes: the correctness gate is parity with the
@@ -484,8 +523,17 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
                       f"err={run.get('err')}")
         return run
     except subprocess.TimeoutExpired:
-        return {"error": f"timed out after {timeout_s:.0f}s",
-                "timed_out": True}
+        err = {"error": f"timed out after {timeout_s:.0f}s",
+               "timed_out": True}
+        # The killed child's flight recorder is the only record of how
+        # far it got (SIGKILL leaves no stderr tail): point at it.
+        fp = _flight_path(f"candidate_{fmt}_k128"
+                          if cfg.get("k128_run") else f"candidate_{fmt}")
+        if os.path.exists(fp):
+            err["flight"] = fp
+            _progress(f"fmt={fmt} timed out; black box at {fp} "
+                      f"(graft_trace blackbox)")
+        return err
     # Narrow: ONLY child-output parse errors.  A blanket Exception here
     # would swallow the one-shot deadline TimeoutError raised by the
     # SIGALRM handler while the parent waits in subprocess.run — the
@@ -777,6 +825,8 @@ def run_one_variant(name: str) -> None:
     TPU plugin from initializing) — for testing the variants without an
     accelerator."""
     _maybe_force_cpu()
+    _install_flight(f"variant_{name}")
+    _progress(f"variant={name} start")
     import jax
 
     jax.config.update("jax_default_matmul_precision", "highest")
@@ -859,6 +909,11 @@ def _last_onchip_evidence() -> dict | None:
     live ``value``."""
     import glob
 
+    from arrow_matrix_tpu.utils.artifacts import (
+        load_last_json_line,
+        record_is_onchip,
+    )
+
     paths = (glob.glob(os.path.join("bench_results", "onchip_*.json"))
              + glob.glob(os.path.join("bench_cache", "onchip_*.json")))
     by_mtime = []
@@ -879,10 +934,8 @@ def _last_onchip_evidence() -> dict | None:
     k128_extra = None
     scanned = 0
     for mt, p in sorted(by_mtime, reverse=True):
-        try:
-            with open(p) as f:
-                d = json.loads(f.read().strip().splitlines()[-1])
-        except (OSError, json.JSONDecodeError, IndexError):
+        d = load_last_json_line(p)
+        if d is None:
             continue
         scanned += 1
         if d.get("metric") != "spmm_iter_ms" or not d.get("value"):
@@ -891,10 +944,10 @@ def _last_onchip_evidence() -> dict | None:
         # artifact on rc=0 even when the bench inside degraded to a
         # CPU fallback (tunnel flapped mid-window) — a CPU number in
         # the onchip_* namespace must never become the "most recent
-        # real-chip measurement".  An artifact with NO platform field
-        # (the pre-platform-label contract) still qualifies: only an
-        # explicit CPU/degraded label disqualifies.
-        if d.get("degraded") or d.get("platform") == "cpu":
+        # real-chip measurement".  The shared predicate keeps this
+        # bench and the watcher agreeing on the edge cases (unlabeled
+        # artifacts qualify; only an explicit label disqualifies).
+        if not record_is_onchip(d):
             continue
         if newest is None:
             newest, newest_mtime, data = p, mt, d

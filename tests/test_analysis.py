@@ -240,6 +240,34 @@ def test_shipped_package_lints_clean():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
+def test_graft_flight_obs_entry_points_lint_clean():
+    """The graft-flight additions specifically: the memory/imbalance
+    accounting and the flight recorder are observability code that
+    runs INSIDE measured regions, so they above all must not introduce
+    the hazards the linter hunts (host syncs, fresh jits, unblocked
+    timing)."""
+    obs_dir = os.path.join(os.path.dirname(
+        os.path.abspath(arrow_matrix_tpu.__file__)), "obs")
+    paths = [os.path.join(obs_dir, m)
+             for m in ("memview.py", "imbalance.py", "flight.py")]
+    findings, _ = lint_paths(paths)
+    assert not findings, "\n".join(f.format() for f in findings)
+
+    # The --mem_report CLI idiom: lower/compile/memory_analysis is
+    # host-side executable introspection, not a device round-trip —
+    # the accounting call pattern must stay silent under every rule.
+    fired, _ = _rules("""
+        from arrow_matrix_tpu import obs
+        def report(dist, step_fn, x, k):
+            mem = obs.account_memory(
+                "algo", step_fn, x,
+                predicted_bytes=obs.predicted_bytes_for(dist, k))
+            imb = obs.account_imbalance("algo", dist)
+            return obs.format_memory_report(mem), imb
+    """)
+    assert fired == []
+
+
 def test_cli_exits_nonzero_on_violation(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(textwrap.dedent(FIXTURES["R1"][0]))

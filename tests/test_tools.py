@@ -113,3 +113,165 @@ def test_ladder_race_cpu_fixture():
     assert out["runs"]["tight"]["gated"]
     assert (out["runs"]["tight"]["gather_slots"]
             < out["runs"]["default"]["gather_slots"])
+
+
+# ---------------------------------------------------------------------------
+# Shared on-chip artifact predicate + tunnel_watcher stage logic
+# ---------------------------------------------------------------------------
+
+
+def _watcher():
+    """A fresh tunnel_watcher module instance per test (its per-stage
+    completion set is module state)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tunnel_watcher_under_test",
+        os.path.join(REPO, "tools", "tunnel_watcher.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.log = lambda msg: None        # never touch pipeline.log
+    return mod
+
+
+def test_obs_gate_memory_problems():
+    """The gate's memory contract: absent report fails, sane ratio
+    passes, blown ratio names the algorithm and the bytes."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_gate_under_test", os.path.join(REPO, "tools", "obs_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+
+    ok = {"algorithms": {"a": {"memory": {"total_bytes": 100},
+                               "hbm_measured_bytes": 100,
+                               "hbm_predicted_bytes": 80,
+                               "hbm_vs_predicted": 1.25}}}
+    assert gate.memory_problems(ok, 8.0) == []
+    # No predictor -> no ratio to enforce, but the report must exist.
+    no_model = {"algorithms": {"a": {"memory": {"total_bytes": 100},
+                                     "hbm_measured_bytes": 100,
+                                     "hbm_vs_predicted": None}}}
+    assert gate.memory_problems(no_model, 8.0) == []
+    absent = {"algorithms": {"a": {"memory": None}}}
+    assert gate.memory_problems(absent, 8.0) == [
+        "a: memory report absent"]
+    blown = {"algorithms": {"a": {"memory": {"total_bytes": 800},
+                                  "hbm_measured_bytes": 800,
+                                  "hbm_predicted_bytes": 80,
+                                  "hbm_vs_predicted": 10.0}}}
+    problems = gate.memory_problems(blown, 8.0)
+    assert len(problems) == 1 and "exceeds 8.00" in problems[0]
+
+
+def test_artifacts_shared_predicate(tmp_path):
+    """ONE on-chip definition for bench.py and the watcher: explicit
+    CPU/degraded labels disqualify, unlabeled records qualify, and a
+    missing artifact is its own verdict — never 'degraded'."""
+    from arrow_matrix_tpu.utils.artifacts import (
+        classify_artifact,
+        load_last_json_line,
+        record_is_onchip,
+    )
+
+    assert record_is_onchip({"platform": "tpu", "value": 1.0})
+    assert record_is_onchip({"value": 1.0})          # pre-label contract
+    assert not record_is_onchip({"platform": "cpu"})
+    assert not record_is_onchip({"degraded": True, "platform": "tpu"})
+
+    p = tmp_path / "a.json"
+    assert classify_artifact(str(p)) == "missing"
+    p.write_text("not json at all")
+    assert classify_artifact(str(p)) == "missing"
+    assert load_last_json_line(str(p)) is None
+    # JSON-lines: only the LAST line is the committed record.
+    p.write_text('{"platform": "tpu"}\n{"platform": "cpu"}\n')
+    assert load_last_json_line(str(p)) == {"platform": "cpu"}
+    assert classify_artifact(str(p)) == "degraded"
+    p.write_text('{"platform": "tpu", "value": 2.5}\n')
+    assert classify_artifact(str(p)) == "onchip"
+    p.write_text('{"metric": "spmm_iter_ms", "value": 2.5}\n')
+    assert classify_artifact(str(p)) == "onchip"     # unlabeled
+
+
+def test_watcher_bench_stage_missing_artifact_is_failed(tmp_path):
+    """rc=0 with NO artifact means the stage failed (retriable) — the
+    old code returned 'degraded' and bailed the whole pass as if the
+    tunnel were proven down."""
+    tw = _watcher()
+    tw.REPO = str(tmp_path)
+    (tmp_path / "bench_cache").mkdir()
+    tw.run_stage = lambda *a, **k: True
+
+    assert tw._bench_stage("s", {}, 1.0, "never_written.json") == "failed"
+
+    art = tmp_path / "bench_cache" / "cpu.json"
+    art.write_text('{"platform": "cpu", "degraded": true}\n')
+    assert tw._bench_stage("s", {}, 1.0, "cpu.json") == "degraded"
+
+    art = tmp_path / "bench_cache" / "chip.json"
+    art.write_text('{"platform": "tpu", "value": 3.0}\n')
+    assert tw._bench_stage("s", {}, 1.0, "chip.json") == "onchip"
+
+    # Unlabeled artifacts follow bench.py's pre-label contract now —
+    # the watcher used to reject these (opposite default).
+    art = tmp_path / "bench_cache" / "old.json"
+    art.write_text('{"value": 3.0}\n')
+    assert tw._bench_stage("s", {}, 1.0, "old.json") == "onchip"
+
+    # And a launch failure is a failure regardless of artifacts.
+    tw.run_stage = lambda *a, **k: False
+    assert tw._bench_stage("s", {}, 1.0, "chip.json") == "failed"
+
+
+def test_watcher_per_stage_completion_retries_after_flap(tmp_path):
+    """A tunnel flap mid-pass must not permanently skip the stages
+    after it: the next healthy window retries exactly the pending
+    stages and never re-runs a completed one."""
+    tw = _watcher()
+    bench_outcomes = {}
+    bench_calls = []
+    stage_calls = []
+
+    def fake_bench_stage(name, env, timeout_s, json_name):
+        bench_calls.append(name)
+        return bench_outcomes[name]
+
+    def fake_run_stage(name, cmd, env, timeout_s, json_name=None):
+        stage_calls.append(name)
+        return True
+
+    tw._bench_stage = fake_bench_stage
+    tw.run_stage = fake_run_stage
+
+    # Window 1: headline lands, then the 2^24 stage comes back with an
+    # explicit CPU fallback -> the pass bails before planar.
+    bench_outcomes.update(bench_quick="onchip", bench_full="onchip",
+                          bench_2e24="degraded")
+    assert tw._healthy_pass_stages(False, "w1") is True
+    assert "planar" not in stage_calls
+    remaining = tw._stages_remaining(False)
+    assert "bench_2e24" in remaining and "planar" in remaining
+    assert "planar_1e8" in remaining
+    assert "bench_full" not in remaining     # completed stages stick
+
+    # Window 2: only the pending stages run; completed ones are never
+    # re-run (duplicate chip minutes), and planar_1e8 fires gated on
+    # the planar COMPLETION FLAG set earlier in the same window.
+    bench_calls.clear()
+    stage_calls.clear()
+    bench_outcomes["bench_2e24"] = "onchip"
+    assert tw._healthy_pass_stages(False, "w2") is True
+    assert bench_calls == ["bench_2e24"]
+    assert "planar" in stage_calls and "planar_1e8" in stage_calls
+    assert "ladder_race" not in stage_calls
+    assert "gather_probe" not in stage_calls
+    assert tw._stages_remaining(False) == []
+
+    # Window 3 is empty: every tracked stage (and any opportunistic
+    # ba27 attempt from window 2) is done or still precondition-gated.
+    bench_calls.clear()
+    stage_calls.clear()
+    assert tw._healthy_pass_stages(False, "w3") is True
+    assert bench_calls == [] and stage_calls == []

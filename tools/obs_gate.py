@@ -5,9 +5,16 @@ Counterpart of tools/lint_gate.py for the observability layer: runs
 all five parallel algorithms through arrow_matrix_tpu.obs.smoke on a
 4-device virtual CPU pool, then validates the run directory (named
 spans present per phase, trace JSON well-formed, per-iteration device
-time and collective-byte metrics recorded).  Exits 0 on a valid run,
-1 otherwise — the unattended pre-push / CI form of the same invariant
-amt_doctor's OBS probe checks interactively.
+time, collective-byte metrics, and the per-executable HBM memory
+report).  On top of the structural validation the gate enforces the
+memory contract: every algorithm must carry a memory report, and no
+algorithm's measured/predicted HBM ratio may exceed
+``OBS_GATE_MAX_HBM_RATIO`` (default 8.0 — the compiled executable
+materializing ~an order of magnitude more than the format model
+predicts is the OOM-in-waiting memview exists to catch; the smoke
+ratios sit in 1.0-2.6x).  Exits 0 on a valid run, 1 otherwise — the
+unattended pre-push / CI form of the same invariant amt_doctor's OBS
+probe checks interactively.
 
 Usage:
   python tools/obs_gate.py [run_dir]
@@ -20,6 +27,25 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def memory_problems(summary: dict, max_ratio: float) -> list:
+    """Gate problems from the smoke summary's memory section: a report
+    must exist per algorithm, and measured/predicted must stay under
+    ``max_ratio`` wherever the format exposes a predictor."""
+    problems = []
+    for name, rec in sorted(summary.get("algorithms", {}).items()):
+        if rec.get("memory") is None or not rec.get("hbm_measured_bytes"):
+            problems.append(f"{name}: memory report absent")
+            continue
+        ratio = rec.get("hbm_vs_predicted")
+        if ratio is not None and ratio > max_ratio:
+            problems.append(
+                f"{name}: measured/predicted HBM ratio {ratio:.2f} "
+                f"exceeds {max_ratio:.2f} "
+                f"({rec['hbm_measured_bytes']} vs "
+                f"{rec.get('hbm_predicted_bytes')} bytes)")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
@@ -30,8 +56,10 @@ def main(argv=None) -> int:
     from arrow_matrix_tpu.obs.smoke import run_smoke, validate_run_dir
 
     out = argv[0] if argv else tempfile.mkdtemp(prefix="obs_gate_")
-    run_smoke(out, n=128, width=32, k=4, n_dev=4, iters=2)
+    summary = run_smoke(out, n=128, width=32, k=4, n_dev=4, iters=2)
     problems = validate_run_dir(out)
+    max_ratio = float(os.environ.get("OBS_GATE_MAX_HBM_RATIO", "8.0"))
+    problems += memory_problems(summary, max_ratio)
     if problems:
         for p in problems:
             print(f"obs gate: {p}", file=sys.stderr)

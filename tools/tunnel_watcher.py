@@ -279,38 +279,53 @@ def healthy_pass(skip_scale: bool) -> bool:
         return _healthy_pass_stages(skip_scale, ts)
 
 
-_quick_captured = False
+#: Stages that have landed this watcher lifetime.  Replaces the old
+#: single pass/quick flags: a tunnel flap mid-pass used to permanently
+#: skip every stage after the flap (once the headline latched `passed`,
+#: later healthy windows only heartbeat) — now each stage records its
+#: own completion and a later heal window retries exactly the stages
+#: still missing, never re-running a completed one (duplicate chip
+#: minutes).
+_stage_done: set[str] = set()
 
 
 def _bench_stage(name: str, env: dict, timeout_s: float,
                  json_name: str) -> str:
     """Run a bench.py stage; 'onchip' | 'degraded' | 'failed'.
-    'degraded' means rc=0 but the artifact records a CPU fallback —
-    the tunnel is proven down again mid-window."""
+    'degraded' means the artifact EXPLICITLY records a CPU fallback
+    (platform=cpu or a degraded flag) — the tunnel is proven down
+    again mid-window and the pass bails.  A MISSING or unreadable
+    artifact is 'failed', not 'degraded': absence of evidence is not
+    evidence of a dead tunnel, so the pass continues and the stage is
+    retried in a later window."""
     if not run_stage(name, [sys.executable, "bench.py"], env,
                      timeout_s, json_name=json_name):
         return "failed"
-    if _artifact_is_onchip(json_name):
+    verdict = _artifact_verdict(json_name)
+    if verdict == "onchip":
         return "onchip"
+    if verdict == "missing":
+        log(f"stage {name}: rc=0 but artifact {json_name} is missing "
+            f"or unreadable — counting as failed (retriable), NOT as "
+            f"a proven CPU fallback")
+        return "failed"
     log(f"stage {name}: completed but DEGRADED (CPU fallback) — "
         f"bailing out of this pass; next probe cycle retries")
     return "degraded"
 
 
-def _artifact_is_onchip(json_name: str) -> bool:
-    """True iff the captured bench JSON records a non-degraded
-    accelerator run (``platform`` != cpu and not flagged degraded)."""
-    import json as _json
+def _artifact_verdict(json_name: str) -> str:
+    """Three-way verdict ('onchip' | 'degraded' | 'missing') on a
+    captured bench JSON, via the shared predicate in utils.artifacts —
+    ONE definition with bench.py's own evidence scan, so the two sides
+    agree on the edge cases (unlabeled pre-platform-label artifacts
+    qualify as on-chip; only an explicit label disqualifies)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from arrow_matrix_tpu.utils.artifacts import classify_artifact
 
-    try:
-        with open(os.path.join(REPO, "bench_cache", json_name)) as f:
-            d = _json.loads(f.read().strip().splitlines()[-1])
-        return d.get("platform") not in (None, "cpu") \
-            and not d.get("degraded")
-    except Exception as e:
-        log(f"onchip-artifact check failed for {json_name}: "
-            f"{type(e).__name__}: {e}")
-        return False
+    return classify_artifact(os.path.join(REPO, "bench_cache",
+                                          json_name))
 
 
 def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
@@ -324,7 +339,7 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
     # die behind hours of scale stages again), then the long scale
     # points.  bench_quick reuses the bench decomposition cache and a
     # single fold candidate with no scipy/k128 comparison.
-    # A quick success is recorded (module flag: re-running it in a
+    # A quick success is recorded in _stage_done (re-running it in a
     # later window would duplicate chip minutes) but does NOT complete
     # the pass — only bench_full does, so a short window's capture
     # never stops the full race from retrying in longer windows.
@@ -335,9 +350,11 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
     # this watcher exists for), and a CPU number must neither complete
     # the pass nor justify running hours of further stages on a
     # proven-dead tunnel — "degraded" bails the pass; the next probe
-    # cycle retries.
-    global _quick_captured
-    if not _quick_captured:
+    # cycle retries.  Every OTHER stage records per-stage completion:
+    # a degraded bail mid-pass no longer skips the remaining stages
+    # for the watcher's whole lifetime — the next healthy window picks
+    # up exactly where the flap cut this one off.
+    if "bench_quick" not in _stage_done:
         q = _bench_stage(
             "bench_quick",
             env={"AMT_BENCH_FMT": "fold",
@@ -347,58 +364,89 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
             timeout_s=720.0, json_name=f"onchip_bench_quick_{ts}.json")
         if q == "degraded":
             return False
-        _quick_captured = q == "onchip"
-    full = _bench_stage(
-        "bench_full", env={"AMT_BENCH_DEADLINE": "3300"},
-        timeout_s=3600.0, json_name=f"onchip_bench_{ts}.json")
-    if full == "degraded":
-        return False
-    ok = full == "onchip"
-    if os.path.exists(os.path.join(REPO, "tools", "ladder_race.py")):
-        run_stage(
-            "ladder_race",
-            [sys.executable, "tools/ladder_race.py"],
-            env={}, timeout_s=2400.0,
-            json_name=f"onchip_ladder_{ts}.json")
-    if os.path.exists(os.path.join(REPO, "tools",
-                                   "pallas_gather_probe.py")):
-        run_stage("pallas_gather",
-                  [sys.executable, "tools/pallas_gather_probe.py"],
-                  env={}, timeout_s=1200.0,
-                  json_name=f"onchip_pallas_gather_{ts}.json")
-    run_stage("gather_probe",
-              [sys.executable, "tools/gather_probe.py"],
-              env={}, timeout_s=1800.0)
-    if not skip_scale:
-        if _bench_stage(
-                "bench_2e24",
-                env={"AMT_BENCH_N": str(1 << 24),
-                     "AMT_BENCH_LEVELS": "14",
-                     "AMT_BENCH_FMT": "fold",
-                     "AMT_BENCH_K128": "0",
-                     "AMT_BENCH_COMPARE": "0",
-                     "AMT_BENCH_DEADLINE": "5400"},
-                timeout_s=5700.0,
-                json_name=f"onchip_bench_2e24_{ts}.json") == "degraded":
+        if q == "onchip":
+            _stage_done.add("bench_quick")
+    if "bench_full" not in _stage_done:
+        full = _bench_stage(
+            "bench_full", env={"AMT_BENCH_DEADLINE": "3300"},
+            timeout_s=3600.0, json_name=f"onchip_bench_{ts}.json")
+        if full == "degraded":
+            return False
+        if full == "onchip":
+            _stage_done.add("bench_full")
+    ok = "bench_full" in _stage_done
+    if "ladder_race" not in _stage_done:
+        if os.path.exists(os.path.join(REPO, "tools",
+                                       "ladder_race.py")):
+            if run_stage(
+                    "ladder_race",
+                    [sys.executable, "tools/ladder_race.py"],
+                    env={}, timeout_s=2400.0,
+                    json_name=f"onchip_ladder_{ts}.json"):
+                _stage_done.add("ladder_race")
+        else:   # tool absent: nothing to retry, don't block completion
+            _stage_done.add("ladder_race")
+    if "pallas_gather" not in _stage_done:
+        if os.path.exists(os.path.join(REPO, "tools",
+                                       "pallas_gather_probe.py")):
+            if run_stage("pallas_gather",
+                         [sys.executable,
+                          "tools/pallas_gather_probe.py"],
+                         env={}, timeout_s=1200.0,
+                         json_name=f"onchip_pallas_gather_{ts}.json"):
+                _stage_done.add("pallas_gather")
+        else:
+            _stage_done.add("pallas_gather")
+    if "gather_probe" not in _stage_done:
+        if run_stage("gather_probe",
+                     [sys.executable, "tools/gather_probe.py"],
+                     env={}, timeout_s=1800.0):
+            _stage_done.add("gather_probe")
+    if not skip_scale and "bench_2e24" not in _stage_done:
+        big = _bench_stage(
+            "bench_2e24",
+            env={"AMT_BENCH_N": str(1 << 24),
+                 "AMT_BENCH_LEVELS": "14",
+                 "AMT_BENCH_FMT": "fold",
+                 "AMT_BENCH_K128": "0",
+                 "AMT_BENCH_COMPARE": "0",
+                 "AMT_BENCH_DEADLINE": "5400"},
+            timeout_s=5700.0,
+            json_name=f"onchip_bench_2e24_{ts}.json")
+        if big == "onchip":
+            _stage_done.add("bench_2e24")
+        elif big == "degraded":
             return ok
-    if os.path.exists(os.path.join(REPO, "tools", "planar_bench.py")):
-        planar_ok = run_stage(
-            "planar", [sys.executable, "tools/planar_bench.py"],
-            env={}, timeout_s=2400.0,
-            json_name=f"onchip_planar_{ts}.json")
-        if planar_ok and not skip_scale:
-            # The flagship scale point: 10240^2 = 104.9M rows on ONE
-            # chip via bf16 feature carriage (~8.4 GB resident).  Only
-            # after the 4096^2 stage proves the path — a failure there
-            # would burn ~40 min of healthy-tunnel time for nothing.
-            run_stage(
+    if "planar" not in _stage_done:
+        if os.path.exists(os.path.join(REPO, "tools",
+                                       "planar_bench.py")):
+            if run_stage(
+                    "planar", [sys.executable, "tools/planar_bench.py"],
+                    env={}, timeout_s=2400.0,
+                    json_name=f"onchip_planar_{ts}.json"):
+                _stage_done.add("planar")
+        else:
+            _stage_done.add("planar")
+            _stage_done.add("planar_1e8")
+    if (not skip_scale and "planar_1e8" not in _stage_done
+            and "planar" in _stage_done):
+        # The flagship scale point: 10240^2 = 104.9M rows on ONE chip
+        # via bf16 feature carriage (~8.4 GB resident).  Only after
+        # the 4096^2 stage proves the path — a failure there would
+        # burn ~40 min of healthy-tunnel time for nothing.  Gated on
+        # the planar COMPLETION FLAG, not this pass's local result: a
+        # 4096^2 capture from an earlier window proves the path just
+        # as well, so a flap between the two stages no longer costs
+        # the flagship point the whole round.
+        if run_stage(
                 "planar_1e8",
                 [sys.executable, "tools/planar_bench.py"],
                 env={"AMT_PLANAR_SIDE": "10240",
                      "AMT_PLANAR_DTYPE": "bf16"},
                 timeout_s=4200.0,
-                json_name=f"onchip_planar_1e8_{ts}.json")
-    if (not skip_scale
+                json_name=f"onchip_planar_1e8_{ts}.json"):
+            _stage_done.add("planar_1e8")
+    if (not skip_scale and "ba27" not in _stage_done
             and os.path.exists(os.path.join(
                 REPO, "bench_cache", "ba27_fold", "rehearsal.json"))
             and os.path.exists(os.path.join(REPO, "tools",
@@ -408,10 +456,26 @@ def _healthy_pass_stages(skip_scale: bool, ts: str) -> bool:
         # itself refuses a toy-sized export).  Budget ~14 GB of the
         # 16 GB HBM — last in the list: the probes and planar stages
         # above it are cheaper per healthy minute.
-        run_stage("ba27", [sys.executable, "tools/ba27_bench.py"],
-                  env={}, timeout_s=4800.0,
-                  json_name=f"onchip_ba27_{ts}.json")
+        if run_stage("ba27", [sys.executable, "tools/ba27_bench.py"],
+                     env={}, timeout_s=4800.0,
+                     json_name=f"onchip_ba27_{ts}.json"):
+            _stage_done.add("ba27")
     return ok
+
+
+def _stages_remaining(skip_scale: bool) -> list[str]:
+    """Stages a later healthy window should still attempt.  ba27 is
+    never listed: its preconditions (an exported rehearsal) may never
+    materialize in a round, and an opportunistic extra must not keep
+    the watcher re-running full passes forever."""
+    stages = ["bench_quick", "bench_full", "ladder_race",
+              "pallas_gather", "gather_probe"]
+    if not skip_scale:
+        stages.append("bench_2e24")
+    stages.append("planar")
+    if not skip_scale:
+        stages.append("planar_1e8")
+    return [s for s in stages if s not in _stage_done]
 
 
 def main() -> None:
@@ -469,19 +533,27 @@ def main() -> None:
                     log(f"recovery: cleared wedged holders {cleared}")
             except Exception as e:
                 log(f"recovery check failed: {type(e).__name__}: {e}")
-        elif _host_busy_fresh() and not passed:
+        elif (_host_busy_fresh()
+              and _stages_remaining(args.skip_scale)):
             # Host-heavy work in flight: a bench started now would
             # contend for the single core (round-3 wedge trigger).
             log("probe: deferred (host_busy.lock present)")
         elif probe():
-            if passed:
-                log("probe: healthy (heartbeat; pass already complete)")
+            remaining = _stages_remaining(args.skip_scale)
+            if not remaining:
+                log("probe: healthy (heartbeat; all stages complete)")
             else:
-                log("tunnel HEALTHY — running on-chip stages")
-                passed = healthy_pass(args.skip_scale)
-                if passed:
-                    log("healthy pass complete — continuing heartbeat "
+                log("tunnel HEALTHY — running on-chip stages "
+                    f"(pending: {', '.join(remaining)})")
+                passed = healthy_pass(args.skip_scale) or passed
+                remaining = _stages_remaining(args.skip_scale)
+                if not remaining:
+                    log("all stages complete — continuing heartbeat "
                         "probes through driver time")
+                elif passed:
+                    log("headline landed; stages still pending: "
+                        f"{', '.join(remaining)} — retrying in the "
+                        f"next healthy window")
                 else:
                     log("bench failed on a healthy probe — retrying "
                         "next cycle")
