@@ -614,3 +614,51 @@ def check_unsynced_timing(ctx: ModuleContext) -> Iterable[Tuple[int, str]]:
                         f"block_until_ready; dispatch is asynchronous, "
                         f"so this measures launch overhead, not device "
                         f"time — block on the result inside the region")
+
+
+@register("R8", "swallowed-exception",
+          "a broad `except Exception: pass` in runtime code silently "
+          "swallows device errors, injected faults, and watchdog "
+          "escapes — recovery must see them")
+def check_swallowed_exception(ctx: ModuleContext
+                              ) -> Iterable[Tuple[int, str]]:
+    """Broad exception handlers whose only action is to discard.
+
+    ``except Exception: pass`` (or bare ``except:``, or a tuple
+    containing ``Exception``/``BaseException``, with a body of only
+    ``pass``/``continue``/``...``) turns every failure — device OOM,
+    injected chaos-gate faults, a supervisor's watchdog escape riding a
+    worker thread — into silent success.  The graft-heal contract is
+    that every fault is *seen* (flight-recorder event, metrics counter,
+    retry) before any decision to continue; a swallow-and-go handler
+    around a narrow, documented hazard should name the narrow exception
+    type, and a deliberate broad swallow takes an inline waiver
+    (``# graft-lint: disable=R8``) stating why.
+    """
+    broad = {"Exception", "BaseException"}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not node.body or not all(
+                isinstance(s, ast.Pass) or isinstance(s, ast.Continue)
+                or (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis)
+                for s in node.body):
+            continue
+        t = node.type
+        types = ([] if t is None
+                 else list(t.elts) if isinstance(t, ast.Tuple)
+                 else [t])
+        names = [(ctx.resolve(nd) or "").rsplit(".", 1)[-1]
+                 for nd in types]
+        if t is not None and not any(nm in broad for nm in names):
+            continue
+        caught = ("bare except" if t is None
+                  else "except " + "/".join(n for n in names if n))
+        yield node.lineno, (
+            f"{caught} whose body only discards swallows every "
+            f"failure silently — catch the narrow exception this site "
+            f"expects, or record the fault (obs.flight / metrics) "
+            f"before continuing; a deliberate broad swallow takes an "
+            f"inline `# graft-lint: disable=R8` waiver")

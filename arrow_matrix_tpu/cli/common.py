@@ -90,6 +90,62 @@ def setup_platform(args: argparse.Namespace) -> None:
         os.environ.setdefault("JAX_PLATFORMS", "tpu")
 
 
+def add_heal_args(parser: argparse.ArgumentParser,
+                  checkpoint_every_default: int = 10) -> None:
+    """graft-heal run-loop flags, shared by all three SpMM CLIs: the
+    supervised iteration loop (watchdog / bounded retry / finite-check)
+    plus iteration-state checkpointing (``utils/checkpoint.py``)."""
+    g = parser.add_argument_group(
+        "graft-heal", "supervised run loop: watchdog, bounded retry, "
+                      "checkpoint resume (see faults/)")
+    g.add_argument("--checkpoint", type=str, default=None,
+                   help="Directory/base for iteration-state checkpoints "
+                        "(requires --carry): X and the iteration "
+                        "counter are saved every --checkpoint_every "
+                        "iterations (orbax when available — sharded "
+                        "arrays persist per-shard without a host "
+                        "gather) and the run resumes from the "
+                        "checkpoint when one exists.  Beyond reference "
+                        "parity: the reference's only resume point is "
+                        "the decomposition artifact.")
+    g.add_argument("--checkpoint_every", type=int,
+                   default=checkpoint_every_default)
+    g.add_argument("--watchdog", type=float, default=0.0,
+                   help="Per-iteration watchdog seconds (0 disables): "
+                        "an iteration exceeding the budget is treated "
+                        "as a fault — retried from its entry state, or "
+                        "escalated to process-level recovery when it "
+                        "never drains.")
+    g.add_argument("--max_retries", type=int, default=2,
+                   help="Consecutive faulted attempts of one iteration "
+                        "before the run fails (each retry backs off "
+                        "exponentially and rolls back to the last "
+                        "checkpoint when one exists).")
+    g.add_argument("--finite_check", type=str2bool, nargs="?",
+                   default=True, const=True,
+                   help="Jitted all-finite check on the carried X each "
+                        "iteration; NaN/Inf rolls back to the last "
+                        "checkpoint instead of silently poisoning "
+                        "every subsequent iteration (carry mode only).")
+
+
+def make_supervisor(args: argparse.Namespace, name: str, *,
+                    carry: bool, layout: Optional[str] = None,
+                    registry=None):
+    """Build the graft-heal Supervisor for a CLI run from its flags
+    (one recipe so all three CLIs agree on flag semantics)."""
+    from arrow_matrix_tpu.faults import Supervisor
+
+    return Supervisor(
+        name, carry=carry,
+        watchdog_s=getattr(args, "watchdog", 0.0),
+        max_retries=getattr(args, "max_retries", 2),
+        checkpoint_path=getattr(args, "checkpoint", None),
+        checkpoint_every=getattr(args, "checkpoint_every", 0),
+        finite_check=bool(getattr(args, "finite_check", True)) and carry,
+        layout=layout, registry=registry)
+
+
 def load_sparse_matrix(path: str, dtype=np.float32) -> sparse.csr_matrix:
     """Load a sparse matrix from .npz (scipy), .mtx (matrix market), or
     .mat (matlab; the reference's primary input format,

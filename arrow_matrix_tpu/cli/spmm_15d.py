@@ -18,7 +18,9 @@ import numpy as np
 from arrow_matrix_tpu.cli.common import (
     add_device_args,
     add_distributed_args,
+    add_heal_args,
     load_sparse_matrix,
+    make_supervisor,
     normalize_scale,
     random_adjacency,
     setup_platform,
@@ -62,6 +64,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "--gpu-tiling analog, spmm_15d.py:371-449)."
                              "  <= 0 disables chunking.")
     parser.add_argument("-z", "--iterations", type=int, default=10)
+    parser.add_argument("--carry", type=str2bool, nargs="?",
+                        default=False, const=True,
+                        help="Carry X across iterations (X := A @ X "
+                             "propagation; the blocked result is "
+                             "gathered and re-distributed each "
+                             "iteration — the 1.5D output layout "
+                             "differs from its input layout) instead "
+                             "of timing the same input repeatedly.")
+    add_heal_args(parser)
     parser.add_argument("--logdir", type=str, default="./logs")
     parser.add_argument("--comm_report", type=str2bool, nargs="?",
                         default=False, const=True,
@@ -82,6 +93,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.checkpoint and not args.carry:
+        # Pure flag error: fail before any build/compile work.
+        raise SystemExit("--checkpoint requires --carry (there is no "
+                         "iteration state to resume when X is the "
+                         "same input every iteration)")
     setup_platform(args)
 
     import jax
@@ -195,20 +211,43 @@ def main(argv=None) -> int:
         imb = obs.account_imbalance("spmm_15d", dist)
         if imb is not None:
             print(obs.format_imbalance_report(imb))
-    for it in range(args.iterations):
+    if args.carry and dist.shape[0] != dist.shape[1]:
+        raise SystemExit(f"--carry needs a square matrix (X := A @ X); "
+                         f"have {dist.shape}")
+    sup = make_supervisor(args, "spmm_15d", carry=args.carry,
+                          layout=f"15d/c{c}/blocked_input")
+    start_it = 0
+    if args.carry and args.checkpoint:
+        state = sup.resume(like=x)
+        if state is not None:
+            x, start_it = state
+            print(f"resumed from {args.checkpoint} at iteration "
+                  f"{start_it}")
+
+    def body(xb, it):
         wb.set_iteration_data({"iteration": it})
         tic = time.perf_counter()
-        y = dist.spmm(x)
-        jax.block_until_ready(y)
+        yb = dist.spmm(xb)
+        jax.block_until_ready(yb)
         wb.log({"spmm_time": time.perf_counter() - tic})
+        if not args.carry:
+            return yb
+        # The 1.5D output layout (p/c, c, l_ni, k) differs from the
+        # input layout — re-distribute outside the timed window (the
+        # reference benchmark never carries; checkpoint/resume needs a
+        # stable input-layout state).
+        return dist.set_features(dist.gather_result(yb))
 
-    s = wb.get_log().summarize()["spmm_time"]
-    print(f"spmm_time mean {s['mean'] * 1e3:.3f} ms over {s['count']} "
-          f"iterations (min {s['min'] * 1e3:.3f})")
+    _, ok = sup.run(body, x, start_it, args.iterations)
+
+    s = wb.get_log().summarize().get("spmm_time")
+    if s:
+        print(f"spmm_time mean {s['mean'] * 1e3:.3f} ms over "
+              f"{s['count']} iterations (min {s['min'] * 1e3:.3f})")
     out = wb.finish(args.logdir)
     if out:
         print(f"log written to {out}.json")
-    return 0
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -27,6 +27,8 @@ import numpy as np
 from arrow_matrix_tpu.cli.common import (
     add_device_args,
     add_distributed_args,
+    add_heal_args,
+    make_supervisor,
     setup_platform,
     str2bool,
 )
@@ -170,18 +172,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "propagation, the GNN-style iterated run) "
                              "instead of the reference benchmark's "
                              "fresh random X per iteration.")
-    parser.add_argument("--checkpoint", type=str, default=None,
-                        help="Directory for iteration-state checkpoints "
-                             "(requires --carry): X and the iteration "
-                             "counter are saved every "
-                             "--checkpoint_every iterations (orbax "
-                             "when available — sharded arrays persist "
-                             "per-shard without a host gather) and the "
-                             "run resumes from the checkpoint when one "
-                             "exists.  Beyond reference parity: the "
-                             "reference's only resume point is the "
-                             "decomposition artifact.")
-    parser.add_argument("--checkpoint_every", type=int, default=10)
+    add_heal_args(parser)
     parser.add_argument("--comm_report", type=str2bool, nargs="?",
                         default=False, const=True,
                         help="Account the per-iteration collective "
@@ -352,8 +343,17 @@ def main(argv=None) -> int:
 
     # Both branches above guarantee a nonzero width (it names the
     # artifact files).
-    loaded = load_decomposition(path, width, block_diagonal=args.blocked,
-                                mem_map=args.memmap)
+    from arrow_matrix_tpu.io.graphio import ArtifactIntegrityError
+
+    try:
+        loaded = load_decomposition(path, width,
+                                    block_diagonal=args.blocked,
+                                    mem_map=args.memmap)
+    except ArtifactIntegrityError as e:
+        # Fail before the run, not 900 s into it: a tampered or
+        # half-written artifact is a nonzero exit naming the file.
+        print(f"artifact integrity check failed: {e}")
+        return 1
     widths = load_level_widths(path, width, block_diagonal=args.blocked)
     if widths is None:
         widths = width
@@ -511,90 +511,86 @@ def main(argv=None) -> int:
             print(obs.format_imbalance_report(imb))
 
     rng = np.random.default_rng(args.seed)
-    fail = False
-    start_it = 0
-    x = None
-    if args.carry:
-        x = warm   # the warmup input IS the carry-mode initial state
-        if args.checkpoint:
-            from arrow_matrix_tpu.utils.checkpoint import load_state
+    from arrow_matrix_tpu import faults
 
-            state = load_state(args.checkpoint, like=x)
-            if state is not None:
-                x, start_it = state
-                print(f"resumed from {args.checkpoint} at iteration "
-                      f"{start_it}")
+    # Layout tag: how X is carried.  A checkpoint written under one
+    # executor configuration refuses to resume under another (the
+    # checkpoint module's loud-mismatch contract) instead of silently
+    # permuting rows.
+    layout = f"{algo}/{args.fmt}/{args.feature_dtype or 'f32'}"
+    sup = make_supervisor(args, "spmm_arrow", carry=args.carry,
+                          layout=layout, registry=obs_reg)
+    start_it = 0
+    x0 = warm   # the warmup input IS the carry-mode initial state
+    if args.carry and args.checkpoint:
+        state = sup.resume(like=x0)
+        if state is not None:
+            x0, start_it = state
+            print(f"resumed from {args.checkpoint} at iteration "
+                  f"{start_it}")
+
+    def body(x, it):
+        wb.set_iteration_data({"iteration": it})
+        if args.carry:
+            x_host = None
+        else:
+            # Fresh random X every iteration (arrow_bench.py:114-116).
+            x_host = graphs.random_dense(n, args.features,
+                                         seed=int(rng.integers(2**31)))
+            x = multi.set_features(x_host)
+        if args.carry and args.validate:
+            # The golden compares one step from the CURRENT state.
+            x_host = multi.gather_result(x)
+        with obs_tracer.span("step", iteration=it):
+            tic = time.perf_counter()
+            y = multi.step(x)
+            jax.block_until_ready(y)
+            dt = time.perf_counter() - tic
+        wb.log({"spmm_time": dt})
+        obs_reg.record("iteration_time_ms", dt * 1e3,
+                       algorithm="spmm_arrow")
+        if args.validate:
+            from arrow_matrix_tpu.utils import numerics
+
+            got = multi.gather_result(y)
+            want = decomposition_spmm(golden_levels, x_host)
+            err = numerics.relative_error(got, want)
+            # One step separates the compared states (X is fresh per
+            # iteration); tolerance per the documented accumulation-
+            # order policy (utils/numerics.py).  bf16 carriage rounds
+            # inputs and outputs to 8-bit mantissas: the bound becomes
+            # the bf16 epsilon, not the f32 accumulation model.
+            tol = numerics.relative_tolerance(
+                sum(l.matrix.nnz for l in golden_levels) / max(n, 1),
+                iters=1)
+            if args.feature_dtype == "bf16":
+                tol = max(tol, 2e-2)
+            wb.log({"frobenius_err": float(err)})
+            print(f"iteration {it}: rel err vs host {err:.3e} "
+                  f"(gate {tol:.1e})")
+            if not np.isfinite(err) or err > tol:
+                # Policy failure: the supervisor never retries it, and
+                # no checkpoint of this state is written — a rerun must
+                # not resume past a numerically bad iteration.
+                raise faults.Abort(
+                    f"validation gate failed at iteration {it}: rel "
+                    f"err {err:.3e} (gate {tol:.1e})")
+        return y
+
     # --trace wraps the iteration loop; the finally below flushes the
-    # profiler even when an exception escapes the step's own
-    # try/except (validate block, save_state, Ctrl-C).
+    # profiler even when an exception escapes the supervised loop
+    # (watchdog escalation, Ctrl-C).
     from contextlib import ExitStack
 
     _trace_stack = ExitStack()
     if args.trace:
         _trace_stack.enter_context(wb.trace(args.trace))
     try:
-        for it in range(start_it, args.iterations):
-            wb.set_iteration_data({"iteration": it})
-            if args.carry:
-                x_host = None
-            else:
-                # Fresh random X every iteration (arrow_bench.py:114-116).
-                x_host = graphs.random_dense(n, args.features,
-                                             seed=int(rng.integers(2**31)))
-                x = multi.set_features(x_host)
-            try:
-                if args.carry and args.validate:
-                    # The golden compares one step from the CURRENT state.
-                    x_host = multi.gather_result(x)
-                with obs_tracer.span("step", iteration=it):
-                    tic = time.perf_counter()
-                    y = multi.step(x)
-                    jax.block_until_ready(y)
-                    dt = time.perf_counter() - tic
-                wb.log({"spmm_time": dt})
-                obs_reg.record("iteration_time_ms", dt * 1e3,
-                               algorithm="spmm_arrow")
-                if args.carry:
-                    x = y
-            except Exception as e:  # abort like the collective LOR flag
-                print(f"iteration {it} failed: {e}")
-                fail = True
-                break
-            if args.validate:
-                from arrow_matrix_tpu.utils import numerics
-
-                got = multi.gather_result(y)
-                want = decomposition_spmm(golden_levels, x_host)
-                err = numerics.relative_error(got, want)
-                # One step separates the compared states (X is fresh per
-                # iteration); tolerance per the documented accumulation-
-                # order policy (utils/numerics.py).  bf16 carriage rounds
-                # inputs and outputs to 8-bit mantissas: the bound becomes
-                # the bf16 epsilon, not the f32 accumulation model.
-                tol = numerics.relative_tolerance(
-                    sum(l.matrix.nnz for l in golden_levels) / max(n, 1),
-                    iters=1)
-                if args.feature_dtype == "bf16":
-                    tol = max(tol, 2e-2)
-                wb.log({"frobenius_err": float(err)})
-                print(f"iteration {it}: rel err vs host {err:.3e} "
-                      f"(gate {tol:.1e})")
-                if not np.isfinite(err) or err > tol:
-                    fail = True
-                    break
-            # Checkpoint only a state that passed this iteration's gates —
-            # persisting before validation would let a rerun resume past
-            # (and so mask) a numerically bad iteration.
-            if (args.carry and args.checkpoint
-                    and (it + 1) % max(args.checkpoint_every, 1) == 0):
-                from arrow_matrix_tpu.utils.checkpoint import save_state
-
-                save_state(args.checkpoint, x, it + 1)
-
+        _, ok = sup.run(body, x0, start_it, args.iterations)
+        fail = not ok
     finally:
-        # The flush must survive exceptions outside the
-        # step's own try/except (validate block, save_state,
-        # Ctrl-C) — a requested trace must never be lost.
+        # The flush must survive exceptions outside the supervised
+        # loop — a requested trace must never be lost.
         _trace_stack.close()
     summary = wb.get_log().summarize()
     if "spmm_time" in summary:

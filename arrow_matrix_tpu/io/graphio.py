@@ -19,9 +19,11 @@ from __future__ import annotations
 
 import enum
 import glob as _glob
+import hashlib
+import json
 import os
 import re
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 from scipy import sparse
@@ -37,6 +39,7 @@ class FileKind(enum.Enum):
     permutation = 5
     nnzrows = 6
     widths = 7
+    manifest = 8
 
 
 _SUFFIX = {
@@ -47,6 +50,7 @@ _SUFFIX = {
     FileKind.permutation: "_permutation.npy",
     FileKind.nnzrows: "_nnzrows.npy",
     FileKind.widths: "_widths.npy",
+    FileKind.manifest: "_manifest.json",
 }
 
 
@@ -117,6 +121,110 @@ CsrLike = Union[sparse.csr_matrix,
                 Tuple[Optional[np.ndarray], np.ndarray, np.ndarray]]
 
 
+# -- artifact integrity (graft-heal) ----------------------------------------
+
+class ArtifactIntegrityError(RuntimeError):
+    """A decomposition artifact fails its sha256 sidecar manifest —
+    truncated, corrupted, or missing.  Raised loudly at load time,
+    naming the offending file, instead of feeding garbage blocks into a
+    900 s bench run."""
+
+
+MANIFEST_VERSION = 1
+
+VERIFY_ENV = "AMT_VERIFY_ARTIFACTS"
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while block := fh.read(chunk):
+            h.update(block)
+    return h.hexdigest()
+
+
+def manifest_path(base: str, width: Optional[int],
+                  block_diagonal: bool = True) -> str:
+    """Sidecar manifest path for an artifact set (one manifest per
+    base+width, covering every level's files)."""
+    return format_path(base, width, None, block_diagonal,
+                       FileKind.manifest)
+
+
+def write_manifest(base: str, width: Optional[int], paths: List[str],
+                   block_diagonal: bool = True) -> str:
+    """Write the sha256 sidecar manifest covering ``paths``; returns
+    the manifest path.  Entries are keyed by basename so the artifact
+    directory can be moved wholesale."""
+    files: Dict[str, Dict[str, Any]] = {}
+    for p in paths:
+        files[os.path.basename(p)] = {"sha256": _sha256_file(p),
+                                      "bytes": os.path.getsize(p)}
+    doc = {"version": MANIFEST_VERSION, "files": files}
+    mp = manifest_path(base, width, block_diagonal)
+    tmp = mp + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+    os.replace(tmp, mp)
+    return mp
+
+
+def verify_manifest(base: str, width: Optional[int],
+                    block_diagonal: bool = True) -> bool:
+    """Verify every file the sidecar manifest lists; returns False when
+    no manifest exists (legacy / reference-written artifacts), True
+    when all hashes check out, and raises
+    :class:`ArtifactIntegrityError` naming the offending file
+    otherwise.  Size is checked before content so a truncated npy is
+    reported as truncated, not as a hash mismatch."""
+    mp = manifest_path(base, width, block_diagonal)
+    if not os.path.exists(mp):
+        return False
+    with open(mp, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    directory = os.path.dirname(mp) or "."
+    for name in sorted(doc.get("files", {})):
+        rec = doc["files"][name]
+        p = os.path.join(directory, name)
+        if not os.path.exists(p):
+            if name.endswith(_SUFFIX[FileKind.data]):
+                # An absent data file is a supported artifact state
+                # (implicit unit weights for unweighted graphs), not
+                # corruption.  A data file that EXISTS must still hash.
+                continue
+            raise ArtifactIntegrityError(
+                f"artifact file {p} is listed in manifest {mp} but "
+                f"missing on disk — the artifact set is incomplete; "
+                f"re-run arrow_decompose")
+        size = os.path.getsize(p)
+        if "bytes" in rec and size != int(rec["bytes"]):
+            raise ArtifactIntegrityError(
+                f"artifact file {p} is {size} bytes but manifest {mp} "
+                f"records {int(rec['bytes'])} — truncated or "
+                f"overwritten; re-run arrow_decompose")
+        digest = _sha256_file(p)
+        if digest != rec["sha256"]:
+            raise ArtifactIntegrityError(
+                f"artifact file {p} fails sha256 verification against "
+                f"manifest {mp} (got {digest[:16]}…, manifest records "
+                f"{str(rec['sha256'])[:16]}…) — corrupt; re-run "
+                f"arrow_decompose")
+    return True
+
+
+def _verify_default(mem_map: bool) -> bool:
+    """Verify-on-load policy: on by default, ``AMT_VERIFY_ARTIFACTS=0``
+    disables, ``=1`` forces.  Memory-mapped loads default OFF — hashing
+    reads every byte, which defeats the O(touched-blocks) footprint the
+    caller asked for."""
+    env = os.environ.get(VERIFY_ENV, "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return not mem_map
+
+
 def save_decomposition(levels: List[ArrowLevel], base: str,
                        block_diagonal: bool = True,
                        dtype=np.float32) -> None:
@@ -131,19 +239,29 @@ def save_decomposition(levels: List[ArrowLevel], base: str,
     """
     os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
     width0 = levels[0].arrow_width if levels else 0
+    written: List[str] = []
+
+    def _save(path, arr):
+        np.save(path, arr)
+        written.append(path)
+
     for i, lvl in enumerate(levels):
         m = lvl.matrix.tocsr().astype(dtype)
         m.sum_duplicates()
         m.sort_indices()
-        np.save(format_path(base, width0, i, block_diagonal, FileKind.indptr), m.indptr)
-        np.save(format_path(base, width0, i, block_diagonal, FileKind.indices), m.indices)
-        np.save(format_path(base, width0, i, block_diagonal, FileKind.data), m.data)
-        np.save(format_path(base, width0, i, block_diagonal, FileKind.permutation),
-                np.asarray(lvl.permutation, dtype=np.int64))
+        _save(format_path(base, width0, i, block_diagonal, FileKind.indptr), m.indptr)
+        _save(format_path(base, width0, i, block_diagonal, FileKind.indices), m.indices)
+        _save(format_path(base, width0, i, block_diagonal, FileKind.data), m.data)
+        _save(format_path(base, width0, i, block_diagonal, FileKind.permutation),
+              np.asarray(lvl.permutation, dtype=np.int64))
     nnz_rows = np.asarray([l.nonzero_rows for l in levels], dtype=np.int64)
-    np.save(format_path(base, width0, 0, block_diagonal, FileKind.nnzrows), nnz_rows)
+    _save(format_path(base, width0, 0, block_diagonal, FileKind.nnzrows), nnz_rows)
     widths = np.asarray([l.arrow_width for l in levels], dtype=np.int64)
-    np.save(format_path(base, width0, 0, block_diagonal, FileKind.widths), widths)
+    _save(format_path(base, width0, 0, block_diagonal, FileKind.widths), widths)
+    # Integrity manifest last: it covers everything written above, so a
+    # writer crash before this line leaves no manifest (load degrades to
+    # unverified) rather than a manifest naming half-written files.
+    write_manifest(base, width0, written, block_diagonal)
 
 
 def load_level_widths(base: str, width: Optional[int],
@@ -193,6 +311,7 @@ def load_decomposition(base: str, width: Optional[int] = None,
                        block_diagonal: bool = True,
                        mem_map: bool = False,
                        with_permutation: bool = True,
+                       verify: Optional[bool] = None,
                        ) -> List[Tuple[CsrLike, Optional[np.ndarray]]]:
     """Load all levels of a decomposition in the npy-triplet format.
 
@@ -200,7 +319,19 @@ def load_decomposition(base: str, width: Optional[int] = None,
     open_memmap``); blocks are materialized lazily by ``load_block``.
     Missing ``_data`` files mean implicit unit values (reference
     graphio.py:298).
+
+    ``verify=None`` follows the :func:`_verify_default` policy (sha256
+    manifest check on, unless memory-mapping or
+    ``AMT_VERIFY_ARTIFACTS=0``); artifacts without a manifest load
+    unverified either way.
     """
+    from arrow_matrix_tpu import faults
+
+    faults.inject("io.load_decomposition", target=base)
+    if verify is None:
+        verify = _verify_default(mem_map)
+    if verify:
+        verify_manifest(base, width, block_diagonal)
     out: List[Tuple[CsrLike, Optional[np.ndarray]]] = []
     # When this framework's _widths.npy metadata exists it bounds the
     # level count: without the bound, glob discovery could splice a

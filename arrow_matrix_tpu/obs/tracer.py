@@ -58,7 +58,7 @@ def _device_annotation(name: str):
             stack.enter_context(jax.profiler.TraceAnnotation(name))
         except ImportError:
             pass
-        except Exception:
+        except Exception:  # graft-lint: disable=R8 — observer-only
             # Annotation APIs vary across jax versions; tracing must
             # never take down the run it observes.
             pass

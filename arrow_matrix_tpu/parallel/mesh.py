@@ -104,6 +104,9 @@ def put_global(x, sharding: NamedSharding) -> jax.Array:
     disk — the IO-parallel loading of the reference's per-rank slice
     files (reference arrow/baseline/spmm_petsc.py:421-440), for free.
     """
+    from arrow_matrix_tpu.faults import inject as _fault_hook
+
+    _fault_hook("mesh.put_global")
     if all(d.process_index == jax.process_index()
            for d in sharding.device_set):
         return jax.device_put(x, sharding)
@@ -191,6 +194,9 @@ def fetch_replicated(arr) -> np.ndarray:
     ``Gather`` to rank 0, reference arrow/arrow_slim_mpi.py:423) — and
     every process reads its now-local copy.
     """
+    from arrow_matrix_tpu.faults import inject as _fault_hook
+
+    _fault_hook("mesh.fetch_replicated")
     if getattr(arr, "is_fully_addressable", True):
         return np.asarray(arr)
     repl = NamedSharding(arr.sharding.mesh, P())

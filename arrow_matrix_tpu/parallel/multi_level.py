@@ -766,6 +766,9 @@ class MultiLevelArrow:
     def step(self, x: jax.Array) -> jax.Array:
         """One iteration ``X := A @ X`` through all levels; input and
         output are flat (total_rows, k) arrays in level-0 order."""
+        from arrow_matrix_tpu.faults import on_step as _fault_hook
+
+        x = _fault_hook("multi_level.step", x)
         return self._step(x, self.fwd, self.bwd, self.blocks)
 
     def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:

@@ -224,6 +224,9 @@ def build_route(table: np.ndarray, n_dev: int,
     measured ~6x peak-RSS cut at 2^26 in
     tools/measure_routing_build.py).
     """
+    from arrow_matrix_tpu.faults import inject as _fault_hook
+
+    _fault_hook("routing.build_route")
     table = np.asarray(table, dtype=np.int64)
     total = table.size
     if src_total is None:

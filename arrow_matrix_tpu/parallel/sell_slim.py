@@ -1168,6 +1168,9 @@ class SellMultiLevel:
         return (self._level_args, self.fwd, self.bwd)
 
     def step(self, xt: jax.Array) -> jax.Array:
+        from arrow_matrix_tpu.faults import on_step as _fault_hook
+
+        xt = _fault_hook("sell_slim.step", xt)
         return self._step(xt, self._level_args, self.fwd, self.bwd)
 
     def run(self, xt: jax.Array, iterations: int,

@@ -17,11 +17,18 @@ permuting rows.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
 import jax
 import numpy as np
+
+# Checkpoint format version: bump when the saved state's meaning
+# changes (not when orbax/npz encodings differ).  Version 1 adds the
+# version + layout tags themselves; untagged checkpoints (version 0,
+# pre-graft-heal) still load but cannot be layout-verified.
+CHECKPOINT_VERSION = 1
 
 
 def _orbax():
@@ -33,13 +40,65 @@ def _orbax():
         return None
 
 
-def save_state(path: str, x: jax.Array, step: int) -> None:
-    """Write {x, step} under ``path`` (a directory), atomically."""
+def _meta_path(path: str) -> str:
+    return path + ".meta.json"
+
+
+def _write_meta(path: str, step: int, layout: Optional[str]) -> None:
+    meta = {"version": CHECKPOINT_VERSION, "step": int(step),
+            "layout": layout}
+    tmp = _meta_path(path) + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+    os.replace(tmp, _meta_path(path))
+
+
+def _read_meta(path: str) -> Optional[dict]:
+    try:
+        with open(_meta_path(path), encoding="utf-8") as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+
+
+def _check_meta(path: str, meta: Optional[dict],
+                layout: Optional[str]) -> None:
+    """Fail loudly on a version or layout mismatch; tolerate untagged
+    (pre-version) checkpoints so old artifacts keep loading."""
+    if meta is None:
+        return
+    version = int(meta.get("version", 0))
+    if version > CHECKPOINT_VERSION:
+        raise RuntimeError(
+            f"checkpoint at {path} has format version {version}, this "
+            f"build understands <= {CHECKPOINT_VERSION} — refusing to "
+            f"reinterpret a newer checkpoint")
+    saved_layout = meta.get("layout")
+    if layout is not None and saved_layout is not None \
+            and saved_layout != layout:
+        raise RuntimeError(
+            f"checkpoint at {path} was written with layout "
+            f"{saved_layout!r} but the resuming executor carries X as "
+            f"{layout!r} — resuming would silently permute rows; "
+            f"rebuild the executor with the checkpointing mode or "
+            f"delete the checkpoint")
+
+
+def save_state(path: str, x: jax.Array, step: int,
+               layout: Optional[str] = None) -> None:
+    """Write {x, step} under ``path`` (a directory), atomically.
+
+    ``layout`` tags the checkpoint with how X is carried (e.g.
+    ``"multi_level/flat"``); load_state verifies it so a resume under a
+    different execution mode fails loudly.
+    """
     path = os.path.abspath(path)
     ocp = _orbax()
     if ocp is not None:
         ckpt = ocp.PyTreeCheckpointer()
         ckpt.save(path, {"x": x, "step": np.int64(step)}, force=True)
+        if jax.process_index() == 0:
+            _write_meta(path, step, layout)
         return
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     from arrow_matrix_tpu.parallel.mesh import fetch_replicated
@@ -47,7 +106,9 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
     x_host = fetch_replicated(x)   # collective: every process joins
     if jax.process_count() == 1:
         tmp = path + ".tmp.npz"
-        np.savez(tmp, x=x_host, step=np.int64(step))
+        np.savez(tmp, x=x_host, step=np.int64(step),
+                 version=np.int64(CHECKPOINT_VERSION),
+                 layout=np.str_(layout or ""))
         os.replace(tmp, path + ".npz")
         return
     # Multi-process: one writer; its OUTCOME is broadcast, not
@@ -65,7 +126,9 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
     if jax.process_index() == 0:   # one writer
         try:
             tmp = path + ".tmp.npz"
-            np.savez(tmp, x=x_host, step=np.int64(step))
+            np.savez(tmp, x=x_host, step=np.int64(step),
+                     version=np.int64(CHECKPOINT_VERSION),
+                     layout=np.str_(layout or ""))
             os.replace(tmp, path + ".npz")
         except Exception as e:   # noqa: BLE001 — ANY writer failure
             # (OSError, MemoryError, zipfile errors...) must still
@@ -86,7 +149,8 @@ def save_state(path: str, x: jax.Array, step: int) -> None:
         ) from write_err
 
 
-def load_state(path: str, like: Optional[jax.Array] = None
+def load_state(path: str, like: Optional[jax.Array] = None,
+               layout: Optional[str] = None
                ) -> Optional[tuple[jax.Array, int]]:
     """Read {x, step} from ``path``; None when absent.
 
@@ -94,7 +158,9 @@ def load_state(path: str, like: Optional[jax.Array] = None
     executor) provides the expected shape/dtype/sharding: orbax
     restores each shard directly to its device; shape mismatches raise
     (an executor built differently from the checkpointing one must not
-    silently reinterpret rows).
+    silently reinterpret rows).  ``layout`` is verified against the tag
+    the checkpoint was saved with (both paths); untagged pre-version
+    checkpoints skip the check.
     """
     path = os.path.abspath(path)
     ocp = _orbax()
@@ -104,6 +170,7 @@ def load_state(path: str, like: Optional[jax.Array] = None
             f"importable here — silently restarting from iteration 0 "
             f"would discard it; install orbax or delete the directory")
     if ocp is not None and os.path.isdir(path):
+        _check_meta(path, _read_meta(path), layout)
         ckpt = ocp.PyTreeCheckpointer()
         if like is not None:
             restore_args = ocp.ArrayRestoreArgs(sharding=like.sharding,
@@ -115,6 +182,13 @@ def load_state(path: str, like: Optional[jax.Array] = None
         x, step = out["x"], int(out["step"])
     elif os.path.exists(path + ".npz"):
         with np.load(path + ".npz") as z:
+            meta = None
+            if "version" in z.files:
+                saved_layout = str(z["layout"]) if "layout" in z.files \
+                    else ""
+                meta = {"version": int(z["version"]),
+                        "layout": saved_layout or None}
+            _check_meta(path, meta, layout)
             x, step = z["x"], int(z["step"])
         if like is not None:
             from arrow_matrix_tpu.parallel.mesh import put_global
@@ -128,4 +202,8 @@ def load_state(path: str, like: Optional[jax.Array] = None
             f"checkpoint X has shape {tuple(x.shape)}, executor expects "
             f"{tuple(like.shape)} — resume with the same mode/format/"
             f"devices the checkpoint was written with")
+    from arrow_matrix_tpu.obs import flight
+
+    flight.record("heal", "resumed", path=path, step=step,
+                  layout=layout)
     return x, step

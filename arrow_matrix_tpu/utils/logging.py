@@ -217,7 +217,7 @@ def log_local_runs(log_dir: str = "./logs") -> list[str]:
             finally:
                 try:
                     wandb.finish()
-                except Exception:
+                except Exception:  # graft-lint: disable=R8 — best-effort close of an already-reported upload
                     pass
             with open(indicator, "w"):
                 pass

@@ -58,7 +58,7 @@ def force_cpu_devices(n_devices: int | None = None) -> None:
         from jax._src import xla_bridge as _xb
 
         _xb._backend_factories.pop("axon", None)
-    except Exception:  # pragma: no cover - jax internals moved; harmless
+    except Exception:  # pragma: no cover - jax internals moved; harmless  # graft-lint: disable=R8
         pass
     jax.config.update("jax_platforms", "cpu")
 
@@ -84,7 +84,7 @@ def device_memory_budget(device=None, fraction: float = 0.5,
         if limit:
             free = int(limit) - int(stats.get("bytes_in_use", 0))
             return max(int(free * fraction), 0)
-    except Exception:
+    except Exception:  # graft-lint: disable=R8 — memory_stats is best-effort; the RAM/default fallbacks below ARE the handling
         pass
     if dev.platform == "cpu":
         try:

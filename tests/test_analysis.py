@@ -168,6 +168,30 @@ FIXTURES = {
             return y, dt
         """,
     ),
+    "R8": (
+        # broad except with a body of only `pass`: device errors,
+        # injected faults, and watchdog escapes vanish silently.
+        """
+        def f(step, x):
+            try:
+                return step(x)
+            except Exception:
+                pass
+        """,
+        # narrow type, and a broad handler that actually handles.
+        """
+        def f(step, x):
+            try:
+                return step(x)
+            except ValueError:
+                pass
+            try:
+                return step(x)
+            except Exception as e:
+                print(f"step failed: {e}")
+                raise
+        """,
+    ),
 }
 
 
@@ -188,7 +212,7 @@ def test_rule_negative_silent(rule):
 
 def test_all_shipped_rules_registered():
     ids = {spec.rule_id for spec in rule_table()}
-    assert ids >= {"R1", "R2", "R3", "R4", "R5", "R6", "R7"}
+    assert ids >= {"R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8"}
 
 
 def test_waiver_suppresses_and_records():
@@ -200,6 +224,19 @@ def test_waiver_suppresses_and_records():
             return np.asarray(y)  # graft-lint: disable=R6
         """)
     assert fired == [] and waived == ["R6"]
+
+
+def test_r8_waiver_with_reason_text():
+    """A deliberate broad swallow takes an inline waiver on the
+    `except` line; trailing free-text reasons must not break parsing."""
+    fired, waived = _rules("""
+        def f(probe):
+            try:
+                return probe()
+            except Exception:  # graft-lint: disable=R8 — best-effort probe
+                pass
+        """)
+    assert fired == [] and waived == ["R8"]
 
 
 def test_file_waiver_suppresses_all():

@@ -80,6 +80,17 @@ def test_cli_carry_checkpoint_resume(tmp_path, monkeypatch):
                                    "--checkpoint_every", "2",
                                    "--validate", "true"])
     assert rc == 0
+    # The resumed run's final state must be bit-identical to an
+    # uninterrupted checkpointing run of the same 6 iterations.
+    ck2 = str(tmp_path / "ck2")
+    rc = spmm_arrow.main(common + ["--iterations", "6",
+                                   "--checkpoint", ck2,
+                                   "--checkpoint_every", "2"])
+    assert rc == 0
+    xa, sa = load_state(ck)
+    xb, sb = load_state(ck2)
+    assert sa == sb == 6
+    assert np.asarray(xa).tobytes() == np.asarray(xb).tobytes()
 
 
 def test_cli_checkpoint_requires_carry(tmp_path, monkeypatch):
@@ -125,3 +136,68 @@ def test_checkpoint_roundtrip_sell_space_shared(small):
     assert step == 2
     np.testing.assert_array_equal(np.asarray(xr), np.asarray(x2))
     assert xr.sharding == x.sharding
+
+
+def test_checkpoint_layout_mismatch_raises(tmp_path):
+    """A checkpoint tagged with one carriage layout must refuse to
+    resume under another — silently permuted rows are worse than a
+    crash."""
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    save_state(str(tmp_path / "ckl"), x, 2, layout="fold/ell/f32")
+    with pytest.raises(RuntimeError, match="layout"):
+        load_state(str(tmp_path / "ckl"), layout="sell/slim/f32")
+    # matching layout (and layout-agnostic load) both succeed
+    xr, step = load_state(str(tmp_path / "ckl"), layout="fold/ell/f32")
+    assert step == 2
+    xr, step = load_state(str(tmp_path / "ckl"))
+    np.testing.assert_array_equal(np.asarray(xr), x)
+
+
+def test_checkpoint_layout_mismatch_npz_fallback(tmp_path, monkeypatch):
+    """Same layout guard on the npz fallback path (no orbax)."""
+    from arrow_matrix_tpu.utils import checkpoint as ckpt
+
+    monkeypatch.setattr(ckpt, "_orbax", lambda: None)
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    ckpt.save_state(str(tmp_path / "ckn"), x, 3, layout="petsc/1d_sliced")
+    with pytest.raises(RuntimeError, match="layout"):
+        ckpt.load_state(str(tmp_path / "ckn"), layout="15d/c2")
+    xr, step = ckpt.load_state(str(tmp_path / "ckn"),
+                               layout="petsc/1d_sliced")
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(xr), x)
+
+
+def test_checkpoint_untagged_legacy_npz_tolerated(tmp_path, monkeypatch):
+    """A pre-versioning npz checkpoint (no version/layout fields) still
+    loads; a checkpoint from a NEWER format version fails loudly."""
+    from arrow_matrix_tpu.utils import checkpoint as ckpt
+
+    monkeypatch.setattr(ckpt, "_orbax", lambda: None)
+    x = np.ones((4, 2), dtype=np.float32)
+    np.savez(str(tmp_path / "legacy.npz"), x=x, step=np.int64(5))
+    xr, step = ckpt.load_state(str(tmp_path / "legacy"),
+                               layout="fold/ell/f32")
+    assert step == 5
+    np.savez(str(tmp_path / "future.npz"), x=x, step=np.int64(5),
+             version=np.int64(ckpt.CHECKPOINT_VERSION + 1),
+             layout=np.str_(""))
+    with pytest.raises(RuntimeError, match="newer"):
+        ckpt.load_state(str(tmp_path / "future"))
+
+
+def test_load_state_emits_resumed_flight_event(tmp_path):
+    from arrow_matrix_tpu.obs import flight
+
+    x = np.ones((3, 2), dtype=np.float32)
+    save_state(str(tmp_path / "ckev"), x, 7, layout="t")
+    rec = flight.FlightRecorder(str(tmp_path / "flight.json"))
+    old = flight.get_recorder()
+    flight.set_recorder(rec)
+    try:
+        load_state(str(tmp_path / "ckev"))
+    finally:
+        flight.set_recorder(old)
+    ev = [e for e in rec.events if e.get("name") == "resumed"]
+    assert ev and ev[0]["kind"] == "heal"
+    assert ev[0]["data"]["step"] == 7

@@ -1,0 +1,346 @@
+"""graft-heal unit tests: fault-plan parsing and hit-counter
+semantics, injection-hook no-op behavior, supervisor retry / rollback /
+watchdog / abort paths, artifact-integrity manifests, and the fast
+chaos-gate scenario matrix (the full gate, with its subprocess SIGKILL
+scenario, is marked slow)."""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu import faults
+from arrow_matrix_tpu.faults import plan as fault_plan
+from arrow_matrix_tpu.faults.supervisor import (
+    Abort,
+    Supervisor,
+    WatchdogTimeout,
+    state_is_finite,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# Plan parsing
+# ---------------------------------------------------------------------------
+
+def test_plan_from_json_roundtrip():
+    p = fault_plan.FaultPlan.from_json(
+        {"scenario": "hang", "site": "mesh.*", "after": 3, "hang_s": 2.5})
+    assert p.scenario == "hang" and p.site == "mesh.*"
+    assert p.after == 3 and p.count == 1 and p.hang_s == 2.5
+
+
+def test_plan_rejects_unknown_scenario():
+    with pytest.raises(ValueError, match="scenario"):
+        fault_plan.FaultPlan.from_json({"scenario": "meteor"})
+
+
+def test_plan_rejects_unknown_field():
+    with pytest.raises(ValueError, match="unknown"):
+        fault_plan.FaultPlan.from_json({"scenario": "nan", "when": 3})
+
+
+def test_parse_plan_json_string_and_file(tmp_path):
+    spec = {"scenario": "error", "site": "io.*", "after": 1}
+    p = fault_plan.parse_plan(json.dumps(spec))
+    assert p.scenario == "error" and p.after == 1
+    f = tmp_path / "plan.json"
+    f.write_text(json.dumps(spec))
+    q = fault_plan.parse_plan(str(f))
+    assert q == p
+
+
+# ---------------------------------------------------------------------------
+# Hit counters and firing windows
+# ---------------------------------------------------------------------------
+
+def test_inject_noop_without_plan():
+    # must be a literal no-op: no exception, no state
+    for _ in range(3):
+        faults.inject("mesh.fetch_replicated")
+
+
+def test_inject_fires_in_window_only():
+    faults.set_plan({"scenario": "error", "site": "mesh.*", "after": 2,
+                     "count": 1})
+    faults.inject("mesh.put_global")          # hit 0
+    faults.inject("mesh.put_global")          # hit 1
+    with pytest.raises(faults.FaultInjected):
+        faults.inject("mesh.put_global")      # hit 2: fires
+    faults.inject("mesh.put_global")          # hit 3: window closed
+
+
+def test_site_pattern_and_target_filtering():
+    faults.set_plan({"scenario": "error", "site": "io.*",
+                     "target": "ogbn"})
+    faults.inject("mesh.put_global")                      # wrong site
+    faults.inject("io.load_decomposition", target="ba")   # wrong target
+    with pytest.raises(faults.FaultInjected):
+        faults.inject("io.load_decomposition", target="/data/ogbn_arxiv")
+
+
+def test_on_step_nan_burst_is_seeded_and_deterministic():
+    faults.set_plan({"scenario": "nan", "site": "*.step", "after": 0,
+                     "burst": 3, "seed": 9})
+    x = jnp.zeros((8, 4), dtype=jnp.float32)
+    y = faults.on_step("multi_level.step", x)
+    assert int(np.isnan(np.asarray(y)).sum()) == 3
+    faults.set_plan({"scenario": "nan", "site": "*.step", "after": 0,
+                     "burst": 3, "seed": 9})
+    y2 = faults.on_step("multi_level.step", x)
+    assert np.array_equal(np.isnan(np.asarray(y)), np.isnan(np.asarray(y2)))
+
+
+def test_on_step_passthrough_without_plan():
+    x = jnp.ones((4, 2))
+    assert faults.on_step("multi_level.step", x) is x
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+def _count_body(fail_at, exc=RuntimeError("transient")):
+    """Body: x + 1 per iteration; raises once at iteration fail_at."""
+    tripped = []
+
+    def body(x, it):
+        if it == fail_at and not tripped:
+            tripped.append(it)
+            raise exc
+        return x + 1.0
+
+    return body
+
+
+def test_supervisor_clean_run():
+    sup = Supervisor("t", carry=True, verbose=False)
+    y, ok = sup.run(lambda x, it: x + 1.0, jnp.zeros(3), 0, 5)
+    assert ok and np.allclose(np.asarray(y), 5.0)
+    assert sup.faults_seen == 0 and sup.recoveries == 0
+
+
+def test_supervisor_retries_transient_error():
+    sup = Supervisor("t", carry=True, verbose=False, backoff_s=0.01)
+    y, ok = sup.run(_count_body(2), jnp.zeros(3), 0, 5)
+    assert ok and np.allclose(np.asarray(y), 5.0)
+    assert sup.faults_seen == 1 and sup.recoveries == 1
+
+
+def test_supervisor_exhausts_retries():
+    def body(x, it):
+        raise RuntimeError("always")
+
+    sup = Supervisor("t", carry=True, verbose=False, max_retries=2,
+                     backoff_s=0.01)
+    y, ok = sup.run(body, jnp.zeros(3), 0, 5)
+    assert not ok
+    assert sup.faults_seen >= 3   # initial + 2 retries
+
+
+def test_supervisor_abort_is_not_retried():
+    calls = []
+
+    def body(x, it):
+        calls.append(it)
+        raise Abort("validation gate failed")
+
+    sup = Supervisor("t", carry=True, verbose=False)
+    _, ok = sup.run(body, jnp.zeros(3), 0, 5)
+    assert not ok and calls == [0]
+
+
+def test_supervisor_nan_rollback_to_checkpoint(tmp_path):
+    ck = str(tmp_path / "ck")
+    poisoned = []
+
+    def body(x, it):
+        if it == 3 and not poisoned:
+            poisoned.append(it)
+            return x.at[0].set(float("nan"))
+        return x + 1.0
+
+    sup = Supervisor("t", carry=True, verbose=False, backoff_s=0.01,
+                     checkpoint_path=ck, checkpoint_every=2)
+    y, ok = sup.run(body, jnp.zeros(3), 0, 5)
+    assert ok and np.allclose(np.asarray(y), 5.0)
+    assert sup.faults_seen == 1 and sup.recoveries == 1
+    assert sup.last_checkpoint_step == 5   # final save
+
+
+def test_supervisor_watchdog_retry():
+    slow = []
+
+    def body(x, it):
+        if it == 1 and not slow:
+            slow.append(it)
+            time.sleep(0.6)
+        return x + 1.0
+
+    sup = Supervisor("t", carry=True, verbose=False, watchdog_s=0.15,
+                     watchdog_grace_s=30.0, backoff_s=0.01)
+    y, ok = sup.run(body, jnp.zeros(3), 0, 3)
+    assert ok and np.allclose(np.asarray(y), 3.0)
+    assert sup.faults_seen == 1 and sup.recoveries == 1
+
+
+def test_state_is_finite():
+    assert state_is_finite(jnp.ones((4, 2)))
+    assert not state_is_finite(jnp.array([1.0, float("inf")]))
+    assert not state_is_finite(jnp.array([1.0, float("nan")]))
+
+
+def test_supervisor_resume_matches_uninterrupted(tmp_path):
+    """Resume mid-run: final X bit-identical to a never-interrupted
+    run of the same body."""
+    body = lambda x, it: x * 1.5 + it
+    x0 = jnp.arange(6, dtype=jnp.float32)
+
+    ref, ok = Supervisor("ref", carry=True, verbose=False).run(
+        body, x0, 0, 6)
+    assert ok
+
+    ck = str(tmp_path / "ck")
+    sup1 = Supervisor("a", carry=True, verbose=False,
+                      checkpoint_path=ck, checkpoint_every=2)
+    _, ok = sup1.run(body, x0, 0, 4)
+    assert ok
+    sup2 = Supervisor("b", carry=True, verbose=False, checkpoint_path=ck)
+    resumed = sup2.resume(like=x0)
+    assert resumed is not None
+    x_mid, start = resumed
+    assert start == 4
+    y, ok = sup2.run(body, x_mid, start, 6)
+    assert ok
+    assert np.asarray(y).tobytes() == np.asarray(ref).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Artifact integrity manifests
+# ---------------------------------------------------------------------------
+
+def _tiny_artifact(tmp_path):
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.io import save_decomposition
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    a = barabasi_albert(64, 2, seed=3)
+    levels = arrow_decomposition(a, 16, max_levels=4,
+                                 block_diagonal=True, seed=3)
+    base = str(tmp_path / "tiny")
+    save_decomposition(levels, base)
+    return base, levels[0].arrow_width
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    from arrow_matrix_tpu.io.graphio import manifest_path, verify_manifest
+
+    base, w = _tiny_artifact(tmp_path)
+    mp = manifest_path(base, w)
+    assert os.path.exists(mp)
+    entries = json.load(open(mp))["files"]
+    assert entries and all("sha256" in v for v in entries.values())
+    assert verify_manifest(base, w)
+
+
+def test_corruption_detected_and_names_file(tmp_path):
+    from arrow_matrix_tpu.io.graphio import (
+        ArtifactIntegrityError,
+        FileKind,
+        format_path,
+        load_decomposition,
+    )
+
+    base, w = _tiny_artifact(tmp_path)
+    victim = format_path(base, w, 0, True, FileKind.data)
+    with open(victim, "r+b") as fh:
+        fh.seek(-4, os.SEEK_END)
+        fh.write(b"\x00\x01\x02\x03")
+    with pytest.raises(ArtifactIntegrityError,
+                       match=os.path.basename(victim)):
+        load_decomposition(base, w)
+
+
+def test_truncation_reported_as_truncation(tmp_path):
+    from arrow_matrix_tpu.io.graphio import (
+        ArtifactIntegrityError,
+        FileKind,
+        format_path,
+        load_decomposition,
+    )
+
+    base, w = _tiny_artifact(tmp_path)
+    victim = format_path(base, w, 0, True, FileKind.indices)
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as fh:
+        fh.truncate(size // 2)
+    with pytest.raises(ArtifactIntegrityError, match="truncated"):
+        load_decomposition(base, w)
+
+
+def test_verify_opt_out(tmp_path, monkeypatch):
+    from arrow_matrix_tpu.io.graphio import (
+        FileKind,
+        format_path,
+        manifest_path,
+        verify_manifest,
+    )
+
+    base, w = _tiny_artifact(tmp_path)
+    victim = format_path(base, w, 0, True, FileKind.data)
+    with open(victim, "r+b") as fh:
+        fh.seek(-4, os.SEEK_END)
+        fh.write(b"\xff\xff\xff\xff")
+    # explicit env opt-out skips verification entirely
+    monkeypatch.setenv("AMT_VERIFY_ARTIFACTS", "0")
+    from arrow_matrix_tpu.io.graphio import load_decomposition
+
+    load_decomposition(base, w)   # corrupt, but not checked
+    monkeypatch.delenv("AMT_VERIFY_ARTIFACTS")
+    # absent manifest -> verify_manifest is False, load proceeds
+    os.remove(manifest_path(base, w))
+    assert not verify_manifest(base, w)
+    load_decomposition(base, w)
+
+
+# ---------------------------------------------------------------------------
+# The chaos gate scenario matrix (fast tier; full gate is slow)
+# ---------------------------------------------------------------------------
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_gate_test", os.path.join(REPO, "tools", "chaos_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_gate_fast_scenarios(tmp_path):
+    gate = _load_gate()
+    problems, scenarios = gate.run_gate(str(tmp_path), fast=True)
+    assert problems == []
+    assert scenarios == ["nan", "hang", "corrupt"]
+
+
+@pytest.mark.slow
+def test_chaos_gate_full(tmp_path):
+    """Subprocess tier: includes the SIGKILL + checkpoint-resume
+    scenario."""
+    gate = _load_gate()
+    problems, scenarios = gate.run_gate(str(tmp_path), fast=False)
+    assert problems == []
+    assert "kill" in scenarios

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Tier-1 chaos gate: the graft-heal fault-injection scenario matrix.
+
+Counterpart of tools/obs_gate.py for the recovery layer: builds a
+small Barabasi-Albert decomposition artifact on a 4-device virtual CPU
+mesh, computes the fault-free final X of a supervised iterated-SpMM
+run, then replays the run under every scenario of the injection
+matrix and asserts each fault is **detected** (supervisor fault event /
+loud integrity error), **recovered** (retry, rollback, restore, or
+checkpoint resume), and that the recovered run's final X is
+**bit-identical** to the fault-free run:
+
+  nan      — seeded NaN burst poisons the carried X at an executor
+             step hook; the supervisor's jitted finite-check catches
+             it and rolls back to the last checkpoint.
+  hang     — an injected sleep outlasts the per-iteration watchdog;
+             the stalled attempt drains during the grace join and the
+             iteration is retried.
+  corrupt  — real bytes of the on-disk npy triplet are overwritten;
+             the sha256 sidecar manifest fails the load loudly naming
+             the offending file; restoring the artifact recovers.
+  kill     — (subprocess; skipped under ``--fast``) a SIGKILL lands
+             mid-iteration in a checkpointing spmm_arrow run; a rerun
+             resumes from the last checkpoint and finishes with the
+             same final state as a never-killed run.
+
+Exits 0 when every scenario passes, 1 otherwise.  Determinism is the
+whole contract: recovery re-runs the same compiled step from the same
+state on CPU, so equality is exact (``tobytes()``), not approximate.
+
+Usage:
+  python tools/chaos_gate.py [--fast] [workdir]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ITERS = 6
+N, WIDTH, K = 256, 32, 4
+SEED = 11
+
+
+def _build(workdir):
+    """Artifact + executor + initial state shared by the in-process
+    scenarios."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.io import (
+        as_levels,
+        load_decomposition,
+        load_level_widths,
+        save_decomposition,
+    )
+    from arrow_matrix_tpu.io.graphio import num_rows
+    from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+    from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+    a = barabasi_albert(N, 3, seed=SEED)
+    levels = arrow_decomposition(a, WIDTH, max_levels=10,
+                                 block_diagonal=True, seed=SEED)
+    base = os.path.join(workdir, "ba")
+    save_decomposition(levels, base)
+    width0 = levels[0].arrow_width
+    loaded = load_decomposition(base, width0)   # manifest-verified
+    widths = load_level_widths(base, width0)
+    lv = as_levels(loaded, widths if widths is not None else width0)
+    ml = MultiLevelArrow(lv, width0, mesh=make_mesh((4,), ("blocks",)),
+                         fmt="ell")
+    x0 = ml.set_features(random_dense(num_rows(lv[0].matrix), K, seed=7))
+    return ml, x0, base, width0
+
+
+def _final_bytes(x):
+    import numpy as np
+
+    return np.asarray(x).tobytes()
+
+
+def _run(ml, x0, ck, **sup_kw):
+    from arrow_matrix_tpu.faults import Supervisor
+
+    sup = Supervisor("chaos", carry=True, checkpoint_path=ck,
+                     checkpoint_every=2, verbose=False, **sup_kw)
+    y, ok = sup.run(lambda x, it: ml.step(x), x0, 0, ITERS)
+    return y, ok, sup
+
+
+def scenario_nan(ml, x0, ref, workdir):
+    from arrow_matrix_tpu import faults
+
+    faults.set_plan({"scenario": "nan", "site": "multi_level.step",
+                     "after": 3, "seed": 5})
+    try:
+        y, ok, sup = _run(ml, x0, os.path.join(workdir, "ck_nan"))
+    finally:
+        faults.clear_plan()
+    problems = []
+    if not ok:
+        problems.append("nan: supervised run did not complete")
+    if sup.faults_seen == 0:
+        problems.append("nan: NaN burst was not detected")
+    if sup.recoveries == 0:
+        problems.append("nan: no recovery was taken")
+    if ok and _final_bytes(y) != ref:
+        problems.append("nan: recovered final X is not bit-identical "
+                        "to the fault-free run")
+    return problems
+
+
+def scenario_hang(ml, x0, ref, workdir):
+    from arrow_matrix_tpu import faults
+
+    faults.set_plan({"scenario": "hang", "site": "multi_level.step",
+                     "after": 2, "hang_s": 1.2})
+    try:
+        y, ok, sup = _run(ml, x0, os.path.join(workdir, "ck_hang"),
+                          watchdog_s=0.3, watchdog_grace_s=60.0)
+    finally:
+        faults.clear_plan()
+    problems = []
+    if not ok:
+        problems.append("hang: supervised run did not complete")
+    if sup.faults_seen == 0:
+        problems.append("hang: watchdog did not fire on the injected "
+                        "stall")
+    if sup.recoveries == 0:
+        problems.append("hang: no recovery was taken")
+    if ok and _final_bytes(y) != ref:
+        problems.append("hang: recovered final X is not bit-identical "
+                        "to the fault-free run")
+    return problems
+
+
+def scenario_corrupt(x0, ref, base, width0, workdir):
+    from arrow_matrix_tpu.io import as_levels, load_decomposition
+    from arrow_matrix_tpu.io import load_level_widths
+    from arrow_matrix_tpu.io.graphio import (
+        ArtifactIntegrityError,
+        FileKind,
+        format_path,
+    )
+    from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+
+    problems = []
+    victim = format_path(base, width0, 0, True, FileKind.data)
+    pristine = open(victim, "rb").read()
+    with open(victim, "r+b") as fh:   # flip real bytes mid-file
+        fh.seek(max(0, len(pristine) // 2))
+        fh.write(b"\xff\x00\xff\x00\xff\x00\xff\x00")
+    try:
+        load_decomposition(base, width0)
+        problems.append("corrupt: corrupted artifact loaded without "
+                        "an integrity error")
+    except ArtifactIntegrityError as e:
+        if os.path.basename(victim) not in str(e):
+            problems.append(f"corrupt: integrity error does not name "
+                            f"the offending file: {e}")
+    # Recovery: restore the artifact, reload (verified), rebuild, rerun.
+    with open(victim, "wb") as fh:
+        fh.write(pristine)
+    loaded = load_decomposition(base, width0)
+    widths = load_level_widths(base, width0)
+    lv = as_levels(loaded, widths if widths is not None else width0)
+    ml2 = MultiLevelArrow(lv, width0,
+                          mesh=make_mesh((4,), ("blocks",)), fmt="ell")
+    y, ok, _ = _run(ml2, x0, os.path.join(workdir, "ck_corrupt"))
+    if not ok:
+        problems.append("corrupt: post-restore run did not complete")
+    elif _final_bytes(y) != ref:
+        problems.append("corrupt: post-restore final X is not "
+                        "bit-identical to the fault-free run")
+    return problems
+
+
+def scenario_kill(workdir):
+    from arrow_matrix_tpu.utils.checkpoint import load_state
+
+    problems = []
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("AMT_FAULT_PLAN", None)
+    ck_ok = os.path.join(workdir, "ck_ref")
+    ck_kill = os.path.join(workdir, "ck_kill")
+    cmd = [sys.executable, "-m", "arrow_matrix_tpu.cli.spmm_arrow",
+           "--vertices", str(N), "--width", str(WIDTH),
+           "--features", str(K), "--device", "cpu", "--carry", "true",
+           "--seed", str(SEED), "--iterations", str(ITERS),
+           "--checkpoint_every", "2",
+           "--logdir", os.path.join(workdir, "logs")]
+
+    def run(extra, fault_env=None):
+        e = dict(env)
+        if fault_env:
+            e["AMT_FAULT_PLAN"] = fault_env
+        return subprocess.run(cmd + extra, env=e, cwd=workdir,
+                              capture_output=True, text=True,
+                              timeout=600)
+
+    r = run(["--checkpoint", ck_ok])
+    if r.returncode != 0:
+        return [f"kill: fault-free reference run failed rc="
+                f"{r.returncode}: {r.stderr[-500:]}"]
+    # Warmup step is hit 0, so hit 5 is iteration 4 — after the step-2
+    # and step-4 checkpoints exist.
+    plan = json.dumps({"scenario": "kill", "site": "*.step",
+                       "after": 5})
+    r = run(["--checkpoint", ck_kill], fault_env=plan)
+    if r.returncode == 0:
+        return ["kill: injected SIGKILL did not terminate the run"]
+    mid = load_state(ck_kill)
+    if mid is None:
+        return ["kill: no checkpoint survived the SIGKILL"]
+    if mid[1] != 4:
+        problems.append(f"kill: expected the step-4 checkpoint to "
+                        f"survive, found step {mid[1]}")
+    r = run(["--checkpoint", ck_kill])
+    if r.returncode != 0:
+        return problems + [f"kill: resume run failed rc={r.returncode}"
+                           f": {r.stderr[-500:]}"]
+    if "resumed" not in r.stdout:
+        problems.append("kill: rerun did not report resuming from the "
+                        "checkpoint")
+    a = load_state(ck_ok)
+    b = load_state(ck_kill)
+    if a is None or b is None:
+        return problems + ["kill: final checkpoints missing"]
+    if a[1] != ITERS or b[1] != ITERS:
+        problems.append(f"kill: final steps {a[1]}/{b[1]} != {ITERS}")
+    if _final_bytes(a[0]) != _final_bytes(b[0]):
+        problems.append("kill: resumed run's final X is not "
+                        "bit-identical to the never-killed run")
+    return problems
+
+
+def run_gate(workdir, fast=False):
+    """Run the matrix; returns (problems, scenarios_run)."""
+    from arrow_matrix_tpu import faults
+    from arrow_matrix_tpu.obs import flight
+
+    rec = flight.FlightRecorder(os.path.join(workdir, "flight.json"))
+    flight.set_recorder(rec)
+    faults.clear_plan()   # a stray AMT_FAULT_PLAN must not skew the gate
+    try:
+        ml, x0, base, width0 = _build(workdir)
+        y_ref, ok, _ = _run(ml, x0, None)
+        if not ok:
+            return ["baseline: fault-free supervised run failed"], []
+        ref = _final_bytes(y_ref)
+        problems = []
+        scenarios = ["nan", "hang", "corrupt"]
+        problems += scenario_nan(ml, x0, ref, workdir)
+        problems += scenario_hang(ml, x0, ref, workdir)
+        problems += scenario_corrupt(x0, ref, base, width0, workdir)
+        if not fast:
+            scenarios.append("kill")
+            problems += scenario_kill(workdir)
+        kinds = {e.get("kind") for e in rec.events}
+        if "fault" not in kinds or "heal" not in kinds:
+            problems.append(f"flight recorder saw kinds {sorted(kinds)}"
+                            f" — fault and heal events are required")
+        return problems, scenarios
+    finally:
+        rec.seal("chaos gate done")
+        flight.set_recorder(None)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fast = "--fast" in argv
+    argv = [a for a in argv if a != "--fast"]
+
+    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(4)
+
+    import tempfile
+
+    workdir = argv[0] if argv else tempfile.mkdtemp(prefix="chaos_gate_")
+    os.makedirs(workdir, exist_ok=True)
+    problems, scenarios = run_gate(workdir, fast=fast)
+    if problems:
+        for p in problems:
+            print(f"chaos gate: {p}", file=sys.stderr)
+        print("chaos gate: FAILED", file=sys.stderr)
+        return 1
+    print(f"chaos gate: ok — scenarios {'+'.join(scenarios)} detected, "
+          f"recovered, bit-identical ({workdir})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
