@@ -24,9 +24,39 @@ Two HLO sources, selected by ``mode``:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 from arrow_matrix_tpu.utils import commstats
+
+#: Modeled interconnect bandwidth for the exposed-time estimate,
+#: bytes/second.  Default 45 GB/s per link direction (a v5e ICI-class
+#: figure); override with AMT_LINK_GBPS for other fabrics.  The
+#: absolute scale matters less than its consistency: exposed_comm_ms
+#: compares candidates and overlap settings against each other and
+#: against zero.
+LINK_BYTES_PER_S = float(os.environ.get("AMT_LINK_GBPS", "45")) * 1e9
+
+
+def exposed_comm_ms(measured_bytes: int, overlap_slabs: int = 1,
+                    link_bytes_per_s: Optional[float] = None) -> float:
+    """Modeled milliseconds of collective time a step leaves EXPOSED
+    (not hidden under compute) — the graft-stream headline metric.
+
+    The total modeled wire time is ``measured_bytes / link_bw``.  With
+    the chunked overlap schedule at S sub-slabs, slab i+1's exchange
+    runs while slab i computes, so only the first slab's exchange (1/S
+    of the bytes) is structurally un-hideable:
+    ``exposed = wire_time / S``.  S=1 (no overlap) exposes everything —
+    the serial exchange-then-compute baseline.  This is
+    measured-bytes-through-the-ideal-cost-model, not a wall-clock
+    measurement: it moves when the compiled program's collective bytes
+    or the overlap structure move, and is exact at the two ends
+    (0 bytes -> 0 ms; no overlap -> full wire time).
+    """
+    bw = LINK_BYTES_PER_S if link_bytes_per_s is None else link_bytes_per_s
+    s = max(int(overlap_slabs), 1)
+    return (float(measured_bytes) / bw) * 1e3 / s
 
 
 def ideal_bytes_for(obj, k: int, itemsize: int = 4) -> Optional[int]:
@@ -40,14 +70,19 @@ def ideal_bytes_for(obj, k: int, itemsize: int = 4) -> Optional[int]:
 
 def account_collectives(algorithm: str, jitted_fn, *args,
                         ideal_bytes: Optional[int] = None,
-                        mode: str = "auto",
+                        mode: str = "auto", overlap_slabs: int = 1,
                         registry=None, **kwargs) -> Dict[str, Any]:
     """Account one jitted entry point's collective bytes at trace time.
 
     Returns ``{"algorithm", "collectives" (full commstats dict, usable
     with format_stats), "measured_bytes", "ideal_bytes", "ratio",
-    "source"}``.  ``ratio`` is None when no ideal model was supplied or
-    the ideal is zero (single-device meshes legitimately move nothing).
+    "source", "overlap_slabs", "exposed_comm_ms"}``.  ``ratio`` is None
+    when no ideal model was supplied or the ideal is zero
+    (single-device meshes legitimately move nothing).
+    ``exposed_comm_ms`` is ALWAYS present (see :func:`exposed_comm_ms`;
+    tools/obs_gate.py rejects comm reports without it): the modeled
+    un-hidden collective milliseconds given the step's
+    ``overlap_slabs`` setting.
     """
     if mode not in ("auto", "lowered", "compiled"):
         raise ValueError(f"unknown mode {mode!r}")
@@ -70,6 +105,7 @@ def account_collectives(algorithm: str, jitted_fn, *args,
     ratio = None
     if ideal_bytes:
         ratio = measured / ideal_bytes
+    exposed_ms = exposed_comm_ms(measured, overlap_slabs)
 
     if registry is not None:
         registry.gauge("comm_measured_bytes", algorithm=algorithm).set(
@@ -80,6 +116,8 @@ def account_collectives(algorithm: str, jitted_fn, *args,
         if ratio is not None:
             registry.gauge("comm_vs_ideal_ratio", algorithm=algorithm).set(
                 ratio)
+        registry.gauge("comm_exposed_ms", algorithm=algorithm).set(
+            exposed_ms)
 
     return {
         "algorithm": algorithm,
@@ -88,4 +126,6 @@ def account_collectives(algorithm: str, jitted_fn, *args,
         "ideal_bytes": ideal_bytes,
         "ratio": ratio,
         "source": source,
+        "overlap_slabs": max(int(overlap_slabs), 1),
+        "exposed_comm_ms": round(exposed_ms, 6),
     }

@@ -179,7 +179,9 @@ def run_smoke(run_dir: str, n: int = 256, width: int = 32, k: int = 4,
         with tracer.span(f"{name}/comm_account") as span_args:
             rep = account_collectives(
                 name, jit_fn, *jit_args,
-                ideal_bytes=ideal_bytes_for(obj, k), registry=reg)
+                ideal_bytes=ideal_bytes_for(obj, k),
+                overlap_slabs=getattr(obj, "overlap_slabs", 1),
+                registry=reg)
             span_args["measured_bytes"] = rep["measured_bytes"]
             span_args["source"] = rep["source"]
 
@@ -225,6 +227,8 @@ def run_smoke(run_dir: str, n: int = 256, width: int = 32, k: int = 4,
             "ideal_bytes": rep["ideal_bytes"],
             "bytes_vs_ideal": rep["ratio"],
             "comm_source": rep["source"],
+            "overlap_slabs": rep["overlap_slabs"],
+            "exposed_comm_ms": rep["exposed_comm_ms"],
             "hbm_measured_bytes": mem["measured_bytes"],
             "hbm_predicted_bytes": mem["predicted_bytes"],
             "hbm_vs_predicted": mem["ratio"],

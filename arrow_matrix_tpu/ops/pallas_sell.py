@@ -1,0 +1,342 @@
+"""Fused SELL-SpMM Pallas TPU kernel (graft-stream).
+
+The XLA fold kernel (``ops/sell.py`` -> ``ops/ell.py ell_spmm_t``) pays
+for a materialized ``(k, chunk, rows)`` gather intermediate per tier —
+one full HBM round trip of every gathered feature row before the
+weighted reduction touches it.  At the measured 0.976-of-roofline
+headline that intermediate IS the remaining cost.  This kernel fuses
+gather -> multiply -> accumulate in VMEM:
+
+  * features are packed into **granule lines**: ``C = 8`` consecutive
+    rows of the row-major ``(n, k)`` view form one contiguous
+    ``C*k``-float line (512 B at k=16), so every gather is a full-lane
+    line fetch instead of a 64 B sub-transaction column pick
+    (the ``tools/pallas_gather_probe.py`` design, productionized);
+  * column indices ride in twice: the whole slab via
+    ``pltpu.PrefetchScalarGridSpec`` **scalar prefetch** (SMEM — DMA
+    address computation ``granule = col // C`` needs scalar access),
+    and the row tile's block in VMEM for the vectorized sub-row select
+    (``off = col % C``);
+  * the streaming path issues ``wave``-sized groups of
+    ``pltpu.make_async_copy`` granule fetches with **two waves in
+    flight** (double-buffered DMA: wave w+1's copies are started
+    before wave w is awaited), accumulating each slot's weighted
+    contribution into a VMEM accumulator — the ``(k, chunk, rows)``
+    intermediate never exists;
+  * slot-major slabs: a tier whose column array exceeds the scalar
+    (SMEM) budget is streamed through the kernel in row slabs, each
+    slab one ``pallas_call``.
+
+Two statically-selected bodies share the select/accumulate math:
+
+  ``stream=True``   — the wave-pipelined async-copy gather (the TPU
+                      path; also runs under ``interpret=True`` at tiny
+                      shapes to pin the DMA logic on CPU);
+  ``stream=False``  — a vectorized in-kernel gather (``interpret``
+                      only: it reads the packed feature table wholesale,
+                      which Mosaic forbids on a real HBM ref).  This is
+                      the tier-1 correctness path at protocol shape —
+                      same grid, same masking, same accumulation order.
+
+Correctness contract: matches ``ops.sell.sell_spmm_t`` within the
+``utils/numerics.py`` gate (f32 accumulation either way; only the
+reduction order over slots differs).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arrow_matrix_tpu.ops.ell import align_up
+from arrow_matrix_tpu.ops.pallas_blocks import _interpret
+from arrow_matrix_tpu.ops.sell import SellMatrix
+
+GRANULE = 8          # rows per packed feature line (C): 8*k floats each
+
+# Streaming lane constraint: a granule line spans C*k lanes, and the
+# Mosaic vector unit wants the minor dimension in whole 128-lane tiles.
+STREAM_K_MULTIPLE = 16   # C * 16 = 128
+
+#: Scalar-prefetch (SMEM) budget for one slab's column array.  Tiers
+#: whose cols exceed it are streamed through the kernel in row slabs.
+SMEM_COLS_BUDGET = int(os.environ.get("AMT_PALLAS_SELL_SMEM",
+                                      str(1 << 20)))
+
+DEFAULT_ROW_BLOCK = 256  # rows per grid program (multiple of GRANULE)
+DEFAULT_WAVE = 16        # async copies per DMA wave (streaming path)
+
+
+def pack_features_t(x_t: jax.Array) -> jax.Array:
+    """Pack feature-major ``(k, n)`` features into granule lines
+    ``(n_pad // C, C*k)``: line g holds rows ``[g*C, (g+1)*C)`` of the
+    row-major view, contiguous — one full-lane DMA per gathered row
+    group.  Zero-pads n up to a GRANULE multiple."""
+    k, n = x_t.shape
+    n_pad = align_up(max(n, 1), GRANULE)
+    x = x_t.T                                     # (n, k) row-major view
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+    return x.reshape(n_pad // GRANULE, GRANULE * k)
+
+
+def _select_accumulate(lines, cols_j, w_j, r, k):
+    """Shared select/accumulate math of both kernel bodies: mask each
+    row's granule line down to its ``col % C`` sub-row, fold the C
+    segments, weight, and return the (r//C, C, k) f32 contribution."""
+    c = GRANULE
+    off = (cols_j % c).astype(jnp.int32)                      # (r,)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r, c * k), 1) // k
+    masked = jnp.where(lane == off[:, None],
+                       lines.astype(jnp.float32), 0.0)
+    picked = masked.reshape(r // c, c, c, k).sum(axis=2)      # (r//C, C, k)
+    return picked * w_j.reshape(r // c, c, 1)
+
+
+def _make_slab_call(m_t: int, slab: int, k: int, row_block: int,
+                    binary: bool, stream: bool, wave: int,
+                    interpret: bool):
+    """One ``pallas_call`` over a (m_t, slab) column slab -> packed
+    (slab // C, C*k) f32 partial output."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    c = GRANULE
+    lanes = c * k
+    grid = (slab // row_block,)
+    n_waves = row_block // wave
+
+    def _weight(w_all, cols_all, j, r):
+        if binary:
+            # Slot-validity mask (j < deg), generated in registers —
+            # same addends as the golden's iota-vs-degree compare.
+            return (j < w_all[0]).astype(jnp.float32)
+        return jax.lax.dynamic_index_in_dim(
+            w_all, j, axis=0, keepdims=False).astype(jnp.float32)
+
+    def kernel_vectorized(cols_smem, cols_vmem, w_vmem, x_any, out_ref):
+        # interpret-only body: wholesale read + take stands in for the
+        # DMA engine; grid, masking and accumulation order are shared
+        # with the streaming body, so tier-1 pins both.
+        del cols_smem
+        xg = x_any[...]
+        cols_all = cols_vmem[...].astype(jnp.int32)            # (m_t, R)
+        w_all = w_vmem[...]
+        g_all = cols_all // c
+
+        def slot_body(j, acc):
+            g_j = jax.lax.dynamic_index_in_dim(g_all, j, axis=0,
+                                               keepdims=False)
+            cols_j = jax.lax.dynamic_index_in_dim(cols_all, j, axis=0,
+                                                  keepdims=False)
+            lines = jnp.take(xg, g_j, axis=0)                 # (R, C*k)
+            w_j = _weight(w_all, cols_all, j, row_block)
+            return acc + _select_accumulate(lines, cols_j, w_j,
+                                            row_block, k)
+
+        acc0 = jnp.zeros((row_block // c, c, k), dtype=jnp.float32)
+        acc = jax.lax.fori_loop(0, m_t, slot_body, acc0)
+        out_ref[...] = acc.reshape(row_block // c, lanes)
+
+    def kernel_stream(cols_smem, cols_vmem, w_vmem, x_any, out_ref,
+                      scratch, sems):
+        row0 = pl.program_id(0) * row_block
+        cols_all = cols_vmem[...].astype(jnp.int32)
+        w_all = w_vmem[...]
+
+        def copy(j, w, r):
+            """The (slot j, wave w, lane r) granule fetch: address from
+            SMEM (scalar prefetch), destination its own scratch row,
+            semaphore by wave parity — two waves in flight."""
+            rr = w * wave + r
+            g = cols_smem[j, row0 + rr] // c
+            return pltpu.make_async_copy(
+                x_any.at[g], scratch.at[rr], sems.at[w % 2, r])
+
+        def issue(j, w):
+            jax.lax.fori_loop(
+                0, wave, lambda r, _: (copy(j, w, r).start(), 0)[1], 0)
+
+        def wait(j, w):
+            jax.lax.fori_loop(
+                0, wave, lambda r, _: (copy(j, w, r).wait(), 0)[1], 0)
+
+        def slot_body(j, acc):
+            issue(j, 0)
+
+            def wave_body(w, carry):
+                @pl.when(w + 1 < n_waves)
+                def _():
+                    issue(j, w + 1)        # double buffer: next wave in
+                wait(j, w)                 # flight while this one lands
+                return carry
+
+            jax.lax.fori_loop(0, n_waves, wave_body, 0)
+            cols_j = jax.lax.dynamic_index_in_dim(cols_all, j, axis=0,
+                                                  keepdims=False)
+            w_j = _weight(w_all, cols_all, j, row_block)
+            return acc + _select_accumulate(scratch[...], cols_j, w_j,
+                                            row_block, k)
+
+        acc0 = jnp.zeros((row_block // c, c, k), dtype=jnp.float32)
+        acc = jax.lax.fori_loop(0, m_t, slot_body, acc0)
+        out_ref[...] = acc.reshape(row_block // c, lanes)
+
+    w_block = ((1, row_block) if binary else (m_t, row_block))
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,            # cols -> SMEM, whole slab
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m_t, row_block), lambda i, sc: (0, i),
+                         memory_space=pltpu.VMEM),   # cols, vector math
+            pl.BlockSpec(w_block, lambda i, sc: (0, i),
+                         memory_space=pltpu.VMEM),   # data / deg
+            pl.BlockSpec(memory_space=pl.ANY),       # packed x: HBM
+        ],
+        out_specs=pl.BlockSpec((row_block // c, lanes),
+                               lambda i, sc: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=([pltpu.VMEM((row_block, lanes), jnp.float32),
+                         pltpu.SemaphoreType.DMA((2, wave))]
+                        if stream else []),
+    )
+    kernel = kernel_stream if stream else kernel_vectorized
+
+    def call(cols_slab, w_slab, x_packed):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((slab // c, lanes),
+                                           jnp.float32),
+            grid_spec=gs,
+            interpret=interpret,
+        )(cols_slab, cols_slab, w_slab, x_packed)
+
+    return call
+
+
+def _tier_row_block(n_t: int, row_block: int) -> int:
+    """Rows per grid program: the requested block, shrunk to the tier
+    (GRANULE-aligned) so a tiny tier doesn't pad to a full block."""
+    return min(row_block, align_up(max(n_t, 1), GRANULE))
+
+
+def sell_tier_spmm_packed(cols: jax.Array, x_packed: jax.Array,
+                          data: Optional[jax.Array] = None,
+                          deg: Optional[jax.Array] = None,
+                          row_block: int = DEFAULT_ROW_BLOCK,
+                          wave: int = DEFAULT_WAVE,
+                          stream: Optional[bool] = None,
+                          interpret: Optional[bool] = None) -> jax.Array:
+    """One tier's fused SpMM against granule-packed features.
+
+    cols: (m_t, n_t) slot-major int32; x_packed: (n_gran, C*k) from
+    :func:`pack_features_t`; ``data`` (m_t, n_t) weighted or ``deg``
+    (n_t,) binary.  Returns (n_t, k) f32 — row-major (the caller
+    re-majors per call, see :func:`sell_spmm_t_pallas`).
+    """
+    if interpret is None:
+        interpret = _interpret()
+    if stream is None:
+        stream = not interpret
+    m_t, n_t = cols.shape
+    k = x_packed.shape[1] // GRANULE
+    if data is None and deg is None and m_t > 0:
+        raise ValueError("binary SELL tier (data=None) requires deg")
+    if m_t == 0 or n_t == 0:
+        return jnp.zeros((n_t, k), dtype=jnp.float32)
+    if stream and k % STREAM_K_MULTIPLE != 0:
+        raise ValueError(
+            f"streaming pallas_sell needs k % {STREAM_K_MULTIPLE} == 0 "
+            f"(granule lines must fill whole 128-lane tiles), got k={k}; "
+            f"use the XLA fold kernel for this feature width")
+    if not stream and not interpret:
+        raise ValueError(
+            "the vectorized pallas_sell body is interpret-only (it "
+            "reads the feature table wholesale); compiled TPU runs "
+            "must use stream=True")
+
+    binary = data is None
+    rb = _tier_row_block(n_t, row_block)
+    rb = max(GRANULE, rb - rb % GRANULE)
+    w = min(wave, rb)
+    while rb % w:
+        w -= 1
+    rows_pad = align_up(n_t, rb)
+    pad = rows_pad - n_t
+    if pad:
+        cols = jnp.pad(cols, ((0, 0), (0, pad)))
+        if binary:
+            deg = jnp.pad(deg, (0, pad))
+        else:
+            data = jnp.pad(data, ((0, 0), (0, pad)))
+    weights = (deg.astype(jnp.int32).reshape(1, rows_pad) if binary
+               else data)
+
+    # Slot-major slab streaming: bound each call's scalar-prefetch
+    # (SMEM) bytes; every slab is a whole number of row blocks.
+    per_row = m_t * 4
+    slab = max(rb, (SMEM_COLS_BUDGET // max(per_row, 1)) // rb * rb)
+    outs = []
+    for lo in range(0, rows_pad, slab):
+        hi = min(lo + slab, rows_pad)
+        call = _make_slab_call(m_t, hi - lo, k, rb, binary, stream, w,
+                               interpret)
+        outs.append(call(
+            jax.lax.slice_in_dim(cols, lo, hi, axis=1),
+            jax.lax.slice_in_dim(weights, lo, hi, axis=1),
+            x_packed))
+    packed = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+    return packed.reshape(rows_pad, k)[:n_t]
+
+
+def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
+                       row_block: int = DEFAULT_ROW_BLOCK,
+                       wave: int = DEFAULT_WAVE,
+                       stream: Optional[bool] = None,
+                       interpret: Optional[bool] = None) -> jax.Array:
+    """Drop-in fused twin of ``ops.sell.sell_spmm_t``: (k, n_rows)
+    feature-major output, one kernel launch stream per tier, outputs
+    concatenated along the sorted row axis (tiers are contiguous runs
+    of the sorted order — no scatter).
+
+    The ``gather_budget``/``chunk`` tiling knobs of the XLA kernel have
+    no counterpart here: the fused kernel's footprint is its
+    ``row_block`` VMEM tile, not a materialized gather intermediate.
+    """
+    k = x_t.shape[0]
+    x_packed = pack_features_t(x_t)
+    outs = []
+    for t, cols in enumerate(m.cols):
+        out_t = sell_tier_spmm_packed(
+            cols, x_packed,
+            data=None if m.data is None else m.data[t],
+            deg=None if m.deg is None else m.deg[t],
+            row_block=row_block, wave=wave, stream=stream,
+            interpret=interpret)
+        outs.append(out_t.T.astype(x_t.dtype))               # (k, n_t)
+    if not outs:
+        return jnp.zeros((k, 0), dtype=x_t.dtype)
+    return jnp.concatenate(outs, axis=1)
+
+
+def supported_feature_width(k: int) -> bool:
+    """Whether the streaming (compiled-TPU) path can carry width ``k``
+    — callers racing formats use this to fall back to the XLA fold
+    kernel instead of tripping the lane-alignment ValueError."""
+    return k % STREAM_K_MULTIPLE == 0
+
+
+@functools.partial(jax.jit, static_argnames=("row_block", "wave",
+                                             "stream", "interpret"))
+def sell_spmm_t_pallas_jit(m: SellMatrix, x_t: jax.Array,
+                           row_block: int = DEFAULT_ROW_BLOCK,
+                           wave: int = DEFAULT_WAVE,
+                           stream: Optional[bool] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    return sell_spmm_t_pallas(m, x_t, row_block=row_block, wave=wave,
+                              stream=stream, interpret=interpret)

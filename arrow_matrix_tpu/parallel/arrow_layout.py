@@ -167,7 +167,8 @@ def _local_slim_step(blocks: ArrowBlocks, x: jax.Array, axis: str,
 
 
 def make_slim_spmm(blocks: ArrowBlocks, mesh: Mesh, axis: str = "blocks",
-                   chunk: Optional[int] = None, kernel: str = "xla"):
+                   chunk: Optional[int] = None, kernel: str = "xla",
+                   overlap_slabs: int = 1):
     """Build the jitted shard_map slim SpMM step for one arrow matrix.
 
     Returns ``step(blocks, x) -> c`` operating on globally-shaped arrays
@@ -181,17 +182,26 @@ def make_slim_spmm(blocks: ArrowBlocks, mesh: Mesh, axis: str = "blocks",
     if kernel == "pallas" and blocks.fmt != "dense":
         raise ValueError("kernel='pallas' requires the dense block format")
     return jax.jit(slim_step_shard_map(blocks, mesh, axis=axis,
-                                       chunk=chunk, kernel=kernel))
+                                       chunk=chunk, kernel=kernel,
+                                       overlap_slabs=overlap_slabs))
 
 
 def slim_step_shard_map(blocks: ArrowBlocks, mesh: Mesh,
                         axis: str = "blocks",
-                        chunk: Optional[int] = None, kernel: str = "xla"):
+                        chunk: Optional[int] = None, kernel: str = "xla",
+                        overlap_slabs: int = 1):
     """The raw (unjitted) shard_map slim step — the single construction
     point shared by ``make_slim_spmm`` and the multi-level orchestrator's
-    per-level pallas path (one place to evolve specs/options)."""
+    per-level pallas path (one place to evolve specs/options).
+
+    ``overlap_slabs`` applies the chunked overlap schedule
+    (graft-stream) to the block-major layout: the (nb, w, k) features
+    split into S static sub-slabs along the feature axis, each an
+    independent shard_map step whose x0-psum / halo ppermutes can fly
+    while the previous slab's block matmuls run.  Bit-identical f32 —
+    no output element's addends regroup."""
     spec_blocks = jax.tree_util.tree_map(lambda _: P(axis), blocks)
-    return shard_map(
+    step = shard_map(
         functools.partial(_local_slim_step, axis=axis,
                           n_dev=mesh.shape[axis], chunk=chunk,
                           kernel=kernel),
@@ -200,6 +210,20 @@ def slim_step_shard_map(blocks: ArrowBlocks, mesh: Mesh,
         out_specs=P(axis),
         **shard_map_check_kwargs(),
     )
+    if overlap_slabs <= 1:
+        return step
+    from arrow_matrix_tpu.parallel.routing import overlap_slices
+
+    def step_overlapped(blocks_arg, x):
+        outs = []
+        for j, (lo, hi) in enumerate(
+                overlap_slices(x.shape[2], overlap_slabs)):
+            with jax.named_scope(f"overlap_slab_{j}"):
+                outs.append(step(blocks_arg,
+                                 lax.slice_in_dim(x, lo, hi, axis=2)))
+        return jnp.concatenate(outs, axis=2)
+
+    return step_overlapped
 
 
 # ---------------------------------------------------------------------------

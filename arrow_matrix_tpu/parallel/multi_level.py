@@ -194,7 +194,8 @@ class MultiLevelArrow:
                  binary="auto", feature_dtype=None,
                  layout: str = "slim", arm_axis: str = "arm",
                  fold_growth: float = 1.2,
-                 fold_align: Optional[int] = None):
+                 fold_align: Optional[int] = None,
+                 overlap_slabs: int = 1):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -273,7 +274,7 @@ class MultiLevelArrow:
             if mesh is not None:
                 dense_budget *= mesh.shape[axis]
         self.dense_budget = dense_budget
-        if kernel not in ("xla", "pallas"):
+        if kernel not in ("xla", "pallas", "pallas_sell"):
             raise ValueError(f"unknown kernel {kernel!r}")
         if kernel == "pallas":
             try:
@@ -282,7 +283,22 @@ class MultiLevelArrow:
                 raise ValueError(
                     f"kernel='pallas' but pallas is unavailable in this "
                     f"JAX build: {e}") from e
+        if kernel == "pallas_sell":
+            if fmt != "fold":
+                raise ValueError(
+                    "kernel='pallas_sell' is the fused fold kernel "
+                    "(ops/pallas_sell.py); it requires fmt='fold'")
+            try:
+                from arrow_matrix_tpu.ops import pallas_sell  # noqa: F401
+            except ImportError as e:
+                raise ValueError(
+                    f"kernel='pallas_sell' but pallas is unavailable in "
+                    f"this JAX build: {e}") from e
         self.kernel = kernel
+        if overlap_slabs < 1:
+            raise ValueError(f"overlap_slabs must be >= 1, got "
+                             f"{overlap_slabs}")
+        self.overlap_slabs = int(overlap_slabs)
         self.width = width
         self.mesh = mesh
         self.axis = axis
@@ -466,7 +482,8 @@ class MultiLevelArrow:
         self._step = jax.jit(functools.partial(
             multi_level_spmm, widths=tuple(widths), chunk=chunk,
             kernel=kernel, gather_budget=gather_budget,
-            mesh=mesh, axis=axis, layout=layout, arm_axis=arm_axis))
+            mesh=mesh, axis=axis, layout=layout, arm_axis=arm_axis,
+            overlap_slabs=self.overlap_slabs))
 
         def scan_steps(x, fwd, bwd, blocks, n):
             def body(xc, _):
@@ -475,7 +492,8 @@ class MultiLevelArrow:
                                       kernel=kernel,
                                       gather_budget=gather_budget,
                                       mesh=mesh, axis=axis,
-                                      layout=layout, arm_axis=arm_axis)
+                                      layout=layout, arm_axis=arm_axis,
+                                      overlap_slabs=self.overlap_slabs)
                 return xc, None
 
             out, _ = jax.lax.scan(body, x, None, length=n)
@@ -575,11 +593,34 @@ class MultiLevelArrow:
         self.fwd = self.bwd = ()
         self._ideal_route_units = 0  # single-chip fold: zero routing
 
-        def fold_step(xt, fwd, bwd, blocks):
+        kernel = getattr(self, "kernel", "xla")
+        slabs = int(getattr(self, "overlap_slabs", 1))
+
+        def fold_slab(xt, blocks):
+            if kernel == "pallas_sell":
+                # Fused gather->FMA kernel: no materialized gather
+                # intermediate, so no chunk/gather_budget tiling.
+                from arrow_matrix_tpu.ops.pallas_sell import (
+                    sell_spmm_t_pallas,
+                )
+
+                return sell_spmm_t_pallas(blocks[0], xt)
             if chunk == "auto":
                 return sell_spmm_t(blocks[0], xt,
                                    gather_budget=gather_budget)
             return sell_spmm_t(blocks[0], xt, chunk=chunk)
+
+        def fold_step(xt, fwd, bwd, blocks):
+            if slabs <= 1:
+                return fold_slab(xt, blocks)
+            # Single-chip fold has no collectives to hide; the split
+            # still runs (one sub-step per slab) so --overlap_slabs
+            # sweeps stay shape-uniform across formats.
+            from arrow_matrix_tpu.parallel.routing import overlap_slices
+
+            outs = [fold_slab(xt[lo:hi], blocks)
+                    for lo, hi in overlap_slices(xt.shape[0], slabs)]
+            return jnp.concatenate(outs, axis=0)
 
         self._step = jax.jit(fold_step)
 
@@ -632,7 +673,8 @@ class MultiLevelArrow:
     @classmethod
     def load_folded(cls, in_dir: str, feature_dtype="keep",
                     chunk="auto", gather_budget: int = 1 << 30,
-                    device_put: bool = True) -> "MultiLevelArrow":
+                    device_put: bool = True, kernel: str = "xla",
+                    overlap_slabs: int = 1) -> "MultiLevelArrow":
         """Rebuild a fold executor from an ``export_folded`` directory
         without the source decomposition.  ``feature_dtype="keep"``
         uses the exported carriage dtype; ``device_put=False`` keeps
@@ -651,6 +693,8 @@ class MultiLevelArrow:
         self.axis = "blocks"
         self.folded = True
         self.carries_feature_major = True
+        self.kernel = kernel
+        self.overlap_slabs = int(overlap_slabs)
         if feature_dtype == "keep":
             feature_dtype = meta["feature_dtype"]
         self.feature_dtype = resolve_feature_dtype(feature_dtype)
@@ -871,7 +915,8 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
                      gather_budget: int = 1 << 30,
                      mesh: Optional[Mesh] = None,
                      axis: str = "blocks", layout: str = "slim",
-                     arm_axis: str = "arm") -> jax.Array:
+                     arm_axis: str = "arm",
+                     overlap_slabs: int = 1) -> jax.Array:
     """One decomposition-wide SpMM (jitted; K unrolled — K is small).
 
     Forward feature propagation (reference
@@ -884,6 +929,26 @@ def multi_level_spmm(x: jax.Array, fwd, bwd,
     chip, per shard under shard_map on a mesh.
     """
     from arrow_matrix_tpu.parallel.routing import take as routed_or_take
+
+    if overlap_slabs > 1:
+        # Chunked overlap schedule (graft-stream): each feature
+        # sub-slab runs the full level chain independently, so slab
+        # i+1's routing exchange is free to fly while slab i's level
+        # SpMMs run.  Flat carriage is row-major: the feature axis is
+        # axis 1.  Bit-identical f32 — per-element addends never
+        # regroup.
+        from arrow_matrix_tpu.parallel.routing import overlap_slices
+
+        outs = []
+        for j, (lo, hi) in enumerate(
+                overlap_slices(x.shape[1], overlap_slabs)):
+            with jax.named_scope(f"overlap_slab_{j}"):
+                outs.append(multi_level_spmm(
+                    x[:, lo:hi], fwd, bwd, blocks, widths=widths,
+                    chunk=chunk, kernel=kernel,
+                    gather_budget=gather_budget, mesh=mesh, axis=axis,
+                    layout=layout, arm_axis=arm_axis))
+        return jnp.concatenate(outs, axis=1)
 
     total, k = x.shape
     k_levels = len(blocks)

@@ -387,9 +387,31 @@ def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
               route.recv_dst)
 
 
+def overlap_slices(k: int, overlap_slabs: int) -> list:
+    """Static sub-slab bounds of the feature axis for the chunked
+    overlap schedule (graft-stream): split ``k`` feature rows into
+    ``overlap_slabs`` equal contiguous slabs so each slab's exchange is
+    a separate collective — slab i+1's dispatch is dataflow-independent
+    of slab i's compute, which is what lets XLA's latency-hiding
+    scheduler run them concurrently.  Everything here is trace-time
+    static (``k`` is a shape), so sweeping S never recompiles within
+    one S.
+    """
+    s = int(overlap_slabs)
+    if s <= 1:
+        return [(0, k)]
+    if s > k or k % s:
+        raise ValueError(
+            f"overlap_slabs={s} must divide the feature width k={k} "
+            f"(equal static sub-slabs; pick S from the divisors of k)")
+    step = k // s
+    return [(i * step, (i + 1) * step) for i in range(s)]
+
+
 def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
                   axis: str = "blocks",
-                  feat_axis: Optional[str] = None) -> jax.Array:
+                  feat_axis: Optional[str] = None,
+                  overlap_slabs: int = 1) -> jax.Array:
     """Feature-major twin of ``routed_take``: ``out[:, j] =
     xt[:, table[j]]`` on a (k, total) array sharded on axis 1 — the
     exchange for the padding-free carried layouts
@@ -398,7 +420,21 @@ def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
     ``feat_axis`` additionally shards the feature rows (axis 0): the
     tables are per-device along ``axis`` and independent of feature
     rows, so each feature slice runs its own identical exchange — the
-    k-tiling axis composes with the explicit routing for free."""
+    k-tiling axis composes with the explicit routing for free.
+
+    ``overlap_slabs`` splits the exchange into S independent
+    sub-exchanges along the feature axis (``overlap_slices``): a caller
+    interleaving its own compute between them gets slab i+1's
+    all_to_all in flight while slab i is consumed."""
+    if overlap_slabs > 1:
+        if feat_axis is not None:
+            raise ValueError(
+                "overlap_slabs composes with the unsharded feature "
+                "axis (feat_axis=None): a feat-sharded slab would "
+                "re-split an already-distributed dimension")
+        outs = [routed_take_t(xt[lo:hi], route, mesh, axis)
+                for lo, hi in overlap_slices(xt.shape[0], overlap_slabs)]
+        return jnp.concatenate(outs, axis=0)
     r_src, r_dst = route.rows_src, route.rows_dst
 
     def local_fn(xl, local_src, local_dst, send_idx, recv_dst):

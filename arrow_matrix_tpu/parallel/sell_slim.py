@@ -859,6 +859,37 @@ def make_sharded_step(mesh: Mesh, axis: str, width: int, rows_out: int,
     return step
 
 
+def _overlap_step(step, overlap_slabs: int, xt_pos: int = -1):
+    """Chunked overlap schedule (graft-stream): wrap a feature-major
+    step so the carried (k, total) array is split into S static
+    sub-slabs along the feature axis, each running the full step —
+    halo ppermutes / routed all_to_alls for slab i+1 are dataflow-
+    independent of slab i's SELL compute, so XLA's latency-hiding
+    scheduler can dispatch the next exchange while the current slab
+    computes.  f32 results are bit-identical to the unsplit step: the
+    split never regroups any output element's addends.  ``S`` is
+    trace-time static (audited by the recompile gate); ``xt_pos``
+    locates the carried array in the step's signature."""
+    if overlap_slabs <= 1:
+        return step
+    from arrow_matrix_tpu.parallel.routing import overlap_slices
+
+    def wrapped(*args):
+        args = list(args)
+        pos = xt_pos if xt_pos >= 0 else len(args) + xt_pos
+        xt = args[pos]
+        outs = []
+        for j, (lo, hi) in enumerate(
+                overlap_slices(xt.shape[0], overlap_slabs)):
+            with jax.named_scope(f"overlap_slab_{j}"):
+                sub = list(args)
+                sub[pos] = lax.slice_in_dim(xt, lo, hi, axis=0)
+                outs.append(step(*sub))
+        return jnp.concatenate(outs, axis=0)
+
+    return wrapped
+
+
 class SellSlim:
     """One arrow matrix distributed over a mesh axis in padding-free
     layouts (see module docstring).  API mirrors the other layouts:
@@ -867,7 +898,8 @@ class SellSlim:
 
     def __init__(self, matrix: CsrLike, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32,
-                 binary="auto", feature_dtype=None, ladder=None):
+                 binary="auto", feature_dtype=None, ladder=None,
+                 overlap_slabs: int = 1):
         # The source canonicalizes (in-memory CSR up front, memmapped
         # triplets per slice): binary detection must see canonical
         # values — duplicate all-ones entries sum to non-unit weights
@@ -893,10 +925,10 @@ class SellSlim:
         self._oop, _ = _carried_maps(
             np.arange(self.shard_len * self.n_dev), ops.body_order,
             self.shard_len, self.shard_len * self.n_dev)
-        self._step = jax.jit(make_sharded_step(mesh, axis, width,
-                                               ops.rows_out,
-                                               hops=ops.hops,
-                                               rem=ops.rem))
+        self.overlap_slabs = int(overlap_slabs)
+        raw_step = make_sharded_step(mesh, axis, width, ops.rows_out,
+                                     hops=ops.hops, rem=ops.rem)
+        self._step = jax.jit(_overlap_step(raw_step, self.overlap_slabs))
 
     def _feature_sharding(self):
         return NamedSharding(self.mesh, P(None, self.axis))
@@ -973,7 +1005,7 @@ class SellMultiLevel:
                  axis: str = "blocks", dtype=np.float32, binary="auto",
                  routing: str = "a2a",
                  feat_axis: Optional[str] = None, feature_dtype=None,
-                 ladder=None):
+                 ladder=None, overlap_slabs: int = 1):
         """``routing``: "a2a" (default) compiles the inter-level
         reorderings into explicit per-device send/recv tables over one
         fixed-shape all_to_all each (parallel/routing.py — tier-padding
@@ -988,7 +1020,13 @@ class SellMultiLevel:
 
         if routing not in ("gather", "a2a"):
             raise ValueError(f"unknown routing {routing!r}")
+        if overlap_slabs > 1 and feat_axis is not None:
+            raise ValueError(
+                "overlap_slabs composes with feat_axis=None: the "
+                "k-tiling axis already splits the feature rows across "
+                "devices; the overlap schedule splits them in time")
 
+        self.overlap_slabs = int(overlap_slabs)
         self.routing = routing
         self.feat_axis = feat_axis
         self.feature_dtype = resolve_feature_dtype(feature_dtype)
@@ -1130,11 +1168,13 @@ class SellMultiLevel:
 
             return step_fn(xt, [_O(t) for t in level_args], fwd, bwd)
 
-        self._step = jax.jit(step_packed)
+        step_sched = _overlap_step(step_packed, self.overlap_slabs,
+                                   xt_pos=0)
+        self._step = jax.jit(step_sched)
 
         def scan_steps(xt, level_args, fwd, bwd, n):
             def body(xc, _):
-                return step_packed(xc, level_args, fwd, bwd), None
+                return step_sched(xc, level_args, fwd, bwd), None
 
             out, _ = lax.scan(body, xt, None, length=n)
             return out

@@ -30,6 +30,23 @@ import os
 from typing import Optional
 
 
+#: Filename markers of throwaway verification artifacts.  A driver or
+#: doctor probe exercising the bench pipeline tags its output (e.g.
+#: ``onchip_bench_quick_VERIFYDRIVE.json``); such files are smoke
+#: exhaust, not round evidence, and must never satisfy an evidence
+#: scan no matter what their record says.
+STRAY_MARKERS = ("VERIFYDRIVE", "SMOKETEST", "DRYRUN")
+
+
+def is_stray_verification_artifact(path: str) -> bool:
+    """True when the artifact's NAME marks it as verification exhaust
+    (see ``STRAY_MARKERS``) — checked case-insensitively against the
+    basename so a stray file can't pass as round evidence regardless
+    of its payload."""
+    base = os.path.basename(path).upper()
+    return any(m in base for m in STRAY_MARKERS)
+
+
 def record_is_onchip(d: dict) -> bool:
     """True unless the record EXPLICITLY disqualifies itself: a truthy
     ``degraded`` flag or ``platform == "cpu"``.  Unlabeled records
@@ -54,7 +71,11 @@ def classify_artifact(path: str) -> str:
     """Three-way artifact verdict: ``"onchip"`` (readable record, not
     disqualified), ``"degraded"`` (readable record with an explicit
     CPU/degraded label), or ``"missing"`` (no file / unreadable /
-    unparseable — retriable, NOT evidence of a dead tunnel)."""
+    unparseable — retriable, NOT evidence of a dead tunnel).  A stray
+    verification artifact (``is_stray_verification_artifact``)
+    classifies as ``"missing"``: it is not evidence either way."""
+    if is_stray_verification_artifact(path):
+        return "missing"
     if not os.path.exists(path):
         return "missing"
     d = load_last_json_line(path)

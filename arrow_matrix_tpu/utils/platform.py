@@ -63,6 +63,48 @@ def force_cpu_devices(n_devices: int | None = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def host_load(max_pids: int = 8) -> dict:
+    """Snapshot of competing host activity, attached to every committed
+    measurement (VERDICT r5 item 6: a number without the load context
+    of the host that produced it cannot be compared across rounds).
+
+    Returns ``{"loadavg_1m": float, "competing": [process names...]}``
+    where ``competing`` lists up to ``max_pids`` OTHER processes in the
+    runnable/uninterruptible states (R/D) — the ones actually eating
+    the cores while the measurement ran.  Linux-only fields degrade to
+    empty on other platforms; never raises.
+    """
+    try:
+        load1 = os.getloadavg()[0]
+    except (OSError, AttributeError):  # pragma: no cover - non-unix
+        load1 = -1.0
+    names: list[str] = []
+    me = os.getpid()
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    stat = f.read()
+            except OSError:
+                continue
+            # comm may contain spaces/parens: field 2 ends at the LAST
+            # ')'; the state letter is the first field after it.
+            close = stat.rfind(")")
+            if close < 0:
+                continue
+            comm = stat[stat.find("(") + 1:close]
+            rest = stat[close + 1:].split()
+            if rest and rest[0] in ("R", "D"):
+                names.append(comm)
+                if len(names) >= max_pids:
+                    break
+    except OSError:  # pragma: no cover - /proc absent
+        pass
+    return {"loadavg_1m": round(float(load1), 2), "competing": names}
+
+
 def device_memory_budget(device=None, fraction: float = 0.5,
                          default: int = 4 << 30) -> int:
     """Bytes available for resident block storage on ``device``, derived

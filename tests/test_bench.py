@@ -112,3 +112,42 @@ def test_failed_race_exits_nonzero_with_error_json(tmp_path):
     assert out["value"] is None
     assert "error" in out
     assert "no_such_format" in json.dumps(out["device_runs"])
+
+
+def test_onchip_evidence_skips_stray_verification_artifacts(tmp_path,
+                                                            monkeypatch):
+    """A driver/doctor probe artifact (VERIFYDRIVE-style name) in the
+    onchip_* namespace is smoke exhaust, never the evidence trail —
+    even when its record claims platform=tpu (VERDICT r5 item 9)."""
+    sys.path.insert(0, REPO)
+    import bench as bench_mod
+
+    cache = tmp_path / "bench_cache"
+    cache.mkdir()
+    stray = {"metric": "spmm_iter_ms", "value": 1.0, "platform": "tpu",
+             "config": {"n": 64, "width": 16, "features": 16}}
+    (cache / "onchip_bench_quick_VERIFYDRIVE.json").write_text(
+        json.dumps(stray))
+    monkeypatch.chdir(tmp_path)
+    assert bench_mod._last_onchip_evidence() is None
+    real = dict(stray, value=42.0)
+    (cache / "onchip_bench_real.json").write_text(json.dumps(real))
+    ev = bench_mod._last_onchip_evidence()
+    assert ev is not None and ev["summary"]["value"] == 42.0
+
+
+def test_bench_config_overlap_and_pallas_sell_candidate(monkeypatch):
+    """graft-stream bench surface: the pallas_sell race candidate
+    exists (fold build + fused kernel), and AMT_BENCH_OVERLAP_SLABS
+    threads the static slab count into the candidate config."""
+    sys.path.insert(0, REPO)
+    import bench as bench_mod
+
+    kw = bench_mod.CANDIDATE_KWARGS["pallas_sell"]
+    assert kw["fmt"] == "fold" and kw["kernel"] == "pallas_sell"
+    monkeypatch.setenv("AMT_BENCH_PLATFORM", "cpu")
+    monkeypatch.setenv("AMT_BENCH_OVERLAP_SLABS", "4")
+    cfg = bench_mod._bench_config("cpu")
+    assert cfg["overlap_slabs"] == 4
+    monkeypatch.delenv("AMT_BENCH_OVERLAP_SLABS")
+    assert bench_mod._bench_config("cpu")["overlap_slabs"] == 1

@@ -361,3 +361,51 @@ def test_discovery_stops_after_grown_level(tmp_path):
     _write_reference_layout(foreign, base)
     loaded = load_decomposition(base, 32, block_diagonal=True)
     assert len(loaded) == len(levels)
+
+
+def test_reference_artifact_roundtrip_feeds_executors(tmp_path):
+    """Cross-implementation round trip, artifact -> EXECUTOR (VERDICT
+    r5 item 8): an artifact in the reference ``save_decomposition_new``
+    shape — per-level achieved-width naming, int32 triplets, no
+    ``_widths.npy`` metadata, no integrity manifest, and the binary
+    case's omitted ``_data`` files — must load through io/graphio.py,
+    rebuild as ArrowLevels, and drive both the folded single-chip
+    operator and the feature-major mesh executor to the golden SpMM."""
+    from arrow_matrix_tpu.decomposition import decomposition_spmm
+    from arrow_matrix_tpu.io import load_level_widths
+    from arrow_matrix_tpu.parallel.mesh import make_mesh
+    from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+    from arrow_matrix_tpu.utils import numerics, random_dense
+    import os
+
+    a = barabasi_albert(1024, 4, seed=2)
+    levels = arrow_decomposition(a, 64, max_levels=3,
+                                 block_diagonal=True, seed=0)
+    base = str(tmp_path / "ref_exec")
+    _write_reference_layout(levels, base)
+    # The reference writer omits _data for binary adjacencies
+    # (reference graphio.py:298: missing data file => implicit ones).
+    for i, lvl in enumerate(levels):
+        if np.all(lvl.matrix.data == 1.0):
+            os.remove(format_path(base, lvl.arrow_width, i, True,
+                                  FileKind.data))
+
+    loaded = load_decomposition(base, 64, block_diagonal=True)
+    widths = load_level_widths(base, 64, block_diagonal=True)
+    relevels = as_levels(loaded, widths)
+    assert len(relevels) == len(levels)
+
+    x = random_dense(a.shape[0], 8, seed=3)
+    want = decomposition_spmm(levels, x)
+    tol = numerics.relative_tolerance(
+        sum(int(lvl.matrix.nnz) for lvl in levels) / a.shape[0])
+
+    ml = MultiLevelArrow(relevels, 64, mesh=None, fmt="fold")
+    got = ml.gather_result(ml.step(ml.set_features(x)))
+    assert numerics.relative_error(got, want) <= tol
+
+    sm = SellMultiLevel(relevels, 64, make_mesh((8,), ("blocks",)),
+                        routing="a2a")
+    got = sm.gather_result(sm.step(sm.set_features(x)))
+    assert numerics.relative_error(got, want) <= tol

@@ -102,8 +102,12 @@ def main() -> None:
     ap.add_argument("--threads", type=int, default=4)
     args = ap.parse_args()
 
+    from arrow_matrix_tpu.utils.platform import host_load
+
+    load_before = host_load()
     r1 = run_one(args.logn, 1)
     rT = run_one(args.logn, args.threads)
+    load_after = host_load()
     assert r1["out_checksum"] == rT["out_checksum"], \
         "thread counts disagree — parity broken"
 
@@ -113,6 +117,7 @@ def main() -> None:
     result = {
         "tool": "measure_decomp_phases",
         "n": 1 << args.logn,
+        "host_load": {"before": load_before, "after": load_after},
         "t1": r1, "tN": rT,
         "parallel_share_of_native": round(par_s / max(r1["native_s"], 1e-9),
                                           4),

@@ -72,8 +72,14 @@ def run_child(log2: int, n_dev: int, mode: str) -> dict:
 
 
 def main() -> None:
+    from arrow_matrix_tpu.utils.platform import host_load
+
     n_dev = int(os.environ.get("AMT_ROUTE_DEVS", 8))
-    out = {"n_dev": n_dev, "rungs": {}}
+    # Measurement hygiene (VERDICT item 6): committed numbers carry the
+    # host contention they were taken under, sampled at both ends (a
+    # competitor appearing mid-run shows up in "after").
+    out = {"n_dev": n_dev, "host_load": {"before": host_load()},
+           "rungs": {}}
     for log2 in (24, 26):
         rung: dict = {"total_rows": 1 << log2}
         for mode in ("memory", "streamed"):
@@ -92,6 +98,7 @@ def main() -> None:
         print(f"2^{log2}: identical tables, incremental-RSS cut "
               f"{rung['rss_cut']}x", flush=True)
         out["rungs"][f"2^{log2}"] = rung
+    out["host_load"]["after"] = host_load()
     path = os.path.join(REPO, "bench_results", "routing_build.json")
     try:
         with open(path) as f:

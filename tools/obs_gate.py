@@ -46,6 +46,18 @@ def memory_problems(summary: dict, max_ratio: float) -> list:
     return problems
 
 
+def comm_problems(summary: dict) -> list:
+    """Gate problems from the comm section: every algorithm's comm
+    report must carry the ``exposed_comm_ms`` field (graft-stream) —
+    a comm account without the exposed-time model can't state whether
+    the overlap schedule is doing its job."""
+    problems = []
+    for name, rec in sorted(summary.get("algorithms", {}).items()):
+        if rec.get("exposed_comm_ms") is None:
+            problems.append(f"{name}: comm report lacks exposed_comm_ms")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
@@ -60,6 +72,7 @@ def main(argv=None) -> int:
     problems = validate_run_dir(out)
     max_ratio = float(os.environ.get("OBS_GATE_MAX_HBM_RATIO", "8.0"))
     problems += memory_problems(summary, max_ratio)
+    problems += comm_problems(summary)
     if problems:
         for p in problems:
             print(f"obs gate: {p}", file=sys.stderr)

@@ -390,6 +390,17 @@ def _backend_race(n: int) -> dict:
     return out
 
 
+def rung_dryrun_multichip_mid() -> dict:
+    """Opt-in mid-scale multichip dry run (VERDICT r4 item 7): n=2^16
+    BA-8 at width 512 on an 8-device virtual CPU mesh, fold + sell-a2a,
+    each golden-gated, with a trace-time comm account per algorithm
+    carrying the graft-stream ``exposed_comm_ms`` model — so MULTICHIP
+    artifacts record more than toy-shape evidence."""
+    import __graft_entry__ as ge
+
+    return ge.dryrun_multichip(8, scale="mid")
+
+
 def rung_backend_race22() -> dict:
     return _backend_race(N22)
 
@@ -403,15 +414,19 @@ RUNGS = {"decompose24": rung_decompose24, "ingest24": rung_ingest24,
          "decompose_1e8_grid": rung_decompose_1e8_grid,
          "decompose_1e8_ba": rung_decompose_1e8_ba,
          "rehearse_1e8_ba_step": rung_rehearse_1e8_ba_step,
+         "dryrun_multichip_mid": rung_dryrun_multichip_mid,
          "backend_race22": rung_backend_race22,
          "backend_race23": rung_backend_race23}
 
 #: What a bare `python tools/scale_ladder.py` runs.  The 1e8 rungs are
 #: opt-in by explicit name: the BA 2^27 decompose needs hour-plus wall
 #: clock and tens of GB of RSS — a no-arg ladder run must stay bounded.
+#: The mid-scale multichip dry run is opt-in too: it is VERDICT-item
+#: evidence gathering, not part of the bounded default sweep.
 DEFAULT_RUNGS = [r for r in RUNGS
                  if r not in ("decompose_1e8_grid", "decompose_1e8_ba",
-                              "rehearse_1e8_ba_step")]
+                              "rehearse_1e8_ba_step",
+                              "dryrun_multichip_mid")]
 
 
 def main() -> None:
@@ -436,8 +451,11 @@ def main() -> None:
     if os.path.exists(OUT):
         with open(OUT) as f:
             results = json.load(f)
+    from arrow_matrix_tpu.utils.platform import host_load
+
     for rung in rungs:
         print(f"[ladder] {rung} ...", flush=True)
+        load_before = host_load()
         t0 = time.perf_counter()
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--rung", rung],
@@ -446,6 +464,10 @@ def main() -> None:
         if proc.returncode == 0 and proc.stdout.strip():
             new = json.loads(proc.stdout.strip().splitlines()[-1])
             new["wall_s"] = wall
+            # Measurement hygiene (VERDICT item 6): each committed rung
+            # records the host contention it ran under, both ends.
+            new["host_load"] = {"before": load_before,
+                                "after": host_load()}
             if new.get("cached"):
                 # A cache hit never becomes the rung's RESULT: either
                 # the recorded measured numbers stay (they are the
