@@ -131,9 +131,15 @@ def add_heal_args(parser: argparse.ArgumentParser,
 
 def make_supervisor(args: argparse.Namespace, name: str, *,
                     carry: bool, layout: Optional[str] = None,
-                    registry=None):
+                    registry=None, canonicalize=None):
     """Build the graft-heal Supervisor for a CLI run from its flags
-    (one recipe so all three CLIs agree on flag semantics)."""
+    (one recipe so all three CLIs agree on flag semantics).
+
+    ``canonicalize`` is the executor's checkpoint canonicalizer — for
+    2.5D replicated runs (graft-repl) pass its ``merge_carries`` so
+    saves persist the merged carriage instead of replica 0's partial
+    slab view.
+    """
     from arrow_matrix_tpu.faults import Supervisor
 
     return Supervisor(
@@ -143,7 +149,7 @@ def make_supervisor(args: argparse.Namespace, name: str, *,
         checkpoint_path=getattr(args, "checkpoint", None),
         checkpoint_every=getattr(args, "checkpoint_every", 0),
         finite_check=bool(getattr(args, "finite_check", True)) and carry,
-        layout=layout, registry=registry)
+        layout=layout, registry=registry, canonicalize=canonicalize)
 
 
 def load_sparse_matrix(path: str, dtype=np.float32) -> sparse.csr_matrix:

@@ -56,6 +56,19 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-r", "--replication", type=int, default=0,
                         help="Replication factor c; 0 = largest valid "
                              "power of two (spmm_15d_main.py:87-96).")
+    parser.add_argument("--repl", type=str, default=None,
+                        choices=["auto", "1", "2", "4"],
+                        help="graft-repl spelling of -r/--replication "
+                             "(one flag name across the SpMM CLIs): an "
+                             "explicit c, or 'auto' for the largest "
+                             "structurally valid factor whose ×c "
+                             "replicated-operator footprint the HBM "
+                             "budget certifies (obs/comm planner; "
+                             "AMT_HBM_GB overrides the budget).  A "
+                             "budget that rejects every c>1 degrades "
+                             "LOUDLY to c=1.  Unlike -r 0's purely "
+                             "structural pick, 'auto' never plans an "
+                             "OOM.")
     parser.add_argument("--validate", type=str2bool, nargs="?", default=True)
     parser.add_argument("-m", "--memory", type=float, default=0.5,
                         help="Fraction of currently-free device memory "
@@ -136,6 +149,46 @@ def main(argv=None) -> int:
         name = f"random_{args.vertices}_{args.edges}"
 
     n_dev = len(jax.devices())
+    if args.repl is not None:
+        if args.replication:
+            raise SystemExit("--repl and -r/--replication set the same "
+                             "factor; give one")
+        if args.repl == "auto":
+            # HBM-certified structural maximum: the 1.5D scheme
+            # replicates this device's A shard ×c, so the planner is
+            # the same base×c-fits-budget certificate as spmm_arrow's
+            # 2.5D mode (memview.largest_fitting_repl), filtered by
+            # the reference's c^2 | n_dev divisibility rule.
+            import sys
+
+            from arrow_matrix_tpu.obs.comm import hbm_budget_bytes
+            from arrow_matrix_tpu.obs.memview import largest_fitting_repl
+
+            nnz = int(a.nnz) if hasattr(a, "nnz") else int(a[1].size)
+            rows = int(a.shape[0]) if hasattr(a, "shape") \
+                else int(a[2].size - 1)
+            base_est = (nnz * 8 // max(n_dev, 1)
+                        + 2 * (-(-rows // max(n_dev, 1)))
+                        * args.columns * 4)
+            budget = hbm_budget_bytes()
+            structural = [cc for cc in (1, 2, 4, 8)
+                          if cc <= largest_replication(n_dev)
+                          and n_dev % (cc * cc) == 0]
+            c_fit = largest_fitting_repl(base_est, budget, structural)
+            if c_fit == 1 and max(structural) > 1:
+                print(f"[graft-repl] auto replication DEGRADED to "
+                      f"c=1: base footprint ~{base_est} B x c exceeds "
+                      f"the HBM budget {budget / 2**30:.2f} GiB for "
+                      f"every structural c {structural[1:]} (set "
+                      f"AMT_HBM_GB to raise)", file=sys.stderr)
+            else:
+                print(f"--repl auto plan: c={c_fit} (structural "
+                      f"candidates {structural}, base ~{base_est} B "
+                      f"per device, budget "
+                      f"{budget / 2**30:.2f} GiB)")
+            args.replication = c_fit
+        else:
+            args.replication = int(args.repl)
     c = args.replication or largest_replication(n_dev)
     if n_dev % (c * c) != 0:
         raise SystemExit(
@@ -192,9 +245,13 @@ def main(argv=None) -> int:
         from arrow_matrix_tpu import obs
         from arrow_matrix_tpu.utils import commstats
 
+        # repl is recorded for the obs schema; reduce_bytes stays 0 —
+        # the 1.5D scheme's reduction is the per-step all-reduce
+        # already inside the measured bytes, not a deferred merge.
         rep = obs.account_collectives(
             "spmm_15d", dist._step, dist.a_cols, dist.a_data, x,
-            ideal_bytes=obs.ideal_bytes_for(dist, args.columns))
+            ideal_bytes=obs.ideal_bytes_for(dist, args.columns),
+            repl=c, reduce_bytes=obs.reduce_bytes_for(dist, args.columns))
         print(f"per-iteration collective bytes ({rep['source']} HLO):")
         print(commstats.format_stats(rep["collectives"]))
         if rep["ratio"] is not None:

@@ -137,6 +137,22 @@ def build_parser() -> argparse.ArgumentParser:
                              "levels, ~2x the tiers; the gather cost "
                              "model favors it, pending a real "
                              "multi-chip race).")
+    parser.add_argument("--repl", type=str, default="1",
+                        choices=["auto", "1", "2", "4"],
+                        help="2.5D replication factor c (graft-repl): "
+                             "each of the c replica groups owns a "
+                             "static k/c feature slab, cutting every "
+                             "per-step exchange's bytes by c at c-fold "
+                             "operator memory plus one masked-psum "
+                             "merge at gather time.  Composes with "
+                             "--fmt sell on a mesh (c must divide the "
+                             "device count and --features; --routing "
+                             "a2a only) and with --fmt fold on one "
+                             "chip (sequential column groups, zero "
+                             "comm).  'auto' runs the obs/comm T(c) "
+                             "model under the HBM budget (AMT_HBM_GB "
+                             "to override) and degrades LOUDLY to c=1 "
+                             "when nothing bigger fits.")
     parser.add_argument("--fold_growth", type=float, default=1.2,
                         help="fmt=fold tier growth factor: padded "
                              "slots <= growth x nnz by construction. "
@@ -221,6 +237,24 @@ def main(argv=None) -> int:
         raise SystemExit("--checkpoint requires --carry (there is no "
                          "iteration state to resume when X is fresh "
                          "every iteration)")
+    if args.repl != "1":
+        # 2.5D flag preconditions knowable before any backend work.
+        if not args.slim:
+            raise SystemExit(
+                "--repl (2.5D replication) composes with the slim "
+                "layout; the wide (arm, blocks) mesh spends its extra "
+                "devices on the row/column split, not replicas")
+        if args.mode == "space":
+            raise SystemExit(
+                "--repl composes with --mode time; the space-shared "
+                "mesh spends its extra devices on level groups, not "
+                "replicas")
+        if args.routing == "gather":
+            raise SystemExit(
+                "--repl carries per-replica-group PARTIAL feature "
+                "slabs; the GSPMD gather lowering assumes a "
+                "replicated carriage and corrupts the exchange — use "
+                "--routing a2a (the sell default)")
     if not args.slim:
         # Wide layout preconditions — loud flag errors before any
         # decomposition/compile work (VERDICT r2 item 7: --slim false
@@ -260,7 +294,11 @@ def main(argv=None) -> int:
         load_level_widths,
         save_decomposition,
     )
-    from arrow_matrix_tpu.parallel import MultiLevelArrow, make_mesh
+    from arrow_matrix_tpu.parallel import (
+        MultiLevelArrow,
+        make_mesh,
+        make_repl_mesh,
+    )
     from arrow_matrix_tpu.utils import graphs
     from arrow_matrix_tpu.utils import logging as wb
 
@@ -308,6 +346,11 @@ def main(argv=None) -> int:
         ok = "sell" if args.mode == "space" else "fold or sell"
         raise SystemExit(f"--feature_dtype bf16 needs --fmt {ok} "
                          f"(the other formats carry f32)")
+    if args.repl != "1" and args.fmt not in ("sell", "fold"):
+        raise SystemExit(
+            f"--repl needs --fmt sell (mesh replica groups) or fold "
+            f"(single-chip column groups); --fmt {args.fmt} has no "
+            f"2.5D mode")
 
     width = args.width
     if args.path is None:
@@ -367,6 +410,48 @@ def main(argv=None) -> int:
 
     n = num_rows(levels[0].matrix)
 
+    # 2.5D replication factor (graft-repl).  'auto' runs the T(c)
+    # planner on cheap pre-build estimates — operator bytes from nnz,
+    # exchange bytes from the paper's O(n_dev * width * k) bound — so
+    # an infeasible plan costs nothing but this arithmetic; the HBM
+    # certificate (base x c <= budget) is what keeps auto from
+    # planning an OOM, and a budget that rejects every c>1 degrades
+    # LOUDLY to c=1 (auto_repl prints to stderr).
+    repl_c = 1
+    if args.repl == "auto":
+        from arrow_matrix_tpu.obs.comm import auto_repl
+
+        itemsz = 2 if args.feature_dtype == "bf16" else 4
+        nnz = sum(int(lvl.matrix.nnz) for lvl in levels)
+        rows_dev = -(-n // max(n_dev, 1))
+        base_est = (nnz * 8 // max(n_dev, 1)
+                    + 2 * rows_dev * args.features * 4)
+        exch_est = (max(n_dev - 1, 0) * width * args.features
+                    * itemsz * len(levels)) if n_dev > 1 else 0
+        plan = auto_repl(n_dev, args.features, base_est,
+                         exchange_bytes=exch_est, n_coll=len(levels),
+                         reduce_bytes=rows_dev * args.features * itemsz,
+                         iterations=max(args.iterations, 1))
+        repl_c = plan["c"]
+        pred = ", ".join(f"c={c}: {t:.4f} ms" for c, t
+                         in sorted(plan["predicted_ms"].items()))
+        print(f"--repl auto plan: c={repl_c} ({pred}; budget "
+              f"{plan['budget_bytes'] / 2**30:.2f} GiB, base "
+              f"~{plan['base_hbm_bytes']} B"
+              + (", DEGRADED" if plan["degraded"] else "") + ")")
+    elif args.repl != "1":
+        repl_c = int(args.repl)
+        if n_dev > 1 and n_dev % repl_c:
+            raise SystemExit(
+                f"--repl {repl_c} must divide the device count "
+                f"({n_dev}): each replica group needs an equal share "
+                f"of the mesh")
+        if args.features % repl_c:
+            raise SystemExit(
+                f"--repl {repl_c} must divide --features "
+                f"({args.features}): each replica group owns an equal "
+                f"static column slab")
+
     # Version-string run name (reference arrow_bench.py:43-47 pattern),
     # derived from what actually runs: slim-style sharding, banded or
     # block-diagonal tiling, time- or space-shared level execution.
@@ -425,6 +510,13 @@ def main(argv=None) -> int:
             if not args.slim:
                 # (device-count parity already validated up front)
                 mesh = make_mesh((2, n_dev // 2), ("arm", "blocks"))
+            elif repl_c > 1 and n_dev > 1:
+                # 2.5D: (blocks, repl) — each of the repl_c replica
+                # groups runs the whole level schedule over
+                # n_dev/repl_c block shards on its own k/c slab.
+                mesh = make_repl_mesh(n_dev, repl_c)
+                print(f"2.5D mesh: {n_dev // repl_c} block shards "
+                      f"x {repl_c} replica groups")
             else:
                 mesh = (make_mesh((n_dev,), ("blocks",))
                         if n_dev > 1 else None)
@@ -436,7 +528,9 @@ def main(argv=None) -> int:
                 multi = SellMultiLevel(levels, width, mesh,
                                        routing=args.routing,
                                        feature_dtype=args.feature_dtype,
-                                       ladder=args.ladder)
+                                       ladder=args.ladder,
+                                       repl_axis=("repl" if repl_c > 1
+                                                  else None))
             else:
                 multi = MultiLevelArrow(
                     levels, width, mesh=mesh,
@@ -448,7 +542,8 @@ def main(argv=None) -> int:
                     routing=(args.routing if mesh is not None
                              else "gather"),
                     fold_growth=args.fold_growth,
-                    fold_align=args.fold_align)
+                    fold_align=args.fold_align,
+                    repl=repl_c)
 
     # Untimed warmup: trace + compile must not pollute iteration 0's
     # spmm_time (the sibling baseline CLIs warm up the same way).
@@ -483,6 +578,9 @@ def main(argv=None) -> int:
                 ideal_bytes=obs.ideal_bytes_for(multi, args.features,
                                                 itemsize=itemsize),
                 mode="lowered" if pinned else "auto",
+                repl=getattr(multi, "repl", 1),
+                reduce_bytes=obs.reduce_bytes_for(
+                    multi, args.features, itemsize=itemsize),
                 registry=obs_reg)
             print(f"per-iteration collective bytes "
                   f"({rep['source']} HLO):")
@@ -496,6 +594,11 @@ def main(argv=None) -> int:
                 print(f"measured vs paper-model ideal: "
                       f"{rep['measured_bytes']} / {rep['ideal_bytes']} "
                       f"bytes = {rep['ratio']:.2f}x")
+            if rep["repl"] > 1:
+                print(f"2.5D replication c={rep['repl']}: per-step "
+                      f"exchange bytes above are cut by c; the final "
+                      f"masked-psum merge pays {rep['reduce_bytes']} "
+                      f"B/device once per gather")
 
     if args.mem_report:
         itemsize = 2 if args.feature_dtype == "bf16" else 4
@@ -517,9 +620,18 @@ def main(argv=None) -> int:
     # executor configuration refuses to resume under another (the
     # checkpoint module's loud-mismatch contract) instead of silently
     # permuting rows.
-    layout = f"{algo}/{args.fmt}/{args.feature_dtype or 'f32'}"
+    layout = (f"{algo}/{args.fmt}/{args.feature_dtype or 'f32'}"
+              + (f"/repl{repl_c}" if repl_c > 1 else ""))
+    # Under 2.5D replication the carried state is per-replica-group
+    # partial; checkpoints must persist the merged canonical form
+    # (merge_carries docstring) or a resume would silently restore
+    # replica 0's partial slab view.
+    canon = (multi.merge_carries
+             if repl_c > 1 and hasattr(multi, "merge_carries")
+             else None)
     sup = make_supervisor(args, "spmm_arrow", carry=args.carry,
-                          layout=layout, registry=obs_reg)
+                          layout=layout, registry=obs_reg,
+                          canonicalize=canon)
     start_it = 0
     x0 = warm   # the warmup input IS the carry-mode initial state
     if args.carry and args.checkpoint:

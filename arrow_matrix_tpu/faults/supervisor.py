@@ -103,7 +103,8 @@ class Supervisor:
                  finite_check: bool = True,
                  layout: Optional[str] = None,
                  registry=None,
-                 verbose: bool = True):
+                 verbose: bool = True,
+                 canonicalize: Optional[Callable[[Any], Any]] = None):
         self.name = name
         self.carry = carry
         self.watchdog_s = float(watchdog_s or 0.0)
@@ -117,6 +118,14 @@ class Supervisor:
         self.layout = layout
         self.registry = registry
         self.verbose = verbose
+        #: Optional device-level map applied to the carried state right
+        #: before every save (graft-repl: the 2.5D executors carry
+        #: per-replica-group PARTIAL slabs — ``fetch_replicated`` in the
+        #: checkpoint layer would silently persist replica 0's partial
+        #: view.  The executors' ``merge_carries`` produces the fully
+        #: replicated canonical state, which is a bit-exact resume
+        #: point because the step re-extracts each group's own slab).
+        self.canonicalize = canonicalize
         self.faults_seen = 0
         self.recoveries = 0
         self.last_checkpoint_step: Optional[int] = None
@@ -159,6 +168,8 @@ class Supervisor:
     def _save(self, x, step: int) -> None:
         from arrow_matrix_tpu.utils.checkpoint import save_state
 
+        if self.canonicalize is not None:
+            x = self.canonicalize(x)
         save_state(self.checkpoint_path, x, step, layout=self.layout)
         self.last_checkpoint_step = step
         self._event("heal", "checkpointed", step=step)

@@ -32,7 +32,13 @@ traces, prints memory reports (``memreport``), inspects flight
 artifacts (``blackbox``), and drives the smoke harness.
 """
 
-from arrow_matrix_tpu.obs.comm import account_collectives, ideal_bytes_for
+from arrow_matrix_tpu.obs.comm import (
+    account_collectives,
+    auto_repl,
+    hbm_budget_bytes,
+    ideal_bytes_for,
+    reduce_bytes_for,
+)
 from arrow_matrix_tpu.obs.flight import FlightRecorder
 from arrow_matrix_tpu.obs.imbalance import (
     account_imbalance,
@@ -66,15 +72,18 @@ __all__ = [
     "account_collectives",
     "account_imbalance",
     "account_memory",
+    "auto_repl",
     "chained_iteration_ms",
     "format_imbalance_report",
     "format_memory_report",
     "get_registry",
+    "hbm_budget_bytes",
     "ideal_bytes_for",
     "init_registry",
     "iteration_time_ms",
     "memory_report",
     "predicted_bytes_for",
+    "reduce_bytes_for",
     "set_registry",
     "shard_report_for",
     "timed",

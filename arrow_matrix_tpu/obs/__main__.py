@@ -200,11 +200,16 @@ def cmd_export(args) -> int:
 
 
 def cmd_memreport(args) -> int:
+    from arrow_matrix_tpu.obs.comm import hbm_budget_bytes
     from arrow_matrix_tpu.obs.imbalance import format_imbalance_report
-    from arrow_matrix_tpu.obs.memview import format_memory_report
+    from arrow_matrix_tpu.obs.memview import (
+        format_memory_report,
+        largest_fitting_repl,
+    )
 
     summary = _load_summary(args.run)
     algos = summary.get("algorithms", {})
+    budget = hbm_budget_bytes()
     missing = 0
     for name, rec in sorted(algos.items()):
         print(f"== {name} ==")
@@ -218,6 +223,15 @@ def cmd_memreport(args) -> int:
                    "ratio": rec.get("hbm_vs_predicted"),
                    "source": rec.get("hbm_source", "unknown")}
             print(format_memory_report(rep))
+        predicted = rec.get("hbm_predicted_bytes")
+        if predicted:
+            # graft-repl planning line: 2.5D replication multiplies the
+            # per-device footprint by exactly c; this is the largest c
+            # the static predictor certifies against the HBM budget.
+            c_fit = largest_fitting_repl(predicted, budget)
+            print(f"largest 2.5D replication fitting budget "
+                  f"({budget / 2**30:.2f} GiB): c={c_fit} "
+                  f"(predicted {predicted} B per device x c)")
         imb = rec.get("imbalance")
         if imb is not None:
             print(format_imbalance_report(imb))

@@ -68,21 +68,168 @@ def ideal_bytes_for(obj, k: int, itemsize: int = 4) -> Optional[int]:
     return int(fn(k, itemsize=itemsize))
 
 
+def reduce_bytes_for(obj, k: int, itemsize: int = 4) -> int:
+    """Per-device bytes of the 2.5D final reduction (the masked psum
+    over the replica axis, paid at gather time — graft-repl), from the
+    orchestration's ``reduce_comm_bytes`` model; 0 when the object has
+    no replica axis or no model.  Kept separate from the per-step
+    ``measured_bytes``: the 2.5D accounting charges the merge once per
+    gather, not once per iteration."""
+    fn = getattr(obj, "reduce_comm_bytes", None)
+    if fn is None:
+        return 0
+    return int(fn(k, itemsize=itemsize))
+
+
+def hbm_budget_bytes(default: Optional[int] = None) -> int:
+    """Per-device HBM budget for the replication planner: the
+    ``AMT_HBM_GB`` override when set (tests pin tiny budgets to force
+    the loud c=1 degrade), else ``default``, else the actual target
+    chip's free-memory budget (utils/platform)."""
+    env = os.environ.get("AMT_HBM_GB")
+    if env:
+        return int(float(env) * 2**30)
+    if default is not None:
+        return int(default)
+    from arrow_matrix_tpu.utils.platform import device_memory_budget
+
+    return int(device_memory_budget(None))
+
+
+def repl_predict_ms(c: int, exchange_bytes: int, n_coll: int = 0,
+                    compute_ms: float = 0.0, reduce_bytes: int = 0,
+                    iterations: int = 1,
+                    link_bytes_per_s: Optional[float] = None,
+                    latency_s: float = 1e-6) -> float:
+    """The c-parameterized step-time model of the 2.5D scheme
+    (graft-repl; Lazzaro et al. 2.5D SpMM):
+
+        T(c) = compute + bytes/(c*bw) + n_coll*lat + reduce(c)/bw
+
+    ``exchange_bytes`` / ``n_coll`` describe the UNREPLICATED (c=1)
+    step at feature width k: with the block count fixed, replication
+    hands each replica group a k/c feature slab through the identical
+    exchange structure, so the wire term divides by exactly c while
+    the collective count — and with it the latency term — stays put
+    (replication buys bandwidth, never rounds).  ``reduce_bytes`` is
+    the per-device final-merge cost, paid once per gather and
+    amortized over ``iterations`` steps; it is 0 at c=1 — the term
+    that makes T(c) non-monotone for latency- or reduce-dominated
+    problems and gives the planner a real crossover to find."""
+    bw = LINK_BYTES_PER_S if link_bytes_per_s is None else link_bytes_per_s
+    c = max(int(c), 1)
+    wire_s = float(exchange_bytes) / (c * bw)
+    lat_s = float(n_coll) * latency_s
+    reduce_s = 0.0
+    if c > 1 and reduce_bytes:
+        reduce_s = float(reduce_bytes) / bw / max(int(iterations), 1)
+    return compute_ms + (wire_s + lat_s + reduce_s) * 1e3
+
+
+def auto_repl(n_dev: int, k: int, base_hbm_bytes: int,
+              budget_bytes: Optional[int] = None,
+              choices=(1, 2, 4), exchange_bytes: int = 0,
+              n_coll: int = 0, compute_ms: float = 0.0,
+              reduce_bytes: int = 0, iterations: int = 1,
+              link_bytes_per_s: Optional[float] = None,
+              latency_s: float = 1e-6,
+              quiet: bool = False) -> Dict[str, Any]:
+    """Model-driven replication factor (the graft-repl planner).
+
+    A candidate c is FEASIBLE when it divides both the device count
+    (equal replica groups) and the feature width (equal column slabs),
+    and the HBM predictor certifies the ×c footprint:
+    ``base_hbm_bytes * c <= budget`` (the per-device operator slice
+    and carriage both grow exactly ×c with c-fold coarser block
+    shards).  Among feasible c the planner minimizes
+    :func:`repl_predict_ms`; ties break toward smaller c (don't pay
+    memory for nothing — e.g. a zero-comm fold step).  When the
+    budget rejects every c>1 the plan degrades LOUDLY to c=1 (stderr,
+    plus ``"degraded": True`` in the plan) — never silently.
+
+    Returns ``{"c", "feasible", "rejected", "predicted_ms",
+    "budget_bytes", "base_hbm_bytes", "degraded"}`` where
+    ``predicted_ms`` maps each feasible c to its modeled step time and
+    ``rejected`` maps each rejected c to the reason string.
+    """
+    budget = hbm_budget_bytes(budget_bytes)
+    feasible, rejected = [], {}
+    budget_rejected = False
+    for c in sorted(set(int(c) for c in choices)):
+        if c < 1:
+            rejected[c] = "c must be >= 1"
+            continue
+        if n_dev % c:
+            rejected[c] = f"does not divide n_dev={n_dev}"
+            continue
+        if k % c:
+            rejected[c] = f"does not divide feature width k={k}"
+            continue
+        need = base_hbm_bytes * c
+        if need > budget:
+            rejected[c] = (f"predicted {need} B exceeds HBM budget "
+                           f"{budget} B")
+            budget_rejected = True
+            continue
+        feasible.append(c)
+    if 1 not in feasible:
+        # c=1 is the always-available baseline: a base footprint past
+        # the budget is a (loud) capacity problem, not a plan.
+        feasible.insert(0, 1)
+        rejected.pop(1, None)
+    predicted = {
+        c: repl_predict_ms(c, exchange_bytes, n_coll=n_coll,
+                           compute_ms=compute_ms,
+                           reduce_bytes=reduce_bytes,
+                           iterations=iterations,
+                           link_bytes_per_s=link_bytes_per_s,
+                           latency_s=latency_s)
+        for c in feasible
+    }
+    best = min(feasible, key=lambda c: (predicted[c], c))
+    degraded = best == 1 and budget_rejected
+    if degraded and not quiet:
+        import sys
+
+        print(f"[graft-repl] auto replication DEGRADED to c=1: the "
+              f"HBM predictor rejected every c>1 "
+              f"({ {c: r for c, r in rejected.items() if c > 1} }) "
+              f"against budget {budget / 2**30:.2f} GiB "
+              f"(base footprint {base_hbm_bytes / 2**30:.3f} GiB; "
+              f"set AMT_HBM_GB to raise)", file=sys.stderr)
+    return {
+        "c": best,
+        "feasible": feasible,
+        "rejected": rejected,
+        "predicted_ms": predicted,
+        "budget_bytes": budget,
+        "base_hbm_bytes": int(base_hbm_bytes),
+        "degraded": degraded,
+    }
+
+
 def account_collectives(algorithm: str, jitted_fn, *args,
                         ideal_bytes: Optional[int] = None,
                         mode: str = "auto", overlap_slabs: int = 1,
+                        repl: int = 1,
+                        reduce_bytes: Optional[int] = None,
                         registry=None, **kwargs) -> Dict[str, Any]:
     """Account one jitted entry point's collective bytes at trace time.
 
     Returns ``{"algorithm", "collectives" (full commstats dict, usable
     with format_stats), "measured_bytes", "ideal_bytes", "ratio",
-    "source", "overlap_slabs", "exposed_comm_ms"}``.  ``ratio`` is None
-    when no ideal model was supplied or the ideal is zero
-    (single-device meshes legitimately move nothing).
-    ``exposed_comm_ms`` is ALWAYS present (see :func:`exposed_comm_ms`;
-    tools/obs_gate.py rejects comm reports without it): the modeled
-    un-hidden collective milliseconds given the step's
-    ``overlap_slabs`` setting.
+    "source", "overlap_slabs", "exposed_comm_ms", "repl",
+    "reduce_bytes"}``.  ``ratio`` is None when no ideal model was
+    supplied or the ideal is zero (single-device meshes legitimately
+    move nothing).  ``exposed_comm_ms`` is ALWAYS present (see
+    :func:`exposed_comm_ms`; tools/obs_gate.py rejects comm reports
+    without it): the modeled un-hidden collective milliseconds given
+    the step's ``overlap_slabs`` setting.  ``repl`` and
+    ``reduce_bytes`` are likewise always present (graft-repl; the
+    gate rejects repl>1 reports without them): the 2.5D replication
+    factor of the accounted step and the per-device bytes of its
+    final merge — charged once per gather, so kept OUT of the
+    per-step ``measured_bytes``/``exposed_comm_ms``.
     """
     if mode not in ("auto", "lowered", "compiled"):
         raise ValueError(f"unknown mode {mode!r}")
@@ -118,6 +265,10 @@ def account_collectives(algorithm: str, jitted_fn, *args,
                 ratio)
         registry.gauge("comm_exposed_ms", algorithm=algorithm).set(
             exposed_ms)
+        registry.gauge("comm_repl", algorithm=algorithm).set(
+            max(int(repl), 1))
+        registry.gauge("comm_reduce_bytes", algorithm=algorithm).set(
+            int(reduce_bytes or 0))
 
     return {
         "algorithm": algorithm,
@@ -128,4 +279,6 @@ def account_collectives(algorithm: str, jitted_fn, *args,
         "source": source,
         "overlap_slabs": max(int(overlap_slabs), 1),
         "exposed_comm_ms": round(exposed_ms, 6),
+        "repl": max(int(repl), 1),
+        "reduce_bytes": int(reduce_bytes or 0),
     }

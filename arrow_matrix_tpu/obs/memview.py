@@ -108,13 +108,40 @@ def memory_report(jitted_fn, *args, **kwargs) -> Dict[str, Any]:
     return report
 
 
-def predicted_bytes_for(obj, k: int, itemsize: int = 4) -> Optional[int]:
+def predicted_bytes_for(obj, k: int, itemsize: int = 4,
+                        repl: int = 1) -> Optional[int]:
     """The orchestration's own static per-shard HBM model for one step
-    at feature width ``k``, or None when it has no model."""
+    at feature width ``k``, or None when it has no model.
+
+    ``repl`` is the 2.5D planning multiplier (graft-repl): at
+    replication c the per-device operator slice AND carriage grow
+    exactly ×c (c-fold coarser block shards), so a c=1 executor's
+    model predicts the replicated footprint as ``base × c`` — the
+    number ``auto_repl`` certifies against the HBM budget before
+    anything is built.  Executors without the ``repl`` kwarg (older
+    models) fall back to the same ×c scaling applied outside."""
     fn = getattr(obj, "predicted_hbm_bytes", None)
     if fn is None:
         return None
-    return int(fn(k, itemsize=itemsize))
+    repl = max(int(repl), 1)
+    try:
+        return int(fn(k, itemsize=itemsize, repl=repl))
+    except TypeError:
+        return int(fn(k, itemsize=itemsize)) * repl
+
+
+def largest_fitting_repl(base_bytes: int, budget_bytes: int,
+                         choices=(1, 2, 4, 8)) -> int:
+    """Largest replication factor whose predicted ×c footprint fits
+    the per-device HBM budget (always at least 1 — c=1 is the
+    unreplicated baseline, not a plan choice).  The memreport CLI
+    prints this per executable; ``obs/comm.auto_repl`` applies the
+    same certificate plus divisibility and the T(c) time model."""
+    best = 1
+    for c in sorted(set(int(c) for c in choices)):
+        if c >= 1 and base_bytes * c <= budget_bytes:
+            best = max(best, c)
+    return best
 
 
 def account_memory(algorithm: str, jitted_fn, *args,

@@ -30,7 +30,11 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
-from arrow_matrix_tpu.obs.comm import account_collectives, ideal_bytes_for
+from arrow_matrix_tpu.obs.comm import (
+    account_collectives,
+    ideal_bytes_for,
+    reduce_bytes_for,
+)
 from arrow_matrix_tpu.obs.imbalance import account_imbalance
 from arrow_matrix_tpu.obs.memview import account_memory, predicted_bytes_for
 from arrow_matrix_tpu.obs.metrics import MetricsRegistry
@@ -181,6 +185,8 @@ def run_smoke(run_dir: str, n: int = 256, width: int = 32, k: int = 4,
                 name, jit_fn, *jit_args,
                 ideal_bytes=ideal_bytes_for(obj, k),
                 overlap_slabs=getattr(obj, "overlap_slabs", 1),
+                repl=getattr(obj, "repl", 1),
+                reduce_bytes=reduce_bytes_for(obj, k),
                 registry=reg)
             span_args["measured_bytes"] = rep["measured_bytes"]
             span_args["source"] = rep["source"]
@@ -229,6 +235,8 @@ def run_smoke(run_dir: str, n: int = 256, width: int = 32, k: int = 4,
             "comm_source": rep["source"],
             "overlap_slabs": rep["overlap_slabs"],
             "exposed_comm_ms": rep["exposed_comm_ms"],
+            "repl": rep["repl"],
+            "reduce_bytes": rep["reduce_bytes"],
             "hbm_measured_bytes": mem["measured_bytes"],
             "hbm_predicted_bytes": mem["predicted_bytes"],
             "hbm_vs_predicted": mem["ratio"],

@@ -30,8 +30,10 @@ Modules:
 from arrow_matrix_tpu.parallel.mesh import (
     fetch_replicated,
     initialize_multihost,
+    largest_replication,
     make_hybrid_mesh,
     make_mesh,
+    make_repl_mesh,
     put_global,
     shard_blocked,
     blocks_sharding,
@@ -44,5 +46,5 @@ from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
 from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel, SellSlim
 from arrow_matrix_tpu.parallel.sell_space import SellSpaceShared
 from arrow_matrix_tpu.parallel.space_shared import SpaceSharedArrow
-from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D, largest_replication
+from arrow_matrix_tpu.parallel.spmm_15d import SpMM15D
 from arrow_matrix_tpu.parallel.spmm_1d import MatrixSlice1D, equal_slices

@@ -87,6 +87,41 @@ def make_mesh(shape: Optional[Sequence[int]] = None,
     return Mesh(arr, tuple(axis_names))
 
 
+def largest_replication(n_dev: int) -> int:
+    """Largest power-of-two c with c**2 <= n_dev that yields a valid
+    grid, i.e. n_dev divisible by c**2 (reference auto-replication rule
+    plus its runtime divisibility requirement,
+    scripts/spmm_15d_main.py:87-96, spmm_15d.py:34-40)."""
+    c = 1
+    while (2 * c) ** 2 <= n_dev and n_dev % ((2 * c) ** 2) == 0:
+        c *= 2
+    return c
+
+
+def make_repl_mesh(n_dev: int, repl: int,
+                   axis_names: Sequence[str] = ("blocks", "repl"),
+                   devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D ``(blocks, repl)``-style mesh for the replicated (2.5D)
+    arrow/SELL executors: ``n_dev // repl`` block shards x ``repl``
+    replica groups.  Each replica group (a column of the mesh) holds a
+    complete copy of the operator — that is the c-fold memory the 2.5D
+    scheme (arxiv 1705.10218) trades for cheaper exchanges — and runs
+    its exchanges among its own ``n_dev // repl`` devices only.
+
+    ``repl=1`` degenerates to the 1-D layout (a trailing axis of
+    extent 1), so callers can thread one mesh shape through both the
+    replicated and the baseline paths."""
+    repl = int(repl)
+    if repl < 1:
+        raise ValueError(f"repl={repl} must be >= 1")
+    if n_dev % repl != 0:
+        raise ValueError(
+            f"repl={repl} must divide the device count n_dev={n_dev} "
+            f"(each replica group needs an equal share of the mesh)")
+    return make_mesh((n_dev // repl, repl), tuple(axis_names),
+                     devices=devices)
+
+
 def blocks_sharding(mesh: Mesh, axis: str = "blocks") -> NamedSharding:
     """Sharding for a (nb, w, k) blocked array: block axis over ``axis``."""
     return NamedSharding(mesh, P(axis))

@@ -195,7 +195,7 @@ class MultiLevelArrow:
                  layout: str = "slim", arm_axis: str = "arm",
                  fold_growth: float = 1.2,
                  fold_align: Optional[int] = None,
-                 overlap_slabs: int = 1):
+                 overlap_slabs: int = 1, repl: int = 1):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -299,6 +299,28 @@ class MultiLevelArrow:
             raise ValueError(f"overlap_slabs must be >= 1, got "
                              f"{overlap_slabs}")
         self.overlap_slabs = int(overlap_slabs)
+        # 2.5D replication factor (graft-repl).  On one chip this is
+        # the column-group schedule of the replicated scheme with the
+        # communication already at zero: the carried features split
+        # into c static column groups, each running the full fold step
+        # — bit-identical f32 (no accumulation regroups) and the
+        # degenerate proof point of the T(c) model's zero-comm end.
+        # The mesh-replicated executors live in SellSlim/SellMultiLevel
+        # (repl_axis on a make_repl_mesh mesh); this class's mesh path
+        # carries row-major features the slab split predates.
+        if repl < 1:
+            raise ValueError(f"repl must be >= 1, got {repl}")
+        if repl > 1 and mesh is not None:
+            raise ValueError(
+                "repl>1 on a mesh is the SellMultiLevel/SellSlim "
+                "repl_axis mode (build the mesh with make_repl_mesh); "
+                "MultiLevelArrow supports repl on the single-chip "
+                "fold path only")
+        if repl > 1 and fmt != "fold":
+            raise ValueError(
+                f"repl={repl} requires fmt='fold' (the single-chip "
+                f"column-group schedule), got fmt={fmt!r}")
+        self.repl = int(repl)
         self.width = width
         self.mesh = mesh
         self.axis = axis
@@ -595,6 +617,7 @@ class MultiLevelArrow:
 
         kernel = getattr(self, "kernel", "xla")
         slabs = int(getattr(self, "overlap_slabs", 1))
+        repl = int(getattr(self, "repl", 1))
 
         def fold_slab(xt, blocks):
             if kernel == "pallas_sell":
@@ -610,7 +633,7 @@ class MultiLevelArrow:
                                    gather_budget=gather_budget)
             return sell_spmm_t(blocks[0], xt, chunk=chunk)
 
-        def fold_step(xt, fwd, bwd, blocks):
+        def fold_group(xt, blocks):
             if slabs <= 1:
                 return fold_slab(xt, blocks)
             # Single-chip fold has no collectives to hide; the split
@@ -620,6 +643,24 @@ class MultiLevelArrow:
 
             outs = [fold_slab(xt[lo:hi], blocks)
                     for lo, hi in overlap_slices(xt.shape[0], slabs)]
+            return jnp.concatenate(outs, axis=0)
+
+        def fold_step(xt, fwd, bwd, blocks):
+            if repl <= 1:
+                return fold_group(xt, blocks)
+            # 2.5D column-group schedule (graft-repl), repl outermost:
+            # each replica group owns a static k/c feature slab and
+            # runs the full overlap schedule on it (S must divide
+            # k/c).  SpMM is column-separable, so the groups never
+            # interact and the f32 result is bit-identical to repl=1.
+            from arrow_matrix_tpu.parallel.routing import repl_slab_width
+
+            kc = repl_slab_width(xt.shape[0], repl)
+            outs = []
+            for j in range(repl):
+                with jax.named_scope(f"repl_group_{j}"):
+                    outs.append(fold_group(xt[j * kc:(j + 1) * kc],
+                                           blocks))
             return jnp.concatenate(outs, axis=0)
 
         self._step = jax.jit(fold_step)
@@ -822,19 +863,32 @@ class MultiLevelArrow:
         obs/comm judges the compiled collective bytes against."""
         return self._ideal_route_units * k * itemsize
 
-    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+    def reduce_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """2.5D final-reduction bytes: always 0 here — the single-chip
+        column-group schedule concatenates disjoint slabs (no merge),
+        and the mesh path has no replica axis (see SellSlim/
+        SellMultiLevel.reduce_comm_bytes for the mesh scheme)."""
+        return 0
+
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4,
+                            repl: int = 1) -> int:
         """Static per-shard HBM model for one step at feature width
         ``k``: this device's slice of every level's block stacks and
         route tables, plus the carried feature input and output
         (total_rows / n_dev rows each).  obs/memview judges the
-        compiled executable against this."""
+        compiled executable against this.  ``repl`` is the 2.5D
+        planning multiplier (operator + carriage grow exactly ×c per
+        device at replication c on a mesh; the single-chip column
+        schedule is footprint-neutral but keeps the uniform ×c
+        planning convention)."""
         from arrow_matrix_tpu.obs.memview import tree_device_bytes
 
         n_dev = self.mesh.shape[self.axis] if self.mesh is not None else 1
         ops_bytes = sum(b.device_nbytes() for b in self.blocks)
         ops_bytes += tree_device_bytes(self.fwd, self.bwd)
-        return (ops_bytes // n_dev
+        base = (ops_bytes // n_dev
                 + 2 * (self.total_rows // n_dev) * k * itemsize)
+        return base * max(int(repl), 1)
 
     def shard_report(self) -> dict:
         """Load report over the layout's compute units — block rows for

@@ -408,6 +408,91 @@ def overlap_slices(k: int, overlap_slabs: int) -> list:
     return [(i * step, (i + 1) * step) for i in range(s)]
 
 
+def repl_slab_width(k: int, repl: int) -> int:
+    """Per-replica feature-slab width for the 2.5D replicated
+    executors (graft-repl): replica group j owns the static column
+    slab ``[j*k/c, (j+1)*k/c)``.  SpMM is column-separable, so the
+    slab split never regroups any f32 accumulation — the replicated
+    run is bit-identical to c=1.  Mirrors ``overlap_slices``
+    validation: c must divide k."""
+    c = int(repl)
+    if c <= 1:
+        return int(k)
+    if c > k or k % c:
+        raise ValueError(
+            f"repl={c} must divide the feature width k={k} "
+            f"(each replica group owns an equal static column slab)")
+    return k // c
+
+
+def repl_slab_take_t(xt: jax.Array, mesh: Mesh, axis: str,
+                     repl_axis: str) -> jax.Array:
+    """(k, total) -> (k/c, total): keep only the feature slab this
+    replica group owns.  The result is intentionally DIVERGENT across
+    ``repl_axis`` (each group holds different rows under the same
+    shape/spec — legal under check=False shard_map); every downstream
+    exchange over ``axis`` then moves a 1/c-width payload within its
+    own replica group."""
+    c = mesh.shape[repl_axis]
+    kc = repl_slab_width(xt.shape[0], c)
+
+    def local_fn(xl):
+        j = jax.lax.axis_index(repl_axis)
+        return jax.lax.dynamic_slice_in_dim(xl, j * kc, kc, axis=0)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(P(None, axis),),
+                     out_specs=P(None, axis),
+                     **shard_map_check_kwargs())(xt)
+
+
+def repl_slab_scatter_t(slab: jax.Array, k: int, mesh: Mesh, axis: str,
+                        repl_axis: str) -> jax.Array:
+    """(k/c, total) per-replica slabs -> (k, total): replica group j's
+    slab lands back at feature rows ``[j*k/c, (j+1)*k/c)``, zeros
+    elsewhere.  The output stays divergent across ``repl_axis`` (each
+    group carries its own slab + zeros) — exactly the partial-carry
+    form ``repl_merge_t``'s masked psum merges."""
+    c = mesh.shape[repl_axis]
+    kc = slab.shape[0]
+    if kc * c != k:
+        raise ValueError(f"slab width {kc} x repl={c} != k={k}")
+
+    def local_fn(sl):
+        j = jax.lax.axis_index(repl_axis)
+        out = jnp.zeros((k, sl.shape[1]), sl.dtype)
+        return jax.lax.dynamic_update_slice_in_dim(out, sl, j * kc,
+                                                   axis=0)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(P(None, axis),),
+                     out_specs=P(None, axis),
+                     **shard_map_check_kwargs())(slab)
+
+
+def repl_merge_t(ct: jax.Array, mesh: Mesh, axis: str,
+                 repl_axis: str) -> jax.Array:
+    """Final masked ``psum`` over the replica axis merging the
+    per-replica partial carries into one truly replicated (k, total)
+    array: replica group j contributes only its owned feature slab
+    (everything else is masked to zero), so every output element has
+    exactly ONE real addend and c-1 zeros — the merge is f32-exact.
+    This is the 2.5D scheme's final reduction; its cost is reported as
+    ``reduce_bytes`` in the comm accounts, separate from the per-step
+    exchange bytes it buys down."""
+    c = mesh.shape[repl_axis]
+    kc = repl_slab_width(ct.shape[0], c)
+
+    def local_fn(cl):
+        j = jax.lax.axis_index(repl_axis)
+        owner = jnp.arange(cl.shape[0]) // kc
+        masked = jnp.where((owner == j)[:, None], cl,
+                           jnp.zeros_like(cl))
+        return jax.lax.psum(masked, repl_axis)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(P(None, axis),),
+                     out_specs=P(None, axis),
+                     **shard_map_check_kwargs())(ct)
+
+
 def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
                   axis: str = "blocks",
                   feat_axis: Optional[str] = None,

@@ -38,6 +38,7 @@ Reference counterparts: ``ArrowSlimMPI`` (arrow/arrow_slim_mpi.py) and
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -890,6 +891,61 @@ def _overlap_step(step, overlap_slabs: int, xt_pos: int = -1):
     return wrapped
 
 
+def _resolve_repl(mesh: Mesh, axis: str, repl_axis: Optional[str],
+                  feat_axis: Optional[str] = None) -> int:
+    """Validate a 2.5D replica axis request and return its factor c
+    (1 when ``repl_axis is None``)."""
+    if repl_axis is None:
+        return 1
+    if repl_axis not in mesh.axis_names:
+        raise ValueError(
+            f"repl_axis={repl_axis!r} is not a mesh axis "
+            f"{tuple(mesh.axis_names)}; build the 2-D mesh with "
+            f"make_repl_mesh(n_dev, c)")
+    if repl_axis == axis:
+        raise ValueError(
+            f"repl_axis={repl_axis!r} must differ from the block "
+            f"axis {axis!r}")
+    if feat_axis is not None:
+        raise ValueError(
+            "repl_axis composes with feat_axis=None: the k-tiling "
+            "axis already shards the feature rows across devices; "
+            "the replica groups split them across exchange rounds")
+    return int(mesh.shape[repl_axis])
+
+
+def _repl_step(step, mesh: Mesh, axis: str, repl_axis: str,
+               xt_pos: int = -1):
+    """2.5D replicated schedule (graft-repl): wrap a feature-major
+    step so each replica group runs it on only the static feature
+    slab it owns (k/c rows), then scatters the result back into a
+    full-k partial carriage (zeros outside the owned slab).  Every
+    collective inside the step names only the block axis, so it runs
+    within the replica group on a 1/c-width payload; SpMM is
+    column-separable, so the partial carriage is closed under
+    iteration and the masked ``psum`` merging the replicas is
+    deferred to gather time (``routing.repl_merge_t``) — its cost is
+    the 2.5D scheme's ``reduce_bytes``, paid once per gather rather
+    than per step."""
+    from arrow_matrix_tpu.parallel.routing import (
+        repl_slab_scatter_t,
+        repl_slab_take_t,
+    )
+
+    def wrapped(*args):
+        args = list(args)
+        pos = xt_pos if xt_pos >= 0 else len(args) + xt_pos
+        xt = args[pos]
+        k = xt.shape[0]
+        with jax.named_scope("repl_slab_take"):
+            args[pos] = repl_slab_take_t(xt, mesh, axis, repl_axis)
+        out = step(*args)
+        with jax.named_scope("repl_slab_scatter"):
+            return repl_slab_scatter_t(out, k, mesh, axis, repl_axis)
+
+    return wrapped
+
+
 class SellSlim:
     """One arrow matrix distributed over a mesh axis in padding-free
     layouts (see module docstring).  API mirrors the other layouts:
@@ -899,11 +955,14 @@ class SellSlim:
     def __init__(self, matrix: CsrLike, width: int, mesh: Mesh,
                  axis: str = "blocks", dtype=np.float32,
                  binary="auto", feature_dtype=None, ladder=None,
-                 overlap_slabs: int = 1):
+                 overlap_slabs: int = 1,
+                 repl_axis: Optional[str] = None):
         # The source canonicalizes (in-memory CSR up front, memmapped
         # triplets per slice): binary detection must see canonical
         # values — duplicate all-ones entries sum to non-unit weights
         # and must go weighted (triplet slices reject duplicates).
+        self.repl_axis = repl_axis
+        self.repl = _resolve_repl(mesh, axis, repl_axis)
         src = _SliceSource(matrix, mesh.shape[axis], width)
         is_binary = src.resolve_binary(binary)
         self.feature_dtype = resolve_feature_dtype(feature_dtype)
@@ -928,7 +987,20 @@ class SellSlim:
         self.overlap_slabs = int(overlap_slabs)
         raw_step = make_sharded_step(mesh, axis, width, ops.rows_out,
                                      hops=ops.hops, rem=ops.rem)
-        self._step = jax.jit(_overlap_step(raw_step, self.overlap_slabs))
+        # Wrapper order: repl outermost, overlap inside — each replica
+        # group overlap-schedules its own k/c slab (S must divide k/c).
+        step_sched = _overlap_step(raw_step, self.overlap_slabs)
+        if self.repl > 1:
+            step_sched = _repl_step(step_sched, mesh, axis, repl_axis)
+        self._step = jax.jit(step_sched)
+        if self.repl > 1:
+            from arrow_matrix_tpu.parallel.routing import repl_merge_t
+
+            self._merge = jax.jit(functools.partial(
+                repl_merge_t, mesh=mesh, axis=axis,
+                repl_axis=repl_axis))
+        else:
+            self._merge = lambda ct: ct
 
     def _feature_sharding(self):
         return NamedSharding(self.mesh, P(None, self.axis))
@@ -952,27 +1024,63 @@ class SellSlim:
         return self._step(o.body, o.head, o.head_unsort, o.orig_pos, xt)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
-        """Device (k, total_out) -> host (n, k) in original row order."""
+        """Device (k, total_out) -> host (n, k) in original row order.
+        With ``repl_axis`` the carriage is per-replica partial, so the
+        masked psum merge over the replica axis runs first
+        (``fetch_replicated`` assumes a truly replicated array)."""
         return _gather_carried(
-            fetch_replicated(ct).astype(np.float32, copy=False).T,
+            fetch_replicated(self._merge(ct)).astype(
+                np.float32, copy=False).T,
             self._oop, self.n)
+
+    def merge_carries(self, ct: jax.Array) -> jax.Array:
+        """Canonical (fully replicated) form of the carried state: the
+        2.5D masked-psum merge over the replica axis when ``repl > 1``,
+        identity otherwise.  The merged carriage is a valid bit-exact
+        resume state (the step re-extracts each replica's own slab), so
+        checkpoints MUST save this form — ``utils/checkpoint``'s host
+        path calls ``fetch_replicated``, which would silently drop the
+        other replicas' slabs from a divergent carriage."""
+        return self._merge(ct)
 
     def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
         """Paper cost model for one slim step at feature width ``k``:
         the arrow bound is O(width) rows exchanged per device — the
         head-partial reduction every non-root device contributes
         (paper Thm: communication O(n_dev * width) per iteration,
-        independent of n)."""
-        return max(self.n_dev - 1, 0) * self.width * k * itemsize
+        independent of n).  Under 2.5D replication each replica
+        group's exchanges carry a k/c feature slab, so the per-device
+        ideal scales by 1/c (n_dev is already the per-group block
+        count on a repl mesh)."""
+        return (max(self.n_dev - 1, 0) * self.width
+                * (k // max(self.repl, 1)) * itemsize)
 
-    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+    def reduce_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Per-device bytes of the 2.5D final reduction (the masked
+        psum over the replica axis at gather time); 0 when repl==1.
+        Reported as the comm account's ``reduce_bytes`` — the once-
+        per-gather price of cutting every per-step exchange by c."""
+        if self.repl <= 1:
+            return 0
+        return self.rows_out * k * itemsize
+
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4,
+                            repl: int = 1) -> int:
         """Static per-shard HBM model for one slim step at feature
         width ``k``: this device's slice of the tier stacks (every
         stack carries a leading device axis) plus the carried feature
         input and output (rows_out positions each).  obs/memview
-        judges the compiled executable against this."""
-        return (self.ops.device_nbytes() // self.n_dev
+        judges the compiled executable against this.
+
+        ``repl`` is the PLANNING multiplier for the 2.5D scheme: at
+        replication c both the operator slice and the carriage per
+        device grow exactly ×c (c-fold coarser block shards).  An
+        executor already built on a repl mesh bakes its own ×c into
+        the base (n_dev is the per-group block count) — keep the
+        default ``repl=1`` when judging it."""
+        base = (self.ops.device_nbytes() // self.n_dev
                 + 2 * self.rows_out * k * itemsize)
+        return base * max(int(repl), 1)
 
     def shard_report(self) -> dict:
         """Per-device load report from the packed tier metadata
@@ -1005,7 +1113,8 @@ class SellMultiLevel:
                  axis: str = "blocks", dtype=np.float32, binary="auto",
                  routing: str = "a2a",
                  feat_axis: Optional[str] = None, feature_dtype=None,
-                 ladder=None, overlap_slabs: int = 1):
+                 ladder=None, overlap_slabs: int = 1,
+                 repl_axis: Optional[str] = None):
         """``routing``: "a2a" (default) compiles the inter-level
         reorderings into explicit per-device send/recv tables over one
         fixed-shape all_to_all each (parallel/routing.py — tier-padding
@@ -1029,6 +1138,16 @@ class SellMultiLevel:
         self.overlap_slabs = int(overlap_slabs)
         self.routing = routing
         self.feat_axis = feat_axis
+        self.repl_axis = repl_axis
+        self.repl = _resolve_repl(mesh, axis, repl_axis,
+                                  feat_axis=feat_axis)
+        if self.repl > 1 and routing == "gather":
+            raise ValueError(
+                "repl_axis composes with routing='a2a': the GSPMD "
+                "gather lowering treats the carried features as "
+                "replicated, but the 2.5D slab carriage is divergent "
+                "across replica groups (verified corrupt, not just "
+                "reordered f32)")
         self.feature_dtype = resolve_feature_dtype(feature_dtype)
 
         if not levels:
@@ -1170,7 +1289,22 @@ class SellMultiLevel:
 
         step_sched = _overlap_step(step_packed, self.overlap_slabs,
                                    xt_pos=0)
+        # Repl outermost, overlap inside: each replica group runs the
+        # whole forward/aggregate pipeline (routes included) on its
+        # k/c feature slab, overlap-scheduled in S sub-slabs of that
+        # slab (S must divide k/c).
+        if self.repl > 1:
+            step_sched = _repl_step(step_sched, mesh, axis, repl_axis,
+                                    xt_pos=0)
         self._step = jax.jit(step_sched)
+        if self.repl > 1:
+            from arrow_matrix_tpu.parallel.routing import repl_merge_t
+
+            self._merge = jax.jit(functools.partial(
+                repl_merge_t, mesh=mesh, axis=axis,
+                repl_axis=repl_axis))
+        else:
+            self._merge = lambda ct: ct
 
         def scan_steps(xt, level_args, fwd, bwd, n):
             def body(xc, _):
@@ -1224,33 +1358,68 @@ class SellMultiLevel:
                   n=iterations)
 
     def gather_result(self, ct: jax.Array) -> np.ndarray:
+        """With ``repl_axis`` the carriage is per-replica partial, so
+        the masked psum merge over the replica axis runs first
+        (``fetch_replicated`` assumes a truly replicated array)."""
         return _gather_carried(
-            fetch_replicated(ct).astype(np.float32, copy=False).T,
+            fetch_replicated(self._merge(ct)).astype(
+                np.float32, copy=False).T,
             self._orig_of_pos0, self.n)
+
+    def merge_carries(self, ct: jax.Array) -> jax.Array:
+        """Canonical (fully replicated) form of the carried state: the
+        2.5D masked-psum merge over the replica axis when ``repl > 1``,
+        identity otherwise.  The merged carriage is a valid bit-exact
+        resume state (the step re-extracts each replica's own slab), so
+        checkpoints MUST save this form — ``utils/checkpoint``'s host
+        path calls ``fetch_replicated``, which would silently drop the
+        other replicas' slabs from a divergent carriage."""
+        return self._merge(ct)
 
     def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
         """Paper cost model for one multi-level step at feature width
         ``k``: inter-level permutation routing (only rows that change
         device, both directions) plus each level's O(width) head
         exchange — the bound the measured collective bytes are judged
-        against."""
+        against.  Under 2.5D replication every exchange carries a k/c
+        slab within its replica group, so the per-device ideal scales
+        by 1/c (the route units were already built over the coarser
+        per-group block count)."""
         n_dev = self.mesh.shape[self.axis]
         per_level_head = max(n_dev - 1, 0) * self.width
         return (self._ideal_route_units
-                + len(self.ops) * per_level_head) * k * itemsize
+                + len(self.ops) * per_level_head) \
+            * (k // max(self.repl, 1)) * itemsize
 
-    def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
+    def reduce_comm_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Per-device bytes of the 2.5D final reduction (the masked
+        psum over the replica axis at gather time); 0 when repl==1.
+        Reported as the comm account's ``reduce_bytes`` — the once-
+        per-gather price of cutting every per-step exchange by c."""
+        if self.repl <= 1:
+            return 0
+        return self.ops[0].rows_out * k * itemsize
+
+    def predicted_hbm_bytes(self, k: int, itemsize: int = 4,
+                            repl: int = 1) -> int:
         """Static per-shard HBM model for one multi-level step at
         feature width ``k``: this device's slice of every level's tier
         stacks and the inter-level route tables, plus the carried
-        feature input and output (level-0 ordering)."""
+        feature input and output (level-0 ordering).
+
+        ``repl`` is the PLANNING multiplier for the 2.5D scheme: at
+        replication c both the operator slice and the carriage per
+        device grow exactly ×c (c-fold coarser block shards).  An
+        executor already built on a repl mesh bakes its own ×c into
+        the base — keep the default ``repl=1`` when judging it."""
         from arrow_matrix_tpu.obs.memview import tree_device_bytes
 
         n_dev = self.mesh.shape[self.axis]
         ops_bytes = sum(o.device_nbytes() for o in self.ops)
         ops_bytes += tree_device_bytes(self.fwd, self.bwd)
-        return (ops_bytes // n_dev
+        base = (ops_bytes // n_dev
                 + 2 * self.ops[0].rows_out * k * itemsize)
+        return base * max(int(repl), 1)
 
     def shard_report(self) -> dict:
         """Per-device load report summed over the decomposition levels

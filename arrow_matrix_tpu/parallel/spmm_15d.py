@@ -41,6 +41,7 @@ from jax import lax
 from arrow_matrix_tpu.parallel.mesh import (
     build_global_parts,
     fetch_replicated,
+    largest_replication,  # noqa: F401  (re-export: hoisted to mesh.py)
     put_global,
     shard_map_check_kwargs,
 )
@@ -84,17 +85,6 @@ def _slab_source(a, dtype):
         return m
 
     return n, n, slab
-
-
-def largest_replication(n_dev: int) -> int:
-    """Largest power-of-two c with c**2 <= n_dev that yields a valid
-    grid, i.e. n_dev divisible by c**2 (reference auto-replication rule
-    plus its runtime divisibility requirement,
-    scripts/spmm_15d_main.py:87-96, spmm_15d.py:34-40)."""
-    c = 1
-    while (2 * c) ** 2 <= n_dev and n_dev % ((2 * c) ** 2) == 0:
-        c *= 2
-    return c
 
 
 class SpMM15D:

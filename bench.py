@@ -289,6 +289,10 @@ def _bench_config(platform: str, fmt_override: str | None = None) -> dict:
     # compute.  1 = the serial baseline; must divide k.
     cfg["overlap_slabs"] = max(
         int(os.environ.get("AMT_BENCH_OVERLAP_SLABS", "1")), 1)
+    # 2.5D replication factor (graft-repl): fold candidates run the
+    # sequential column-group schedule (bit-identical by construction,
+    # column-separable SpMM); must divide k.  1 = unreplicated.
+    cfg["repl"] = max(int(os.environ.get("AMT_BENCH_REPL", "1")), 1)
     return cfg
 
 
@@ -354,6 +358,11 @@ def run_one_candidate(fmt: str) -> None:
     slabs = max(int(cfg.get("overlap_slabs", 1)), 1)
     if slabs > 1:
         build_kwargs["overlap_slabs"] = slabs
+    # repl composes with the fold schedule only (MultiLevelArrow
+    # validates the same) — never silently attach it to hyb/auto.
+    repl = max(int(cfg.get("repl", 1)), 1)
+    if repl > 1 and build_kwargs.get("fmt") == "fold":
+        build_kwargs["repl"] = repl
     t0 = time.perf_counter()
     multi = MultiLevelArrow(levels, cfg["width"], mesh=None,
                             dense_budget=budget, **build_kwargs)
@@ -371,6 +380,8 @@ def run_one_candidate(fmt: str) -> None:
     }
     if slabs > 1:
         out["overlap_slabs"] = slabs
+    if "repl" in build_kwargs:
+        out["repl"] = build_kwargs["repl"]
     if cfg.get("k128_run"):
         # Second headline feature width (the north-star metric names 16
         # AND 128 features; BASELINE configs 3/5 are k=128), measured
@@ -638,6 +649,8 @@ def run_bench(result: dict, platform: str, device_kind: str,
         result["degraded"] = True
     if cfg["overlap_slabs"] > 1:
         result["overlap_slabs"] = cfg["overlap_slabs"]
+    if cfg["repl"] > 1:
+        result["repl"] = cfg["repl"]
     # Measurement hygiene (VERDICT item 6): the committed line records
     # the host contention at race start — a loaded host explains an
     # anomalous CPU baseline or build time without re-running anything.
@@ -850,6 +863,47 @@ def run_bench(result: dict, platform: str, device_kind: str,
             sweep[str(s)] = point
             if timed_out and _check_wedged(result, cfg,
                                            f"overlap S={s}"):
+                break   # later points would burn out against a dead link
+
+    # --- --repl sweep (graft-repl): re-measure the winning fold-family
+    # format at each requested replication factor c.  On one chip the
+    # c-group column schedule is bit-identical by construction, so the
+    # sweep is the wall-clock cost curve of the 2.5D carve-up — the
+    # compute-side half of the T(c) model (the wire-side 1/c cut needs
+    # a mesh; dryrun_multichip's repl rung measures that one).  Same
+    # per-point subprocess/timeout/gate contract as the overlap sweep.
+    repl_spec = os.environ.get("AMT_BENCH_REPL_SWEEP", "")
+    if repl_spec and not result.get("accelerator_wedged"):
+        fmt_sweep = result.get("fmt_used") or "fold"
+        if not str(fmt_sweep).startswith("fold"):
+            fmt_sweep = "fold"   # repl composes with the fold schedule
+        sweep = result["repl_sweep"] = {"fmt": fmt_sweep}
+        for tok in repl_spec.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if not tok.isdigit() or int(tok) < 1:
+                sweep[tok] = {"error": "not a positive integer"}
+                continue
+            rc = int(tok)
+            if k % rc != 0:
+                sweep[str(rc)] = {"error": f"c={rc} does not divide "
+                                           f"k={k}"}
+                continue
+            _progress(f"repl sweep: fmt={fmt_sweep} c={rc}")
+            run = _spawn_candidate(
+                fmt_sweep, dict(cfg, repl=rc, k128=False),
+                timeout_s=900.0)
+            timed_out = run.pop("timed_out", False)
+            point = {kk: run[kk]
+                     for kk in ("ms", "err", "error", "host_load")
+                     if run.get(kk) is not None}
+            if ("err" in point and np.isfinite(point["err"])
+                    and point["err"] > tol):
+                point["gate_missed"] = tol
+            sweep[str(rc)] = point
+            if timed_out and _check_wedged(result, cfg,
+                                           f"repl c={rc}"):
                 break   # later points would burn out against a dead link
 
 
@@ -1086,6 +1140,16 @@ def main() -> None:
                   file=sys.stderr)
             raise SystemExit(2)
         os.environ["AMT_BENCH_OVERLAP_SWEEP"] = sys.argv[i + 1]
+    # --repl 1,2,4: sweep the winning fold format over the listed 2.5D
+    # replication factors after the race (graft-repl) — same env
+    # threading as the overlap sweep.
+    if "--repl" in sys.argv:
+        i = sys.argv.index("--repl")
+        if i + 1 >= len(sys.argv):
+            print("--repl needs a comma list, e.g. 1,2,4",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        os.environ["AMT_BENCH_REPL_SWEEP"] = sys.argv[i + 1]
     # Deadline alarm: the parent spends its time in subprocess waits
     # (interruptible), so SIGALRM fires reliably here even when a
     # child is wedged inside native code.  AMT_BENCH_DEADLINE=0

@@ -38,13 +38,14 @@ def test_ladder_registry_importable():
         "decompose_1e8_grid", "decompose_1e8_ba",
         "rehearse_1e8_ba_step",
         "backend_race22", "backend_race23",
-        "dryrun_multichip_mid"}
+        "dryrun_multichip_mid", "dryrun_repl_sweep"}
     # The 1e8 rungs are opt-in: a bare `python tools/scale_ladder.py`
     # must stay bounded (the BA 2^27 rungs need ~hours and tens of GB).
-    # The mid multichip dryrun is opt-in too (~15 min on this host).
+    # The mid multichip dryrun and the repl sweep are opt-in too.
     assert set(mod.DEFAULT_RUNGS) == set(mod.RUNGS) - {
         "decompose_1e8_grid", "decompose_1e8_ba",
-        "rehearse_1e8_ba_step", "dryrun_multichip_mid"}
+        "rehearse_1e8_ba_step", "dryrun_multichip_mid",
+        "dryrun_repl_sweep"}
 
 
 def test_recorded_ladder_results_pass_their_gates():

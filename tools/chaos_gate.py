@@ -23,6 +23,11 @@ checkpoint resume), and that the recovered run's final X is
              mid-iteration in a checkpointing spmm_arrow run; a rerun
              resumes from the last checkpoint and finishes with the
              same final state as a never-killed run.
+  kill_repl— (subprocess; skipped under ``--fast``) the same SIGKILL
+             under 2.5D replication (--fmt sell --repl 2): the saved
+             checkpoint must be the canonical merged carriage (the
+             Supervisor ``canonicalize`` hook), so the resumed run is
+             still bit-identical to the never-killed replicated run.
 
 Exits 0 when every scenario passes, 1 otherwise.  Determinism is the
 whole contract: recovery re-runs the same compiled step from the same
@@ -237,6 +242,71 @@ def scenario_kill(workdir):
     return problems
 
 
+def scenario_kill_repl(workdir):
+    """scenario_kill under 2.5D replication (``--repl 2`` on the
+    4-device gate, k=4 so each replica group owns a 2-feature slab).
+    Exercises the graft-repl checkpoint contract: the Supervisor's
+    ``canonicalize`` hook must merge the per-replica-group partial
+    carriage before saving, or the resumed run diverges."""
+    from arrow_matrix_tpu.utils.checkpoint import load_state
+
+    problems = []
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("AMT_FAULT_PLAN", None)
+    ck_ok = os.path.join(workdir, "ck_ref_repl")
+    ck_kill = os.path.join(workdir, "ck_kill_repl")
+    cmd = [sys.executable, "-m", "arrow_matrix_tpu.cli.spmm_arrow",
+           "--vertices", str(N), "--width", str(WIDTH),
+           "--features", str(K), "--device", "cpu", "--carry", "true",
+           "--seed", str(SEED), "--iterations", str(ITERS),
+           "--checkpoint_every", "2", "--fmt", "sell", "--repl", "2",
+           "--logdir", os.path.join(workdir, "logs_repl")]
+
+    def run(extra, fault_env=None):
+        e = dict(env)
+        if fault_env:
+            e["AMT_FAULT_PLAN"] = fault_env
+        return subprocess.run(cmd + extra, env=e, cwd=workdir,
+                              capture_output=True, text=True,
+                              timeout=600)
+
+    r = run(["--checkpoint", ck_ok])
+    if r.returncode != 0:
+        return [f"kill_repl: fault-free reference run failed rc="
+                f"{r.returncode}: {r.stderr[-500:]}"]
+    plan = json.dumps({"scenario": "kill", "site": "*.step",
+                       "after": 5})
+    r = run(["--checkpoint", ck_kill], fault_env=plan)
+    if r.returncode == 0:
+        return ["kill_repl: injected SIGKILL did not terminate the run"]
+    mid = load_state(ck_kill)
+    if mid is None:
+        return ["kill_repl: no checkpoint survived the SIGKILL"]
+    if mid[1] != 4:
+        problems.append(f"kill_repl: expected the step-4 checkpoint to "
+                        f"survive, found step {mid[1]}")
+    r = run(["--checkpoint", ck_kill])
+    if r.returncode != 0:
+        return problems + [f"kill_repl: resume run failed rc="
+                           f"{r.returncode}: {r.stderr[-500:]}"]
+    if "resumed" not in r.stdout:
+        problems.append("kill_repl: rerun did not report resuming from "
+                        "the checkpoint")
+    a = load_state(ck_ok)
+    b = load_state(ck_kill)
+    if a is None or b is None:
+        return problems + ["kill_repl: final checkpoints missing"]
+    if a[1] != ITERS or b[1] != ITERS:
+        problems.append(f"kill_repl: final steps {a[1]}/{b[1]} != "
+                        f"{ITERS}")
+    if _final_bytes(a[0]) != _final_bytes(b[0]):
+        problems.append("kill_repl: resumed replicated run's final X "
+                        "is not bit-identical to the never-killed run")
+    return problems
+
+
 def run_gate(workdir, fast=False):
     """Run the matrix; returns (problems, scenarios_run)."""
     from arrow_matrix_tpu import faults
@@ -259,6 +329,8 @@ def run_gate(workdir, fast=False):
         if not fast:
             scenarios.append("kill")
             problems += scenario_kill(workdir)
+            scenarios.append("kill_repl")
+            problems += scenario_kill_repl(workdir)
         kinds = {e.get("kind") for e in rec.events}
         if "fault" not in kinds or "heal" not in kinds:
             problems.append(f"flight recorder saw kinds {sorted(kinds)}"

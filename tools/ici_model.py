@@ -167,6 +167,50 @@ def main() -> None:
           f"(e.g. {tc - sc} extra launches x >"
           f"{lat_floor * 1e6 / max(tc - sc, 1):.0f} us each: "
           f"DCN-class links or sub-ms levels)")
+    repl_sweep(n, mi)
+
+
+def repl_sweep(n: int, mi: dict, k: int = 16) -> None:
+    """2.5D replication crossover (graft-repl): T(c) = compute +
+    bytes/(c*bw) + n_coll*lat + reduce(c)/bw over the named ICI
+    points, with bytes/n_coll from the c=1 lowered HLO.  Replication
+    divides only the wire term — the crossover is where the exchange
+    stops dominating the latency floor and the amortized final merge,
+    which is exactly what ``obs.comm.auto_repl`` minimizes (subject
+    to its HBM-budget certificate)."""
+    from arrow_matrix_tpu.obs.comm import auto_repl, repl_predict_ms
+
+    K, n_dev, slots = mi["K"], mi["n_dev"], mi["slots"]
+    tb, tc = mi["time"]
+    compute_ms = sum(slots) / n_dev / GATHER_ROWS_PER_S * 1e3
+    # Per-device final-merge payload: the carried output slab.
+    reduce_bytes = -(-n // n_dev) * k * 4
+    iters = 10  # merge amortized over a representative carried run
+    print()
+    print("2.5D replication sweep (time-shared sell/a2a step, "
+          f"merge amortized over {iters} iters):")
+    print(f"{'ICI point':28} {'lat us':>7} "
+          + "".join(f"{f'c={c} ms':>10}" for c in (1, 2, 4))
+          + "  chosen c")
+    for name, bw in ICI_POINTS.items():
+        for lat in LATENCIES_US:
+            t_c = [repl_predict_ms(c, tb, n_coll=tc,
+                                   compute_ms=compute_ms,
+                                   reduce_bytes=reduce_bytes,
+                                   iterations=iters,
+                                   link_bytes_per_s=bw * 1e9,
+                                   latency_s=lat * 1e-6)
+                   for c in (1, 2, 4)]
+            plan = auto_repl(n_dev, k, base_hbm_bytes=0,
+                             exchange_bytes=tb, n_coll=tc,
+                             compute_ms=compute_ms,
+                             reduce_bytes=reduce_bytes,
+                             iterations=iters,
+                             link_bytes_per_s=bw * 1e9,
+                             latency_s=lat * 1e-6, quiet=True)
+            print(f"{name:28} {lat:7.0f} "
+                  + "".join(f"{t:10.3f}" for t in t_c)
+                  + f"  c={plan['c']}")
 
 
 if __name__ == "__main__":

@@ -50,11 +50,22 @@ def comm_problems(summary: dict) -> list:
     """Gate problems from the comm section: every algorithm's comm
     report must carry the ``exposed_comm_ms`` field (graft-stream) —
     a comm account without the exposed-time model can't state whether
-    the overlap schedule is doing its job."""
+    the overlap schedule is doing its job.  A replicated run
+    (``repl > 1``, graft-repl) must additionally carry its ``repl``
+    and ``reduce_bytes`` fields: a 2.5D report that hides the final
+    merge's cost (or the factor that bought the exchange cut) is not
+    an account."""
     problems = []
     for name, rec in sorted(summary.get("algorithms", {}).items()):
         if rec.get("exposed_comm_ms") is None:
             problems.append(f"{name}: comm report lacks exposed_comm_ms")
+        if rec.get("repl", 1) is None or rec.get("repl", 1) > 1:
+            if "repl" not in rec or rec.get("repl") is None:
+                problems.append(f"{name}: repl>1 run lacks repl field")
+            if rec.get("reduce_bytes") is None:
+                problems.append(
+                    f"{name}: repl>1 comm report lacks reduce_bytes "
+                    f"(the 2.5D final-merge cost)")
     return problems
 
 

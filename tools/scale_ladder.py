@@ -401,6 +401,19 @@ def rung_dryrun_multichip_mid() -> dict:
     return ge.dryrun_multichip(8, scale="mid")
 
 
+def rung_dryrun_repl_sweep() -> dict:
+    """2.5D replication sweep (graft-repl): fold + fixed-B sell-a2a at
+    c in {1,2,4} on an 8-device virtual CPU mesh, enforcing the honest
+    contract — bit-identical results at every c and measured wire
+    bytes exactly 1/c — plus the 8-device c=1 production reference.
+    The rung FAILS (non-zero exit) if either invariant breaks; the
+    committed record is the evidence PERFORMANCE.md's 2.5D section
+    cites."""
+    import __graft_entry__ as ge
+
+    return ge.dryrun_multichip(8, scale="repl")
+
+
 def rung_backend_race22() -> dict:
     return _backend_race(N22)
 
@@ -415,18 +428,21 @@ RUNGS = {"decompose24": rung_decompose24, "ingest24": rung_ingest24,
          "decompose_1e8_ba": rung_decompose_1e8_ba,
          "rehearse_1e8_ba_step": rung_rehearse_1e8_ba_step,
          "dryrun_multichip_mid": rung_dryrun_multichip_mid,
+         "dryrun_repl_sweep": rung_dryrun_repl_sweep,
          "backend_race22": rung_backend_race22,
          "backend_race23": rung_backend_race23}
 
 #: What a bare `python tools/scale_ladder.py` runs.  The 1e8 rungs are
 #: opt-in by explicit name: the BA 2^27 decompose needs hour-plus wall
 #: clock and tens of GB of RSS — a no-arg ladder run must stay bounded.
-#: The mid-scale multichip dry run is opt-in too: it is VERDICT-item
-#: evidence gathering, not part of the bounded default sweep.
+#: The mid-scale multichip dry run and the 2.5D repl sweep are opt-in
+#: too: they are evidence gathering, not part of the bounded default
+#: sweep.
 DEFAULT_RUNGS = [r for r in RUNGS
                  if r not in ("decompose_1e8_grid", "decompose_1e8_ba",
                               "rehearse_1e8_ba_step",
-                              "dryrun_multichip_mid")]
+                              "dryrun_multichip_mid",
+                              "dryrun_repl_sweep")]
 
 
 def main() -> None:
