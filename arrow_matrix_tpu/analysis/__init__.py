@@ -1,26 +1,37 @@
-"""graft-lint: static analysis for the JAX/TPU hot paths.
+"""graft-lint + graft-prove: static analysis for the JAX/TPU hot paths.
 
-Two complementary engines guard the invariants the benches depend on
+Three complementary engines guard the invariants the benches depend on
 (PERFORMANCE.md measurement discipline):
 
 * **AST pass** (`core` + `rules`): a visitor-based linter over the
   package source with an extensible rule registry.  The shipped rules
-  (R1-R7) encode the recompilation, host-sync, and sharding hazards
-  that silently destroy TPU throughput — the class of bug an MPI code
-  never meets but a jit/shard_map code re-discovers one bench
-  regression at a time.
+  (R1-R9, enumerated in rules.py) encode the recompilation, host-sync,
+  sharding, and hot-loop-env hazards that silently destroy TPU
+  throughput — the class of bug an MPI code never meets but a
+  jit/shard_map code re-discovers one bench regression at a time.
 * **Trace-time audit** (`audit`): jit-compiles the core SpMM entry
   points on the host CPU mesh and asserts zero recompiles across two
   same-shape calls, recording a compile-count manifest under
   ``bench_cache/`` so compile-cache regressions diff in review.
+* **HLO contract prover** (`prove` + `contracts`): lowers every
+  distributed executor on a virtual mesh, parses the optimized HLO,
+  and checks six static rules (H1-H6) against the executor's declared
+  ``collective_contract`` — no unattributed collectives, bytes within
+  tolerance of the ideal model, the repl=c ÷c slab law plus exactly
+  the priced psum merge, no silent dtype upcasts, donated buffers
+  actually aliased, no layout thrash in the hot loop.  Verdicts land
+  in the checked-in ``bench_cache/hlo_manifest.json``.
 
-Run ``python -m arrow_matrix_tpu.analysis <paths>`` to lint and
-``python -m arrow_matrix_tpu.analysis audit`` for the trace audit;
-``graft_lint`` is the installed console script (tools/lint_gate.py is
-the CI wrapper).  Findings are suppressed inline with
-``# graft-lint: disable=R1`` (see core.WAIVER_RE).
+Run ``python -m arrow_matrix_tpu.analysis <paths>`` to lint,
+``python -m arrow_matrix_tpu.analysis audit`` for the trace audit, and
+``python -m arrow_matrix_tpu.analysis prove`` for the HLO proof;
+``graft_lint`` / ``graft_prove`` are the installed console scripts
+(tools/lint_gate.py and tools/proof_gate.py are the CI wrappers).
+Findings are suppressed inline with ``# graft-lint: disable=R1``
+(see core.WAIVER_RE).
 """
 
+from arrow_matrix_tpu.analysis.contracts import CollectiveContract
 from arrow_matrix_tpu.analysis.core import (
     Finding,
     lint_file,
@@ -30,6 +41,7 @@ from arrow_matrix_tpu.analysis.core import (
 )
 
 __all__ = [
+    "CollectiveContract",
     "Finding",
     "lint_file",
     "lint_paths",

@@ -1,0 +1,93 @@
+"""Collective contracts: what each distributed executor PROMISES its
+compiled program looks like (graft-prove engine 3, the static twin of
+obs/comm's measured/ideal ratio).
+
+A ``CollectiveContract`` is exported by every parallel executor
+(``collective_contract(k)``) and declares, for one step at feature
+width ``k``:
+
+* which collective op kinds the lowered (explicit shard_map) and
+  compiled (post-GSPMD) HLO may legitimately contain — anything else
+  is a partitioner surprise (H1);
+* the ideal per-step exchange bytes (``ideal_comm_bytes``, already
+  divided by the 2.5D replication factor c) and the accepted
+  measured/ideal ratio band — the HLO accountant counts per-device
+  output shapes while the paper model counts logical row traffic, so
+  each executor carries its own empirically-grounded tolerance (H2);
+* the replication factor, overlap slab count, and the priced psum
+  merge bytes (``reduce_comm_bytes``) the ÷c law is checked against
+  (H3);
+* the carried feature dtype (H4) and the flat HLO parameter numbers
+  a donated entry point must alias (H5);
+* the hot-loop copy budget XLA's while-loop copy insertion is allowed
+  (H6 — transposes are never allowed).
+
+The contract is a plain frozen value: analysis/prove.py consumes it,
+and ``to_json`` makes it diffable inside bench_cache/hlo_manifest.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveContract:
+    """One executor's static communication promise at feature width k."""
+
+    algorithm: str
+    #: Ideal per-step exchange bytes (paper cost model; already ÷c).
+    step_bytes: int
+    #: Once-per-gather 2.5D psum merge bytes (0 when repl == 1).
+    reduce_bytes: int
+    #: 2.5D replication factor c.
+    repl: int
+    #: Overlap schedule slab count S (each collective carries k/(c·S)).
+    overlap_slabs: int
+    #: Carried feature dtype short name ("f32", "bf16", ...).
+    dtype: str
+    #: Collective kinds the LOWERED (pre-partitioning) step may contain.
+    lowered_kinds: Tuple[str, ...]
+    #: Collective kinds the COMPILED (post-GSPMD) step may contain.
+    compiled_kinds: Tuple[str, ...]
+    #: Accepted measured/ideal byte ratio (lo, hi) for H2.
+    ratio_band: Tuple[float, float]
+    #: Flat HLO parameter numbers the donated entry point must alias
+    #: (empty = the executor ships no donated entry point; H5 skips).
+    donated_params: Tuple[int, ...] = ()
+    #: While-body copies tolerated in the hot loop (XLA's loop copy
+    #: insertion is benign up to this; transposes are never allowed).
+    hot_copy_budget: int = 8
+    #: Non-empty exempts H3 with this rationale (e.g. 1.5D replication
+    #: reduces broadcast rounds instead of slab width).
+    h3_exempt: str = ""
+    #: Free-text pricing notes surfaced in the manifest.
+    notes: str = ""
+
+    def __post_init__(self):
+        if self.repl < 1:
+            raise ValueError(f"repl must be >= 1, got {self.repl}")
+        if self.overlap_slabs < 1:
+            raise ValueError(
+                f"overlap_slabs must be >= 1, got {self.overlap_slabs}")
+        lo, hi = self.ratio_band
+        if not (0 <= lo <= hi):
+            raise ValueError(f"ratio_band must be 0 <= lo <= hi, "
+                             f"got {self.ratio_band}")
+        if self.step_bytes < 0 or self.reduce_bytes < 0:
+            raise ValueError("byte counts must be non-negative")
+
+    def expected_slab(self, k: int) -> int:
+        """Leading feature dimension every collective in the lowered
+        step must carry: the k/(c·S) slab of the 2.5D + overlap
+        schedule (the statically-visible form of the ÷c law)."""
+        return k // self.repl // self.overlap_slabs
+
+    def to_json(self) -> dict:
+        rec = dataclasses.asdict(self)
+        rec["lowered_kinds"] = sorted(self.lowered_kinds)
+        rec["compiled_kinds"] = sorted(self.compiled_kinds)
+        rec["ratio_band"] = list(self.ratio_band)
+        rec["donated_params"] = list(self.donated_params)
+        return rec

@@ -1,4 +1,14 @@
-"""The shipped graft-lint rules (R1-R7).
+"""The shipped graft-lint rules (R1-R9).
+
+* R1 host-sync-in-jit — float()/.item()/np.asarray on traced values
+* R2 recompile-hazard — jit-in-loop, jit-then-call, unhashable statics
+* R3 missing-donation — scan-carry entry points jitted undonated
+* R4 spec-axis-consistency — PartitionSpec axes the mesh never declares
+* R5 dtype-promotion — bare float literals in traced arithmetic
+* R6 unguarded-device-get — unbounded device->host fetches
+* R7 unsynced-timing — perf_counter regions with no block_until_ready
+* R8 swallowed-exception — broad except handlers that only discard
+* R9 env-read-in-step — AMT_* environment reads inside the hot loop
 
 Each rule encodes a hazard this codebase has actually met (or defends
 against by convention), grounded at the call sites named in its
@@ -662,3 +672,64 @@ def check_swallowed_exception(ctx: ModuleContext
             f"expects, or record the fault (obs.flight / metrics) "
             f"before continuing; a deliberate broad swallow takes an "
             f"inline `# graft-lint: disable=R8` waiver")
+
+
+# ---------------------------------------------------------------------------
+# R9 — env-read-in-step
+# ---------------------------------------------------------------------------
+
+#: Spellings of an environment read, post alias resolution.
+_ENV_GETTERS = frozenset({"os.getenv", "os.environ.get"})
+
+
+def _env_read_name(ctx: ModuleContext, node) -> Optional[str]:
+    """The constant variable name an expression reads from the
+    environment, or None when it is not an env read / not constant."""
+    if isinstance(node, ast.Call):
+        if ctx.resolve(node.func) in _ENV_GETTERS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+    elif isinstance(node, ast.Subscript):
+        if ctx.resolve(node.value) == "os.environ":
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                return sl.value
+    return None
+
+
+@register("R9", "env-read-in-step",
+          "os.environ/os.getenv reads of AMT_* knobs inside a jitted "
+          "step function or a per-iteration loop re-read host state "
+          "every step; resolve the knob once at build time")
+def check_env_read_in_step(ctx: ModuleContext) -> Iterable[Tuple[int, str]]:
+    """AMT_* environment reads on the per-step path.
+
+    The AMT_* knobs are build-time configuration (the pallas_sell.py
+    fuse gate, decompose worker counts, the comm chunk sizes): every
+    shipped read happens once at module import or object construction.
+    An ``os.environ.get("AMT_...")`` inside a function handed to
+    jax.jit/shard_map is worse than slow — the value is baked at TRACE
+    time, so flipping the knob later silently does nothing while the
+    code reads as if it were live.  Inside a per-iteration loop it is a
+    dict probe plus getenv lock on the hot path and drifts the bench
+    timings the obs layer records.  Hoist the read to build time and
+    thread the value in as an argument or closure constant; a
+    deliberate per-step read (e.g. a chaos-gate probe) takes an inline
+    ``# graft-lint: disable=R9`` waiver stating why.
+    """
+    for node in ast.walk(ctx.tree):
+        name = _env_read_name(ctx, node)
+        if name is None or not name.startswith("AMT_"):
+            continue
+        if ctx.in_traced_scope(node):
+            yield node.lineno, (
+                f"environment read of {name!r} inside a jitted scope is "
+                f"baked at trace time (silently stale after the first "
+                f"compile); hoist it to build time and pass the value in")
+        elif (ctx.in_loop(node)
+              and ctx.enclosing_function(node) is not None):
+            yield node.lineno, (
+                f"environment read of {name!r} inside a per-iteration "
+                f"loop probes host state every step; resolve the knob "
+                f"once before the loop")

@@ -137,6 +137,39 @@ def probe_lint() -> tuple[bool, str]:
         return False, f"{type(e).__name__}: {str(e)[:100]}"
 
 
+def probe_prove() -> tuple[bool, str]:
+    """graft-prove health: the H1-H3 checkers must trip on a planted
+    surprise all-gather (in-process selftest, host-only), and the
+    checked-in HLO contract manifest — when the working tree carries
+    one — must record every contract proven.  The full prover
+    (`python -m arrow_matrix_tpu.analysis prove`) compiles on a
+    virtual mesh and is the lint_gate/--prove and tier-1 job, not a
+    doctor probe."""
+    try:
+        from arrow_matrix_tpu.analysis import prove
+
+        if not prove.selftest():
+            return False, ("selftest failed: a planted surprise "
+                           "all-gather did not trip H1-H3")
+        mpath = prove.DEFAULT_MANIFEST
+        if os.path.isfile(mpath):
+            import json
+
+            with open(mpath, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+            if not manifest.get("ok"):
+                return False, f"{mpath} records violated contracts"
+            detail = (f"gate trips on planted surprises; {mpath}: "
+                      f"{len(manifest.get('entries', ()))} entries ok")
+        else:
+            detail = ("gate trips on planted surprises; no checked-in "
+                      "manifest here — run `python -m "
+                      "arrow_matrix_tpu.analysis prove`")
+        return True, detail
+    except Exception as e:  # the doctor must never crash on a probe
+        return False, f"{type(e).__name__}: {str(e)[:100]}"
+
+
 def probe_obs() -> tuple[bool, str]:
     """graft-scope round-trip: the obs layer imports and a minimal
     smoke trace (one algorithm, 2 devices) produces a valid run
@@ -224,7 +257,11 @@ def main(argv=None) -> int:
     _check("native decomposer", n, detail)
 
     lint_ok, detail = probe_lint()
-    ok &= _check("graft-lint (static analysis, R1-R7)", lint_ok, detail)
+    ok &= _check("graft-lint (static analysis, R1-R9)", lint_ok, detail)
+
+    prove_ok, detail = probe_prove()
+    ok &= _check("graft-prove (HLO collective contracts, H1-H6)",
+                 prove_ok, detail)
 
     obs_ok, detail = probe_obs()
     ok &= _check("graft-scope (obs smoke trace)", obs_ok, detail)

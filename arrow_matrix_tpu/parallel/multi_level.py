@@ -870,6 +870,55 @@ class MultiLevelArrow:
         SellMultiLevel.reduce_comm_bytes for the mesh scheme)."""
         return 0
 
+    def collective_contract(self, k: int, itemsize: int = 4):
+        """Static communication promise for graft-prove, by execution
+        mode: the a2a routing writes explicit all-to-alls (GSPMD's
+        partitioning of the sharded level compute may additionally
+        lower to all-reduce/collective-permute — declared, so H1 trips
+        only on a genuine surprise all-gather); the gather routing
+        leaves the exchanges to GSPMD entirely; a single chip (and
+        fmt='fold', including its repl>1 column-group schedule) is the
+        zero-communication end of the T(c) model.  The donated scan
+        entry carries the features as flat param 0 (H5)."""
+        from arrow_matrix_tpu.analysis.contracts import CollectiveContract
+
+        single_chip = self.mesh is None or getattr(
+            self, "routing", "none") == "none"
+        if single_chip:
+            lowered_kinds = compiled_kinds = ()
+        elif self.routing == "a2a":
+            lowered_kinds = ("all-to-all",)
+            compiled_kinds = ("all-to-all", "all-reduce",
+                              "collective-permute")
+        else:  # routing == "gather": exchanges are GSPMD's to choose
+            lowered_kinds = ()
+            compiled_kinds = ("all-gather", "all-reduce",
+                              "collective-permute", "all-to-all")
+        return CollectiveContract(
+            algorithm="multi_level",
+            step_bytes=self.ideal_comm_bytes(k, itemsize),
+            reduce_bytes=self.reduce_comm_bytes(k, itemsize),
+            repl=self.repl,
+            overlap_slabs=self.overlap_slabs,
+            dtype=np.dtype(self.feature_dtype or np.float32).name
+            .replace("float", "f").replace("bfloat", "bf"),
+            lowered_kinds=lowered_kinds,
+            compiled_kinds=compiled_kinds,
+            ratio_band=(0.25, 4.0),
+            donated_params=(0,),
+            # One XLA loop-copy set per while body (iteration scan +
+            # per-level inner scans), multiplied by the S overlap
+            # sub-steps; transposes stay forbidden.
+            hot_copy_budget=16 * self.overlap_slabs,
+            h3_exempt=("single-chip fold repl is a column-group "
+                       "schedule over ZERO collectives: there is no "
+                       "exchange to carry a slab and no merge to price "
+                       "(disjoint slabs concatenate)"
+                       if single_chip and self.repl > 1 else ""),
+            notes="flat row-major carriage: the routed a2a moves "
+                  "(rows, k) slices, so the ÷c slab law lives in the "
+                  "SELL feature-major executors")
+
     def predicted_hbm_bytes(self, k: int, itemsize: int = 4,
                             repl: int = 1) -> int:
         """Static per-shard HBM model for one step at feature width

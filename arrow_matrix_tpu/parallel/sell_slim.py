@@ -1064,6 +1064,30 @@ class SellSlim:
             return 0
         return self.rows_out * k * itemsize
 
+    def collective_contract(self, k: int, itemsize: int = 4):
+        """Static communication promise for graft-prove (analysis/
+        contracts.py): the slim step's only exchange is the head-partial
+        psum (all-reduce) over the block axis, carrying the k/(c·S)
+        feature slab; the measured/ideal band covers the HLO accountant
+        counting per-device padded output shapes against the paper's
+        logical O(width) row bound."""
+        from arrow_matrix_tpu.analysis.contracts import CollectiveContract
+
+        return CollectiveContract(
+            algorithm="sell_slim",
+            step_bytes=self.ideal_comm_bytes(k, itemsize),
+            reduce_bytes=self.reduce_comm_bytes(k, itemsize),
+            repl=self.repl,
+            overlap_slabs=self.overlap_slabs,
+            dtype=np.dtype(self.feature_dtype or np.float32).name
+            .replace("float", "f").replace("bfloat", "bf"),
+            lowered_kinds=("all-reduce",),
+            compiled_kinds=("all-reduce",),
+            ratio_band=(0.25, 4.0),
+            notes="HLO counts the psum's padded (slab, rows_out) "
+                  "output per device; the ideal counts (n_dev-1)*width "
+                  "logical rows")
+
     def predicted_hbm_bytes(self, k: int, itemsize: int = 4,
                             repl: int = 1) -> int:
         """Static per-shard HBM model for one slim step at feature
@@ -1399,6 +1423,36 @@ class SellMultiLevel:
         if self.repl <= 1:
             return 0
         return self.ops[0].rows_out * k * itemsize
+
+    def collective_contract(self, k: int, itemsize: int = 4):
+        """Static communication promise for graft-prove: the a2a
+        routing tables exchange inter-level rows (all-to-all) and each
+        level's head partials psum over the block axis (all-reduce),
+        every collective carrying the k/(c·S) feature slab.  The scan
+        entry point donates the carried features (flat param 0), so
+        the prover additionally demands input-output aliasing (H5)."""
+        from arrow_matrix_tpu.analysis.contracts import CollectiveContract
+
+        return CollectiveContract(
+            algorithm="sell_multi",
+            step_bytes=self.ideal_comm_bytes(k, itemsize),
+            reduce_bytes=self.reduce_comm_bytes(k, itemsize),
+            repl=self.repl,
+            overlap_slabs=self.overlap_slabs,
+            dtype=np.dtype(self.feature_dtype or np.float32).name
+            .replace("float", "f").replace("bfloat", "bf"),
+            lowered_kinds=("all-to-all", "all-reduce"),
+            compiled_kinds=("all-to-all", "all-reduce"),
+            ratio_band=(0.25, 4.0),
+            donated_params=(0,),
+            # XLA's while-loop copy insertion lands one copy set per
+            # loop body (outer iteration scan + per-level hop scans),
+            # and the overlap schedule multiplies the bodies by S;
+            # transposes stay forbidden.
+            hot_copy_budget=16 * self.overlap_slabs,
+            notes="a2a fixed-slot padding and per-level psum padding "
+                  "sit above the moved-row ideal; the band absorbs "
+                  "both")
 
     def predicted_hbm_bytes(self, k: int, itemsize: int = 4,
                             repl: int = 1) -> int:

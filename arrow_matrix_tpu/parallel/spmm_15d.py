@@ -294,6 +294,35 @@ class SpMM15D:
             per_dev += self.l_ni
         return n_dev * per_dev * k * itemsize
 
+    def collective_contract(self, k: int, itemsize: int = 4):
+        """Static communication promise for graft-prove: the 1.5D step
+        is pure psum — the masked broadcast of each round's X block
+        over the grid column and (c > 1) the replica reduction of the
+        partials, both all-reduce in HLO.  The 1.5D replication scheme
+        cuts the ROUND COUNT (p/c² broadcasts instead of p/c), not the
+        per-collective slab width, and its replica all-reduce is part
+        of the step itself — so the ÷c slab law (H3) does not apply
+        and reduce_bytes stays 0 (no deferred merge)."""
+        from arrow_matrix_tpu.analysis.contracts import CollectiveContract
+
+        return CollectiveContract(
+            algorithm="spmm_15d",
+            step_bytes=self.ideal_comm_bytes(k, itemsize),
+            reduce_bytes=0,
+            repl=self.c,
+            overlap_slabs=1,
+            dtype="f32",
+            lowered_kinds=("all-reduce",),
+            compiled_kinds=("all-reduce",),
+            ratio_band=(0.02, 1.5),
+            h3_exempt="1.5D replication reduces broadcast rounds, not "
+                      "slab width; the replica all-reduce is priced "
+                      "inside ideal_comm_bytes, not as a deferred merge",
+            notes="ideal counts the reference's global logical volume "
+                  "(n_dev * rounds * l_nkb rows); HLO counts one "
+                  "device's psum outputs once per op — hence the low "
+                  "ratio floor")
+
     def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
         """Static per-shard HBM model for one 1.5D step at feature
         width ``k``: this device's slice of the round-blocked ELL

@@ -445,6 +445,28 @@ class MatrixSlice1D:
         measured/ideal ratio exposes."""
         return self._ideal_route_rows * k * itemsize
 
+    def collective_contract(self, k: int, itemsize: int = 4):
+        """Static communication promise for graft-prove: the petsc-1D
+        step's only exchange is the fixed-slot nonlocal-row all_to_all
+        (no replication, no overlap schedule, no donated entry).  HLO
+        counts one device's fixed-slot tuple once; the ideal counts
+        every device's requested rows — hence a ratio well under 1 at
+        small scale."""
+        from arrow_matrix_tpu.analysis.contracts import CollectiveContract
+
+        return CollectiveContract(
+            algorithm="spmm_1d",
+            step_bytes=self.ideal_comm_bytes(k, itemsize),
+            reduce_bytes=0,
+            repl=1,
+            overlap_slabs=1,
+            dtype="f32",
+            lowered_kinds=("all-to-all",),
+            compiled_kinds=("all-to-all",),
+            ratio_band=(0.05, 2.0),
+            notes="fixed-slot a2a padding vs requested-row ideal "
+                  "(the reference Alltoallv payload)")
+
     def predicted_hbm_bytes(self, k: int, itemsize: int = 4) -> int:
         """Static per-shard HBM model for one step at feature width
         ``k``: this device's slice of the ELL stacks and exchange

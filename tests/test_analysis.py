@@ -1,5 +1,5 @@
 """graft-lint (arrow_matrix_tpu.analysis) — one positive and one
-negative fixture per rule R1-R7, the waiver machinery, the
+negative fixture per rule R1-R9, the waiver machinery, the
 package-clean gate (the shipped tree must lint clean, the same
 invariant amt_doctor and tools/lint_gate.py enforce), and a
 reduced-scale run of the trace-time recompile audit."""
@@ -192,6 +192,30 @@ FIXTURES = {
                 raise
         """,
     ),
+    "R9": (
+        # AMT_* environment read inside a jitted step function: the
+        # value is baked at trace time, so flipping the knob after the
+        # first compile silently does nothing.
+        """
+        import os
+        import jax
+        @jax.jit
+        def step(x):
+            if os.environ.get("AMT_FUSE", "1") == "1":
+                return x @ x
+            return x
+        """,
+        # the shipped idiom: module-level / build-time reads resolve
+        # the knob once (pallas_sell.py, utils/comm.py).
+        """
+        import os
+        FUSE = os.environ.get("AMT_FUSE", "1") == "1"
+        CHUNK = int(os.getenv("AMT_CHUNK_MB", "64"))
+        def build(x):
+            mode = os.environ.get("AMT_MODE", "auto")
+            return (x, mode, FUSE, CHUNK)
+        """,
+    ),
 }
 
 
@@ -212,7 +236,7 @@ def test_rule_negative_silent(rule):
 
 def test_all_shipped_rules_registered():
     ids = {spec.rule_id for spec in rule_table()}
-    assert ids >= {"R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8"}
+    assert ids >= {"R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9"}
 
 
 def test_waiver_suppresses_and_records():

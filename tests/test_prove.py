@@ -1,0 +1,160 @@
+"""graft-prove (arrow_matrix_tpu.analysis.prove) — the HLO-level
+collective-contract verifier.
+
+Covers the three layers of the gate:
+
+* **Fixture verdicts** (host-only, no lowering): the checked-in repl=2
+  HLO fixture conforms to the pinned fixture contract and the
+  intentionally-broken sibling (planted surprise all-gather) fails
+  H1-H3 — the demonstration that ``tools/proof_gate.py`` exits nonzero
+  when a surprise collective or a broken repl byte contract appears.
+* **The live prover at reduced scale**: every contracted executor over
+  the (c, S) grid lowers on the shared CPU pool and proves H1-H6, and
+  the fresh run does not drift from the checked-in
+  ``bench_cache/hlo_manifest.json``.
+* **The H5 donation sweep** (the bugfix-sweep satellite): the donated
+  scan entry points must show real input-output aliasing in compiled
+  HLO, and every exempt executor must carry a recorded skip reason —
+  no silent coverage shrink.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from arrow_matrix_tpu.analysis import prove
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "fixtures")
+GOOD = os.path.join(FIXDIR, "collectives_repl2.hlo")
+BROKEN = os.path.join(FIXDIR, "collectives_repl2_broken.hlo")
+MANIFEST = os.path.join(REPO, "bench_cache", "hlo_manifest.json")
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+# ---------------------------------------------------------------------------
+# Host-only: selftest + fixture verdicts (H1-H3)
+# ---------------------------------------------------------------------------
+
+
+def test_selftest_trips_on_planted_surprise():
+    assert prove.selftest()
+
+
+def test_repl2_fixture_conforms():
+    results = prove.verify_fixture(_read(GOOD))
+    assert results["ok"], results
+    for rule in ("H1", "H2", "H3"):
+        assert results[rule]["status"] == "pass", results[rule]
+
+
+def test_broken_fixture_fails_h1_h2_h3():
+    """The planted all-gather must trip all three: an undeclared kind
+    (H1), 8192 extra bytes blowing the ratio band (H2), and an 8-row
+    output violating the k/(c*S)=4 slab law (H3)."""
+    results = prove.verify_fixture(_read(BROKEN))
+    assert not results["ok"]
+    for rule in ("H1", "H2", "H3"):
+        assert results[rule]["status"] == "fail", results[rule]
+    assert "all-gather" in results["H1"]["detail"]
+
+
+def test_fixture_contract_matches_good_fixture_bytes():
+    """The pinned contract and the checked-in fixture must agree
+    exactly: 2048 B tuple all-to-all + 1024 B all-reduce."""
+    c = prove.fixture_contract()
+    summ = prove.summarize_hlo(_read(GOOD))
+    assert summ.total_bytes == c.step_bytes == 3072
+    assert c.expected_slab(8) == 4
+
+
+def test_proof_gate_fixture_mode_exit_codes():
+    """tools/proof_gate.py --fixture is the CLI demonstration that the
+    gate exits nonzero on a planted surprise all-gather."""
+    for path, rc in ((GOOD, 0), (BROKEN, 1)):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "proof_gate.py"),
+             "--fixture", path],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == rc, (path, proc.stdout, proc.stderr)
+    assert "VIOLATES" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# The live prover at reduced scale + drift against the checked-in
+# manifest (the tier-1 invariant tools/proof_gate.py runs standalone).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fresh_manifest():
+    return prove.run_prove(write=False, **prove.PROVE_SCALE)
+
+
+def test_prover_proves_all_contracts(fresh_manifest):
+    assert fresh_manifest["ok"], json.dumps(
+        [e for e in fresh_manifest["entries"] if not e["ok"]], indent=2)
+    names = {e["entry"] for e in fresh_manifest["entries"]}
+    # The (c, S) grid: every executor at c in {1,2} and S in {1,2}
+    # proves or records a skip reason — never silently disappears.
+    for algo in ("spmm_1d", "spmm_15d", "sell_slim", "sell_multi",
+                 "multi_level"):
+        assert any(algo in n for n in names), (algo, sorted(names))
+    for combo in ("[c=1", "[c=2", "S=1]", "S=2]"):
+        assert any(combo in n for n in names), (combo, sorted(names))
+    assert all(s["reason"] for s in fresh_manifest["skipped"]), (
+        "every skipped grid cell must record a reason")
+
+
+def test_manifest_checked_in_ok_and_no_drift(fresh_manifest):
+    with open(MANIFEST, encoding="utf-8") as fh:
+        checked_in = json.load(fh)
+    assert checked_in["ok"]
+    drift = prove.manifest_drift(checked_in, fresh_manifest)
+    assert drift == [], "\n".join(drift)
+
+
+def test_repl2_entries_obey_div_c_and_priced_merge(fresh_manifest):
+    """H3 on the real executors: every repl=2 sell entry's merge
+    program prices exactly reduce_comm_bytes (deferred psum), and the
+    rule records a pass (slab ÷c law held in every lowered shape)."""
+    repl2 = [e for e in fresh_manifest["entries"]
+             if e["contract"]["repl"] == 2 and not e["contract"]["h3_exempt"]]
+    assert repl2, "no repl=2 entries proved"
+    for e in repl2:
+        assert e["rules"]["H3"]["status"] == "pass", e["rules"]["H3"]
+        assert (e["measured"]["merge_bytes"]
+                == e["contract"]["reduce_bytes"]), e["entry"]
+
+
+def test_h5_donation_sweep(fresh_manifest):
+    """The bugfix-sweep satellite, pinned: the donated scan entry
+    points (SellMultiLevel._scan_donated, MultiLevelArrow.
+    _scan_steps_donated) must alias their donated carry (param 0) in
+    compiled HLO; executors without a donated entry point must record
+    an explicit skip, not a hollow pass."""
+    donated = skipped = 0
+    for e in fresh_manifest["entries"]:
+        h5 = e["rules"]["H5"]
+        if e["contract"]["donated_params"]:
+            assert h5["status"] == "pass", (e["entry"], h5)
+            assert 0 in e["measured"]["aliased_params"], e["entry"]
+            donated += 1
+        else:
+            assert h5["status"] == "skip", (e["entry"], h5)
+            skipped += 1
+    assert donated >= 4 and skipped >= 1, (donated, skipped)
+
+
+def test_h1_h6_statuses_recorded_for_every_entry(fresh_manifest):
+    for e in fresh_manifest["entries"]:
+        assert set(e["rules"]) == set(prove.RULE_IDS), e["entry"]
+        for rule, r in e["rules"].items():
+            assert r["status"] in ("pass", "fail", "skip"), (e["entry"], rule)

@@ -7,10 +7,13 @@ is the same invariant as a standalone pre-push / CI step, matching the
 other tools/*.py entry points the watcher runs unattended.  It prints
 the findings (if any) and exits with graft-lint's status: 0 clean,
 1 findings.  ``--audit`` additionally runs the trace-time recompile
-audit and refreshes bench_cache/compile_manifest.json.
+audit and refreshes bench_cache/compile_manifest.json; ``--prove``
+additionally runs the HLO collective-contract prover in check mode
+(fails on any violated contract or drift against the checked-in
+bench_cache/hlo_manifest.json — tools/proof_gate.py standalone).
 
 Usage:
-  python tools/lint_gate.py [--audit] [paths...]
+  python tools/lint_gate.py [--audit] [--prove] [paths...]
 """
 
 import os
@@ -26,6 +29,9 @@ def main(argv=None) -> int:
     run_audit = "--audit" in argv
     if run_audit:
         argv.remove("--audit")
+    run_prove = "--prove" in argv
+    if run_prove:
+        argv.remove("--prove")
     rc = graft_lint_main(argv)
     if rc != 0:
         print("lint gate: FAILED (fix the findings or waive them with "
@@ -36,6 +42,12 @@ def main(argv=None) -> int:
         rc = graft_lint_main(["audit"])
         if rc != 0:
             print("lint gate: trace-time audit FAILED", file=sys.stderr)
+            return rc
+    if run_prove:
+        rc = graft_lint_main(["prove", "--check"])
+        if rc != 0:
+            print("lint gate: HLO contract proof FAILED",
+                  file=sys.stderr)
             return rc
     print("lint gate: ok", file=sys.stderr)
     return 0

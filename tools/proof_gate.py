@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Tier-1 proof gate: re-run graft-prove and fail on any violated
+collective contract OR on drift against the checked-in
+bench_cache/hlo_manifest.json.
+
+This is the CI wrapper around ``python -m arrow_matrix_tpu.analysis
+prove --check`` (the pytest suite runs the same invariant in
+tests/test_prove.py): it lowers every contracted executor on a virtual
+CPU mesh and checks H1-H6 statically, so a GSPMD surprise all-gather,
+a broken ÷c byte contract, a dropped donation alias, or hot-loop
+layout thrash fails the push before anything executes.
+
+Usage:
+  python tools/proof_gate.py                 prove + drift check (CI)
+  python tools/proof_gate.py --refresh       prove + rewrite manifest
+  python tools/proof_gate.py --fixture F     run H1-H3 on an HLO
+                                             fixture file (exits
+                                             nonzero when the fixture
+                                             violates the pinned
+                                             fixture contract — how
+                                             tests demonstrate the
+                                             gate trips on a planted
+                                             surprise all-gather)
+  python tools/proof_gate.py --selftest      verify the gate itself
+                                             trips on a broken program
+                                             (no jax needed)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--refresh", action="store_true",
+                    help="rewrite bench_cache/hlo_manifest.json instead "
+                         "of drift-checking against it")
+    ap.add_argument("--fixture", default=None,
+                    help="run H1-H3 on this HLO fixture file and exit "
+                         "nonzero on any violation")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the checkers trip on a planted "
+                         "surprise all-gather (host-only, no jax)")
+    args = ap.parse_args(argv)
+
+    from arrow_matrix_tpu.analysis import prove
+
+    if args.selftest:
+        ok = prove.selftest()
+        print("proof gate selftest:",
+              "ok (broken program trips H1-H3)" if ok else "FAILED")
+        return 0 if ok else 1
+
+    if args.fixture is not None:
+        with open(args.fixture, encoding="utf-8") as fh:
+            results = prove.verify_fixture(fh.read())
+        for rule in ("H1", "H2", "H3"):
+            r = results[rule]
+            mark = "ok  " if r["status"] == "pass" else "FAIL"
+            print(f"[{mark}] {rule}: {r['detail']}")
+        print("fixture conforms" if results["ok"]
+              else "proof gate: FIXTURE VIOLATES THE CONTRACT")
+        return 0 if results["ok"] else 1
+
+    cli = [] if args.refresh else ["--check"]
+    rc = prove.main(cli)
+    if rc != 0:
+        print("proof gate: FAILED (a collective contract is violated or "
+              "the manifest drifted — rerun `python -m "
+              "arrow_matrix_tpu.analysis prove` and review the diff)",
+              file=sys.stderr)
+        return rc
+    print("proof gate: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
