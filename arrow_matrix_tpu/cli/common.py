@@ -121,6 +121,12 @@ def add_heal_args(parser: argparse.ArgumentParser,
                         "before the run fails (each retry backs off "
                         "exponentially and rolls back to the last "
                         "checkpoint when one exists).")
+    g.add_argument("--retry_jitter", type=float, default=0.0,
+                   help="±fraction of deterministic, seedable jitter "
+                        "on each backoff delay (faults/policy.py): 0 "
+                        "keeps the bare exponential schedule; serving "
+                        "deployments use ~0.2 so retries across "
+                        "tenants don't synchronize.")
     g.add_argument("--finite_check", type=str2bool, nargs="?",
                    default=True, const=True,
                    help="Jitted all-finite check on the carried X each "
@@ -140,12 +146,11 @@ def make_supervisor(args: argparse.Namespace, name: str, *,
     saves persist the merged carriage instead of replica 0's partial
     slab view.
     """
-    from arrow_matrix_tpu.faults import Supervisor
+    from arrow_matrix_tpu.faults import RetryPolicy, Supervisor
 
     return Supervisor(
         name, carry=carry,
-        watchdog_s=getattr(args, "watchdog", 0.0),
-        max_retries=getattr(args, "max_retries", 2),
+        policy=RetryPolicy.from_args(args),
         checkpoint_path=getattr(args, "checkpoint", None),
         checkpoint_every=getattr(args, "checkpoint_every", 0),
         finite_check=bool(getattr(args, "finite_check", True)) and carry,

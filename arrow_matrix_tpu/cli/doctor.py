@@ -203,6 +203,41 @@ def probe_obs() -> tuple[bool, str]:
                   "the full five-algorithm run")
 
 
+def probe_serve() -> tuple[bool, str]:
+    """graft-serve round-trip: the serving runtime starts, admits and
+    completes one request on the host-CPU backend, and shuts down
+    cleanly with a valid SLO summary.  Bounded subprocess for the same
+    reasons as the OBS probe: no backend-state inheritance, and a
+    wedged build must not hang the doctor."""
+    code = ("import sys, tempfile; sys.argv=[]; "
+            "from arrow_matrix_tpu.utils.platform import "
+            "force_cpu_devices; force_cpu_devices(1); "
+            "from arrow_matrix_tpu.serve import smoke_serve; "
+            "d = tempfile.mkdtemp(prefix='serve_probe_'); "
+            "s = smoke_serve(d, n=64, width=16, k=2, tenants=1, "
+            "requests=1, iterations=1); "
+            "lat = s['latency_ms']; "
+            "ok = (s['completed'] == 1 and s['failed'] == 0 and "
+            "lat['p50'] is not None and lat['p99'] is not None and "
+            "s['hbm']['budget_bytes'] > 0); "
+            "print('SERVE ok' if ok else 'SERVE FAIL: ' + repr(s))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("SERVE")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "SERVE ok":
+        return False, lines[-1][:120]
+    return True, ("one-request serve round-trips — run `graft_serve` "
+                  "for the full multi-tenant load")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -265,6 +300,10 @@ def main(argv=None) -> int:
 
     obs_ok, detail = probe_obs()
     ok &= _check("graft-scope (obs smoke trace)", obs_ok, detail)
+
+    serve_ok, detail = probe_serve()
+    ok &= _check("graft-serve (one-request round trip)", serve_ok,
+                 detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

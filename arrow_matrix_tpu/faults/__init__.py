@@ -35,10 +35,12 @@ from arrow_matrix_tpu.faults.plan import (
     reload_plan,
     set_plan,
 )
+from arrow_matrix_tpu.faults.policy import RetryPolicy
 from arrow_matrix_tpu.faults.supervisor import (
     Abort,
     NonFiniteState,
     Supervisor,
+    WatchdogStalled,
     WatchdogTimeout,
     state_is_finite,
 )
@@ -48,7 +50,9 @@ __all__ = [
     "FaultInjected",
     "FaultPlan",
     "NonFiniteState",
+    "RetryPolicy",
     "Supervisor",
+    "WatchdogStalled",
     "WatchdogTimeout",
     "active_plan",
     "clear_plan",

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from arrow_matrix_tpu.faults.policy import RetryPolicy
 from arrow_matrix_tpu.obs import flight
 
 
@@ -98,6 +99,7 @@ class Supervisor:
                  max_retries: int = 2,
                  backoff_s: float = 0.05,
                  backoff_factor: float = 2.0,
+                 policy: Optional[RetryPolicy] = None,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 0,
                  finite_check: bool = True,
@@ -107,11 +109,24 @@ class Supervisor:
                  canonicalize: Optional[Callable[[Any], Any]] = None):
         self.name = name
         self.carry = carry
-        self.watchdog_s = float(watchdog_s or 0.0)
-        self.watchdog_grace_s = float(watchdog_grace_s)
-        self.max_retries = int(max_retries)
-        self.backoff_s = float(backoff_s)
-        self.backoff_factor = float(backoff_factor)
+        # The retry/backoff/watchdog knobs live in one shared
+        # RetryPolicy (faults/policy.py) so the batch CLIs and
+        # graft-serve run the identical recovery behavior.  The loose
+        # keyword form is kept for existing callers; an explicit
+        # ``policy`` wins.
+        if policy is None:
+            policy = RetryPolicy(
+                max_retries=int(max_retries),
+                backoff_s=float(backoff_s),
+                backoff_factor=float(backoff_factor),
+                watchdog_s=float(watchdog_s or 0.0),
+                watchdog_grace_s=float(watchdog_grace_s))
+        self.policy = policy
+        self.watchdog_s = float(policy.watchdog_s or 0.0)
+        self.watchdog_grace_s = float(policy.watchdog_grace_s)
+        self.max_retries = int(policy.max_retries)
+        self.backoff_s = float(policy.backoff_s)
+        self.backoff_factor = float(policy.backoff_factor)
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = int(checkpoint_every)
         self.finite_check = finite_check
@@ -154,15 +169,40 @@ class Supervisor:
 
     def resume(self, like) -> Optional[tuple]:
         """Load the last checkpoint (None when absent/not configured);
-        returns ``(x, step)`` restored onto ``like``'s sharding."""
+        returns ``(x, step)`` restored onto ``like``'s sharding.
+
+        Every successful load emits a ``resumed`` flight event carrying
+        this supervisor's name (the request/run id) — the checkpoint
+        layer's own event has the path but not the identity of the run
+        that adopted the state.  A checkpoint predating the version/
+        layout tags (pre-canonicalize, "legacy") cannot be verified
+        against the current layout: it still loads, but with a LOUD
+        warning and ``legacy=True`` on the event, never a crash.
+        """
         if not self.checkpoint_path:
             return None
-        from arrow_matrix_tpu.utils.checkpoint import load_state
+        from arrow_matrix_tpu.utils.checkpoint import (
+            checkpoint_meta,
+            load_state,
+        )
 
+        meta = checkpoint_meta(self.checkpoint_path)
         state = load_state(self.checkpoint_path, like=like,
                            layout=self.layout)
         if state is not None:
             self.last_checkpoint_step = state[1]
+            legacy = meta is None or int(meta.get("version") or 0) < 1
+            if legacy:
+                import sys
+
+                print(f"[graft-heal {self.name}] WARNING: checkpoint "
+                      f"at {self.checkpoint_path} predates the "
+                      f"version/layout tags (legacy format) — the "
+                      f"carried-X layout cannot be verified against "
+                      f"{self.layout!r}; resuming anyway",
+                      file=sys.stderr)
+            self._event("heal", "resumed", step=state[1],
+                        path=self.checkpoint_path, legacy=legacy)
         return state
 
     def _save(self, x, step: int) -> None:
@@ -243,7 +283,6 @@ class Supervisor:
         x = x0
         it = start_it
         consecutive = 0
-        backoff = self.backoff_s
         while it < stop_it:
             try:
                 y = self._attempt(body, x, it)
@@ -278,12 +317,11 @@ class Supervisor:
                                 iteration=it,
                                 retries=self.max_retries)
                     return x, False
-                time.sleep(backoff)
-                backoff *= self.backoff_factor
+                time.sleep(self.policy.delay_s(consecutive,
+                                               salt=f"{self.name}:it{it}"))
                 x, it = self._rollback(x, it, like=x0)
                 continue
             consecutive = 0
-            backoff = self.backoff_s
             if self.carry:
                 x = y
             it += 1

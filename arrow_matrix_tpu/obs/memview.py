@@ -130,6 +130,26 @@ def predicted_bytes_for(obj, k: int, itemsize: int = 4,
         return int(fn(k, itemsize=itemsize)) * repl
 
 
+def request_bytes_for(obj, k: int, itemsize: int = 4,
+                      repl: int = 1) -> Optional[int]:
+    """The *incremental* per-shard HBM bytes a request of feature
+    width ``k`` adds on top of the executor's resident operator —
+    the per-request admission price graft-serve charges against its
+    live HBM accountant.  Executors exposing ``carriage_hbm_bytes``
+    (parallel/multi_level.py) answer directly; otherwise the price is
+    the difference of the static model at k and at 0 (the resident
+    operator alone).  None when the executor has no model at all —
+    the caller must then admit pessimistically or loudly."""
+    fn = getattr(obj, "carriage_hbm_bytes", None)
+    if fn is not None:
+        return int(fn(k, itemsize=itemsize, repl=repl))
+    full = predicted_bytes_for(obj, k, itemsize=itemsize, repl=repl)
+    base = predicted_bytes_for(obj, 0, itemsize=itemsize, repl=repl)
+    if full is None or base is None:
+        return None
+    return max(int(full) - int(base), 0)
+
+
 def largest_fitting_repl(base_bytes: int, budget_bytes: int,
                          choices=(1, 2, 4, 8)) -> int:
     """Largest replication factor whose predicted ×c footprint fits

@@ -103,6 +103,10 @@ class Histogram(_Instrument):
             "max": vals[-1],
             "p50": pct(0.5),
             "p90": pct(0.9),
+            # Tail percentile for graft-serve SLO reports; with fewer
+            # than ~100 observations this clamps to the max (honest
+            # for a bench-scale sample).
+            "p99": pct(0.99),
         }
 
 

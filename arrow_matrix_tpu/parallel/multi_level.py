@@ -939,6 +939,17 @@ class MultiLevelArrow:
                 + 2 * (self.total_rows // n_dev) * k * itemsize)
         return base * max(int(repl), 1)
 
+    def carriage_hbm_bytes(self, k: int, itemsize: int = 4,
+                           repl: int = 1) -> int:
+        """Incremental per-shard carriage bytes a feature width ``k``
+        adds on top of the resident operator (``predicted_hbm_bytes(k)
+        - predicted_hbm_bytes(0)``): the marginal cost of admitting one
+        more request against an executor whose operator stays
+        HBM-resident across requests — graft-serve's admission price
+        (obs/memview.request_bytes_for)."""
+        return (self.predicted_hbm_bytes(k, itemsize, repl)
+                - self.predicted_hbm_bytes(0, itemsize, repl))
+
     def shard_report(self) -> dict:
         """Load report over the layout's compute units — block rows for
         arrow levels (contiguous runs of which form the device shards,

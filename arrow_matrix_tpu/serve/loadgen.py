@@ -1,0 +1,182 @@
+"""Deterministic load generation + SLO reporting for graft-serve.
+
+``synthetic_trace`` derives every request — tenant assignment and
+feature payload — from ``numpy.random.default_rng(seed)``: no
+wall-clock randomness anywhere, so two runs of the same trace through
+a fault-free server complete with bit-identical per-request results
+and identical admission censuses (the replay property every chaos
+scenario in tools/serve_gate.py compares against).
+
+``slo_summary`` folds the server's census and the tickets' latencies
+into the serving SLO report (requests/s, p50/p90/p99 latency, shed and
+rejection counts, HBM occupancy, per-tenant breakdown) —
+tools/obs_gate.py requires these fields in every serve report, and
+PERFORMANCE.md's serving table is this dict verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from arrow_matrix_tpu.serve import request as rq
+from arrow_matrix_tpu.serve.scheduler import ArrowServer, ExecConfig
+
+
+def synthetic_trace(n_rows: int, *, tenants: int = 4,
+                    requests: int = 16, k: int = 4,
+                    iterations: int = 3, seed: int = 0,
+                    deadline_s: Optional[float] = None
+                    ) -> List[rq.Request]:
+    """A reproducible heavy-traffic trace: ``requests`` requests from
+    ``tenants`` synthetic tenants, feature payloads and tenant
+    assignment both drawn from one seeded generator."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(requests):
+        tenant = f"tenant{int(rng.integers(tenants))}"
+        x = rng.standard_normal((n_rows, k)).astype(np.float32)
+        out.append(rq.Request(request_id=f"r{i:04d}", tenant=tenant,
+                              x=x, iterations=iterations,
+                              deadline_s=deadline_s))
+    return out
+
+
+def run_trace(server: ArrowServer,
+              trace: List[rq.Request]) -> List[rq.Ticket]:
+    """Submit the whole trace, then drain synchronously (or, when the
+    server's worker thread is running, wait for every ticket) —
+    returns the tickets in trace order."""
+    tickets = [server.submit(r) for r in trace]
+    if server._thread is not None and server._thread.is_alive():
+        for t in tickets:
+            t.wait()
+    else:
+        server.drain()
+    return tickets
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def latency_summary_ms(tickets: List[rq.Ticket]) -> Dict[str, float]:
+    lats = [t.latency_s * 1e3 for t in tickets
+            if t.status == rq.COMPLETED and t.latency_s is not None]
+    if not lats:
+        return {"count": 0, "p50": None, "p90": None, "p99": None,
+                "mean": None, "max": None}
+    return {"count": len(lats),
+            "p50": _pct(lats, 0.5), "p90": _pct(lats, 0.9),
+            "p99": _pct(lats, 0.99),
+            "mean": sum(lats) / len(lats), "max": max(lats)}
+
+
+def slo_summary(server: ArrowServer, tickets: List[rq.Ticket],
+                wall_s: float) -> dict:
+    """The serving SLO report tools/obs_gate.py validates."""
+    base = server.summary()
+    per_tenant = {}
+    for name, rec in base["tenants"].items():
+        mine = [t for t in tickets if t.request.tenant == name]
+        rec = dict(rec)
+        rec["latency_ms"] = latency_summary_ms(mine)
+        per_tenant[name] = rec
+    completed = base["completed"]
+    return {
+        "server": base["server"],
+        "requests": len(tickets),
+        "completed": completed,
+        "failed": base["failed"],
+        "shed": base["shed"],
+        "rejected": base["rejected"],
+        "wall_s": wall_s,
+        "requests_per_s": (completed / wall_s) if wall_s > 0 else None,
+        "latency_ms": latency_summary_ms(tickets),
+        "hbm": base["hbm"],
+        "batches": base["batches"],
+        "batched_requests": base["batched_requests"],
+        "faults_seen": base["faults_seen"],
+        "recoveries": base["recoveries"],
+        "checkpoint_corruptions": base["checkpoint_corruptions"],
+        "per_tenant": per_tenant,
+    }
+
+
+def write_serve_artifacts(run_dir: str, summary: dict,
+                          registry=None) -> str:
+    """Persist ``serve_summary.json`` (+ the registry's
+    ``metrics.jsonl``) under ``run_dir``; returns the summary path."""
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, "serve_summary.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(summary, fh, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    if registry is not None:
+        registry.write_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    return path
+
+
+def ba_executor_factory(n: int, width: int, seed: int,
+                        fmt: str = "fold", mesh=None,
+                        feature_dtype=None):
+    """Factory-of-executors over one Barabasi-Albert decomposition:
+    the decomposition is computed once (the resident operator), each
+    :class:`ExecConfig` rung builds its own executor over the same
+    levels.  Returns ``(factory, n_rows)``."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    a = barabasi_albert(n, 3, seed=seed)
+    levels = arrow_decomposition(a, width, max_levels=10,
+                                 block_diagonal=True, seed=seed)
+
+    def factory(cfg: ExecConfig):
+        from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+        return MultiLevelArrow(levels, width, mesh=mesh, fmt=fmt,
+                               kernel=cfg.kernel,
+                               overlap_slabs=cfg.overlap_slabs,
+                               repl=cfg.repl,
+                               feature_dtype=feature_dtype)
+
+    return factory, n
+
+
+def smoke_serve(run_dir: str, *, n: int = 96, width: int = 16,
+                k: int = 2, tenants: int = 2, requests: int = 4,
+                iterations: int = 2, seed: int = 3,
+                queue_capacity: int = 8,
+                hbm_budget_bytes: Optional[int] = None,
+                max_batch_k: int = 0, registry=None) -> dict:
+    """One tiny end-to-end serve run on the host-CPU backend: build a
+    BA operator, serve a deterministic trace, write the SLO artifacts
+    into ``run_dir``, return the summary.  The amt_doctor SERVE probe
+    and tools/obs_gate.py both ride this."""
+    if registry is None:
+        from arrow_matrix_tpu.obs.metrics import MetricsRegistry
+
+        registry = MetricsRegistry(run_dir=run_dir)
+    factory, n_rows = ba_executor_factory(n, width, seed, fmt="fold")
+    server = ArrowServer(factory, ExecConfig(),
+                         hbm_budget_bytes=hbm_budget_bytes,
+                         queue_capacity=queue_capacity,
+                         max_batch_k=max_batch_k,
+                         registry=registry, name="smoke")
+    trace = synthetic_trace(n_rows, tenants=tenants,
+                            requests=requests, k=k,
+                            iterations=iterations, seed=seed)
+    t0 = time.perf_counter()
+    tickets = run_trace(server, trace)
+    wall = time.perf_counter() - t0
+    summary = slo_summary(server, tickets, wall)
+    write_serve_artifacts(run_dir, summary, registry=registry)
+    return summary

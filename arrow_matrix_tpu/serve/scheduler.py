@@ -1,0 +1,649 @@
+"""The graft-serve scheduler: an always-on, multi-tenant SpMM server.
+
+The batch world's unit of supervision was one run; here the graft-heal
+Supervisor (faults/supervisor.py) is promoted to a per-request process
+manager inside a long-lived server:
+
+  * **admission control** — every request is priced against the live
+    HBM accountant (serve/admission.py) *before* enqueue; over-budget
+    requests are rejected explicitly (429-style), and a full bounded
+    queue sheds explicitly — no silent drops, ever.
+  * **request-level supervision** — each scheduled batch runs under a
+    fresh Supervisor stamped from the server's one
+    :class:`~arrow_matrix_tpu.faults.policy.RetryPolicy` (watchdog,
+    bounded retry, deterministic seeded backoff jitter) with an
+    idempotent per-request checkpoint path: a killed server resumes
+    every in-flight request from its last sha256-verified checkpoint,
+    and already-completed requests replay for free from their final
+    saves.
+  * **graceful degradation** — repeated faults on a tenant's requests
+    walk that tenant down the ladder pallas_sell -> xla, repl=c -> 1,
+    overlap S -> 1 (:func:`degradation_ladder`) instead of failing the
+    request; only a tenant already on the last rung can fail.
+  * **dynamic batching** — compatible queued requests (same effective
+    execution config, same iteration count) are concatenated along the
+    feature axis and split back after the run.  SpMM is
+    column-separable (the graft-repl/graft-stream slab law:
+    ``routing.overlap_slices`` / ``repl_slab_width``), so each
+    request's slice of the batched result is bit-identical to running
+    it alone — asserted by tools/serve_gate.py.
+
+Determinism contract: with a deterministic trace (serve/loadgen.py)
+and the synchronous ``drain()`` mode, the admission census
+(accepted/shed/rejected counts per tenant) and every completed
+request's result bytes are replay-identical — the property the chaos
+scenarios lean on.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from arrow_matrix_tpu.faults import RetryPolicy, Supervisor
+from arrow_matrix_tpu.obs import flight
+from arrow_matrix_tpu.serve import request as rq
+from arrow_matrix_tpu.serve.admission import (
+    HBMAccountant,
+    request_price_bytes,
+)
+from arrow_matrix_tpu.utils.checkpoint import CheckpointIntegrityError
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecConfig:
+    """One rung of the execution ladder: the three knobs graceful
+    degradation can trade away (fused kernel, 2.5D column replication,
+    overlap sub-slabs) without changing the result's row order or the
+    carriage layout — a degraded rerun resumes the same checkpoints."""
+
+    kernel: str = "xla"
+    repl: int = 1
+    overlap_slabs: int = 1
+
+    def accepts_k(self, k: int) -> bool:
+        """Whether a feature width is schedulable under this config
+        (the graft-repl/graft-stream divisibility contracts: c | k and
+        S | k/c)."""
+        if k <= 0 or k % self.repl:
+            return False
+        return (k // self.repl) % self.overlap_slabs == 0
+
+
+def degradation_ladder(base: ExecConfig) -> Tuple[ExecConfig, ...]:
+    """Cumulative degradation rungs from ``base`` down to the plain
+    XLA c=1 S=1 executor: fused kernel first (cheapest to give up),
+    then replication, then overlap."""
+    rungs = [base]
+    cur = base
+    if cur.kernel != "xla":
+        cur = dataclasses.replace(cur, kernel="xla")
+        rungs.append(cur)
+    if cur.repl > 1:
+        cur = dataclasses.replace(cur, repl=1)
+        rungs.append(cur)
+    if cur.overlap_slabs > 1:
+        cur = dataclasses.replace(cur, overlap_slabs=1)
+        rungs.append(cur)
+    return tuple(rungs)
+
+
+class _Tenant:
+    __slots__ = ("rung", "fault_score", "degradations")
+
+    def __init__(self):
+        self.rung = 0
+        self.fault_score = 0
+        self.degradations: List[dict] = []
+
+
+class ArrowServer:
+    """Long-lived multi-tenant server over one resident arrow operator.
+
+    ``executor_factory(config: ExecConfig)`` builds an executor
+    (``set_features`` / ``step`` / ``gather_result`` plus the memview
+    HBM model) for one ladder rung; executors are built lazily and
+    cached — the base rung is built eagerly so the resident operator
+    is charged before the first request.
+
+    Two execution modes share all logic: ``start()`` spawns a worker
+    thread (the always-on deployment; ``shutdown(wait=True)`` drains
+    the queue first), while ``drain()`` processes synchronously in the
+    caller's thread — the deterministic mode every test and gate uses.
+    """
+
+    def __init__(self, executor_factory: Callable[[ExecConfig], Any],
+                 base_config: ExecConfig = ExecConfig(), *,
+                 hbm_budget_bytes: Optional[int] = None,
+                 queue_capacity: int = 64,
+                 policy: Optional[RetryPolicy] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 2,
+                 max_batch_k: int = 0,
+                 degrade_after: int = 2,
+                 itemsize: int = 4,
+                 registry=None,
+                 name: str = "serve",
+                 verbose: bool = False):
+        if queue_capacity < 1:
+            raise ValueError(f"queue_capacity must be >= 1, got "
+                             f"{queue_capacity}")
+        self.name = name
+        self.verbose = verbose
+        self.registry = registry
+        self.policy = policy or RetryPolicy()
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.queue_capacity = int(queue_capacity)
+        self.max_batch_k = int(max_batch_k)
+        self.degrade_after = max(int(degrade_after), 1)
+        self.itemsize = int(itemsize)
+        self._factory = executor_factory
+        self.base_config = base_config
+        self.ladder = degradation_ladder(base_config)
+        self._executors: Dict[ExecConfig, Any] = {}
+        self._tenants: Dict[str, _Tenant] = {}
+        self._queue: collections.deque = collections.deque()
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._counts = collections.Counter()
+        self._latencies_s: List[float] = []
+        self._tenant_latencies_s: Dict[str, List[float]] = {}
+        self.batches = 0
+        self.batched_requests = 0
+        self.faults_seen = 0
+        self.recoveries = 0
+        self.checkpoint_corruptions = 0
+
+        base = self._build_executor(base_config)
+        if hbm_budget_bytes is None:
+            from arrow_matrix_tpu.obs.comm import hbm_budget_bytes as _b
+
+            hbm_budget_bytes = _b(None)
+        self.accountant = HBMAccountant(hbm_budget_bytes,
+                                        registry=registry, name=name)
+        from arrow_matrix_tpu.obs.memview import predicted_bytes_for
+
+        resident = predicted_bytes_for(base, 0, itemsize=self.itemsize,
+                                       repl=base_config.repl) or 0
+        self.accountant.charge_resident(resident)
+        self._event("started", resident_bytes=resident,
+                    budget_bytes=self.accountant.budget_bytes,
+                    ladder=[dataclasses.asdict(c) for c in self.ladder])
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[graft-serve {self.name}] {msg}", flush=True)
+
+    def _event(self, event: str, **data) -> None:
+        flight.record("serve", event, server=self.name, **data)
+
+    def _count(self, what: str, tenant: Optional[str] = None,
+               **labels) -> None:
+        self._counts[what] += 1
+        if tenant is not None:
+            self._counts[f"{what}:{tenant}"] += 1
+        if self.registry is not None:
+            lb = dict(labels)
+            if tenant is not None:
+                lb["tenant"] = tenant
+            self.registry.counter(f"serve_{what}", server=self.name,
+                                  **lb).inc()
+
+    def _tenant(self, tenant: str) -> _Tenant:
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = self._tenants[tenant] = _Tenant()
+        return t
+
+    def _build_executor(self, cfg: ExecConfig):
+        ex = self._executors.get(cfg)
+        if ex is None:
+            ex = self._executors[cfg] = self._factory(cfg)
+        return ex
+
+    def _effective_config(self, ticket: rq.Ticket) -> ExecConfig:
+        """The ladder rung this ticket runs on: its tenant's current
+        rung, or the terminal rung when the request's feature width
+        fails the rung's divisibility contract (repl/overlap need
+        c | k and S | k/c; the terminal rung accepts every k)."""
+        cfg = self.ladder[self._tenant(ticket.request.tenant).rung]
+        if not cfg.accepts_k(ticket.request.k):
+            return self.ladder[-1]
+        return cfg
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, request: rq.Request) -> rq.Ticket:
+        """Admission-control one request: price, reserve, enqueue —
+        or reject (HBM) / shed (queue overflow) explicitly.  Returns
+        the ticket immediately; it resolves when processed."""
+        ticket = rq.Ticket(request)
+        ticket.submitted_s = time.monotonic()
+        self._count("submitted", request.tenant)
+        price = request_price_bytes(
+            self._build_executor(self.base_config), request.k,
+            itemsize=self.itemsize, repl=self.base_config.repl)
+        ticket.predicted_bytes = price
+        with self._cond:
+            if self._stop:
+                ticket._finish(rq.SHED, reason="server_stopped")
+                self._count("shed", request.tenant,
+                            reason="server_stopped")
+                self._event("shed", request=request.request_id,
+                            tenant=request.tenant,
+                            reason="server_stopped")
+                return ticket
+            if not self.accountant.reserve(price):
+                ticket._finish(
+                    rq.REJECTED, reason="hbm_budget",
+                    error=f"predicted {price} B exceeds remaining HBM "
+                          f"headroom "
+                          f"{self.accountant.headroom_bytes()} B")
+                self._count("rejected", request.tenant,
+                            reason="hbm_budget")
+                self._event("rejected", request=request.request_id,
+                            tenant=request.tenant, reason="hbm_budget",
+                            predicted_bytes=price,
+                            headroom_bytes=self.accountant
+                            .headroom_bytes())
+                self._log(f"rejected {request.request_id} "
+                          f"(hbm_budget: {price} B over headroom)")
+                return ticket
+            if len(self._queue) >= self.queue_capacity:
+                self.accountant.release(price)
+                ticket._finish(
+                    rq.SHED, reason="queue_full",
+                    error=f"queue at capacity {self.queue_capacity}")
+                self._count("shed", request.tenant,
+                            reason="queue_full")
+                self._event("shed", request=request.request_id,
+                            tenant=request.tenant, reason="queue_full",
+                            queue_depth=len(self._queue))
+                self._log(f"shed {request.request_id} (queue_full)")
+                return ticket
+            ticket.status = rq.ADMITTED
+            self._queue.append(ticket)
+            self._count("admitted", request.tenant)
+            self._event("admitted", request=request.request_id,
+                        tenant=request.tenant, k=request.k,
+                        predicted_bytes=price,
+                        queue_depth=len(self._queue))
+            self._cond.notify_all()
+        return ticket
+
+    # -- scheduling --------------------------------------------------------
+
+    def _shed_expired(self, ticket: rq.Ticket) -> bool:
+        dl = ticket.request.deadline_s
+        if dl is None or ticket.submitted_s is None:
+            return False
+        if time.monotonic() - ticket.submitted_s <= dl:
+            return False
+        self.accountant.release(ticket.predicted_bytes)
+        ticket._finish(rq.SHED, reason="deadline",
+                       error=f"queued past the {dl:.3f}s deadline")
+        self._count("shed", ticket.request.tenant, reason="deadline")
+        self._event("shed", request=ticket.request.request_id,
+                    tenant=ticket.request.tenant, reason="deadline")
+        self._log(f"shed {ticket.request.request_id} (deadline)")
+        return True
+
+    def _take_batch(self) -> Tuple[List[rq.Ticket],
+                                   Optional[ExecConfig]]:
+        """Pop the head request plus every compatible queued request
+        (same effective config + iteration count, combined width under
+        ``max_batch_k`` and schedulable) — FIFO, deterministic."""
+        with self._lock:
+            head: Optional[rq.Ticket] = None
+            while self._queue:
+                t = self._queue.popleft()
+                if self._shed_expired(t):
+                    continue
+                head = t
+                break
+            if head is None:
+                return [], None
+            cfg = self._effective_config(head)
+            batch = [head]
+            k_total = head.request.k
+            if self.max_batch_k > k_total:
+                keep: List[rq.Ticket] = []
+                for t in list(self._queue):
+                    k2 = t.request.k
+                    if (t.request.iterations == head.request.iterations
+                            and self._effective_config(t) == cfg
+                            and k_total + k2 <= self.max_batch_k
+                            and cfg.accepts_k(k_total + k2)
+                            and not self._shed_expired(t)):
+                        batch.append(t)
+                        k_total += k2
+                    elif not t.done:
+                        keep.append(t)
+                self._queue = collections.deque(keep)
+            return batch, cfg
+
+    def _pump_once(self) -> bool:
+        batch, cfg = self._take_batch()
+        if not batch:
+            return False
+        self._process_batch(batch, cfg)
+        return True
+
+    def drain(self) -> None:
+        """Synchronously process the queue to empty in the caller's
+        thread (the deterministic test/gate mode)."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "drain() is the synchronous mode; a worker thread is "
+                "already running — use shutdown(wait=True)")
+        while self._pump_once():
+            pass
+
+    def start(self) -> None:
+        """Spawn the always-on worker thread."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._serve_loop, daemon=True,
+                name=f"graft-serve-{self.name}")
+            self._thread.start()
+
+    def _serve_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(0.05)
+                if self._stop and not self._queue:
+                    return
+            try:
+                self._pump_once()
+            except Exception as e:  # noqa: BLE001 — the serving loop
+                # must survive anything a batch throws; the batch's
+                # tickets were already failed explicitly.
+                self._log(f"worker survived unexpected error: "
+                          f"{type(e).__name__}: {e}")
+
+    def shutdown(self, wait: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Graceful stop: the worker finishes the queued requests,
+        then exits; later submissions are shed explicitly."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if wait and t is not None:
+            t.join(timeout)
+        self._event("stopped")
+
+    # -- execution ---------------------------------------------------------
+
+    def _executor_for(self, cfg: ExecConfig):
+        """Build (or fetch) the executor for a rung, walking further
+        down the ladder when a rung's build itself fails; returns
+        ``(executor, actual_cfg)`` or ``(None, cfg)``."""
+        start = self.ladder.index(cfg) if cfg in self.ladder else 0
+        for rung in list(self.ladder[start:]) or [cfg]:
+            try:
+                return self._build_executor(rung), rung
+            except Exception as e:  # noqa: BLE001 — a rung that cannot
+                # build is one more thing to degrade past, loudly.
+                self._log(f"rung {rung} failed to build "
+                          f"({type(e).__name__}: {e}); degrading")
+                self._event("rung_build_failed",
+                            config=dataclasses.asdict(rung),
+                            error=f"{type(e).__name__}: {e}")
+        return None, cfg
+
+    def _ck_path(self, key: str) -> Optional[str]:
+        if not self.checkpoint_dir:
+            return None
+        import os
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        return os.path.join(self.checkpoint_dir, f"ck_{key}")
+
+    def _discard_checkpoint(self, path: str, key: str,
+                            err: Exception) -> None:
+        import os
+
+        self.checkpoint_corruptions += 1
+        self._count("checkpoint_corrupt")
+        self._event("checkpoint_corrupt_discarded", request=key,
+                    path=path, error=f"{type(err).__name__}: {err}")
+        print(f"[graft-serve {self.name}] WARNING: discarding "
+              f"unusable checkpoint for request {key}: {err}",
+              flush=True)
+        for p in (path + ".npz", path + ".npz.sha256",
+                  path + ".meta.json"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def _process_batch(self, batch: List[rq.Ticket],
+                       cfg: ExecConfig) -> None:
+        key = "+".join(t.request.request_id for t in batch)
+        iters = batch[0].request.iterations
+        k_total = sum(t.request.k for t in batch)
+        for t in batch:
+            t.status = rq.RUNNING
+            t.attempts += 1
+        self.batches += 1
+        self.batched_requests += len(batch)
+        if self.registry is not None:
+            self.registry.counter("serve_batches",
+                                  server=self.name).inc()
+            self.registry.record("serve_batch_k", float(k_total),
+                                 server=self.name)
+        executor, cfg = self._executor_for(cfg)
+        if executor is None:
+            self._fail_batch(batch, "no executor rung could be built")
+            return
+        x_cat = np.concatenate([t.request.x for t in batch], axis=1)
+        ck = self._ck_path(key)
+        layout = f"serve/{key}/k{k_total}/it{iters}"
+        sup = Supervisor(f"{self.name}:{key}", carry=True,
+                         policy=self.policy, checkpoint_path=ck,
+                         checkpoint_every=(self.checkpoint_every
+                                           if ck else 0),
+                         layout=layout, registry=self.registry,
+                         verbose=False)
+        x0 = executor.set_features(x_cat)
+        start = 0
+        if ck:
+            try:
+                st = sup.resume(x0)
+            except CheckpointIntegrityError as e:
+                self._discard_checkpoint(ck, key, e)
+                st = None
+            except Exception as e:  # noqa: BLE001 — a stale/mismatched
+                # checkpoint (different batch composition, layout tag,
+                # truncated file) must not wedge the server: discard
+                # loudly and recompute.
+                self._discard_checkpoint(ck, key, e)
+                st = None
+            if st is not None:
+                x0, start = st
+                for t in batch:
+                    t.resumed_step = start
+                self._event("resumed_request", request=key, step=start)
+                # The chaos kill scenario greps this line in the CLI's
+                # stdout; print it regardless of verbosity.
+                print(f"[graft-serve {self.name}] resumed request "
+                      f"{key} at iteration {start}", flush=True)
+        y, ok, err = None, False, None
+        body = lambda x, it: executor.step(x)   # noqa: E731
+        try:
+            y, ok = sup.run(body, x0, start, iters)
+        except CheckpointIntegrityError as e:
+            # Corruption surfaced mid-run (rollback hit a corrupted
+            # save): discard and recompute once from scratch.
+            self._discard_checkpoint(ck or "", key, e)
+            try:
+                y, ok = sup.run(body, executor.set_features(x_cat), 0,
+                                iters)
+            except Exception as e2:  # noqa: BLE001
+                err = e2
+        except Exception as e:  # noqa: BLE001 — WatchdogStalled or an
+            # unexpected executor error: the request fails/degrades,
+            # the server survives.
+            err = e
+        self.faults_seen += sup.faults_seen
+        self.recoveries += sup.recoveries
+        for t in batch:
+            t.faults_seen += sup.faults_seen
+            t.recoveries += sup.recoveries
+        if ok:
+            self._finalize_completed(batch, y, executor, cfg)
+            self._note_faults(batch, sup.faults_seen)
+        else:
+            self._handle_failure(batch, err)
+
+    def _note_faults(self, batch: List[rq.Ticket],
+                     faults: int) -> None:
+        """Accumulate recovered-fault pressure per tenant; repeated
+        faults degrade the tenant's rung even when every request still
+        completes (the ladder is preventive, not just reactive)."""
+        if not faults:
+            return
+        with self._lock:
+            for tenant in {t.request.tenant for t in batch}:
+                self._degrade_tenant(tenant, faults,
+                                     reason="repeated_faults")
+
+    def _degrade_tenant(self, tenant: str, faults: int,
+                        reason: str) -> bool:
+        t = self._tenant(tenant)
+        t.fault_score += faults
+        if t.fault_score < self.degrade_after:
+            return False
+        if t.rung + 1 >= len(self.ladder):
+            return False
+        frm, t.rung = t.rung, t.rung + 1
+        t.fault_score = 0
+        rec = {"tenant": tenant,
+               "from": dataclasses.asdict(self.ladder[frm]),
+               "to": dataclasses.asdict(self.ladder[t.rung]),
+               "reason": reason}
+        t.degradations.append(rec)
+        self._count("degraded", tenant, reason=reason)
+        self._event("degraded", **rec)
+        self._log(f"degraded tenant {tenant} to rung {t.rung} "
+                  f"{self.ladder[t.rung]} ({reason})")
+        return True
+
+    def _handle_failure(self, batch: List[rq.Ticket],
+                        err: Optional[Exception]) -> None:
+        """Retries exhausted (or the attempt escalated): degrade the
+        batch's tenants one rung and requeue at the FRONT; only
+        tenants already on the terminal rung fail their requests —
+        explicitly."""
+        detail = (f"{type(err).__name__}: {err}" if err is not None
+                  else "supervised run exhausted its retries")
+        degraded = False
+        with self._lock:
+            for tenant in {t.request.tenant for t in batch}:
+                degraded |= self._degrade_tenant(
+                    tenant, max(self.degrade_after, 1),
+                    reason="request_failure")
+        if degraded:
+            with self._cond:
+                for t in reversed(batch):
+                    t.status = rq.ADMITTED
+                    self._queue.appendleft(t)
+                self._cond.notify_all()
+            self._event("requeued_degraded",
+                        requests=[t.request.request_id for t in batch],
+                        error=detail)
+            self._log(f"requeued {len(batch)} request(s) on a "
+                      f"degraded rung after: {detail}")
+            return
+        self._fail_batch(batch, detail)
+
+    def _fail_batch(self, batch: List[rq.Ticket], detail: str) -> None:
+        for t in batch:
+            self.accountant.release(t.predicted_bytes)
+            t._finish(rq.FAILED, reason="exhausted", error=detail)
+            self._count("failed", t.request.tenant)
+            self._event("failed", request=t.request.request_id,
+                        tenant=t.request.tenant, error=detail)
+            self._log(f"FAILED {t.request.request_id}: {detail}")
+
+    def _finalize_completed(self, batch: List[rq.Ticket], y,
+                            executor, cfg: ExecConfig) -> None:
+        gathered = executor.gather_result(y)
+        off = 0
+        for t in batch:
+            k = t.request.k
+            t.result = np.ascontiguousarray(gathered[:, off:off + k])
+            off += k
+            t.exec_config = cfg
+            self.accountant.release(t.predicted_bytes)
+            t._finish(rq.COMPLETED)
+            self._count("completed", t.request.tenant)
+            lat_ms = (t.latency_s or 0.0) * 1e3
+            with self._lock:
+                self._latencies_s.append(t.latency_s or 0.0)
+                self._tenant_latencies_s.setdefault(
+                    t.request.tenant, []).append(t.latency_s or 0.0)
+            if self.registry is not None:
+                self.registry.record("serve_latency_ms", lat_ms,
+                                     server=self.name)
+                self.registry.record("serve_latency_ms", lat_ms,
+                                     server=self.name,
+                                     tenant=t.request.tenant)
+            self._event("completed", request=t.request.request_id,
+                        tenant=t.request.tenant,
+                        latency_ms=round(lat_ms, 3),
+                        faults_seen=t.faults_seen)
+
+    # -- reporting ---------------------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def summary(self) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+            tenants = {
+                name: {
+                    "rung": t.rung,
+                    "config": dataclasses.asdict(self.ladder[t.rung]),
+                    "fault_score": t.fault_score,
+                    "completed": counts.get(f"completed:{name}", 0),
+                    "failed": counts.get(f"failed:{name}", 0),
+                    "shed": counts.get(f"shed:{name}", 0),
+                    "rejected": counts.get(f"rejected:{name}", 0),
+                    "degradations": list(t.degradations),
+                }
+                for name, t in sorted(self._tenants.items())
+            }
+        return {
+            "server": self.name,
+            "submitted": counts.get("submitted", 0),
+            "admitted": counts.get("admitted", 0),
+            "completed": counts.get("completed", 0),
+            "failed": counts.get("failed", 0),
+            "shed": counts.get("shed", 0),
+            "rejected": counts.get("rejected", 0),
+            "batches": self.batches,
+            "batched_requests": self.batched_requests,
+            "faults_seen": self.faults_seen,
+            "recoveries": self.recoveries,
+            "checkpoint_corruptions": self.checkpoint_corruptions,
+            "hbm": self.accountant.snapshot(),
+            "tenants": tenants,
+        }

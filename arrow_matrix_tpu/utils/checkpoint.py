@@ -17,8 +17,10 @@ permuting rows.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import sys
 from typing import Optional
 
 import jax
@@ -29,6 +31,15 @@ import numpy as np
 # version + layout tags themselves; untagged checkpoints (version 0,
 # pre-graft-heal) still load but cannot be layout-verified.
 CHECKPOINT_VERSION = 1
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """The checkpoint's bytes do not match its sha256 sidecar: the
+    state on disk was corrupted after it was written (bit rot, a torn
+    concurrent writer, an injected ``corrupt`` fault).  Loading it
+    would silently poison every subsequent iteration; callers either
+    fail loudly (batch CLIs) or discard the checkpoint and recompute
+    (graft-serve)."""
 
 
 def _orbax():
@@ -59,6 +70,82 @@ def _read_meta(path: str) -> Optional[dict]:
             return json.load(fh)
     except FileNotFoundError:
         return None
+    except (ValueError, OSError) as e:
+        # A malformed/unreadable sidecar degrades the checkpoint to
+        # legacy (unverifiable) status with a loud warning — it must
+        # never turn a loadable state into a crash.
+        print(f"[checkpoint] WARNING: metadata at {_meta_path(path)} "
+              f"is unreadable ({type(e).__name__}: {e}); treating the "
+              f"checkpoint as legacy/untagged", file=sys.stderr)
+        return None
+
+
+def _sha_path(npz_path: str) -> str:
+    return npz_path + ".sha256"
+
+
+def _file_sha256(p: str) -> str:
+    h = hashlib.sha256()
+    with open(p, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_sha(npz_path: str) -> None:
+    tmp = _sha_path(npz_path) + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(_file_sha256(npz_path) + "\n")
+    os.replace(tmp, _sha_path(npz_path))
+
+
+def _verify_sha(npz_path: str) -> None:
+    """Raise :class:`CheckpointIntegrityError` when the npz bytes do
+    not match the sha256 sidecar; a missing/unreadable sidecar skips
+    the check (pre-sidecar checkpoints keep loading)."""
+    try:
+        with open(_sha_path(npz_path), encoding="utf-8") as fh:
+            want = fh.read().strip()
+    except (FileNotFoundError, OSError):
+        return
+    if not want:
+        return
+    got = _file_sha256(npz_path)
+    if got != want:
+        raise CheckpointIntegrityError(
+            f"checkpoint {npz_path} fails sha256 verification "
+            f"(sidecar records {want[:12]}..., file hashes "
+            f"{got[:12]}...) — the state on disk was corrupted after "
+            f"it was written; delete it (and its .sha256 sidecar) to "
+            f"recompute from scratch")
+
+
+def checkpoint_meta(path: str) -> Optional[dict]:
+    """Best-effort ``{"version", "step", "layout"}`` of the checkpoint
+    at ``path`` without loading the state, or None when absent or
+    unreadable.  Pre-version (legacy) npz checkpoints report
+    ``version: 0`` — callers warn loudly and skip layout verification
+    instead of crashing (the graft-serve resume contract)."""
+    path = os.path.abspath(path)
+    try:
+        if os.path.isdir(path):
+            return _read_meta(path)
+        if os.path.exists(path + ".npz"):
+            with np.load(path + ".npz") as z:
+                if "version" not in z.files:
+                    return {"version": 0, "step": int(z["step"]),
+                            "layout": None}
+                layout = (str(z["layout"]) if "layout" in z.files
+                          else "")
+                return {"version": int(z["version"]),
+                        "step": int(z["step"]),
+                        "layout": layout or None}
+    except Exception as e:  # noqa: BLE001 — metadata probing must not
+        # crash the resume path; the load itself still verifies.
+        print(f"[checkpoint] WARNING: cannot read metadata of {path} "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        return None
+    return None
 
 
 def _check_meta(path: str, meta: Optional[dict],
@@ -110,6 +197,11 @@ def save_state(path: str, x: jax.Array, step: int,
                  version=np.int64(CHECKPOINT_VERSION),
                  layout=np.str_(layout or ""))
         os.replace(tmp, path + ".npz")
+        # sha256 sidecar AFTER the npz replace: a crash between the
+        # two leaves a stale sidecar that fails verification loudly
+        # (never a silently-wrong state), and the fault-injection kill
+        # scenarios land at step hooks, never inside this window.
+        _write_sha(path + ".npz")
         return
     # Multi-process: one writer; its OUTCOME is broadcast, not
     # re-verified by peers re-reading the file — a re-read assumes a
@@ -130,6 +222,7 @@ def save_state(path: str, x: jax.Array, step: int,
                      version=np.int64(CHECKPOINT_VERSION),
                      layout=np.str_(layout or ""))
             os.replace(tmp, path + ".npz")
+            _write_sha(path + ".npz")
         except Exception as e:   # noqa: BLE001 — ANY writer failure
             # (OSError, MemoryError, zipfile errors...) must still
             # reach the allgather below, or every peer deadlocks at a
@@ -181,6 +274,7 @@ def load_state(path: str, like: Optional[jax.Array] = None,
             out = ckpt.restore(path)
         x, step = out["x"], int(out["step"])
     elif os.path.exists(path + ".npz"):
+        _verify_sha(path + ".npz")
         with np.load(path + ".npz") as z:
             meta = None
             if "version" in z.files:

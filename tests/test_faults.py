@@ -333,14 +333,16 @@ def test_chaos_gate_fast_scenarios(tmp_path):
     gate = _load_gate()
     problems, scenarios = gate.run_gate(str(tmp_path), fast=True)
     assert problems == []
-    assert scenarios == ["nan", "hang", "corrupt"]
+    assert scenarios == ["nan", "hang", "corrupt", "serve_hang",
+                         "serve_corrupt", "serve_overflow", "serve_hbm"]
 
 
 @pytest.mark.slow
 def test_chaos_gate_full(tmp_path):
     """Subprocess tier: includes the SIGKILL + checkpoint-resume
-    scenario."""
+    scenarios (batch and serving)."""
     gate = _load_gate()
     problems, scenarios = gate.run_gate(str(tmp_path), fast=False)
     assert problems == []
     assert "kill" in scenarios
+    assert "serve_kill" in scenarios

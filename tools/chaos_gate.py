@@ -29,6 +29,13 @@ checkpoint resume), and that the recovered run's final X is
              Supervisor ``canonicalize`` hook), so the resumed run is
              still bit-identical to the never-killed replicated run.
 
+Plus the graft-serve chaos-under-load matrix (tools/serve_gate.py):
+serve_hang / serve_corrupt / serve_overflow / serve_hbm in-process
+(and serve_kill in full mode) against a live multi-tenant
+ArrowServer — mid-request faults detected and recovered (or cleanly,
+explicitly shed), surviving requests bit-identical to a fault-free
+replay, the server never restarted externally.
+
 Exits 0 when every scenario passes, 1 otherwise.  Determinism is the
 whole contract: recovery re-runs the same compiled step from the same
 state on CPU, so equality is exact (``tobytes()``), not approximate.
@@ -331,10 +338,23 @@ def run_gate(workdir, fast=False):
             problems += scenario_kill(workdir)
             scenarios.append("kill_repl")
             problems += scenario_kill_repl(workdir)
+        # The serving matrix rides the same gate (tools/serve_gate.py):
+        # chaos under multi-tenant load with the same detected/
+        # recovered/bit-identical contract.
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import serve_gate
+
+        serve_problems, serve_scenarios = serve_gate.run_serve_scenarios(
+            workdir, fast=fast)
+        problems += serve_problems
+        scenarios += serve_scenarios
         kinds = {e.get("kind") for e in rec.events}
         if "fault" not in kinds or "heal" not in kinds:
             problems.append(f"flight recorder saw kinds {sorted(kinds)}"
                             f" — fault and heal events are required")
+        if "serve" not in kinds:
+            problems.append(f"flight recorder saw kinds {sorted(kinds)}"
+                            f" — serve events are required")
         return problems, scenarios
     finally:
         rec.seal("chaos gate done")

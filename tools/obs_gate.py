@@ -12,9 +12,12 @@ algorithm's measured/predicted HBM ratio may exceed
 ``OBS_GATE_MAX_HBM_RATIO`` (default 8.0 — the compiled executable
 materializing ~an order of magnitude more than the format model
 predicts is the OOM-in-waiting memview exists to catch; the smoke
-ratios sit in 1.0-2.6x).  Exits 0 on a valid run, 1 otherwise — the
-unattended pre-push / CI form of the same invariant amt_doctor's OBS
-probe checks interactively.
+ratios sit in 1.0-2.6x).  Also runs one graft-serve smoke
+(serve/loadgen.py:smoke_serve) and requires the serving SLO report to
+carry p50/p99 latency, shed/rejected counts, HBM occupancy, and the
+per-tenant breakdown.  Exits 0 on a valid run, 1 otherwise — the
+unattended pre-push / CI form of the same invariants amt_doctor's OBS
+and SERVE probes check interactively.
 
 Usage:
   python tools/obs_gate.py [run_dir]
@@ -69,6 +72,40 @@ def comm_problems(summary: dict) -> list:
     return problems
 
 
+def serve_problems(summary: dict) -> list:
+    """Gate problems from a graft-serve SLO report
+    (serve/loadgen.py:slo_summary): the serving layer's observability
+    contract.  A serve run that cannot state its p50/p99 latency, its
+    shed/rejected census, and its HBM occupancy is flying blind —
+    admission control and load shedding are exactly the decisions
+    these numbers justify."""
+    problems = []
+    lat = summary.get("latency_ms") or {}
+    for q in ("p50", "p99"):
+        if lat.get(q) is None:
+            problems.append(f"serve: SLO report lacks {q} latency")
+    for field in ("shed", "rejected", "completed", "requests_per_s"):
+        if summary.get(field) is None:
+            problems.append(f"serve: SLO report lacks the {field} "
+                            f"field")
+    hbm = summary.get("hbm") or {}
+    for field in ("budget_bytes", "peak_in_use_bytes",
+                  "peak_occupancy"):
+        if hbm.get(field) is None:
+            problems.append(f"serve: SLO report lacks hbm."
+                            f"{field}")
+    if not summary.get("per_tenant"):
+        problems.append("serve: SLO report lacks the per-tenant "
+                        "breakdown")
+    if summary.get("completed", 0) < 1:
+        problems.append("serve: smoke serve completed no requests")
+    run_dir = summary.get("_run_dir")
+    if run_dir and not os.path.isfile(
+            os.path.join(run_dir, "serve_summary.json")):
+        problems.append("serve: serve_summary.json artifact missing")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
@@ -77,6 +114,7 @@ def main(argv=None) -> int:
     force_cpu_devices(4)
 
     from arrow_matrix_tpu.obs.smoke import run_smoke, validate_run_dir
+    from arrow_matrix_tpu.serve import smoke_serve
 
     out = argv[0] if argv else tempfile.mkdtemp(prefix="obs_gate_")
     summary = run_smoke(out, n=128, width=32, k=4, n_dev=4, iters=2)
@@ -84,6 +122,10 @@ def main(argv=None) -> int:
     max_ratio = float(os.environ.get("OBS_GATE_MAX_HBM_RATIO", "8.0"))
     problems += memory_problems(summary, max_ratio)
     problems += comm_problems(summary)
+    serve_dir = os.path.join(out, "serve")
+    s = smoke_serve(serve_dir)
+    s["_run_dir"] = serve_dir
+    problems += serve_problems(s)
     if problems:
         for p in problems:
             print(f"obs gate: {p}", file=sys.stderr)

@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Tier-1 serve gate: chaos-under-load for the graft-serve runtime.
+
+The serving counterpart of tools/chaos_gate.py (which imports these
+scenarios into its matrix): a 4-tenant synthetic trace runs against an
+:class:`~arrow_matrix_tpu.serve.ArrowServer` over a small BA resident
+operator while faults land mid-flight, and every scenario must end
+**detected** + **recovered** (or cleanly, explicitly shed) with every
+surviving request's result **bit-identical** to a fault-free replay —
+and the server process never needing an external restart:
+
+  serve_hang     — an injected stall outlasts the per-request
+                   watchdog while 4 tenants are queued; the request is
+                   retried and every request still completes.
+  serve_corrupt  — a corrupted per-request checkpoint (bad bytes +
+                   mismatched sha256 sidecar) is planted before the
+                   run; the resume detects it loudly, discards, and
+                   recomputes — under a full queue of other tenants.
+  serve_overflow — a burst past the bounded queue: the overflow is
+                   shed EXPLICITLY (deterministic count, ticket state
+                   + reason, flight event), admitted requests are
+                   untouched.
+  serve_hbm      — an HBM budget with headroom for exactly one
+                   request's carriage: admission rejects the rest
+                   429-style with zero over-budget admissions
+                   (verified against the memview price).
+  serve_kill     — (subprocess; skipped under ``--fast``) SIGKILL
+                   lands mid-request in a checkpointing graft_serve
+                   CLI run; the rerun resumes in-flight requests from
+                   their sha256-verified checkpoints and the full
+                   result set is bit-identical to a never-killed run.
+
+Exits 0 when every scenario passes, 1 otherwise.
+
+Usage:
+  python tools/serve_gate.py [--fast] [workdir]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N, WIDTH, K = 128, 16, 2
+TENANTS, REQUESTS, ITERS = 4, 8, 4
+SEED = 11
+
+
+def _policy(**kw):
+    from arrow_matrix_tpu.faults import RetryPolicy
+
+    base = dict(max_retries=2, backoff_s=0.01, jitter=0.2, seed=SEED)
+    base.update(kw)
+    return RetryPolicy(**base)
+
+
+def _server(factory, **kw):
+    from arrow_matrix_tpu.serve import ArrowServer, ExecConfig
+
+    base = dict(queue_capacity=16, policy=_policy(), name="gate")
+    base.update(kw)
+    return ArrowServer(factory, ExecConfig(), **base)
+
+
+def _trace(n_rows):
+    from arrow_matrix_tpu.serve import synthetic_trace
+
+    return synthetic_trace(n_rows, tenants=TENANTS, requests=REQUESTS,
+                           k=K, iterations=ITERS, seed=SEED)
+
+
+def _run(server, n_rows):
+    from arrow_matrix_tpu.serve import run_trace
+
+    return run_trace(server, _trace(n_rows))
+
+
+def _result_bytes(tickets) -> dict:
+    return {t.request.request_id: t.result.tobytes()
+            for t in tickets if t.result is not None}
+
+
+def scenario_serve_hang(factory, n_rows, ref):
+    """Watchdog-timeout recovery with 4 tenants in flight."""
+    from arrow_matrix_tpu import faults
+
+    faults.set_plan({"scenario": "hang", "site": "multi_level.step",
+                     "after": 3, "hang_s": 1.0})
+    srv = _server(factory,
+                  policy=_policy(watchdog_s=0.3,
+                                 watchdog_grace_s=60.0))
+    try:
+        tickets = _run(srv, n_rows)
+    finally:
+        faults.clear_plan()
+    problems = []
+    s = srv.summary()
+    if s["completed"] != REQUESTS:
+        problems.append(f"serve_hang: {s['completed']}/{REQUESTS} "
+                        f"requests completed")
+    if srv.faults_seen == 0:
+        problems.append("serve_hang: the watchdog never fired on the "
+                        "injected stall")
+    if srv.recoveries == 0:
+        problems.append("serve_hang: no recovery was taken")
+    if _result_bytes(tickets) != ref:
+        problems.append("serve_hang: surviving results are not "
+                        "bit-identical to the fault-free replay")
+    return problems
+
+
+def scenario_serve_corrupt(factory, n_rows, ref, workdir):
+    """A corrupted per-request checkpoint under a full queue: the
+    sha256 sidecar fails the resume loudly; the server discards the
+    checkpoint and recomputes — never crashes, never serves poison."""
+    ckdir = os.path.join(workdir, "serve_ck_corrupt")
+    os.makedirs(ckdir, exist_ok=True)
+    # Plant a corrupt npz checkpoint for request r0000 (unbatched key):
+    # garbage npz bytes plus a sidecar recording a different digest —
+    # exactly what post-write disk corruption looks like.
+    victim = os.path.join(ckdir, "ck_r0000.npz")
+    with open(victim, "wb") as fh:
+        fh.write(b"\x00corrupt\xff" * 64)
+    with open(victim + ".sha256", "w", encoding="utf-8") as fh:
+        fh.write("0" * 64 + "\n")
+    srv = _server(factory, checkpoint_dir=ckdir, checkpoint_every=2)
+    tickets = _run(srv, n_rows)
+    problems = []
+    s = srv.summary()
+    if s["checkpoint_corruptions"] < 1:
+        problems.append("serve_corrupt: the corrupted checkpoint was "
+                        "not detected")
+    if s["completed"] != REQUESTS:
+        problems.append(f"serve_corrupt: {s['completed']}/{REQUESTS} "
+                        f"requests completed")
+    if _result_bytes(tickets) != ref:
+        problems.append("serve_corrupt: recomputed results are not "
+                        "bit-identical to the fault-free replay")
+    if os.path.exists(victim):
+        problems.append("serve_corrupt: the corrupt checkpoint was "
+                        "not discarded")
+    return problems
+
+
+def scenario_serve_overflow(factory, n_rows, ref):
+    """Burst past the bounded queue: deterministic, explicit shed."""
+    capacity = 3
+    srv = _server(factory, queue_capacity=capacity)
+    trace = _trace(n_rows)
+    tickets = [srv.submit(r) for r in trace]   # burst: no draining
+    srv.drain()
+    problems = []
+    s = srv.summary()
+    want_shed = REQUESTS - capacity
+    if s["shed"] != want_shed or s["completed"] != capacity:
+        problems.append(
+            f"serve_overflow: expected exactly {capacity} completed + "
+            f"{want_shed} shed, got {s['completed']} + {s['shed']}")
+    for t in tickets:
+        if not t.done:
+            problems.append(f"serve_overflow: request "
+                            f"{t.request.request_id} never reached a "
+                            f"terminal state (silently dropped)")
+        elif t.status == "shed" and t.reason != "queue_full":
+            problems.append(f"serve_overflow: shed request "
+                            f"{t.request.request_id} lacks the "
+                            f"explicit queue_full reason")
+    got = _result_bytes(tickets)
+    for rid, payload in got.items():
+        if ref.get(rid) != payload:
+            problems.append(f"serve_overflow: surviving request {rid} "
+                            f"is not bit-identical to the fault-free "
+                            f"replay")
+    # Replay determinism: the same burst sheds the same census.
+    srv2 = _server(factory, queue_capacity=capacity)
+    tickets2 = [srv2.submit(r) for r in _trace(n_rows)]
+    srv2.drain()
+    census = [(t.status, t.reason) for t in tickets]
+    census2 = [(t.status, t.reason) for t in tickets2]
+    if census != census2:
+        problems.append("serve_overflow: the shed census is not "
+                        "replay-deterministic")
+    return problems
+
+
+def scenario_serve_hbm(factory, n_rows, ref):
+    """Admission control: headroom for exactly one request's carriage
+    — the burst must see zero over-budget admissions, each rejection
+    explicit, and the one admitted request completes bit-identically."""
+    from arrow_matrix_tpu.serve import ExecConfig, request_price_bytes
+
+    executor = factory(ExecConfig())
+    from arrow_matrix_tpu.obs.memview import predicted_bytes_for
+
+    resident = predicted_bytes_for(executor, 0) or 0
+    price = request_price_bytes(executor, K)
+    srv = _server(factory, hbm_budget_bytes=resident + price)
+    tickets = [srv.submit(r) for r in _trace(n_rows)]   # burst
+    srv.drain()
+    problems = []
+    s = srv.summary()
+    if s["admitted"] != 1 or s["rejected"] != REQUESTS - 1:
+        problems.append(
+            f"serve_hbm: expected exactly 1 admission + "
+            f"{REQUESTS - 1} rejections at a one-request budget, got "
+            f"{s['admitted']} + {s['rejected']}")
+    peak = s["hbm"]["peak_in_use_bytes"]
+    if peak > resident + price:
+        problems.append(f"serve_hbm: peak HBM {peak} B exceeded the "
+                        f"budget {resident + price} B — an "
+                        f"over-budget request was admitted")
+    for t in tickets:
+        if t.status == "rejected" and t.reason != "hbm_budget":
+            problems.append(f"serve_hbm: rejected request "
+                            f"{t.request.request_id} lacks the "
+                            f"explicit hbm_budget reason")
+    got = _result_bytes(tickets)
+    for rid, payload in got.items():
+        if ref.get(rid) != payload:
+            problems.append(f"serve_hbm: admitted request {rid} is "
+                            f"not bit-identical to the fault-free "
+                            f"replay")
+    return problems
+
+
+def scenario_serve_kill(workdir):
+    """SIGKILL mid-request in a checkpointing graft_serve CLI run; the
+    rerun resumes and the result set is bit-identical to a never-
+    killed run."""
+    import numpy as np
+
+    problems = []
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("AMT_FAULT_PLAN", None)
+    ck = os.path.join(workdir, "serve_ck_kill")
+    ref_npz = os.path.join(workdir, "serve_ref.npz")
+    kill_npz = os.path.join(workdir, "serve_kill.npz")
+    cmd = [sys.executable, "-m", "arrow_matrix_tpu.cli.graft_serve",
+           "--vertices", str(N), "--width", str(WIDTH),
+           "--features", str(K), "--tenants", str(TENANTS),
+           "--requests", str(REQUESTS), "--iterations", str(ITERS),
+           "--seed", str(SEED), "--device", "cpu",
+           "--checkpoint_every", "2"]
+
+    def run(extra, fault_env=None):
+        e = dict(env)
+        if fault_env:
+            e["AMT_FAULT_PLAN"] = fault_env
+        return subprocess.run(cmd + extra, env=e, cwd=workdir,
+                              capture_output=True, text=True,
+                              timeout=600)
+
+    r = run(["--results_out", ref_npz])
+    if r.returncode != 0:
+        return [f"serve_kill: fault-free reference run failed rc="
+                f"{r.returncode}: {r.stderr[-500:]}"]
+    # 8 requests x 4 iterations = 32 step hits; hit 18 lands
+    # mid-request-4 with four requests already completed (their final
+    # checkpoints on disk) and a step-2 checkpoint for the victim.
+    plan = json.dumps({"scenario": "kill", "site": "*.step",
+                       "after": 18})
+    r = run(["--results_out", kill_npz, "--checkpoint", ck],
+            fault_env=plan)
+    if r.returncode == 0:
+        return ["serve_kill: injected SIGKILL did not terminate the "
+                "server"]
+    r = run(["--results_out", kill_npz, "--checkpoint", ck])
+    if r.returncode != 0:
+        return [f"serve_kill: resume run failed rc={r.returncode}: "
+                f"{r.stderr[-500:]}"]
+    if "resumed request" not in r.stdout:
+        problems.append("serve_kill: rerun did not report resuming "
+                        "any request from its checkpoint")
+    with np.load(ref_npz) as a, np.load(kill_npz) as b:
+        if sorted(a.files) != sorted(b.files):
+            problems.append(f"serve_kill: result sets differ: "
+                            f"{sorted(a.files)} vs {sorted(b.files)}")
+        else:
+            for rid in a.files:
+                if a[rid].tobytes() != b[rid].tobytes():
+                    problems.append(
+                        f"serve_kill: resumed request {rid} is not "
+                        f"bit-identical to the never-killed run")
+    return problems
+
+
+def run_serve_scenarios(workdir, fast=False):
+    """Run the serving matrix; returns (problems, scenarios_run).
+    Assumes the caller pinned the platform and (optionally) installed
+    a flight recorder — tools/chaos_gate.py imports this into its
+    matrix."""
+    from arrow_matrix_tpu import faults
+    from arrow_matrix_tpu.serve import ba_executor_factory
+
+    faults.clear_plan()
+    factory, n_rows = ba_executor_factory(N, WIDTH, SEED, fmt="fold")
+    ref_srv = _server(factory)
+    ref_tickets = _run(ref_srv, n_rows)
+    if ref_srv.summary()["completed"] != REQUESTS:
+        return (["serve baseline: fault-free serve run did not "
+                 "complete every request"], [])
+    ref = _result_bytes(ref_tickets)
+    problems = []
+    scenarios = ["serve_hang", "serve_corrupt", "serve_overflow",
+                 "serve_hbm"]
+    problems += scenario_serve_hang(factory, n_rows, ref)
+    problems += scenario_serve_corrupt(factory, n_rows, ref, workdir)
+    problems += scenario_serve_overflow(factory, n_rows, ref)
+    problems += scenario_serve_hbm(factory, n_rows, ref)
+    if not fast:
+        scenarios.append("serve_kill")
+        problems += scenario_serve_kill(workdir)
+    return problems, scenarios
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fast = "--fast" in argv
+    argv = [a for a in argv if a != "--fast"]
+
+    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(4)
+
+    import tempfile
+
+    from arrow_matrix_tpu.obs import flight
+
+    workdir = argv[0] if argv else tempfile.mkdtemp(prefix="serve_gate_")
+    os.makedirs(workdir, exist_ok=True)
+    rec = flight.FlightRecorder(os.path.join(workdir, "flight.json"))
+    flight.set_recorder(rec)
+    try:
+        problems, scenarios = run_serve_scenarios(workdir, fast=fast)
+        kinds = {e.get("kind") for e in rec.events}
+        if "serve" not in kinds:
+            problems.append(f"flight recorder saw kinds "
+                            f"{sorted(kinds)} — serve events are "
+                            f"required")
+    finally:
+        rec.seal("serve gate done")
+        flight.set_recorder(None)
+    if problems:
+        for p in problems:
+            print(f"serve gate: {p}", file=sys.stderr)
+        print("serve gate: FAILED", file=sys.stderr)
+        return 1
+    print(f"serve gate: ok — scenarios {'+'.join(scenarios)} "
+          f"detected, recovered (or explicitly shed), bit-identical "
+          f"({workdir})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
