@@ -238,6 +238,53 @@ def probe_serve() -> tuple[bool, str]:
                   "for the full multi-tenant load")
 
 
+def probe_pulse() -> tuple[bool, str]:
+    """graft-pulse round-trip: serve a two-request trace with a
+    PulseMonitor attached, start the stdlib scrape endpoint on an
+    ephemeral port, scrape /metrics and /pulse.json once, and validate
+    both against the pulse schema.  Bounded subprocess, as for the OBS
+    and SERVE probes."""
+    code = (
+        "import sys, json, urllib.request; sys.argv=[]; "
+        "from arrow_matrix_tpu.utils.platform import "
+        "force_cpu_devices; force_cpu_devices(1); "
+        "from arrow_matrix_tpu.obs import pulse; "
+        "from arrow_matrix_tpu.serve import ArrowServer, ExecConfig, "
+        "ba_executor_factory, run_trace, synthetic_trace; "
+        "fac, n = ba_executor_factory(64, 16, 3, fmt='fold'); "
+        "mon = pulse.PulseMonitor(window_s=0.05, "
+        "watchdog=pulse.SloWatchdog()); "
+        "srv = ArrowServer(fac, ExecConfig(), name='pulse-probe'); "
+        "srv.attach_pulse(mon); "
+        "run_trace(srv, synthetic_trace(n, tenants=1, requests=2, "
+        "k=2, iterations=1, seed=3)); mon.close(); "
+        "ep = pulse.PulseEndpoint(mon); ep.start(); "
+        "text = urllib.request.urlopen(ep.url + '/metrics', "
+        "timeout=10).read().decode(); "
+        "snap = json.loads(urllib.request.urlopen(ep.url + "
+        "'/pulse.json', timeout=10).read().decode()); "
+        "p = pulse.validate_exposition(text) + "
+        "pulse.validate_ring(snap); ep.stop(); "
+        "p += [] if snap['totals']['completed'] == 2 else "
+        "['completed != 2']; "
+        "print('PULSE ok' if not p else 'PULSE FAIL: ' + p[0])")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("PULSE")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "PULSE ok":
+        return False, lines[-1][:120]
+    return True, ("endpoint scrape + ring schema round-trip — run "
+                  "`graft_serve --pulse` for the live series")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -303,6 +350,10 @@ def main(argv=None) -> int:
 
     serve_ok, detail = probe_serve()
     ok &= _check("graft-serve (one-request round trip)", serve_ok,
+                 detail)
+
+    pulse_ok, detail = probe_pulse()
+    ok &= _check("graft-pulse (endpoint scrape + schema)", pulse_ok,
                  detail)
 
     cache = "bench_cache"

@@ -11,14 +11,20 @@ SIGKILL + checkpoint resume.
 
 Prints the SLO report (requests/s, p50/p99 latency, shed/rejected
 census, HBM occupancy) and writes ``serve_summary.json`` +
-``metrics.jsonl`` + the flight recorder under ``--obs_dir``.  Exits
-non-zero only when a request FAILED (shed/rejected are explicit,
-policy-level outcomes, not server failures).
+``metrics.jsonl`` + the flight recorder under ``--obs_dir``.  With
+``--pulse``, attaches the graft-pulse telemetry layer (obs/pulse.py):
+a request-correlated Perfetto trace (``serve_trace.json``), the
+windowed SLO time series ring (``pulse_ring.json`` +
+``pulse_metrics.prom``), an optional live scrape endpoint
+(``--pulse_port``), and the SLO-burn watchdog feeding the degradation
+ladder.  Exits non-zero only when a request FAILED (shed/rejected are
+explicit, policy-level outcomes, not server failures).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 
@@ -75,6 +81,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--obs_dir", type=str, default=None,
                    help="run directory for serve_summary.json, "
                         "metrics.jsonl, and the flight recorder")
+    p.add_argument("--pulse", action="store_true",
+                   help="attach the graft-pulse live telemetry layer: "
+                        "windowed SLO time series + burn watchdog, "
+                        "request-correlated Perfetto trace "
+                        "(serve_trace.json) and pulse_ring.json/"
+                        "pulse_metrics.prom under --obs_dir")
+    p.add_argument("--pulse_window", type=float, default=0.5,
+                   help="pulse sliding-window width in seconds")
+    p.add_argument("--pulse_port", type=int, default=-1,
+                   help="serve /metrics + /pulse.json on this port "
+                        "for the run's duration (0 = ephemeral, "
+                        "-1 = no endpoint)")
+    p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                   help="p99 latency SLO target in ms for the burn "
+                        "watchdog (0 = no latency rule)")
     p.add_argument("--results_out", type=str, default=None,
                    help="write completed request results to this .npz "
                         "(one array per request id) — the replay "
@@ -127,6 +148,21 @@ def main(argv=None) -> int:
     policy = RetryPolicy.from_args(args)
     budget = (int(args.hbm_budget_mb * 2**20)
               if args.hbm_budget_mb > 0 else None)
+    monitor, endpoint, tracer = None, None, None
+    if args.pulse:
+        import os
+
+        from arrow_matrix_tpu.obs import Tracer, pulse as pulse_mod
+
+        tracer = Tracer("graft-serve", registry=registry)
+        ring = (os.path.join(args.obs_dir, "pulse_ring.json")
+                if args.obs_dir else None)
+        monitor = pulse_mod.PulseMonitor(
+            window_s=args.pulse_window, ring_path=ring,
+            name="graft-serve",
+            watchdog=pulse_mod.SloWatchdog(pulse_mod.default_rules(
+                target_p99_ms=(args.slo_p99_ms
+                               if args.slo_p99_ms > 0 else None))))
     server = ArrowServer(
         factory, base_cfg, hbm_budget_bytes=budget,
         queue_capacity=args.queue, policy=policy,
@@ -134,7 +170,17 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every,
         max_batch_k=args.max_batch_k,
         degrade_after=args.degrade_after,
-        registry=registry, name="graft-serve", verbose=True)
+        registry=registry, tracer=tracer, name="graft-serve",
+        verbose=True)
+    if monitor is not None:
+        server.attach_pulse(monitor)
+        if args.pulse_port >= 0:
+            from arrow_matrix_tpu.obs import PulseEndpoint
+
+            endpoint = PulseEndpoint(monitor,
+                                     port=args.pulse_port).start()
+            print(f"graft-serve: pulse endpoint at {endpoint.url}"
+                  f"/metrics", flush=True)
     trace = synthetic_trace(
         n_rows, tenants=args.tenants, requests=args.requests,
         k=args.features, iterations=args.iterations, seed=args.seed,
@@ -142,7 +188,9 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     tickets = run_trace(server, trace)
     wall = time.perf_counter() - t0
-    summary = slo_summary(server, tickets, wall)
+    if monitor is not None:
+        monitor.close()
+    summary = slo_summary(server, tickets, wall, pulse=monitor)
 
     lat = summary["latency_ms"]
     print(f"graft-serve: {summary['requests']} requests over "
@@ -164,13 +212,41 @@ def main(argv=None) -> int:
               f"{summary['checkpoint_corruptions']} checkpoint "
               f"corruption(s) discarded")
 
+    if monitor is not None:
+        pt = summary["pulse"]
+        burns = [e for e in pt["burn_events"]
+                 if e["event"] == "slo_burn"]
+        print(f"graft-serve: pulse — {len(pt['windows'])} windows of "
+              f"{pt['window_s']}s, {len(burns)} SLO burn(s)"
+              + (": " + ", ".join(sorted({b['rule'] for b in burns}))
+                 if burns else ""), flush=True)
     if args.results_out:
         done = {t.request.request_id: t.result for t in tickets
                 if t.result is not None}
+        if monitor is not None:
+            # Embed the windowed series in the replay artifact for
+            # offline diffing.  Only with --pulse: serve_gate compares
+            # fault vs fault-free artifacts file-by-file, and the
+            # window series is timing-shaped, not replay-identical.
+            done["_pulse_windows"] = np.frombuffer(
+                json.dumps(summary["pulse"]["windows"]).encode(),
+                dtype=np.uint8)
         np.savez(args.results_out, **done)
         print(f"graft-serve: wrote {len(done)} result(s) to "
               f"{args.results_out}")
     if args.obs_dir:
+        import os
+
+        if tracer is not None:
+            tp = tracer.save(os.path.join(args.obs_dir,
+                                          "serve_trace.json"))
+            print(f"graft-serve: wrote request-correlated trace "
+                  f"{tp}")
+        if monitor is not None:
+            with open(os.path.join(args.obs_dir,
+                                   "pulse_metrics.prom"), "w",
+                      encoding="utf-8") as fh:
+                fh.write(monitor.exposition_text())
         path = write_serve_artifacts(args.obs_dir, summary,
                                      registry=registry)
         rec = flight.get_recorder()
@@ -178,6 +254,8 @@ def main(argv=None) -> int:
             rec.seal("graft-serve run complete")
             flight.set_recorder(None)
         print(f"graft-serve: wrote {path}")
+    if endpoint is not None:
+        endpoint.stop()
     if summary["failed"]:
         print(f"graft-serve: {summary['failed']} request(s) FAILED",
               file=sys.stderr)

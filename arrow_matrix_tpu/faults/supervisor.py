@@ -33,6 +33,7 @@ for every scenario in the injection matrix.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 import time
@@ -105,6 +106,7 @@ class Supervisor:
                  finite_check: bool = True,
                  layout: Optional[str] = None,
                  registry=None,
+                 tracer=None,
                  verbose: bool = True,
                  canonicalize: Optional[Callable[[Any], Any]] = None):
         self.name = name
@@ -132,6 +134,7 @@ class Supervisor:
         self.finite_check = finite_check
         self.layout = layout
         self.registry = registry
+        self.tracer = tracer
         self.verbose = verbose
         #: Optional device-level map applied to the carried state right
         #: before every save (graft-repl: the 2.5D executors carry
@@ -146,6 +149,15 @@ class Supervisor:
         self.last_checkpoint_step: Optional[int] = None
 
     # -- events ------------------------------------------------------------
+
+    def _span(self, name: str, **attrs):
+        """A tracer span when graft-serve attached a tracer, else a
+        no-op — attempt/resume/checkpoint phases then appear on the
+        same request-correlated Perfetto track as the scheduler's
+        admission and batch spans (the request context is ambient)."""
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, supervisor=self.name, **attrs)
 
     def _event(self, kind: str, name: str, **data) -> None:
         flight.record(kind, name, supervisor=self.name, **data)
@@ -186,9 +198,10 @@ class Supervisor:
             load_state,
         )
 
-        meta = checkpoint_meta(self.checkpoint_path)
-        state = load_state(self.checkpoint_path, like=like,
-                           layout=self.layout)
+        with self._span("resume", path=self.checkpoint_path):
+            meta = checkpoint_meta(self.checkpoint_path)
+            state = load_state(self.checkpoint_path, like=like,
+                               layout=self.layout)
         if state is not None:
             self.last_checkpoint_step = state[1]
             legacy = meta is None or int(meta.get("version") or 0) < 1
@@ -208,9 +221,11 @@ class Supervisor:
     def _save(self, x, step: int) -> None:
         from arrow_matrix_tpu.utils.checkpoint import save_state
 
-        if self.canonicalize is not None:
-            x = self.canonicalize(x)
-        save_state(self.checkpoint_path, x, step, layout=self.layout)
+        with self._span("checkpoint", step=step):
+            if self.canonicalize is not None:
+                x = self.canonicalize(x)
+            save_state(self.checkpoint_path, x, step,
+                       layout=self.layout)
         self.last_checkpoint_step = step
         self._event("heal", "checkpointed", step=step)
 
@@ -285,12 +300,18 @@ class Supervisor:
         consecutive = 0
         while it < stop_it:
             try:
-                y = self._attempt(body, x, it)
-                if (self.carry and self.finite_check
-                        and not state_is_finite(y)):
-                    raise NonFiniteState(
-                        f"carried X contains NaN/Inf after iteration "
-                        f"{it}")
+                # The attempt span carries iteration + retry ordinal
+                # (and, under graft-serve, the ambient request id), so
+                # a retried iteration shows up as two attempt spans —
+                # the first with an ``error`` arg — on one track.
+                with self._span("attempt", iteration=it,
+                                retry=consecutive):
+                    y = self._attempt(body, x, it)
+                    if (self.carry and self.finite_check
+                            and not state_is_finite(y)):
+                        raise NonFiniteState(
+                            f"carried X contains NaN/Inf after "
+                            f"iteration {it}")
             except Abort as e:
                 self._event("fault", "aborted", iteration=it,
                             error=str(e))

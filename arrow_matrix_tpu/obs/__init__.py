@@ -21,7 +21,13 @@ the paper's headline claim (communication volume) per run:
     max/mean imbalance bound as a measured gauge);
   * :mod:`~arrow_matrix_tpu.obs.flight` — graft-flight, a bounded ring
     of recent obs events eagerly flushed to disk so a wedged or killed
-    run leaves a diagnosable blackbox artifact;
+    run leaves a diagnosable blackbox artifact; also home of the
+    request-correlation context every other obs module stamps from;
+  * :mod:`~arrow_matrix_tpu.obs.pulse` — graft-pulse, the live serving
+    telemetry layer: sliding-window SLO time series over the
+    graft-serve event stream, a crash-readable on-disk ring, a stdlib
+    Prometheus-style scrape endpoint, and the SLO-burn watchdog that
+    feeds measured pressure into the degradation ladder;
   * :mod:`~arrow_matrix_tpu.obs.smoke` — a reduced-scale CPU-mesh run
     of all five parallel algorithms producing one inspectable run
     directory (traces + metrics.jsonl + summary.json).
@@ -39,7 +45,11 @@ from arrow_matrix_tpu.obs.comm import (
     ideal_bytes_for,
     reduce_bytes_for,
 )
-from arrow_matrix_tpu.obs.flight import FlightRecorder
+from arrow_matrix_tpu.obs.flight import (
+    FlightRecorder,
+    current_request,
+    request_context,
+)
 from arrow_matrix_tpu.obs.imbalance import (
     account_imbalance,
     format_imbalance_report,
@@ -58,6 +68,12 @@ from arrow_matrix_tpu.obs.metrics import (
     init_registry,
     set_registry,
 )
+from arrow_matrix_tpu.obs.pulse import (
+    BurnRule,
+    PulseEndpoint,
+    PulseMonitor,
+    SloWatchdog,
+)
 from arrow_matrix_tpu.obs.tracer import (
     Tracer,
     chained_iteration_ms,
@@ -66,10 +82,16 @@ from arrow_matrix_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "BurnRule",
     "FlightRecorder",
     "MetricsRegistry",
+    "PulseEndpoint",
+    "PulseMonitor",
+    "SloWatchdog",
     "Tracer",
     "account_collectives",
+    "current_request",
+    "request_context",
     "account_imbalance",
     "account_memory",
     "auto_repl",

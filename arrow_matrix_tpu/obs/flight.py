@@ -23,16 +23,56 @@ from __future__ import annotations
 
 import atexit
 import collections
+import contextlib
+import contextvars
 import json
 import os
 import sys
+import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 #: Default ring capacity: enough for every phase span + per-iteration
 #: metric of a bench candidate with room to spare, small enough that
 #: the eager per-event flush stays a one-page write.
 DEFAULT_CAPACITY = 256
+
+# -- request-scoped correlation context (graft-pulse) -----------------------
+#
+# The serving runtime processes many requests through one shared
+# tracer/flight/metrics pipeline; without a shared key their streams
+# cannot be joined back into one per-request story.  The context lives
+# here (not in obs/pulse.py) because flight is the dependency-free spine
+# every other obs module already imports: the recorder stamps events,
+# the tracer stamps spans, pulse re-exports the API.  contextvars makes
+# the correlation survive both the worker-thread handoff inside one
+# request and interleaved requests on different threads.
+
+_REQUEST_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "amt_request_ctx", default=None)
+
+
+def current_request() -> Optional[Dict[str, str]]:
+    """The active request correlation context — a dict with
+    ``request_id`` (and ``tenant`` when known) — or None outside any
+    request scope."""
+    return _REQUEST_CTX.get()
+
+
+@contextlib.contextmanager
+def request_context(request_id: str,
+                    tenant: Optional[str] = None) -> Iterator[None]:
+    """Scope every flight event / tracer span / pulse observation made
+    inside the body to one request (or one batch of requests — a
+    batched key like ``"r0001+r0002"`` names every member)."""
+    ctx: Dict[str, str] = {"request_id": str(request_id)}
+    if tenant is not None:
+        ctx["tenant"] = str(tenant)
+    token = _REQUEST_CTX.set(ctx)
+    try:
+        yield
+    finally:
+        _REQUEST_CTX.reset(token)
 
 
 class FlightRecorder:
@@ -48,6 +88,12 @@ class FlightRecorder:
         self.sealed: Optional[str] = None
         self.last_memory_report: Optional[Dict[str, Any]] = None
         self.dropped = 0
+        # graft-serve records from the always-on worker thread while
+        # the submitting thread records admission events: ring append,
+        # dropped accounting, and the snapshot-for-flush must be
+        # mutually exclusive or a flush can serialize a half-updated
+        # ring.  (RLock: seal() flushes while already holding it.)
+        self._lock = threading.RLock()
         self.meta = {
             "pid": os.getpid(),
             "argv": list(sys.argv),
@@ -55,22 +101,31 @@ class FlightRecorder:
         }
 
     def record(self, kind: str, name: str, **data) -> None:
-        """Append one event (and flush, when a path is configured)."""
-        if len(self.events) == self.capacity:
-            self.dropped += 1
+        """Append one event (and flush, when a path is configured).
+        Events are stamped with the recording thread's name and, inside
+        a :func:`request_context` scope, the request id/tenant — the
+        correlation keys graft-pulse joins streams on."""
         ev: Dict[str, Any] = {"ts": time.time(), "kind": kind,
-                              "name": name}
+                              "name": name,
+                              "thread": threading.current_thread().name}
+        ctx = current_request()
+        if ctx is not None:
+            ev.update(ctx)
         if data:
             ev["data"] = data
-        self.events.append(ev)
-        if self.autoflush:
-            self.flush()
+        with self._lock:
+            if len(self.events) == self.capacity:
+                self.dropped += 1
+            self.events.append(ev)
+            if self.autoflush:
+                self.flush()
 
     def note_memory_report(self, report: Dict[str, Any]) -> None:
         """Keep the latest per-executable memory report whole (the ring
         holds it as an event too, but a wedge postmortem wants the full
         breakdown, not whatever survived the ring)."""
-        self.last_memory_report = dict(report)
+        with self._lock:
+            self.last_memory_report = dict(report)
         self.record("memreport", report.get("algorithm", "unknown"),
                     measured_bytes=report.get("measured_bytes"),
                     ratio=report.get("ratio"))
@@ -79,32 +134,38 @@ class FlightRecorder:
         """Final flush with the termination reason.  Idempotent — the
         first seal wins (an excepthook seal must not be overwritten by
         the atexit seal that follows it)."""
-        if self.sealed is None:
-            self.sealed = reason
-            self.flush()
+        with self._lock:
+            if self.sealed is None:
+                self.sealed = reason
+                self.flush()
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
-            "meta": self.meta,
-            "sealed": self.sealed,
-            "dropped": self.dropped,
-            "last_memory_report": self.last_memory_report,
-            "events": list(self.events),
-        }
+        with self._lock:
+            return {
+                "meta": self.meta,
+                "sealed": self.sealed,
+                "dropped": self.dropped,
+                "last_memory_report": self.last_memory_report,
+                "events": list(self.events),
+            }
 
     def flush(self) -> Optional[str]:
         """Atomically rewrite the artifact; returns its path (None when
         no path is configured).  Write failures are swallowed — the
-        recorder must never take down the run it is observing."""
+        recorder must never take down the run it is observing.  The
+        tmp name carries the writing thread's id so two threads
+        flushing concurrently cannot interleave one tmp file."""
         if self.path is None:
             return None
+        snap = self.snapshot()
         try:
             d = os.path.dirname(self.path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            tmp = f"{self.path}.tmp.{os.getpid()}"
+            tmp = (f"{self.path}.tmp.{os.getpid()}."
+                   f"{threading.get_ident()}")
             with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(self.snapshot(), fh)
+                json.dump(snap, fh)
             os.replace(tmp, self.path)
         except OSError:
             pass

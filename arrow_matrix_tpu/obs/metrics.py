@@ -76,37 +76,59 @@ class Gauge(_Instrument):
 
 class Histogram(_Instrument):
     """All observed values retained (runs here are bench-scale:
-    hundreds of observations, not unbounded telemetry)."""
+    hundreds of observations, not unbounded telemetry).
+
+    Value retention is also what makes the histogram *mergeable*
+    without approximation: :meth:`merge` pools the raw samples, so a
+    merged histogram's :meth:`quantile` is exactly the quantile of the
+    pooled observations — the property graft-pulse leans on when it
+    combines per-window (or per-thread) latency histograms into the
+    run-total view and asserts it equals the final SLO report.
+    """
 
     kind = "histogram"
 
-    def __init__(self, registry, name, labels):
-        super().__init__(registry, name, labels)
+    def __init__(self, registry=None, name: str = "histogram",
+                 labels: Optional[Dict[str, Any]] = None):
+        super().__init__(registry, name, labels or {})
         self.values: List[float] = []
 
     def observe(self, v: float) -> None:
         self.values.append(float(v))
         self._emit(float(v))
 
+    def quantile(self, q: float) -> Optional[float]:
+        """The ``q``-quantile (nearest-rank on the sorted samples, the
+        convention every SLO report here already used ad hoc); None on
+        an empty histogram.  ``q`` is clamped to [0, 1]."""
+        if not self.values:
+            return None
+        q = min(max(float(q), 0.0), 1.0)
+        vals = sorted(self.values)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Pool ``other``'s samples into this histogram (in place;
+        returns self for chaining).  No events are emitted — the
+        samples were already recorded where they were observed."""
+        self.values.extend(other.values)
+        return self
+
     def summary(self) -> Dict[str, float]:
         if not self.values:
             return {"count": 0}
-        vals = sorted(self.values)
-
-        def pct(q: float) -> float:
-            return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
-
+        vals = self.values
         return {
             "count": len(vals),
             "mean": sum(vals) / len(vals),
-            "min": vals[0],
-            "max": vals[-1],
-            "p50": pct(0.5),
-            "p90": pct(0.9),
+            "min": min(vals),
+            "max": max(vals),
+            "p50": self.quantile(0.5),
+            "p90": self.quantile(0.9),
             # Tail percentile for graft-serve SLO reports; with fewer
             # than ~100 observations this clamps to the max (honest
             # for a bench-scale sample).
-            "p99": pct(0.99),
+            "p99": self.quantile(0.99),
         }
 
 

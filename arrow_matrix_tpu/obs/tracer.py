@@ -80,8 +80,18 @@ class Tracer:
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
-        """Time a phase; nested spans render nested in Perfetto."""
+        """Time a phase; nested spans render nested in Perfetto.
+
+        Inside a :func:`~arrow_matrix_tpu.obs.flight.request_context`
+        scope the span args carry ``request_id`` (and ``tenant``), so
+        one Perfetto track reconstructs a served request end-to-end —
+        admission, batch formation, supervised attempts, kernel phases
+        — across the threads that handled it (explicit attrs win)."""
         args = dict(attrs)
+        ctx = flight.current_request()
+        if ctx is not None:
+            for k, v in ctx.items():
+                args.setdefault(k, v)
         tic = time.perf_counter()
         try:
             with _device_annotation(name):

@@ -16,7 +16,10 @@ requests runs over it, each under graft-heal supervision.  The pieces:
     ladder pallas_sell -> xla, repl=c -> 1, overlap S -> 1.
   * :mod:`~arrow_matrix_tpu.serve.loadgen` — deterministic synthetic
     traces and the SLO report (requests/s, p50/p99, shed counts, HBM
-    occupancy) obs_gate validates.
+    occupancy) obs_gate validates — one field vocabulary with the
+    graft-pulse streaming series (obs/pulse.py), which attaches to a
+    server via ``ArrowServer.attach_pulse`` for live windowed
+    telemetry and SLO-burn-driven degradation.
 
 Gates: ``tools/serve_gate.py`` (chaos under load — hang/kill/corrupt/
 overflow with >= 4 tenants in flight, surviving requests bit-identical

@@ -12,6 +12,17 @@ into the serving SLO report (requests/s, p50/p90/p99 latency, shed and
 rejection counts, HBM occupancy, per-tenant breakdown) —
 tools/obs_gate.py requires these fields in every serve report, and
 PERFORMANCE.md's serving table is this dict verbatim.
+
+**One schema with graft-pulse.**  The report's field names are the
+same vocabulary the streaming time series uses
+(``obs/pulse.py:SLO_SERIES_FIELDS`` / ``LATENCY_FIELDS``):
+``completed``/``failed``/``shed``/``rejected``, ``requests_per_s``,
+``latency_ms{count,p50,p90,p99,mean,max}``, ``hbm``, ``per_tenant``.
+A summary built with ``pulse=`` additionally embeds the monitor's
+closed-window series under ``"pulse"``, so a replay artifact carries
+both the end-state report and the time-resolved path to it, and the
+two can be diffed field-for-field (the obs gate asserts the pooled
+window histograms match the report's quantiles).
 """
 
 from __future__ import annotations
@@ -80,8 +91,10 @@ def latency_summary_ms(tickets: List[rq.Ticket]) -> Dict[str, float]:
 
 
 def slo_summary(server: ArrowServer, tickets: List[rq.Ticket],
-                wall_s: float) -> dict:
-    """The serving SLO report tools/obs_gate.py validates."""
+                wall_s: float, pulse=None) -> dict:
+    """The serving SLO report tools/obs_gate.py validates; pass the
+    run's :class:`~arrow_matrix_tpu.obs.pulse.PulseMonitor` to embed
+    its windowed time series (one schema, see the module docstring)."""
     base = server.summary()
     per_tenant = {}
     for name, rec in base["tenants"].items():
@@ -90,6 +103,16 @@ def slo_summary(server: ArrowServer, tickets: List[rq.Ticket],
         rec["latency_ms"] = latency_summary_ms(mine)
         per_tenant[name] = rec
     completed = base["completed"]
+    pulse_section = None
+    if pulse is not None:
+        pulse_section = {
+            "window_s": pulse.window_s,
+            "windows": pulse.series(),
+            "totals": pulse.totals_dict(),
+            "burn_events": list(pulse.burn_events),
+            "dropped_windows": pulse.dropped_windows,
+            "ring_path": pulse.ring_path,
+        }
     return {
         "server": base["server"],
         "requests": len(tickets),
@@ -107,6 +130,7 @@ def slo_summary(server: ArrowServer, tickets: List[rq.Ticket],
         "recoveries": base["recoveries"],
         "checkpoint_corruptions": base["checkpoint_corruptions"],
         "per_tenant": per_tenant,
+        "pulse": pulse_section,
     }
 
 
@@ -158,25 +182,39 @@ def smoke_serve(run_dir: str, *, n: int = 96, width: int = 16,
                 hbm_budget_bytes: Optional[int] = None,
                 max_batch_k: int = 0, registry=None) -> dict:
     """One tiny end-to-end serve run on the host-CPU backend: build a
-    BA operator, serve a deterministic trace, write the SLO artifacts
-    into ``run_dir``, return the summary.  The amt_doctor SERVE probe
-    and tools/obs_gate.py both ride this."""
+    BA operator, serve a deterministic trace with a PulseMonitor
+    attached, write the SLO artifacts (``serve_summary.json``,
+    ``pulse_ring.json``, ``pulse_metrics.prom``) into ``run_dir``,
+    return the summary.  The amt_doctor SERVE probe and
+    tools/obs_gate.py both ride this."""
+    from arrow_matrix_tpu.obs import pulse as pulse_mod
+
     if registry is None:
         from arrow_matrix_tpu.obs.metrics import MetricsRegistry
 
         registry = MetricsRegistry(run_dir=run_dir)
+    os.makedirs(run_dir, exist_ok=True)
     factory, n_rows = ba_executor_factory(n, width, seed, fmt="fold")
     server = ArrowServer(factory, ExecConfig(),
                          hbm_budget_bytes=hbm_budget_bytes,
                          queue_capacity=queue_capacity,
                          max_batch_k=max_batch_k,
                          registry=registry, name="smoke")
+    monitor = pulse_mod.PulseMonitor(
+        window_s=0.25, name="smoke",
+        ring_path=os.path.join(run_dir, "pulse_ring.json"),
+        watchdog=pulse_mod.SloWatchdog())
+    server.attach_pulse(monitor)
     trace = synthetic_trace(n_rows, tenants=tenants,
                             requests=requests, k=k,
                             iterations=iterations, seed=seed)
     t0 = time.perf_counter()
     tickets = run_trace(server, trace)
     wall = time.perf_counter() - t0
-    summary = slo_summary(server, tickets, wall)
+    monitor.close()
+    with open(os.path.join(run_dir, "pulse_metrics.prom"), "w",
+              encoding="utf-8") as fh:
+        fh.write(monitor.exposition_text())
+    summary = slo_summary(server, tickets, wall, pulse=monitor)
     write_serve_artifacts(run_dir, summary, registry=registry)
     return summary

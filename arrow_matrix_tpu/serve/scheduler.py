@@ -38,6 +38,7 @@ scenarios lean on.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import threading
 import time
@@ -128,6 +129,7 @@ class ArrowServer:
                  degrade_after: int = 2,
                  itemsize: int = 4,
                  registry=None,
+                 tracer=None,
                  name: str = "serve",
                  verbose: bool = False):
         if queue_capacity < 1:
@@ -136,6 +138,8 @@ class ArrowServer:
         self.name = name
         self.verbose = verbose
         self.registry = registry
+        self.tracer = tracer
+        self.pulse = None   # a PulseMonitor, via attach_pulse()
         self.policy = policy or RetryPolicy()
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = int(checkpoint_every)
@@ -185,7 +189,23 @@ class ArrowServer:
             print(f"[graft-serve {self.name}] {msg}", flush=True)
 
     def _event(self, event: str, **data) -> None:
+        """The one serve-event funnel: the flight recorder gets every
+        event, and — when a PulseMonitor is attached — so does the
+        streaming telemetry layer."""
         flight.record("serve", event, server=self.name, **data)
+        if self.pulse is not None:
+            try:
+                self.pulse.observe(event, **data)
+            except Exception:  # graft-lint: disable=R8 — telemetry
+                # must never take down the server it observes.
+                pass
+
+    def _span(self, name: str, **attrs):
+        """A tracer span when a tracer is attached, else a no-op (the
+        request context stamps request_id/tenant onto the span)."""
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **attrs)
 
     def _count(self, what: str, tenant: Optional[str] = None,
                **labels) -> None:
@@ -226,7 +246,17 @@ class ArrowServer:
     def submit(self, request: rq.Request) -> rq.Ticket:
         """Admission-control one request: price, reserve, enqueue —
         or reject (HBM) / shed (queue overflow) explicitly.  Returns
-        the ticket immediately; it resolves when processed."""
+        the ticket immediately; it resolves when processed.
+
+        The whole admission path runs inside the request's correlation
+        context, so the shed/reject/admit events and the ``admission``
+        span all carry its ``request_id``/``tenant``."""
+        with flight.request_context(request.request_id, request.tenant), \
+                self._span("admission", k=request.k,
+                           iterations=request.iterations):
+            return self._submit(request)
+
+    def _submit(self, request: rq.Request) -> rq.Ticket:
         ticket = rq.Ticket(request)
         ticket.submitted_s = time.monotonic()
         self._count("submitted", request.tenant)
@@ -434,7 +464,22 @@ class ArrowServer:
 
     def _process_batch(self, batch: List[rq.Ticket],
                        cfg: ExecConfig) -> None:
+        """Run one batch inside its correlation context: the batched
+        key ``"r0001+r0002"`` names every member request, so each
+        member's spans/events are recoverable from one Perfetto track
+        (membership in the joined key)."""
         key = "+".join(t.request.request_id for t in batch)
+        tenants = sorted({t.request.tenant for t in batch})
+        tenant = "+".join(tenants)
+        with flight.request_context(key, tenant), \
+                self._span("batch", requests=len(batch),
+                           k_total=sum(t.request.k for t in batch),
+                           iterations=batch[0].request.iterations,
+                           config=dataclasses.asdict(cfg)):
+            self._run_batch(batch, cfg, key)
+
+    def _run_batch(self, batch: List[rq.Ticket], cfg: ExecConfig,
+                   key: str) -> None:
         iters = batch[0].request.iterations
         k_total = sum(t.request.k for t in batch)
         for t in batch:
@@ -459,8 +504,9 @@ class ArrowServer:
                          checkpoint_every=(self.checkpoint_every
                                            if ck else 0),
                          layout=layout, registry=self.registry,
-                         verbose=False)
-        x0 = executor.set_features(x_cat)
+                         tracer=self.tracer, verbose=False)
+        with self._span("set_features", k_total=k_total):
+            x0 = executor.set_features(x_cat)
         start = 0
         if ck:
             try:
@@ -505,8 +551,15 @@ class ArrowServer:
         for t in batch:
             t.faults_seen += sup.faults_seen
             t.recoveries += sup.recoveries
+        if sup.faults_seen or sup.recoveries:
+            # Surface supervised-fault pressure into the event funnel:
+            # this is what the pulse fault_rate burn rule windows over.
+            self._event("supervised", request=key,
+                        faults=sup.faults_seen,
+                        recoveries=sup.recoveries)
         if ok:
-            self._finalize_completed(batch, y, executor, cfg)
+            with self._span("finalize", requests=len(batch)):
+                self._finalize_completed(batch, y, executor, cfg)
             self._note_faults(batch, sup.faults_seen)
         else:
             self._handle_failure(batch, err)
@@ -608,6 +661,51 @@ class ArrowServer:
                         tenant=t.request.tenant,
                         latency_ms=round(lat_ms, 3),
                         faults_seen=t.faults_seen)
+
+    # -- live telemetry (graft-pulse) --------------------------------------
+
+    def attach_pulse(self, monitor) -> Any:
+        """Wire a :class:`~arrow_matrix_tpu.obs.pulse.PulseMonitor`
+        into this server: every serve event (the :meth:`_event`
+        funnel) flows into its sliding windows, HBM occupancy is
+        sampled from the live accountant, and — when the monitor
+        carries a watchdog with no callback yet — SLO-burn trips feed
+        the per-tenant degradation ladder via
+        :meth:`note_slo_pressure`.  Measured SLO pressure then drives
+        the same rungs faults do.  Returns the monitor."""
+        self.pulse = monitor
+        acct = self.accountant
+        monitor.hbm_sampler = lambda: (acct.in_use_bytes,
+                                       acct.occupancy())
+        wd = getattr(monitor, "watchdog", None)
+        if wd is not None and wd.on_burn is None:
+            wd.on_burn = self._on_slo_burn
+        return monitor
+
+    def _on_slo_burn(self, rule, window: dict, event: dict) -> None:
+        """SloWatchdog trip callback: the tenants active in the
+        burning window (all known tenants when it names none) take
+        one forced ladder rung."""
+        tenants = sorted((window.get("per_tenant") or {}).keys())
+        self.note_slo_pressure(f"slo_burn:{rule.name}",
+                               tenants=tenants or None)
+
+    def note_slo_pressure(self, reason: str,
+                          tenants: Optional[List[str]] = None,
+                          score: Optional[int] = None) -> List[str]:
+        """Feed measured SLO pressure into the degradation ladder:
+        each named tenant (default: every known tenant) takes
+        ``score`` fault-score points (default: enough to force one
+        rung immediately).  Returns the tenants that degraded."""
+        degraded = []
+        with self._lock:
+            names = (list(tenants) if tenants is not None
+                     else sorted(self._tenants))
+            pts = self.degrade_after if score is None else int(score)
+            for tenant in names:
+                if self._degrade_tenant(tenant, pts, reason=reason):
+                    degraded.append(tenant)
+        return degraded
 
     # -- reporting ---------------------------------------------------------
 

@@ -1,0 +1,327 @@
+"""graft-pulse unit tests: deterministic window rotation (boundary
+arithmetic, bounded gap fill), mergeable-histogram exactness
+(merge == pooled samples), SLO-burn watchdog hysteresis (no flapping,
+one cleared event), crash-readable ring + Prometheus exposition
+validators, the stdlib scrape endpoint, flight-recorder thread safety
+under concurrent writers, request-id correlation on every serve span,
+and stream-vs-report consistency (the pooled window series reproduces
+the final SLO report).  The chaos-level watchdog-to-ladder scenario
+lives in tools/serve_gate.py:scenario_slo_burn_degrade."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from arrow_matrix_tpu import faults
+from arrow_matrix_tpu.faults import RetryPolicy
+from arrow_matrix_tpu.obs import Tracer, flight, pulse
+from arrow_matrix_tpu.obs.metrics import Histogram
+from arrow_matrix_tpu.obs.pulse import (
+    BurnRule,
+    PulseEndpoint,
+    PulseMonitor,
+    SloWatchdog,
+)
+from arrow_matrix_tpu.serve import (
+    ArrowServer,
+    ExecConfig,
+    ba_executor_factory,
+    run_trace,
+    slo_summary,
+    synthetic_trace,
+)
+
+N, WIDTH, K, SEED = 64, 16, 2, 5
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def factory():
+    """One BA decomposition shared by every server in this module."""
+    return ba_executor_factory(N, WIDTH, SEED, fmt="fold")
+
+
+def _mon(**kw):
+    """A monitor on a manual clock: tests advance ``now[0]``."""
+    now = [0.0]
+    kw.setdefault("window_s", 1.0)
+    return PulseMonitor(clock=lambda: now[0], **kw), now
+
+
+# ---------------------------------------------------------------------------
+# Window rotation (pure clock arithmetic)
+# ---------------------------------------------------------------------------
+
+def test_window_boundary_event_at_edge_goes_to_next_window():
+    m, now = _mon()
+    m.observe("completed", latency_ms=1.0)          # t=0.0 -> window 0
+    now[0] = 0.999
+    m.observe("completed", latency_ms=2.0)          # still window 0
+    now[0] = 1.0
+    m.observe("completed", latency_ms=3.0)   # exactly t0+w -> window 1
+    m.close()
+    s = m.series()
+    assert [w["window"] for w in s] == [0, 1]
+    assert s[0]["completed"] == 2 and s[1]["completed"] == 1
+    assert s[1]["start_s"] == pytest.approx(1.0)
+    # The boundary event's latency landed in window 1's histogram.
+    assert s[1]["latency_ms"]["max"] == pytest.approx(3.0)
+
+
+def test_idle_gap_fill_is_bounded():
+    m, now = _mon()
+    m.observe("completed", latency_ms=1.0)
+    now[0] = 1000.0                       # ~1000 windows of pure idle
+    m.observe("completed", latency_ms=2.0)
+    m.close()
+    s = m.series()
+    assert len(s) <= pulse._MAX_GAP_FILL + 3
+    assert m.dropped_windows > 0
+    idxs = [w["window"] for w in s]
+    assert idxs == sorted(idxs) and len(set(idxs)) == len(idxs)
+    assert m.totals_dict()["completed"] == 2   # totals never drop events
+    assert pulse.validate_ring(m.snapshot()) == []
+
+
+def test_partial_final_window_keeps_rate_honest():
+    m, now = _mon()
+    now[0] = 0.25
+    m.observe("completed", latency_ms=1.0)
+    now[0] = 0.5
+    m.close()
+    (w,) = m.series()
+    assert w["duration_s"] == pytest.approx(0.5)
+    assert w["requests_per_s"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# Mergeable histograms (obs/metrics.py)
+# ---------------------------------------------------------------------------
+
+def test_histogram_merge_equals_pooled():
+    a, b, pooled = Histogram(), Histogram(), Histogram()
+    for i, v in enumerate([5.0, 1.0, 9.0, 3.0, 7.0, 2.0, 8.0]):
+        (a if i % 2 else b).observe(v)
+        pooled.observe(v)
+    a.merge(b)
+    assert sorted(a.values) == sorted(pooled.values)
+    for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+        assert a.quantile(q) == pooled.quantile(q)
+
+
+def test_monitor_merged_latency_is_exactly_pooled():
+    m, now = _mon()
+    pooled = Histogram()
+    for i, ms in enumerate([3.0, 1.0, 4.0, 1.5, 9.0, 2.6]):
+        now[0] = float(i)                        # one window per event
+        m.observe("completed", latency_ms=ms)
+        pooled.observe(ms)
+    merged = m.merged_latency()
+    assert sorted(merged.values) == sorted(pooled.values)
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q) == pooled.quantile(q)
+
+
+# ---------------------------------------------------------------------------
+# SLO-burn watchdog hysteresis
+# ---------------------------------------------------------------------------
+
+def test_burn_hysteresis_never_flaps():
+    wd = SloWatchdog([BurnRule.fault_rate(0.0, min_windows=2)])
+    # One isolated bad window (w0) must NOT trip; two consecutive
+    # (w2, w3) trip once; staying bad (w4) adds nothing; the first
+    # healthy window (w5) clears once.
+    for i, f in enumerate([1, 0, 1, 1, 1, 0, 0]):
+        wd.on_window({"window": i, "faults_seen": f})
+    ev = [(e["event"], e["window"]) for e in wd.events]
+    assert ev == [("slo_burn", 3), ("slo_burn_cleared", 5)]
+    assert wd.burning() == []
+
+
+def test_burn_callback_and_flight_event(tmp_path):
+    rec = flight.FlightRecorder(str(tmp_path / "flight.json"))
+    flight.set_recorder(rec)
+    try:
+        hits = []
+        wd = SloWatchdog(
+            [BurnRule.fault_rate(0.0, min_windows=1)],
+            on_burn=lambda rule, w, ev: hits.append(
+                (rule.name, w["window"], ev["value"])))
+        wd.on_window({"window": 0, "faults_seen": 3})
+        assert hits == [("fault_rate", 0, 3.0)]
+        assert "slo_burn" in {e.get("kind") for e in rec.events}
+    finally:
+        flight.set_recorder(None)
+
+
+def test_burn_rule_missing_metric_is_not_burning():
+    r = BurnRule.p99_latency(10.0)
+    assert r.value({"window": 0}) is None
+    assert not r.burning({"window": 0,
+                          "latency_ms": {"p99": None}})
+
+
+# ---------------------------------------------------------------------------
+# Ring + exposition (artifacts and validators)
+# ---------------------------------------------------------------------------
+
+def test_ring_is_crash_readable_without_close(tmp_path):
+    ring = tmp_path / "pulse_ring.json"
+    m, now = _mon(ring_path=str(ring))
+    for i in range(3):
+        now[0] = float(i)
+        m.observe("completed", tenant="t0", latency_ms=1.0 + i)
+    now[0] = 3.0
+    m.advance()
+    # No close(): the last flush (window close) must already have left
+    # a complete, schema-valid document on disk — the SIGKILL story.
+    doc = pulse.load_ring(str(ring))
+    assert pulse.validate_ring(doc) == []
+    assert doc["closed"] is None
+    assert [w["window"] for w in doc["windows"]] == [0, 1, 2]
+    assert doc["totals"]["per_tenant"]["t0"]["completed"] == 3
+
+
+def test_exposition_parses_and_validator_catches_garbage():
+    m, now = _mon()
+    m.observe("submitted", tenant="t0")
+    m.observe("admitted", tenant="t0", queue_depth=1)
+    m.observe("completed", tenant="t0", latency_ms=2.5)
+    now[0] = 1.0
+    m.close()
+    text = m.exposition_text()
+    assert pulse.validate_exposition(text) == []
+    assert 'pulse_requests_total{status="completed"} 1' in text
+    bad = 'pulse_requests_total{status="ok" 12\nnot a line\n'
+    problems = pulse.validate_exposition(bad)
+    assert any("unparseable" in p for p in problems)
+    assert any("missing required family" in p for p in problems)
+
+
+def test_endpoint_scrapes_metrics_and_ring():
+    m, now = _mon()
+    m.observe("completed", tenant="t0", latency_ms=1.0)
+    now[0] = 1.0
+    m.advance()
+    ep = PulseEndpoint(m, port=0).start()
+    try:
+        with urllib.request.urlopen(f"{ep.url}/metrics",
+                                    timeout=10) as resp:
+            assert pulse.validate_exposition(
+                resp.read().decode()) == []
+        with urllib.request.urlopen(f"{ep.url}/pulse.json",
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+        assert pulse.validate_ring(doc) == []
+        assert doc["totals"]["completed"] == 1
+        with urllib.request.urlopen(f"{ep.url}/healthz",
+                                    timeout=10) as resp:
+            assert resp.read() == b"ok\n"
+    finally:
+        ep.stop()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: request context + concurrent writers
+# ---------------------------------------------------------------------------
+
+def test_request_context_nests_and_restores():
+    assert flight.current_request() is None
+    with flight.request_context("r1", "tenantA"):
+        assert flight.current_request() == {"request_id": "r1",
+                                            "tenant": "tenantA"}
+        with flight.request_context("r2"):
+            assert flight.current_request()["request_id"] == "r2"
+        assert flight.current_request()["request_id"] == "r1"
+    assert flight.current_request() is None
+
+
+def test_flight_concurrent_writers_lose_nothing(tmp_path):
+    path = tmp_path / "flight.json"
+    rec = flight.FlightRecorder(str(path))
+    n_threads, per = 8, 25
+
+    def work(t):
+        with flight.request_context(f"r{t:02d}", tenant=f"t{t}"):
+            for i in range(per):
+                rec.record("serve", f"ev{t}-{i}", i=i)
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(rec.events) == n_threads * per
+    by_req = {}
+    for e in rec.events:
+        assert e["thread"]                    # writer thread stamped
+        assert e["request_id"].startswith("r")
+        by_req.setdefault(e["request_id"], []).append(e)
+    assert len(by_req) == n_threads
+    for evs in by_req.values():
+        assert len(evs) == per                # no cross-thread bleed
+    rec.seal("concurrency test done")
+    doc = json.loads(path.read_text(encoding="utf-8"))
+    assert len(doc["events"]) == n_threads * per
+
+
+# ---------------------------------------------------------------------------
+# Serve integration: correlation + stream-vs-report consistency
+# ---------------------------------------------------------------------------
+
+def test_every_serve_span_carries_request_id(factory):
+    fac, n_rows = factory
+    tracer = Tracer("pulse-test")
+    srv = ArrowServer(fac, ExecConfig(),
+                      policy=RetryPolicy(backoff_s=0.001),
+                      tracer=tracer, name="pulse-span-test")
+    run_trace(srv, synthetic_trace(n_rows, tenants=2, requests=3,
+                                   k=K, iterations=2, seed=SEED))
+    assert srv.summary()["completed"] == 3
+    assert tracer.spans
+    names = {s.name for s in tracer.spans}
+    assert {"admission", "batch", "attempt", "finalize"} <= names
+    for s in tracer.spans:
+        assert s.args.get("request_id"), \
+            f"span {s.name!r} lacks request_id"
+
+
+def test_pulse_series_matches_slo_report(factory):
+    fac, n_rows = factory
+    now = [0.0]
+    mon = PulseMonitor(window_s=1.0, clock=lambda: now[0],
+                       name="pulse-report-test")
+    srv = ArrowServer(fac, ExecConfig(),
+                      policy=RetryPolicy(backoff_s=0.001),
+                      name="pulse-report-test")
+    srv.attach_pulse(mon)
+    trace = synthetic_trace(n_rows, tenants=2, requests=4, k=K,
+                            iterations=2, seed=SEED)
+    tickets = []
+    for r in trace:                       # one window per request
+        tickets.append(srv.submit(r))
+        srv.drain()
+        now[0] += 1.0
+        mon.advance()
+    mon.close("test done")
+    report = slo_summary(srv, tickets, now[0], pulse=mon)
+    pt = report["pulse"]
+    assert pt["totals"]["completed"] == report["completed"] == 4
+    assert [w["completed"] for w in pt["windows"][:4]] == [1, 1, 1, 1]
+    # The pooled stream reproduces the report's quantiles up to the
+    # scheduler's ms rounding of the completed event.
+    for q in ("p50", "p90", "p99"):
+        assert pt["totals"]["latency_ms"][q] == pytest.approx(
+            report["latency_ms"][q], abs=1e-2)
+    assert pulse.validate_ring(mon.snapshot()) == []
+    # HBM was sampled from the live accountant via attach_pulse.
+    assert pt["totals"]["hbm"]["occupancy"] is not None

@@ -15,9 +15,15 @@ predicts is the OOM-in-waiting memview exists to catch; the smoke
 ratios sit in 1.0-2.6x).  Also runs one graft-serve smoke
 (serve/loadgen.py:smoke_serve) and requires the serving SLO report to
 carry p50/p99 latency, shed/rejected counts, HBM occupancy, and the
-per-tenant breakdown.  Exits 0 on a valid run, 1 otherwise — the
-unattended pre-push / CI form of the same invariants amt_doctor's OBS
-and SERVE probes check interactively.
+per-tenant breakdown — plus the graft-pulse surfaces the smoke run
+writes: a schema-valid crash-readable pulse ring
+(``pulse_ring.json``), parseable Prometheus exposition text
+(``pulse_metrics.prom``), the embedded window series using the shared
+SLO field vocabulary, and window totals consistent with the final
+report (same completed count; pooled window quantiles equal the
+report's within the event rounding).  Exits 0 on a valid run, 1
+otherwise — the unattended pre-push / CI form of the same invariants
+amt_doctor's OBS, SERVE, and PULSE probes check interactively.
 
 Usage:
   python tools/obs_gate.py [run_dir]
@@ -106,6 +112,71 @@ def serve_problems(summary: dict) -> list:
     return problems
 
 
+def pulse_problems(summary: dict) -> list:
+    """Gate problems from the graft-pulse surfaces of a smoke serve
+    run: the on-disk ring must be crash-readable and schema-valid, the
+    exposition text parseable, and the embedded window series must be
+    CONSISTENT with the final SLO report — same completed count, and
+    pooled window latency quantiles equal to the report's within the
+    completed-event rounding (1e-3 ms).  One schema, actually
+    enforced."""
+    from arrow_matrix_tpu.obs import pulse
+
+    problems = []
+    run_dir = summary.get("_run_dir")
+    pt = summary.get("pulse")
+    if not pt:
+        return ["pulse: SLO report lacks the embedded pulse section"]
+    if run_dir:
+        ring_path = os.path.join(run_dir, "pulse_ring.json")
+        if not os.path.isfile(ring_path):
+            problems.append("pulse: pulse_ring.json artifact missing")
+        else:
+            try:
+                doc = pulse.load_ring(ring_path)
+            except Exception as e:
+                problems.append(f"pulse: ring unreadable: {e}")
+            else:
+                problems += [f"pulse ring: {p}"
+                             for p in pulse.validate_ring(doc)]
+        prom_path = os.path.join(run_dir, "pulse_metrics.prom")
+        if not os.path.isfile(prom_path):
+            problems.append("pulse: pulse_metrics.prom artifact "
+                            "missing")
+        else:
+            with open(prom_path, encoding="utf-8") as fh:
+                problems += [f"pulse exposition: {p}" for p in
+                             pulse.validate_exposition(fh.read())]
+    for w in pt.get("windows", ()):
+        missing = [f for f in pulse.SLO_SERIES_FIELDS if f not in w]
+        if missing:
+            problems.append(f"pulse: window {w.get('window')} missing "
+                            f"fields {missing}")
+            break
+    totals = pt.get("totals") or {}
+    if totals.get("completed") != summary.get("completed"):
+        problems.append(
+            f"pulse: window totals completed="
+            f"{totals.get('completed')} != SLO report completed="
+            f"{summary.get('completed')}")
+    # Pooled window quantiles vs the report: the windows partition the
+    # completed events, so the monitor's run-total histogram must
+    # reproduce the report's quantiles up to the event's ms rounding.
+    lat_total = (totals.get("latency_ms") or {})
+    lat_report = (summary.get("latency_ms") or {})
+    for q in ("p50", "p90", "p99"):
+        a, b = lat_total.get(q), lat_report.get(q)
+        if a is None or b is None:
+            if (a is None) != (b is None):
+                problems.append(f"pulse: {q} present in only one of "
+                                f"series/report")
+            continue
+        if abs(a - b) > 1e-2:
+            problems.append(f"pulse: pooled series {q}={a:.4f}ms "
+                            f"diverges from report {q}={b:.4f}ms")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
@@ -126,6 +197,7 @@ def main(argv=None) -> int:
     s = smoke_serve(serve_dir)
     s["_run_dir"] = serve_dir
     problems += serve_problems(s)
+    problems += pulse_problems(s)
     if problems:
         for p in problems:
             print(f"obs gate: {p}", file=sys.stderr)

@@ -29,6 +29,17 @@ and the server process never needing an external restart:
                    CLI run; the rerun resumes in-flight requests from
                    their sha256-verified checkpoints and the full
                    result set is bit-identical to a never-killed run.
+  slo_burn_degrade — sustained fault pressure under a deterministic
+                   pulse clock: the graft-pulse SLO-burn watchdog
+                   trips after exactly ``min_windows`` burning windows
+                   (hysteresis: the first faulty window alone never
+                   fires), feeds the degradation ladder
+                   (``slo_burn:fault_rate`` rung), emits the
+                   ``slo_burn_cleared`` recovery event on the first
+                   healthy window — and every request completes
+                   bit-identical to a fault-free run on the same base
+                   rung.  The whole pass is replayed and must
+                   reproduce the identical burn-event sequence.
 
 Exits 0 when every scenario passes, 1 otherwise.
 
@@ -226,6 +237,134 @@ def scenario_serve_hbm(factory, n_rows, ref):
     return problems
 
 
+def scenario_slo_burn_degrade(factory, n_rows):
+    """Measured SLO pressure drives the same ladder faults do: two
+    consecutive windows with injected (recovered) faults trip the
+    ``fault_rate`` burn rule, the watchdog degrades the burning
+    window's tenant one rung, the first healthy window clears the
+    burn — all on a manual clock (one window per request), so the
+    entire episode is replay-deterministic."""
+    from arrow_matrix_tpu import faults
+    from arrow_matrix_tpu.obs import flight, pulse
+    from arrow_matrix_tpu.serve import ArrowServer, ExecConfig
+
+    # overlap_slabs=2 gives the ladder a second rung (-> overlap 1)
+    # that accepts the same K, so a forced degradation has somewhere
+    # to land without changing kernels.
+    base_cfg = ExecConfig(overlap_slabs=2)
+
+    def one_pass(inject):
+        now = [0.0]
+        mon = pulse.PulseMonitor(
+            window_s=1.0, clock=lambda: now[0], name="gate-burn",
+            watchdog=pulse.SloWatchdog(
+                [pulse.BurnRule.fault_rate(0.0, min_windows=2)]))
+        # degrade_after=100: the organic recovered-fault path cannot
+        # reach a rung in this run; only the watchdog's forced score
+        # (note_slo_pressure) can move the ladder.
+        srv = ArrowServer(factory, base_cfg, queue_capacity=16,
+                          policy=_policy(), degrade_after=100,
+                          name="gate-burn")
+        srv.attach_pulse(mon)
+        tickets = []
+        try:
+            for i, r in enumerate(_trace(n_rows)):
+                if inject and i < 2:
+                    faults.set_plan({"scenario": "error",
+                                     "site": "multi_level.step",
+                                     "after": 0, "count": 1})
+                else:
+                    faults.clear_plan()
+                tickets.append(srv.submit(r))
+                srv.drain()
+                now[0] += 1.0
+                mon.advance()
+        finally:
+            faults.clear_plan()
+        mon.close("scenario done")
+        return srv, mon, tickets
+
+    ref_srv, _, ref_tickets = one_pass(inject=False)
+    if ref_srv.summary()["completed"] != REQUESTS:
+        return ["slo_burn_degrade: fault-free reference run on the "
+                "overlap base rung did not complete every request"]
+    ref = _result_bytes(ref_tickets)
+
+    srv, mon, tickets = one_pass(inject=True)
+    problems = []
+    s = srv.summary()
+    if s["completed"] != REQUESTS:
+        problems.append(f"slo_burn_degrade: {s['completed']}/"
+                        f"{REQUESTS} requests completed")
+
+    # Hysteresis + trip: exactly one burn, at window 1 (the second
+    # consecutive faulty window) — window 0 alone must never fire.
+    burns = [(e["rule"], e["window"]) for e in mon.burn_events
+             if e["event"] == "slo_burn"]
+    if burns != [("fault_rate", 1)]:
+        problems.append(f"slo_burn_degrade: expected one fault_rate "
+                        f"burn at window 1, got {burns}")
+    cleared = [(e["rule"], e["window"]) for e in mon.burn_events
+               if e["event"] == "slo_burn_cleared"]
+    if cleared != [("fault_rate", 2)]:
+        problems.append(f"slo_burn_degrade: expected one recovery "
+                        f"(slo_burn_cleared) at window 2, got "
+                        f"{cleared}")
+    faulty = [w["window"] for w in mon.series() if w["faults_seen"]]
+    if faulty != [0, 1]:
+        problems.append(f"slo_burn_degrade: injected faults landed in "
+                        f"windows {faulty}, expected [0, 1]")
+
+    # The burning window's tenant took exactly one forced rung with
+    # the watchdog's reason attached.
+    hits = [(name, d) for name, t in s["tenants"].items()
+            for d in t["degradations"]]
+    burn_hits = [(name, d) for name, d in hits
+                 if d["reason"] == "slo_burn:fault_rate"]
+    if len(burn_hits) != 1:
+        problems.append(f"slo_burn_degrade: expected exactly one "
+                        f"slo_burn:fault_rate degradation, got "
+                        f"{[(n, d['reason']) for n, d in hits]}")
+    else:
+        name, d = burn_hits[0]
+        if s["tenants"][name]["rung"] != 1 \
+                or d["to"]["overlap_slabs"] != 1:
+            problems.append(
+                f"slo_burn_degrade: tenant {name} should sit on rung "
+                f"1 (overlap_slabs=1), got rung "
+                f"{s['tenants'][name]['rung']} -> {d['to']}")
+
+    if _result_bytes(tickets) != ref:
+        problems.append("slo_burn_degrade: surviving results are not "
+                        "bit-identical to the fault-free run on the "
+                        "same base rung")
+    rec = flight.get_recorder()
+    if rec is not None:
+        kinds = {e.get("kind") for e in rec.events}
+        if "slo_burn" not in kinds:
+            problems.append("slo_burn_degrade: the watchdog trip left "
+                            "no slo_burn flight event")
+
+    # Replay determinism: the identical pass reproduces the identical
+    # burn-event sequence, ticket census, and result bytes.
+    srv2, mon2, tickets2 = one_pass(inject=True)
+    seq = [(e["event"], e["rule"], e["window"])
+           for e in mon.burn_events]
+    seq2 = [(e["event"], e["rule"], e["window"])
+            for e in mon2.burn_events]
+    if seq != seq2:
+        problems.append(f"slo_burn_degrade: burn-event sequence is "
+                        f"not replay-deterministic: {seq} vs {seq2}")
+    if [(t.status, t.reason) for t in tickets] != \
+            [(t.status, t.reason) for t in tickets2]:
+        problems.append("slo_burn_degrade: the ticket census is not "
+                        "replay-deterministic")
+    if _result_bytes(tickets2) != _result_bytes(tickets):
+        problems.append("slo_burn_degrade: replayed results are not "
+                        "bit-identical")
+    return problems
+
+
 def scenario_serve_kill(workdir):
     """SIGKILL mid-request in a checkpointing graft_serve CLI run; the
     rerun resumes and the result set is bit-identical to a never-
@@ -307,11 +446,12 @@ def run_serve_scenarios(workdir, fast=False):
     ref = _result_bytes(ref_tickets)
     problems = []
     scenarios = ["serve_hang", "serve_corrupt", "serve_overflow",
-                 "serve_hbm"]
+                 "serve_hbm", "slo_burn_degrade"]
     problems += scenario_serve_hang(factory, n_rows, ref)
     problems += scenario_serve_corrupt(factory, n_rows, ref, workdir)
     problems += scenario_serve_overflow(factory, n_rows, ref)
     problems += scenario_serve_hbm(factory, n_rows, ref)
+    problems += scenario_slo_burn_degrade(factory, n_rows)
     if not fast:
         scenarios.append("serve_kill")
         problems += scenario_serve_kill(workdir)
