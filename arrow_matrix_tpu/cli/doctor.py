@@ -285,6 +285,44 @@ def probe_pulse() -> tuple[bool, str]:
                   "`graft_serve --pulse` for the live series")
 
 
+def probe_tune() -> tuple[bool, str]:
+    """graft-tune round-trip: one tiny smoke search races its
+    subprocess children and persists a plan, and an immediate second
+    search of the unchanged structure is a pure cache hit with ZERO
+    children spawned — the acceptance property tools/tune_gate.py
+    enforces.  Bounded subprocess, as for the SERVE and PULSE probes
+    (force_cpu_devices sets env vars, so the tune children inherit
+    the CPU pinning)."""
+    code = ("import sys, tempfile; sys.argv=[]; "
+            "from arrow_matrix_tpu.utils.platform import "
+            "force_cpu_devices; force_cpu_devices(1); "
+            "from arrow_matrix_tpu.tune import smoke_tune; "
+            "d = tempfile.mkdtemp(prefix='tune_probe_'); "
+            "r1 = smoke_tune(d); r2 = smoke_tune(d); "
+            "ok = (r1['ok'] and not r1['cache_hit'] and "
+            "r1['children_spawned'] > 0 and r2['ok'] and "
+            "r2['cache_hit'] and r2['children_spawned'] == 0); "
+            "print('TUNE ok' if ok else 'TUNE FAIL: ' + "
+            "repr({'r1': {kk: r1.get(kk) for kk in ('ok', 'cache_hit', "
+            "'children_spawned', 'error')}, 'r2': {kk: r2.get(kk) "
+            "for kk in ('ok', 'cache_hit', 'children_spawned')}}))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("TUNE")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "TUNE ok":
+        return False, lines[-1][:120]
+    return True, ("smoke search + pure cache hit round-trips — run "
+                  "`graft_tune search` for a real structure")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -354,6 +392,10 @@ def main(argv=None) -> int:
 
     pulse_ok, detail = probe_pulse()
     ok &= _check("graft-pulse (endpoint scrape + schema)", pulse_ok,
+                 detail)
+
+    tune_ok, detail = probe_tune()
+    ok &= _check("graft-tune (smoke search + cache hit)", tune_ok,
                  detail)
 
     cache = "bench_cache"

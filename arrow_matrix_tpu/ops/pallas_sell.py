@@ -65,11 +65,27 @@ STREAM_K_MULTIPLE = 16   # C * 16 = 128
 
 #: Scalar-prefetch (SMEM) budget for one slab's column array.  Tiers
 #: whose cols exceed it are streamed through the kernel in row slabs.
+#: ``AMT_PALLAS_SELL_SMEM`` is the *default only*, read once at import
+#: (R9: no per-call env reads); callers — and graft-tune plans — pass
+#: ``smem_cols_budget=`` explicitly to override.
 SMEM_COLS_BUDGET = int(os.environ.get("AMT_PALLAS_SELL_SMEM",
                                       str(1 << 20)))
 
 DEFAULT_ROW_BLOCK = 256  # rows per grid program (multiple of GRANULE)
 DEFAULT_WAVE = 16        # async copies per DMA wave (streaming path)
+DEFAULT_RING = 2         # DMA waves in flight (VMEM ring depth)
+
+
+def slab_rows(m_t: int, rb: int,
+              smem_cols_budget: Optional[int] = None) -> int:
+    """Rows per slot-major slab: as many ``rb``-row blocks as fit the
+    scalar-prefetch budget (``m_t * 4`` bytes of int32 cols per row),
+    never less than one row block — a tier whose per-row cols alone
+    exceed the budget still streams, one block at a time."""
+    budget = (SMEM_COLS_BUDGET if smem_cols_budget is None
+              else smem_cols_budget)
+    per_row = m_t * 4
+    return max(rb, (budget // max(per_row, 1)) // rb * rb)
 
 
 def pack_features_t(x_t: jax.Array) -> jax.Array:
@@ -100,7 +116,7 @@ def _select_accumulate(lines, cols_j, w_j, r, k):
 
 def _make_slab_call(m_t: int, slab: int, k: int, row_block: int,
                     binary: bool, stream: bool, wave: int,
-                    interpret: bool):
+                    interpret: bool, ring: int = DEFAULT_RING):
     """One ``pallas_call`` over a (m_t, slab) column slab -> packed
     (slab // C, C*k) f32 partial output."""
     import jax.experimental.pallas as pl
@@ -152,11 +168,12 @@ def _make_slab_call(m_t: int, slab: int, k: int, row_block: int,
         def copy(j, w, r):
             """The (slot j, wave w, lane r) granule fetch: address from
             SMEM (scalar prefetch), destination its own scratch row,
-            semaphore by wave parity — two waves in flight."""
+            semaphore by wave modulo the ring depth — up to ``ring``
+            waves in flight."""
             rr = w * wave + r
             g = cols_smem[j, row0 + rr] // c
             return pltpu.make_async_copy(
-                x_any.at[g], scratch.at[rr], sems.at[w % 2, r])
+                x_any.at[g], scratch.at[rr], sems.at[w % ring, r])
 
         def issue(j, w):
             jax.lax.fori_loop(
@@ -167,13 +184,18 @@ def _make_slab_call(m_t: int, slab: int, k: int, row_block: int,
                 0, wave, lambda r, _: (copy(j, w, r).wait(), 0)[1], 0)
 
         def slot_body(j, acc):
-            issue(j, 0)
+            # Prologue: fill the ring — waves 0..ring-2 in flight (the
+            # steady state tops the ring up to ``ring`` deep; ring=1
+            # degenerates to issue-then-wait, fully serial).
+            for p in range(min(ring - 1, n_waves)):
+                issue(j, p)
 
             def wave_body(w, carry):
-                @pl.when(w + 1 < n_waves)
+                @pl.when(w + ring - 1 < n_waves)
                 def _():
-                    issue(j, w + 1)        # double buffer: next wave in
-                wait(j, w)                 # flight while this one lands
+                    issue(j, w + ring - 1)  # top up: deepest wave whose
+                wait(j, w)                  # sem slot is free of w's
+
                 return carry
 
             jax.lax.fori_loop(0, n_waves, wave_body, 0)
@@ -202,7 +224,7 @@ def _make_slab_call(m_t: int, slab: int, k: int, row_block: int,
                                lambda i, sc: (i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=([pltpu.VMEM((row_block, lanes), jnp.float32),
-                         pltpu.SemaphoreType.DMA((2, wave))]
+                         pltpu.SemaphoreType.DMA((ring, wave))]
                         if stream else []),
     )
     kernel = kernel_stream if stream else kernel_vectorized
@@ -231,18 +253,26 @@ def sell_tier_spmm_packed(cols: jax.Array, x_packed: jax.Array,
                           row_block: int = DEFAULT_ROW_BLOCK,
                           wave: int = DEFAULT_WAVE,
                           stream: Optional[bool] = None,
-                          interpret: Optional[bool] = None) -> jax.Array:
+                          interpret: Optional[bool] = None,
+                          smem_cols_budget: Optional[int] = None,
+                          ring: int = DEFAULT_RING) -> jax.Array:
     """One tier's fused SpMM against granule-packed features.
 
     cols: (m_t, n_t) slot-major int32; x_packed: (n_gran, C*k) from
     :func:`pack_features_t`; ``data`` (m_t, n_t) weighted or ``deg``
     (n_t,) binary.  Returns (n_t, k) f32 — row-major (the caller
     re-majors per call, see :func:`sell_spmm_t_pallas`).
+
+    ``smem_cols_budget`` bounds one slab's scalar-prefetch bytes
+    (default: module-level :data:`SMEM_COLS_BUDGET`); ``ring`` is the
+    DMA ring depth of the streaming path (waves in flight).
     """
     if interpret is None:
         interpret = _interpret()
     if stream is None:
         stream = not interpret
+    if ring < 1:
+        raise ValueError(f"ring depth must be >= 1, got {ring}")
     m_t, n_t = cols.shape
     k = x_packed.shape[1] // GRANULE
     if data is None and deg is None and m_t > 0:
@@ -279,13 +309,12 @@ def sell_tier_spmm_packed(cols: jax.Array, x_packed: jax.Array,
 
     # Slot-major slab streaming: bound each call's scalar-prefetch
     # (SMEM) bytes; every slab is a whole number of row blocks.
-    per_row = m_t * 4
-    slab = max(rb, (SMEM_COLS_BUDGET // max(per_row, 1)) // rb * rb)
+    slab = slab_rows(m_t, rb, smem_cols_budget)
     outs = []
     for lo in range(0, rows_pad, slab):
         hi = min(lo + slab, rows_pad)
         call = _make_slab_call(m_t, hi - lo, k, rb, binary, stream, w,
-                               interpret)
+                               interpret, ring=ring)
         outs.append(call(
             jax.lax.slice_in_dim(cols, lo, hi, axis=1),
             jax.lax.slice_in_dim(weights, lo, hi, axis=1),
@@ -298,7 +327,9 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
                        row_block: int = DEFAULT_ROW_BLOCK,
                        wave: int = DEFAULT_WAVE,
                        stream: Optional[bool] = None,
-                       interpret: Optional[bool] = None) -> jax.Array:
+                       interpret: Optional[bool] = None,
+                       smem_cols_budget: Optional[int] = None,
+                       ring: int = DEFAULT_RING) -> jax.Array:
     """Drop-in fused twin of ``ops.sell.sell_spmm_t``: (k, n_rows)
     feature-major output, one kernel launch stream per tier, outputs
     concatenated along the sorted row axis (tiers are contiguous runs
@@ -317,7 +348,8 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
             data=None if m.data is None else m.data[t],
             deg=None if m.deg is None else m.deg[t],
             row_block=row_block, wave=wave, stream=stream,
-            interpret=interpret)
+            interpret=interpret, smem_cols_budget=smem_cols_budget,
+            ring=ring)
         outs.append(out_t.T.astype(x_t.dtype))               # (k, n_t)
     if not outs:
         return jnp.zeros((k, 0), dtype=x_t.dtype)
@@ -332,11 +364,16 @@ def supported_feature_width(k: int) -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=("row_block", "wave",
-                                             "stream", "interpret"))
+                                             "stream", "interpret",
+                                             "smem_cols_budget", "ring"))
 def sell_spmm_t_pallas_jit(m: SellMatrix, x_t: jax.Array,
                            row_block: int = DEFAULT_ROW_BLOCK,
                            wave: int = DEFAULT_WAVE,
                            stream: Optional[bool] = None,
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           smem_cols_budget: Optional[int] = None,
+                           ring: int = DEFAULT_RING) -> jax.Array:
     return sell_spmm_t_pallas(m, x_t, row_block=row_block, wave=wave,
-                              stream=stream, interpret=interpret)
+                              stream=stream, interpret=interpret,
+                              smem_cols_budget=smem_cols_budget,
+                              ring=ring)

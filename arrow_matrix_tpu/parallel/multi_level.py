@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 from typing import List, Optional, Sequence
 
 import jax
@@ -195,7 +196,9 @@ class MultiLevelArrow:
                  layout: str = "slim", arm_axis: str = "arm",
                  fold_growth: float = 1.2,
                  fold_align: Optional[int] = None,
-                 overlap_slabs: int = 1, repl: int = 1):
+                 overlap_slabs: int = 1, repl: int = 1,
+                 plan=None, plan_k: Optional[int] = None,
+                 kernel_opts: Optional[dict] = None):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -206,6 +209,43 @@ class MultiLevelArrow:
         the features sharded on rows only."""
         if not levels:
             raise ValueError("empty decomposition")
+        # graft-tune consumption: a resolved TunePlan REPLACES the
+        # per-knob arguments (the plan is one configuration object —
+        # hand-set knobs compose with plan=None).  ``plan="auto"``
+        # hashes the structure and looks the cache up; a miss or
+        # version skew warns TunePlanMiss and proceeds on the defaults
+        # given here — loudly, never silently.
+        self.tune_plan = None
+        self.kernel_opts = dict(kernel_opts) if kernel_opts else {}
+        if plan is not None:
+            if mesh is not None:
+                warnings.warn(
+                    "tune plans target the single-chip fold path; "
+                    "ignoring plan= on a mesh "
+                    "(SellSlim/SellMultiLevel consume plans for the "
+                    "mesh executors)", UserWarning, stacklevel=2)
+            else:
+                from arrow_matrix_tpu.tune.plan import resolve_plan
+
+                resolved = resolve_plan(
+                    plan, levels=levels, width=width, dtype=dtype,
+                    growth=fold_growth, slot_align=fold_align,
+                    binary=binary, plan_k=plan_k)
+                if resolved is not None:
+                    self.tune_plan = resolved
+                    bk = resolved.build_kwargs()
+                    fmt = bk["fmt"]
+                    kernel = bk["kernel"]
+                    chunk = bk["chunk"]
+                    fold_growth = bk["fold_growth"]
+                    fold_align = bk["fold_align"]
+                    feature_dtype = bk["feature_dtype"]
+                    overlap_slabs = bk["overlap_slabs"]
+                    repl = bk["repl"]
+                    # Explicit kernel_opts beat the plan's (a caller
+                    # overriding one fused-kernel knob keeps the rest).
+                    self.kernel_opts = {**resolved.kernel_opts(),
+                                        **self.kernel_opts}
         dtype = resolve_block_dtype(dtype)
         # Carried-feature storage dtype — the k=128 amortization
         # lever, where the gather turns bandwidth-bound
@@ -618,6 +658,10 @@ class MultiLevelArrow:
         kernel = getattr(self, "kernel", "xla")
         slabs = int(getattr(self, "overlap_slabs", 1))
         repl = int(getattr(self, "repl", 1))
+        # Tuned fused-kernel call knobs (graft-tune): row_block / wave
+        # / smem_cols_budget / ring, captured at build time — no env
+        # reads inside the jitted step (lint R9).
+        kopts = dict(getattr(self, "kernel_opts", None) or {})
 
         def fold_slab(xt, blocks):
             if kernel == "pallas_sell":
@@ -627,7 +671,7 @@ class MultiLevelArrow:
                     sell_spmm_t_pallas,
                 )
 
-                return sell_spmm_t_pallas(blocks[0], xt)
+                return sell_spmm_t_pallas(blocks[0], xt, **kopts)
             if chunk == "auto":
                 return sell_spmm_t(blocks[0], xt,
                                    gather_budget=gather_budget)

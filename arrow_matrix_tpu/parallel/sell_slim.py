@@ -956,7 +956,26 @@ class SellSlim:
                  axis: str = "blocks", dtype=np.float32,
                  binary="auto", feature_dtype=None, ladder=None,
                  overlap_slabs: int = 1,
-                 repl_axis: Optional[str] = None):
+                 repl_axis: Optional[str] = None,
+                 plan=None, plan_k: Optional[int] = None):
+        # graft-tune consumption: the plan's structural knobs map onto
+        # this executor's vocabulary — tier split -> ladder, overlap S,
+        # carriage dtype.  (repl stays mesh-determined via repl_axis;
+        # the fused kernel knobs are fold-path-only.)  A single matrix
+        # has no levels to hash, so plan='auto' is a loud error here —
+        # pass a TunePlan/dict, or use SellMultiLevel/MultiLevelArrow.
+        self.tune_plan = None
+        if plan is not None:
+            from arrow_matrix_tpu.tune.plan import resolve_plan
+
+            resolved = resolve_plan(plan, plan_k=plan_k)
+            if resolved is not None:
+                self.tune_plan = resolved
+                ladder = (resolved.fold_growth,
+                          SLOT_ALIGN if resolved.fold_align is None
+                          else resolved.fold_align)
+                overlap_slabs = resolved.overlap_slabs
+                feature_dtype = resolved.feature_dtype
         # The source canonicalizes (in-memory CSR up front, memmapped
         # triplets per slice): binary detection must see canonical
         # values — duplicate all-ones entries sum to non-unit weights
@@ -1138,7 +1157,8 @@ class SellMultiLevel:
                  routing: str = "a2a",
                  feat_axis: Optional[str] = None, feature_dtype=None,
                  ladder=None, overlap_slabs: int = 1,
-                 repl_axis: Optional[str] = None):
+                 repl_axis: Optional[str] = None,
+                 plan=None, plan_k: Optional[int] = None):
         """``routing``: "a2a" (default) compiles the inter-level
         reorderings into explicit per-device send/recv tables over one
         fixed-shape all_to_all each (parallel/routing.py — tier-padding
@@ -1150,6 +1170,24 @@ class SellMultiLevel:
         the a2a tables are per-device and feature-row-independent, so
         each feature slice runs its own identical exchange."""
         from arrow_matrix_tpu.parallel.multi_level import pad_permutation
+
+        # graft-tune consumption (see SellSlim): with the full levels
+        # in hand this executor supports plan="auto" — hash the
+        # structure, look the cached plan up, fall back LOUDLY on miss.
+        self.tune_plan = None
+        if plan is not None:
+            from arrow_matrix_tpu.tune.plan import resolve_plan
+
+            resolved = resolve_plan(plan, levels=levels, width=width,
+                                    dtype=dtype, binary=binary,
+                                    plan_k=plan_k)
+            if resolved is not None:
+                self.tune_plan = resolved
+                ladder = (resolved.fold_growth,
+                          SLOT_ALIGN if resolved.fold_align is None
+                          else resolved.fold_align)
+                overlap_slabs = resolved.overlap_slabs
+                feature_dtype = resolved.feature_dtype
 
         if routing not in ("gather", "a2a"):
             raise ValueError(f"unknown routing {routing!r}")

@@ -151,11 +151,18 @@ def write_serve_artifacts(run_dir: str, summary: dict,
 
 def ba_executor_factory(n: int, width: int, seed: int,
                         fmt: str = "fold", mesh=None,
-                        feature_dtype=None):
+                        feature_dtype=None, plan=None,
+                        plan_k=None):
     """Factory-of-executors over one Barabasi-Albert decomposition:
     the decomposition is computed once (the resident operator), each
     :class:`ExecConfig` rung builds its own executor over the same
-    levels.  Returns ``(factory, n_rows)``."""
+    levels.  Returns ``(factory, n_rows)``.
+
+    ``plan`` (graft-tune) threads into every rung's build: the rung's
+    ExecConfig still wins on kernel/overlap/repl — the degradation
+    ladder must be able to step a tuned knob down — while the plan
+    contributes the structural knobs (tier split, chunk, carriage
+    dtype) and the fused-kernel call opts."""
     from arrow_matrix_tpu.decomposition import arrow_decomposition
     from arrow_matrix_tpu.utils import barabasi_albert
 
@@ -163,14 +170,31 @@ def ba_executor_factory(n: int, width: int, seed: int,
     levels = arrow_decomposition(a, width, max_levels=10,
                                  block_diagonal=True, seed=seed)
 
+    resolved = None
+    if plan is not None:
+        from arrow_matrix_tpu.tune.plan import resolve_plan
+
+        resolved = resolve_plan(plan, levels=levels, width=width,
+                                plan_k=plan_k)
+
     def factory(cfg: ExecConfig):
         from arrow_matrix_tpu.parallel import MultiLevelArrow
 
-        return MultiLevelArrow(levels, width, mesh=mesh, fmt=fmt,
+        kwargs = dict(fmt=fmt, feature_dtype=feature_dtype)
+        kernel_opts = None
+        if resolved is not None:
+            bk = resolved.build_kwargs()
+            kwargs.update(fmt=bk["fmt"], chunk=bk["chunk"],
+                          fold_growth=bk["fold_growth"],
+                          fold_align=bk["fold_align"],
+                          feature_dtype=bk["feature_dtype"])
+            kernel_opts = resolved.kernel_opts()
+        return MultiLevelArrow(levels, width, mesh=mesh,
                                kernel=cfg.kernel,
                                overlap_slabs=cfg.overlap_slabs,
                                repl=cfg.repl,
-                               feature_dtype=feature_dtype)
+                               kernel_opts=kernel_opts,
+                               **kwargs)
 
     return factory, n
 

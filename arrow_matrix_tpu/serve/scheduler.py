@@ -131,7 +131,24 @@ class ArrowServer:
                  registry=None,
                  tracer=None,
                  name: str = "serve",
-                 verbose: bool = False):
+                 verbose: bool = False,
+                 tune_plan=None):
+        # graft-tune pickup: a cached TunePlan (or its dict) becomes
+        # the BASE ladder rung — admitted requests run the tuned
+        # kernel/repl/overlap at zero search cost, and the degradation
+        # ladder below still steps every tuned knob back down under
+        # pressure.  The executor_factory sees the tuned ExecConfig
+        # like any other rung; factories that also consume the plan's
+        # structural knobs thread ``plan=`` themselves
+        # (serve/loadgen.ba_executor_factory).
+        self.tune_plan = None
+        if tune_plan is not None:
+            from arrow_matrix_tpu.tune.plan import resolve_plan
+
+            resolved = resolve_plan(tune_plan)
+            if resolved is not None:
+                self.tune_plan = resolved
+                base_config = resolved.exec_config()
         if queue_capacity < 1:
             raise ValueError(f"queue_capacity must be >= 1, got "
                              f"{queue_capacity}")
@@ -181,6 +198,14 @@ class ArrowServer:
         self._event("started", resident_bytes=resident,
                     budget_bytes=self.accountant.budget_bytes,
                     ladder=[dataclasses.asdict(c) for c in self.ladder])
+        if self.tune_plan is not None:
+            self._event("tune_plan_applied",
+                        structure_hash=self.tune_plan.structure_hash,
+                        candidate=self.tune_plan.candidate,
+                        k=self.tune_plan.k,
+                        measured_ms=self.tune_plan.measured_ms,
+                        margin=self.tune_plan.margin,
+                        base_config=dataclasses.asdict(base_config))
 
     # -- plumbing ----------------------------------------------------------
 

@@ -1,0 +1,56 @@
+"""graft-tune: structure-specialized kernel autotuning with a
+persistent plan cache.
+
+The loop (README "graft-tune" section): **search** — fingerprint the
+decomposition's structure and race the pruned candidate space in
+subprocess-isolated bench children; **cache** — persist the winner as
+a versioned :class:`TunePlan` keyed by the structure hash; **consume**
+— executors built with ``plan="auto"`` (and the graft-serve scheduler)
+resolve hash → cached plan → knobs at zero search cost, falling back
+LOUDLY on a miss; **degrade** — the serving degradation ladder steps
+any tuned knob back down under pressure.
+"""
+
+from arrow_matrix_tpu.tune.fingerprint import (
+    FINGERPRINT_VERSION,
+    fingerprint_hash,
+    structure_fingerprint,
+    structure_hash,
+)
+from arrow_matrix_tpu.tune.plan import (
+    PLAN_VERSION,
+    TunePlan,
+    TunePlanMiss,
+    load_plan,
+    plan_dir,
+    plan_path,
+    resolve_plan,
+    save_plans,
+)
+from arrow_matrix_tpu.tune.search import (
+    search,
+    smoke_tune,
+)
+from arrow_matrix_tpu.tune.space import (
+    Candidate,
+    enumerate_candidates,
+)
+
+__all__ = [
+    "FINGERPRINT_VERSION",
+    "PLAN_VERSION",
+    "Candidate",
+    "TunePlan",
+    "TunePlanMiss",
+    "enumerate_candidates",
+    "fingerprint_hash",
+    "load_plan",
+    "plan_dir",
+    "plan_path",
+    "resolve_plan",
+    "save_plans",
+    "search",
+    "smoke_tune",
+    "structure_fingerprint",
+    "structure_hash",
+]

@@ -1,0 +1,33 @@
+"""``python -m arrow_matrix_tpu.tune`` — the candidate-child entry
+point (``--candidate <name>``, config via the ``AMT_TUNE_CFG``
+environment JSON, result as the final stdout JSON line — the
+``bench.py`` child protocol) plus a passthrough to the ``graft_tune``
+CLI for interactive use."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv[:1] == ["--candidate"]:
+        from arrow_matrix_tpu.tune.search import candidate_child_main
+
+        cfg = json.loads(os.environ["AMT_TUNE_CFG"])
+        try:
+            out = candidate_child_main(cfg)
+        except Exception as e:  # noqa: BLE001 — one line, parent parses
+            out = {"name": cfg.get("candidate", {}).get("name"),
+                   "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(out), flush=True)
+        return 0 if out.get("error") is None else 1
+    from arrow_matrix_tpu.cli.graft_tune import main as cli_main
+
+    return cli_main(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

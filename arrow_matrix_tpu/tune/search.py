@@ -1,0 +1,368 @@
+"""The autotune search loop (graft-tune).
+
+``search()`` closes the loop the ISSUE-10 tentpole names: fingerprint
+the structure (``tune/fingerprint.py``), short-circuit on a cached
+plan (a second search of an unchanged graph spawns ZERO bench
+children — the property ``tools/tune_gate.py`` verifies), otherwise
+enumerate + prune the candidate space (``tune/space.py``), race the
+survivors in subprocess-isolated children exactly the way ``bench.py``
+races formats — each candidate in its own timeout-guarded process
+with the flight recorder installed — and persist the winner as a
+versioned :class:`~arrow_matrix_tpu.tune.plan.TunePlan`.
+
+Eligibility: a candidate may only WIN if its full-precision output is
+bit-identical (``np.array_equal``, f32) to the golden ``ops/sell.py``
+fold path — computed once in the parent as the default executor's
+``gather_result(step(x))`` on a seeded input, in original row order.
+The default configuration is itself always raced (and is trivially
+bit-identical), so a winner always exists; candidates that lose
+bit-identity (or are dtype experiments) are still timed and recorded
+as diagnostics in the report.
+
+Children are real subprocesses on purpose: a wedged compile or a
+device grab costs ONE candidate its timeout, never the search; a
+killed child leaves its flight-recorder ring behind
+(``bench_cache/flight/tune_<candidate>.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from arrow_matrix_tpu.tune.fingerprint import (
+    fingerprint_hash,
+    structure_fingerprint,
+)
+from arrow_matrix_tpu.tune.plan import (
+    PLAN_VERSION,
+    TunePlan,
+    load_plan,
+    save_plans,
+)
+from arrow_matrix_tpu.tune.space import Candidate, enumerate_candidates
+
+#: Seed of the deterministic bit-identity input (shared parent/child).
+GOLDEN_SEED = 3
+
+
+def load_levels_from_source(source: dict):
+    """Rebuild the decomposition a child (or the parent) searches
+    over.  Two source kinds:
+
+    * ``{"kind": "ba", "n", "m", "width", "seed", "max_levels"}`` —
+      regenerate a Barabasi-Albert graph and decompose it (both fully
+      seeded, so every process sees the identical structure);
+    * ``{"kind": "dir", "base", "width"}`` — load a committed
+      ``io/graphio.py`` artifact directory (the two bench_cache
+      graphs ship with checked-in plans).
+
+    Returns ``(levels, width)``.
+    """
+    kind = source.get("kind")
+    if kind == "ba":
+        from arrow_matrix_tpu.decomposition import arrow_decomposition
+        from arrow_matrix_tpu.utils import barabasi_albert
+
+        a = barabasi_albert(int(source["n"]), int(source.get("m", 3)),
+                            seed=int(source["seed"]))
+        width = int(source["width"])
+        levels = arrow_decomposition(
+            a, width, max_levels=int(source.get("max_levels", 10)),
+            block_diagonal=True, seed=int(source["seed"]))
+        return levels, width
+    if kind == "dir":
+        from arrow_matrix_tpu.io.graphio import (
+            as_levels,
+            load_decomposition,
+            load_level_widths,
+        )
+
+        base = source["base"]
+        width = source.get("width")
+        loaded = load_decomposition(base, width, block_diagonal=True)
+        widths = load_level_widths(base, width, len(loaded))
+        levels = as_levels(loaded, widths)
+        return levels, int(np.max(np.asarray(widths)))
+    raise ValueError(f"unknown levels source kind {kind!r}")
+
+
+def _build_executor(levels, width: int, cand: Candidate):
+    """One candidate's executor over already-loaded levels (single
+    chip — the tuned path is the fold/serve path, mesh=None)."""
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    kwargs: Dict[str, Any] = {"fmt": "fold"}
+    kwargs.update(cand.build)
+    return MultiLevelArrow(levels, width, mesh=None,
+                           kernel_opts=dict(cand.kernel_opts) or None,
+                           **kwargs)
+
+
+def _golden_output(levels, width: int, x_host: np.ndarray) -> np.ndarray:
+    """The golden: the DEFAULT fold executor — the ``ops/sell.py``
+    ``sell_spmm_t`` path — stepped once, gathered back to original row
+    order, f32."""
+    multi = _build_executor(levels, width, Candidate("default"))
+    x = multi.set_features(x_host)
+    return np.asarray(multi.gather_result(multi.step(x)),
+                      dtype=np.float32)
+
+
+def _flight_install(name: str) -> None:
+    """Best-effort black-box recorder in a tune child (bench.py's
+    ``_install_flight`` contract: a SIGKILLed child still leaves its
+    last-known state on disk)."""
+    try:
+        from arrow_matrix_tpu.obs import flight
+
+        path = os.path.join(
+            os.environ.get("AMT_FLIGHT_DIR",
+                           os.path.join("bench_cache", "flight")),
+            f"{name}.json")
+        flight.install(path)
+    except Exception as e:  # noqa: BLE001 — never cost the measurement
+        print(f"[tune] flight recorder unavailable: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+
+
+def candidate_child_main(cfg: dict) -> dict:
+    """Body of one candidate subprocess (``python -m
+    arrow_matrix_tpu.tune --candidate <name>``): build, verify
+    bit-identity vs the parent's golden artifact, measure ms/iter.
+    Prints nothing itself — the caller emits the returned dict as the
+    final JSON line (``utils/artifacts.parse_last_json_line`` contract).
+    """
+    if (os.environ.get("AMT_BENCH_FORCECPU") == "1"
+            or os.environ.get("AMT_BENCH_CPU") == "1"):
+        from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices()
+    name = cfg["candidate"]["name"]
+    _flight_install(f"tune_{name}")
+    from arrow_matrix_tpu.obs import chained_iteration_ms
+    from arrow_matrix_tpu.utils.graphs import random_dense
+
+    cand = Candidate(name, build=cfg["candidate"].get("build") or {},
+                     kernel_opts=cfg["candidate"].get("kernel_opts")
+                     or {})
+    levels, width = load_levels_from_source(cfg["source"])
+    multi = _build_executor(levels, width, cand)
+    k = int(cfg["k"])
+    x_host = random_dense(multi.n, k, seed=GOLDEN_SEED)
+    x = multi.set_features(x_host)
+
+    bit_identical = None
+    golden_path = cfg.get("golden_path")
+    if golden_path:
+        golden = np.load(golden_path)
+        mine = np.asarray(multi.gather_result(multi.step(x)),
+                          dtype=np.float32)
+        bit_identical = bool(np.array_equal(mine, golden))
+
+    ms = chained_iteration_ms(multi.run, x, int(cfg.get("iters", 3)))
+    return {"name": name, "ms": round(float(ms), 4),
+            "bit_identical": bit_identical}
+
+
+def _spawn_tune_candidate(cand: Candidate, cfg: dict,
+                          timeout_s: float, platform: str) -> dict:
+    """One candidate subprocess -> its parsed JSON (or an error dict);
+    every failure shape is contained to the returned dict, the
+    ``bench.py _spawn_candidate`` contract."""
+    from arrow_matrix_tpu.utils.artifacts import parse_last_json_line
+
+    child_cfg = dict(cfg)
+    child_cfg["candidate"] = {"name": cand.name, "build": cand.build,
+                              "kernel_opts": cand.kernel_opts}
+    env = dict(os.environ, AMT_TUNE_CFG=json.dumps(child_cfg))
+    if platform == "cpu":
+        env["AMT_BENCH_FORCECPU"] = "1"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.abspath(os.path.join("bench_cache",
+                                                "xla_cache")))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "arrow_matrix_tpu.tune",
+             "--candidate", cand.name],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        err: Dict[str, Any] = {"name": cand.name,
+                               "error": f"timed out after "
+                                        f"{timeout_s:.0f}s",
+                               "timed_out": True}
+        fp = os.path.join(
+            os.environ.get("AMT_FLIGHT_DIR",
+                           os.path.join("bench_cache", "flight")),
+            f"tune_{cand.name}.json")
+        if os.path.exists(fp):
+            err["flight"] = fp
+        return err
+    if proc.returncode != 0 or not proc.stdout.strip():
+        return {"name": cand.name,
+                "error": f"rc={proc.returncode}: "
+                         f"{proc.stderr.strip()[-400:]}"}
+    rec = parse_last_json_line(proc.stdout)
+    if rec is None:
+        return {"name": cand.name,
+                "error": f"unusable child output: "
+                         f"{proc.stdout.strip()[-200:]}"}
+    return rec
+
+
+def _plan_from_candidate(cand: Candidate, h: str, k: int) -> TunePlan:
+    """Fold a candidate's overrides over the default knob set."""
+    base = TunePlan(structure_hash=h, k=int(k)).to_dict()
+    base.update({kk: v for kk, v in cand.build.items()})
+    base.update({kk: v for kk, v in cand.kernel_opts.items()})
+    base["candidate"] = cand.name
+    return TunePlan.from_dict(base)
+
+
+def search(source: dict, k: int, *, iters: int = 3,
+           timeout_s: float = 240.0, dtype=np.float32,
+           plan_dir: Optional[str] = None, refresh: bool = False,
+           allow_int8: bool = False,
+           restrict: Optional[List[str]] = None,
+           run_dir: Optional[str] = None,
+           quiet: bool = False) -> Tuple[Optional[TunePlan], dict]:
+    """Search (or cache-hit) the tuned plan for one (structure, k).
+
+    Returns ``(plan, report)``.  ``report["cache_hit"]`` /
+    ``report["children_spawned"]`` are the gate's purity evidence: an
+    unchanged graph's second search is a pure cache hit with zero
+    children.  ``refresh=True`` forces a re-search.
+    """
+    from arrow_matrix_tpu.utils.platform import host_load
+
+    def _say(msg: str) -> None:
+        if not quiet:
+            print(f"[graft-tune] {msg}", file=sys.stderr, flush=True)
+
+    t0 = time.perf_counter()
+    levels, width = load_levels_from_source(source)
+    fp = structure_fingerprint(levels, width, dtype=dtype)
+    h = fingerprint_hash(fp)
+    _say(f"structure {h} (n={fp['n']}, total_rows={fp['total_rows']}, "
+         f"{len(fp['ladder']['rows'])} tiers)")
+
+    if not refresh:
+        cached = load_plan(h, k, plan_dir, quiet=True)
+        if cached is not None:
+            _say(f"cache HIT for k={k}: candidate "
+                 f"{cached.candidate!r} ({cached.measured_ms} ms, "
+                 f"margin {cached.margin})")
+            return cached, {
+                "structure_hash": h, "k": int(k), "cache_hit": True,
+                "children_spawned": 0,
+                "lookup_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                "plan": cached.to_dict(),
+            }
+
+    platform = "cpu"
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except (ImportError, RuntimeError):  # searchable without a device
+        pass
+    evaluator = "cpu-interpret" if platform == "cpu" else platform
+
+    cands, pruned = enumerate_candidates(
+        fp, k, platform=platform, allow_int8=allow_int8,
+        restrict=restrict)
+    for name, why in pruned.items():
+        _say(f"pruned {name}: {why}")
+
+    run_dir = run_dir or os.path.join("bench_cache", "tune_runs", h)
+    os.makedirs(run_dir, exist_ok=True)
+    golden_path = os.path.join(run_dir, f"golden_k{int(k)}.npy")
+    from arrow_matrix_tpu.utils.graphs import random_dense
+
+    x_host = random_dense(fp["n"], int(k), seed=GOLDEN_SEED)
+    np.save(golden_path, _golden_output(levels, width, x_host))
+
+    cfg = {"source": source, "k": int(k), "iters": int(iters),
+           "golden_path": os.path.abspath(golden_path)}
+    results: Dict[str, dict] = {}
+    for cand in cands:
+        _say(f"racing {cand.name}")
+        results[cand.name] = _spawn_tune_candidate(
+            cand, cfg, timeout_s, platform)
+        r = results[cand.name]
+        _say(f"  {cand.name}: ms={r.get('ms')} "
+             f"bit_identical={r.get('bit_identical')} "
+             f"err={r.get('error')}")
+
+    default_ms = results.get("default", {}).get("ms")
+    eligible = [c for c in cands
+                if c.eligible
+                and results[c.name].get("error") is None
+                and results[c.name].get("ms") is not None
+                and results[c.name].get("bit_identical") is True]
+    if not eligible:
+        _say("no eligible candidate (default failed?) — no plan saved")
+        return None, {
+            "structure_hash": h, "k": int(k), "cache_hit": False,
+            "children_spawned": len(cands), "results": results,
+            "pruned": pruned, "error": "no eligible candidate",
+        }
+    winner = min(eligible, key=lambda c: results[c.name]["ms"])
+    w_ms = float(results[winner.name]["ms"])
+    margin = (None if not default_ms
+              else round((float(default_ms) - w_ms) / float(default_ms),
+                         4))
+    plan = _plan_from_candidate(winner, h, k)
+    plan = TunePlan.from_dict({
+        **plan.to_dict(),
+        "measured_ms": w_ms,
+        "default_ms": default_ms,
+        "margin": margin,
+        "bit_identical": True,
+        "host_load": host_load(),
+        "platform": platform,
+        "evaluator": evaluator,
+        "created_unix": round(time.time(), 3),
+    })
+    path = save_plans(h, {int(k): plan}, fingerprint=fp,
+                      directory=plan_dir,
+                      context={"source": source, "iters": int(iters)})
+    _say(f"winner {winner.name!r}: {w_ms} ms vs default {default_ms} "
+         f"(margin {margin}); saved {path}")
+    return plan, {
+        "structure_hash": h, "k": int(k), "cache_hit": False,
+        "children_spawned": len(cands), "results": results,
+        "pruned": pruned, "winner": winner.name,
+        "plan": plan.to_dict(), "plan_path": path,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def smoke_tune(run_dir: str, *, n: int = 96, width: int = 16,
+               seed: int = 3, k: int = 8, iters: int = 2,
+               timeout_s: float = 180.0,
+               plan_dir: Optional[str] = None,
+               restrict: Optional[List[str]] = None,
+               quiet: bool = True) -> dict:
+    """One tiny end-to-end search on a seeded BA graph — the
+    amt_doctor TUNE probe and the tier-1 tests ride this (3 children,
+    host CPU).  Returns the search report with the plan embedded."""
+    if plan_dir is None:
+        plan_dir = os.path.join(run_dir, "tune_plans")
+    if restrict is None:
+        restrict = ["default", "fold_tight", "chunk_4096"]
+    source = {"kind": "ba", "n": int(n), "m": 3, "width": int(width),
+              "seed": int(seed), "max_levels": 4}
+    plan, report = search(source, k, iters=iters, timeout_s=timeout_s,
+                          plan_dir=plan_dir, restrict=restrict,
+                          run_dir=os.path.join(run_dir, "tune_runs"),
+                          quiet=quiet)
+    report["plan_version"] = PLAN_VERSION
+    report["ok"] = plan is not None
+    return report

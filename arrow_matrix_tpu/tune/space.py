@@ -1,0 +1,183 @@
+"""The discrete plan space + feasibility pruning (graft-tune).
+
+Candidates are the small set of configurations worth racing for one
+(structure, k): tier-split variants of the SELL fold (the
+``fold_tight`` / single-tier-ELL / HYB axes), chunking, the fused
+``pallas_sell`` kernel with its slab/SMEM/ring knobs, overlap ``S``,
+2.5D replication ``c``, and the carriage-dtype experiments (bf16,
+plus opt-in int8).
+
+Pruning happens BEFORE any child is spawned, with the models the repo
+already trusts:
+
+* the HBM certificate (``obs/memview.largest_fitting_repl`` over the
+  fingerprint's slot-count byte model) rejects replication factors
+  whose ×c footprint cannot fit the device budget
+  (``obs/comm.hbm_budget_bytes``);
+* divisibility (``c | k``, ``S | (k/c)``) rejects schedules the
+  column-group split cannot express — the same predicate
+  ``serve/scheduler.ExecConfig.accepts_k`` applies at admission;
+* the ``repl_predict_ms`` / ``exposed_comm_ms`` cost models screen
+  out candidates whose *modeled* step time is far beyond the default
+  configuration's model (3x slack — the models rank, the bench race
+  decides);
+* evaluator capability: the streaming pallas path needs
+  ``k % 16 == 0`` on a real chip; DMA-ring variants are stream-only
+  so they are pruned on the interpret (CPU) evaluator.
+
+Carriage-dtype candidates are marked ``eligible=False``: they cannot
+be bit-identical to the f32 golden by construction, so they are timed
+as diagnostics but can never be persisted as the winner.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One raceable configuration: executor build overrides plus
+    fused-kernel call knobs (see ``TunePlan``)."""
+
+    name: str
+    build: Dict[str, Any] = field(default_factory=dict)
+    kernel_opts: Dict[str, Any] = field(default_factory=dict)
+    eligible: bool = True
+    note: str = ""
+
+
+def predicted_operator_bytes(fp: dict, k: int,
+                             feature_itemsize: int = 4) -> int:
+    """Static footprint model from the fingerprint alone: packed SELL
+    slots (int32 cols + data unless binary) plus one carried feature
+    array — the number the HBM certificate multiplies by c."""
+    slots = int(sum(fp["ladder"]["slots"]))
+    rows = int(fp["total_rows"])
+    cols_b = slots * 4
+    data_b = 0 if fp["binary"] else slots * 4
+    deg_b = rows * 4 if fp["binary"] else 0
+    carriage = rows * int(k) * feature_itemsize
+    return cols_b + data_b + deg_b + carriage
+
+
+def enumerate_candidates(fp: dict, k: int, *,
+                         platform: str = "cpu",
+                         allow_int8: bool = False,
+                         budget_bytes: Optional[int] = None,
+                         restrict: Optional[List[str]] = None
+                         ) -> Tuple[List[Candidate], Dict[str, str]]:
+    """The candidate list for one (fingerprint, k), already pruned.
+
+    Returns ``(candidates, pruned)`` where ``pruned`` maps each
+    rejected candidate name to its reason — the search report records
+    both, so a plan's provenance shows what was *not* tried and why.
+
+    ``restrict`` (names) narrows the space — the smoke/doctor path
+    races 3 candidates instead of ~12.
+    """
+    from arrow_matrix_tpu.obs.comm import hbm_budget_bytes, repl_predict_ms
+    from arrow_matrix_tpu.obs.memview import largest_fitting_repl
+
+    interpret = platform == "cpu"
+    raw: List[Candidate] = [
+        Candidate("default", note="the hand-tuned baseline; always "
+                                  "raced, trivially bit-identical"),
+        Candidate("fold_tight",
+                  build={"fold_growth": 1.1, "fold_align": 1},
+                  note="minimal padded slots (more tiers)"),
+        Candidate("fold_coarse",
+                  build={"fold_growth": 1.5},
+                  note="fewer tiers, more padding"),
+        Candidate("ell_one_tier",
+                  build={"fold_growth": 1e9, "fold_align": 1},
+                  note="degenerate tier split: one ELL tier "
+                       "(plus the zero-degree prefix)"),
+        Candidate("hyb",
+                  build={"fmt": "hyb"},
+                  note="split ELL+COO whole-level kernel"),
+        Candidate("chunk_4096",
+                  build={"chunk": 4096},
+                  note="fixed gather chunk vs the auto budget"),
+        Candidate("pallas_sell",
+                  build={"kernel": "pallas_sell"},
+                  note="fused gather->FMA kernel"),
+        Candidate("pallas_sell_smem_small",
+                  build={"kernel": "pallas_sell"},
+                  kernel_opts={"smem_cols_budget": 1 << 14},
+                  note="forced slab streaming (small SMEM budget)"),
+        Candidate("pallas_sell_rb128",
+                  build={"kernel": "pallas_sell"},
+                  kernel_opts={"row_block": 128},
+                  note="half-size VMEM row tile"),
+        Candidate("pallas_sell_ring1",
+                  build={"kernel": "pallas_sell"},
+                  kernel_opts={"ring": 1},
+                  note="serial DMA (no waves in flight)"),
+        Candidate("pallas_sell_ring4",
+                  build={"kernel": "pallas_sell"},
+                  kernel_opts={"ring": 4},
+                  note="deeper VMEM ring"),
+        Candidate("overlap2",
+                  build={"overlap_slabs": 2},
+                  note="S=2 chunked overlap schedule"),
+        Candidate("repl2",
+                  build={"repl": 2},
+                  note="2.5D column groups, c=2"),
+        Candidate("bf16",
+                  build={"feature_dtype": "bf16"}, eligible=False,
+                  note="bf16 carriage diagnostic (never f32 "
+                       "bit-identical; cannot win)"),
+    ]
+    if allow_int8:
+        raw.append(Candidate(
+            "int8", build={"feature_dtype": "int8"}, eligible=False,
+            note="opt-in int8-carriage experiment (diagnostic only)"))
+
+    budget = hbm_budget_bytes(budget_bytes)
+    base_bytes = predicted_operator_bytes(fp, k)
+    # Modeled default step time: slots streamed once at the comm-model
+    # link rate — only used as the 3x cost-model screen's yardstick.
+    default_ms = repl_predict_ms(1, 0, compute_ms=0.0)
+
+    out, pruned = [], {}
+    for c in raw:
+        if restrict is not None and c.name not in restrict:
+            pruned[c.name] = "not in restricted candidate set"
+            continue
+        repl = int(c.build.get("repl", 1))
+        slabs = int(c.build.get("overlap_slabs", 1))
+        if repl > 1:
+            if k % repl:
+                pruned[c.name] = (f"repl={repl} needs repl | k "
+                                  f"(k={k})")
+                continue
+            fit = largest_fitting_repl(base_bytes, budget,
+                                       choices=(1, repl))
+            if fit < repl:
+                pruned[c.name] = (
+                    f"HBM certificate: {base_bytes} B x{repl} exceeds "
+                    f"budget {budget} B")
+                continue
+            predicted = repl_predict_ms(repl, 0, compute_ms=default_ms)
+            if predicted > 3.0 * max(default_ms, 1e-9):
+                pruned[c.name] = (f"cost model: predicted "
+                                  f"{predicted:.3f} ms > 3x default")
+                continue
+        if slabs > 1 and (k // repl) % slabs:
+            pruned[c.name] = (f"overlap S={slabs} needs S | (k/c) "
+                              f"(k={k}, c={repl})")
+            continue
+        if c.build.get("kernel") == "pallas_sell":
+            if not interpret and k % 16:
+                pruned[c.name] = ("streaming pallas_sell needs "
+                                  f"k % 16 == 0 on chip (k={k})")
+                continue
+            if interpret and "ring" in c.kernel_opts:
+                pruned[c.name] = ("DMA ring depth is a stream-only "
+                                  "knob; interpret evaluator runs the "
+                                  "vectorized body")
+                continue
+        out.append(c)
+    return out, pruned

@@ -54,17 +54,28 @@ def record_is_onchip(d: dict) -> bool:
     return not d.get("degraded") and d.get("platform") != "cpu"
 
 
-def load_last_json_line(path: str) -> Optional[dict]:
-    """Parse the LAST line of ``path`` as JSON (bench artifacts are
-    JSON-lines; only the final line is the committed record).  None on
-    any read/parse failure — the caller decides what absence means."""
+def parse_last_json_line(text: str) -> Optional[dict]:
+    """Parse the LAST line of ``text`` as a JSON object (bench children
+    and JSON-lines artifacts both commit their record as the final
+    line; anything above it — warnings, progress chatter — is noise).
+    None when the text is empty, the last line is not JSON, or it is
+    JSON but not an object — the caller decides what absence means."""
     try:
-        with open(path, encoding="utf-8") as fh:
-            d = json.loads(fh.read().strip().splitlines()[-1])
-    except (OSError, json.JSONDecodeError, IndexError,
-            UnicodeDecodeError):
+        d = json.loads(text.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError, AttributeError,
+            TypeError):
         return None
     return d if isinstance(d, dict) else None
+
+
+def load_last_json_line(path: str) -> Optional[dict]:
+    """File-backed :func:`parse_last_json_line`: read ``path`` and
+    parse its last line.  None on any read/parse failure."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return parse_last_json_line(fh.read())
+    except (OSError, UnicodeDecodeError):
+        return None
 
 
 def classify_artifact(path: str) -> str:

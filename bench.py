@@ -521,10 +521,16 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
     Every failure shape — nonzero rc, hang, unparseable stdout — is
     contained to the returned dict (one candidate costs one candidate).
 
+    Child stdout is parsed with the shared
+    ``utils/artifacts.parse_last_json_line`` (last line is the record,
+    anything above it is chatter).
+
     FORCECPU keys on the probed *platform*: any CPU run — including an
     AMT_BENCH_FULL=1 control run, which is flagged degraded like every
     accelerator-unreachable run — must pin children to the host CPU or
     each would hang in the dead TPU plugin."""
+    from arrow_matrix_tpu.utils.artifacts import parse_last_json_line
+
     env = dict(os.environ, AMT_BENCH_CFG=json.dumps(cfg))
     if cfg["platform"] == "cpu":
         env["AMT_BENCH_FORCECPU"] = "1"
@@ -547,7 +553,10 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
             _progress(f"fmt={fmt} FAILED rc={proc.returncode}")
             return {"error": f"rc={proc.returncode}: "
                              f"{proc.stderr.strip()[-400:]}"}
-        run = json.loads(proc.stdout.strip().splitlines()[-1])
+        run = parse_last_json_line(proc.stdout)
+        if run is None:
+            return {"error": f"unusable child output: "
+                             f"{proc.stdout.strip()[-200:]}"}
         if "k128_ms" in run and "ms" not in run:
             _progress(f"fmt={fmt}: k=128 {run['k128_ms']} ms/iter")
         else:
@@ -566,14 +575,11 @@ def _spawn_candidate(fmt: str, cfg: dict, timeout_s: float) -> dict:
             _progress(f"fmt={fmt} timed out; black box at {fp} "
                       f"(graft_trace blackbox)")
         return err
-    # Narrow: ONLY child-output parse errors.  A blanket Exception here
-    # would swallow the one-shot deadline TimeoutError raised by the
-    # SIGALRM handler while the parent waits in subprocess.run — the
-    # race would then keep running past the deadline and the driver
-    # would kill the bench with no JSON emitted.
-    except (json.JSONDecodeError, IndexError) as e:
-        return {"error": f"unusable child output: "
-                         f"{type(e).__name__}: {str(e)[:200]}"}
+    # No blanket except: it would swallow the one-shot deadline
+    # TimeoutError raised by the SIGALRM handler while the parent
+    # waits in subprocess.run — the race would then keep running past
+    # the deadline and the driver would kill the bench with no JSON
+    # emitted.  Child-output parse failures are the None branch above.
 
 
 def _bytes_per_iter_model(block_bytes: int, total_rows: int, k: int,
@@ -986,6 +992,8 @@ def kernel_compare(timeout_s: float = 300.0,
     bench's result): it is filled variant-by-variant AS THE SWEEP
     RUNS, so a deadline alarm mid-sweep keeps every number already
     measured instead of replacing them all with one error."""
+    from arrow_matrix_tpu.utils.artifacts import parse_last_json_line
+
     if out is None:
         out = {}
     out["config"] = dict(COMPARE_CONFIG)
@@ -1006,9 +1014,10 @@ def kernel_compare(timeout_s: float = 300.0,
                     capture_output=True, text=True,
                     timeout=min(timeout_s, left),
                     env=env)
-            if proc.returncode == 0 and proc.stdout.strip():
-                out[name + "_ms"] = json.loads(
-                    proc.stdout.strip().splitlines()[-1])["ms"]
+            rec = (parse_last_json_line(proc.stdout)
+                   if proc.returncode == 0 else None)
+            if rec is not None:
+                out[name + "_ms"] = rec.get("ms")
             else:
                 out[name + "_ms"] = None
                 out[name + "_error"] = (f"rc={proc.returncode}: "

@@ -14,6 +14,7 @@ from arrow_matrix_tpu.ops.pallas_sell import (
     pack_features_t,
     sell_spmm_t_pallas,
     sell_tier_spmm_packed,
+    slab_rows,
     supported_feature_width,
 )
 from arrow_matrix_tpu.ops.sell import SellMatrix, sell_from_csr, sell_spmm_t
@@ -102,6 +103,55 @@ def test_slab_streaming_bounded_smem(monkeypatch):
     monkeypatch.setattr(pallas_sell, "SMEM_COLS_BUDGET", 64 * 24 * 4)
     got = np.asarray(sell_spmm_t_pallas(m, x_t, row_block=64))
     np.testing.assert_array_equal(got, want)
+
+
+def test_slab_rows_degenerate_cases():
+    # A tier so wide one row exceeds the whole budget still makes
+    # forward progress: exactly one row block per slab.
+    assert slab_rows(10**9, 64, smem_cols_budget=1 << 18) == 64
+    # Normal case: the slab is a whole multiple of the row block and
+    # fits the budget (per_row = m_t * 4 bytes of int32 cols).
+    s = slab_rows(6, 64, smem_cols_budget=64 * 24 * 4)
+    assert s % 64 == 0 and s * 6 * 4 <= 64 * 24 * 4
+    # Explicit budget wins over the module-level env default, and the
+    # arithmetic is exact: budget 512 B / (4 slots * 4 B) = 32 rows.
+    assert slab_rows(4, 8, smem_cols_budget=512) == 32
+    # m_t = 0 (the zero tier) must not divide by zero.
+    assert slab_rows(0, 64, smem_cols_budget=1024) >= 64
+
+
+def test_explicit_smem_budget_matches_unbounded():
+    # The per-call budget argument (graft-tune's knob) forces slab
+    # streaming without touching the module attribute; same answer.
+    m, x_t = _synthetic_binary(2048, 1024, 6, 16, seed=13)
+    want = np.asarray(sell_spmm_t_pallas(m, x_t))
+    got = np.asarray(sell_spmm_t_pallas(m, x_t, row_block=64,
+                                        smem_cols_budget=64 * 24 * 4))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("ring", [1, 3, 4])
+def test_ring_depth_variants_match_double_buffer(ring):
+    # The generalized DMA ring at every depth must agree bit-for-bit
+    # with the ring=2 double buffer (identical accumulation order —
+    # the ring only changes how many copies are in flight).
+    m, x_t = _synthetic_binary(1024, 64, 5, 16, seed=11)
+    x_packed = pack_features_t(x_t)
+    cols, deg = m.cols[0], m.deg[0]
+    ref = sell_tier_spmm_packed(cols, x_packed, deg=deg,
+                                stream=True, wave=4, interpret=True)
+    got = sell_tier_spmm_packed(cols, x_packed, deg=deg,
+                                stream=True, wave=4, ring=ring,
+                                interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_ring_validation():
+    m, x_t = _synthetic_binary(256, 64, 3, 16, seed=1)
+    x_packed = pack_features_t(x_t)
+    with pytest.raises(ValueError, match="ring"):
+        sell_tier_spmm_packed(m.cols[0], x_packed, deg=m.deg[0],
+                              stream=True, ring=0, interpret=True)
 
 
 def test_pack_features_granule_lines():

@@ -165,6 +165,26 @@ def test_obs_gate_memory_problems():
     assert len(problems) == 1 and "exceeds 8.00" in problems[0]
 
 
+def test_parse_last_json_line_contract():
+    """ONE parser for every bench/tune child's stdout (the final
+    JSON-line protocol): noise above the record is fine, noise AFTER
+    it — or no record at all — is an explicit None, never a guess."""
+    from arrow_matrix_tpu.utils.artifacts import parse_last_json_line
+
+    assert parse_last_json_line(
+        'warming up...\ncompile cache miss\n{"ms": 1.5}\n'
+    ) == {"ms": 1.5}
+    assert parse_last_json_line('{"ms": 1.5}') == {"ms": 1.5}
+    assert parse_last_json_line("") is None
+    assert parse_last_json_line("   \n  ") is None
+    assert parse_last_json_line(None) is None
+    # The record must be the LAST line: trailing noise invalidates.
+    assert parse_last_json_line('{"ms": 1.5}\nTraceback...') is None
+    # A JSON scalar/array is not a record.
+    assert parse_last_json_line("[1, 2]") is None
+    assert parse_last_json_line("42") is None
+
+
 def test_artifacts_shared_predicate(tmp_path):
     """ONE on-chip definition for bench.py and the watcher: explicit
     CPU/degraded labels disqualify, unlabeled records qualify, and a

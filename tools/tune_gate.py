@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""CI gate over the graft-tune plan cache.
+
+Replays every cached TunePlan (or the ``--hash`` selection) against
+its recorded source and exits nonzero if any plan lost bit-identity
+vs the golden fold path, regressed more than ``--rel-tol`` (default
+5%) vs the default configuration, fails the hash/version integrity
+check, or if a search on the unchanged structure is not a pure cache
+hit (zero bench children).  ``--refresh`` re-searches each structure
+before checking.
+
+Usage:
+    python tools/tune_gate.py                       # gate bench_cache/tune_plans
+    python tools/tune_gate.py --plan-dir DIR --refresh
+    python tools/tune_gate.py --hash 0123abcd...    # one structure
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--plan-dir", default=None,
+                    help="plan cache directory (default "
+                         "bench_cache/tune_plans, or $AMT_TUNE_PLAN_DIR)")
+    ap.add_argument("--hash", action="append", default=None,
+                    help="gate only this structure hash (repeatable)")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timing replays per side; min is compared")
+    ap.add_argument("--rel-tol", type=float, default=0.05)
+    ap.add_argument("--abs-tol-ms", type=float, default=0.25)
+    ap.add_argument("--refresh", action="store_true",
+                    help="re-search each structure before gating")
+    ap.add_argument("--no-timing", action="store_true",
+                    help="skip the regression replay (identity + "
+                         "cache checks only)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    from arrow_matrix_tpu.tune.gate import run_gate
+
+    return run_gate(directory=args.plan_dir, hashes=args.hash,
+                    iters=args.iters, repeats=args.repeats,
+                    rel_tol=args.rel_tol, abs_tol_ms=args.abs_tol_ms,
+                    refresh=args.refresh, timing=not args.no_timing,
+                    quiet=args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
