@@ -323,6 +323,62 @@ def probe_tune() -> tuple[bool, str]:
                   "`graft_tune search` for a real structure")
 
 
+def probe_ledger() -> tuple[bool, str]:
+    """graft-ledger round-trip: append a record to a throwaway store
+    and validate schema + hash chain; then, when the committed fixture
+    store is present (tests/fixtures/ledger), run the drift gate
+    against its baseline (must be green) AND verify a planted 10×
+    regression trips it (the gate must not be green merely because it
+    checks nothing).  Bounded subprocess, as for the other probes."""
+    code = (
+        "import sys, os, tempfile, json; sys.argv=[]; "
+        "d = tempfile.mkdtemp(prefix='ledger_probe_'); "
+        "from arrow_matrix_tpu.ledger import Ledger, "
+        "canonical_record_id, schema_problems; "
+        "from arrow_matrix_tpu.ledger import gate; "
+        "lg = Ledger(d); "
+        "r = lg.record('probe', 'doctor_probe_ms', 1.0, unit='ms', "
+        "host_load=0.0, git_rev=None); "
+        "p = schema_problems(r) + lg.validate(); "
+        "fix = os.path.join('tests', 'fixtures', 'ledger'); "
+        "bp = os.path.join(fix, 'baseline.json'); "
+        "note = 'no committed fixture store — in-memory checks only'; "
+        "fr = []; "
+        "\n"
+        "if os.path.isfile(bp):\n"
+        "    flg = Ledger(fix); fr = flg.read_all()\n"
+        "    base = gate.load_baseline(bp)\n"
+        "    f, _ = gate.check_records(fr, base)\n"
+        "    p += flg.validate() + f\n"
+        "    banded = [x for x in fr if x.get('unit') in ('ms', 's') "
+        "and isinstance(x.get('value'), (int, float))]\n"
+        "    if banded:\n"
+        "        bad = json.loads(json.dumps(banded[0]))\n"
+        "        bad['value'] = bad['value'] * 10\n"
+        "        bad['record_id'] = canonical_record_id(bad)\n"
+        "        f2, _ = gate.check_records([bad], base)\n"
+        "        if not f2:\n"
+        "            p.append('planted 10x regression did not trip')\n"
+        "    note = 'gate green on committed fixture; planted "
+        "regression trips'\n"
+        "print('LEDGER ok: ' + note if not p "
+        "else 'LEDGER FAIL: ' + str(p[0]))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("LEDGER")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if not lines[-1].startswith("LEDGER ok"):
+        return False, lines[-1][:120]
+    return True, lines[-1][len("LEDGER ok: "):][:120]
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -397,6 +453,10 @@ def main(argv=None) -> int:
     tune_ok, detail = probe_tune()
     ok &= _check("graft-tune (smoke search + cache hit)", tune_ok,
                  detail)
+
+    ledger_ok, detail = probe_ledger()
+    ok &= _check("graft-ledger (record + chain + drift gate)",
+                 ledger_ok, detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

@@ -28,6 +28,8 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import numpy as np
 from scipy import sparse
 
+from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+
 from arrow_matrix_tpu.decomposition.decompose import ArrowLevel
 
 
@@ -162,10 +164,7 @@ def write_manifest(base: str, width: Optional[int], paths: List[str],
                                       "bytes": os.path.getsize(p)}
     doc = {"version": MANIFEST_VERSION, "files": files}
     mp = manifest_path(base, width, block_diagonal)
-    tmp = mp + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh, indent=1, sort_keys=True)
-    os.replace(tmp, mp)
+    atomic_write_json(mp, doc, indent=1, sort_keys=True)
     return mp
 
 

@@ -1,0 +1,28 @@
+"""graft-ledger: the unified performance & accuracy record store.
+
+One append-only, hash-chained, schema-versioned JSONL stream
+(``bench_results/ledger/ledger.jsonl``) that every measured number in
+the repo flows through — bench race results, tune winners, serving SLO
+reports, pulse window summaries, scale-ladder rungs, error-vs-iteration
+curves — keyed by the graft-tune structure hash plus executor knobs,
+platform, host load, and git revision.  See ``ledger/store.py`` for the
+integrity model, ``ledger/gate.py`` for drift detection,
+``ledger/probe.py`` for the accuracy probe, ``ledger/export.py`` for
+the legacy ``BENCH_r*.json`` bridge, and ``cli/graft_ledger.py`` for
+the operator surface.
+"""
+
+from arrow_matrix_tpu.ledger.store import (  # noqa: F401
+    DEFAULT_LEDGER_DIR,
+    KINDS,
+    LEDGER_BASENAME,
+    SCHEMA_VERSION,
+    Ledger,
+    bench_metric,
+    canonical_record_id,
+    default_ledger,
+    ledger_dir,
+    ledger_path,
+    record,
+    schema_problems,
+)

@@ -1,0 +1,195 @@
+"""ErrorProbe: error-vs-iteration curves against the f32 golden.
+
+The repo's accuracy story so far is single-shot: graft-tune proves
+bit-identity for ONE step, bench.py reports one final Frobenius error.
+Neither says how reduced-precision carriage (bf16 folded state, int8
+quantized state) DRIFTS as iterations compound — the number a serving
+deployment choosing a carriage dtype actually needs, and the curve the
+paper's accuracy discussion is about.
+
+The probe runs the golden trajectory — the DEFAULT f32 fold executor
+(the exact ``tune/search.py`` golden path) stepped ``iterations``
+times, gathered to host after every step — then replays the same seeded
+input through each probed dtype and records the per-iteration
+Frobenius, relative-Frobenius, and max-abs error against the golden at
+the same iteration.  Everything is seeded (``GOLDEN_SEED`` by default),
+so the curves are deterministic and ``tools/ledger_gate.py`` can treat
+a committed curve as a regression baseline: the f32 curve is
+identically zero BY CONSTRUCTION (same config ⇒ same trajectory), so
+any nonzero f32 point in a later run is itself a bit-identity
+regression.
+
+Dtypes:
+
+* ``f32`` / ``bf16`` — real executors (``feature_dtype`` carriage,
+  ``parallel/multi_level.py``);
+* ``int8`` — EMULATED: ``resolve_block_dtype`` supports only f32/bf16,
+  so the probe round-trips the carried host state through a symmetric
+  per-tensor int8 quantize-dequantize between steps and marks the
+  record ``"emulated": true``.  The curve is still the honest answer
+  to "what would int8 carriage cost?" at the state-precision level.
+
+Each curve is one ledger record: ``kind="error_curve"``,
+``metric=f"error_curve_{dtype}"`` (dtype in the metric keeps baseline
+keys per-dtype), ``value`` = final relative-Frobenius error, curve
+arrays in ``payload``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+#: Seed shared with the tune golden (tune/search.py GOLDEN_SEED).
+DEFAULT_SEED = 3
+
+#: Default probe depth: enough iterations for bf16 drift to show its
+#: compounding shape, small enough to run on every doctor invocation.
+DEFAULT_ITERATIONS = 8
+
+PROBE_DTYPES = ("f32", "bf16", "int8")
+
+
+def _platform_info():
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return jax.default_backend(), getattr(dev, "device_kind",
+                                              dev.platform)
+    except Exception:  # pragma: no cover - no backend available
+        return None, None
+
+
+def _quantize_int8(x: np.ndarray) -> np.ndarray:
+    """Symmetric per-tensor int8 round trip: the precision an int8
+    carriage would keep between steps."""
+    amax = float(np.max(np.abs(x)))
+    if amax == 0.0:
+        return x.copy()
+    scale = amax / 127.0
+    q = np.clip(np.round(x / scale), -127, 127)
+    return (q * scale).astype(np.float32)
+
+
+def _build(levels, width: int, feature_dtype: Optional[str]):
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    return MultiLevelArrow(levels, width, mesh=None, fmt="fold",
+                           feature_dtype=feature_dtype)
+
+
+def _trajectory(multi, x_host: np.ndarray, iterations: int,
+                quantize: bool = False) -> List[np.ndarray]:
+    """Host-gathered state after every step.  ``quantize`` round-trips
+    the state through int8 on the host between steps (the emulated
+    int8 carriage); re-uploading via ``set_features`` keeps the device
+    layout handling in one place."""
+    out: List[np.ndarray] = []
+    x = multi.set_features(x_host)
+    for _ in range(iterations):
+        x = multi.step(x)
+        host = multi.gather_result(x)
+        if quantize:
+            host = _quantize_int8(host)
+            x = multi.set_features(host)
+        out.append(np.asarray(host, dtype=np.float32))
+    return out
+
+
+def error_curve(golden: Sequence[np.ndarray],
+                probed: Sequence[np.ndarray]) -> Dict[str, List[float]]:
+    """Per-iteration error of ``probed`` against ``golden`` (same
+    length): Frobenius, relative Frobenius (vs the golden's norm), and
+    max-abs.  Plain float lists — JSON-ready ledger payload."""
+    fro: List[float] = []
+    rel: List[float] = []
+    mab: List[float] = []
+    for g, p in zip(golden, probed):
+        d = p.astype(np.float64) - g.astype(np.float64)
+        f = float(np.linalg.norm(d))
+        gn = float(np.linalg.norm(g.astype(np.float64)))
+        fro.append(f)
+        rel.append(f / gn if gn > 0 else f)
+        mab.append(float(np.max(np.abs(d))) if d.size else 0.0)
+    return {"frobenius": fro, "rel_frobenius": rel, "max_abs": mab}
+
+
+def error_curves_for_source(source: Dict[str, Any], *, k: int = 4,
+                            iterations: int = DEFAULT_ITERATIONS,
+                            seed: int = DEFAULT_SEED,
+                            dtypes: Sequence[str] = ("f32", "bf16"),
+                            ledger=None) -> List[Dict[str, Any]]:
+    """Probe one structure (a ``tune/search.py`` levels source) at each
+    dtype; returns the ledger records (appended to ``ledger`` when one
+    is given, otherwise built with ``ts_unix=0``/pinned provenance so
+    the result is deterministic for tests).
+
+    The structure key is the graft-tune ``structure_hash`` — the same
+    key the plan cache and every bench record uses, so a curve joins
+    the rest of the ledger on it.
+    """
+    from arrow_matrix_tpu.ledger import store
+    from arrow_matrix_tpu.tune.fingerprint import structure_hash
+    from arrow_matrix_tpu.tune.search import load_levels_from_source
+
+    levels, width = load_levels_from_source(source)
+    shash = structure_hash(levels, width)
+    platform, device_kind = _platform_info()
+
+    rng = np.random.default_rng(seed)
+    # The row count comes from the golden executor itself; build it
+    # first, then draw the seeded input at its shape.
+    golden_exec = _build(levels, width, None)
+    n_rows = golden_exec.n
+    x0 = rng.standard_normal((n_rows, k)).astype(np.float32)
+    golden = _trajectory(golden_exec, x0, iterations)
+
+    records: List[Dict[str, Any]] = []
+    for dtype in dtypes:
+        if dtype not in PROBE_DTYPES:
+            raise ValueError(f"unknown probe dtype {dtype!r}; "
+                             f"expected one of {PROBE_DTYPES}")
+        emulated = dtype == "int8"
+        if emulated:
+            probed = _trajectory(_build(levels, width, None), x0,
+                                 iterations, quantize=True)
+        else:
+            feature_dtype = None if dtype == "f32" else dtype
+            probed = _trajectory(_build(levels, width, feature_dtype),
+                                 x0, iterations)
+        curve = error_curve(golden, probed)
+        knobs = {"dtype": dtype, "k": k, "iterations": iterations,
+                 "seed": seed, "emulated": emulated, "fmt": "fold"}
+        payload = dict(curve)
+        payload["source"] = dict(source)
+        value = curve["rel_frobenius"][-1] if curve["rel_frobenius"] \
+            else None
+        if ledger is not None:
+            rec = ledger.record(
+                "error_curve", f"error_curve_{dtype}", value,
+                unit="rel_frobenius", structure_hash=shash,
+                knobs=knobs, payload=payload, platform=platform,
+                device_kind=device_kind)
+        else:
+            rec = {
+                "schema": store.SCHEMA_VERSION,
+                "kind": "error_curve",
+                "record_id": "",
+                "prev": None,
+                "ts_unix": 0,
+                "metric": f"error_curve_{dtype}",
+                "value": value,
+                "unit": "rel_frobenius",
+                "structure_hash": shash,
+                "platform": platform,
+                "device_kind": device_kind,
+                "host_load": None,
+                "git_rev": None,
+                "knobs": knobs,
+                "payload": payload,
+            }
+            rec["record_id"] = store.canonical_record_id(rec)
+        records.append(rec)
+    return records

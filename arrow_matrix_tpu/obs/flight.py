@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+
 #: Default ring capacity: enough for every phase span + per-iteration
 #: metric of a bench candidate with room to spare, small enough that
 #: the eager per-event flush stays a one-page write.
@@ -159,14 +161,11 @@ class FlightRecorder:
             return None
         snap = self.snapshot()
         try:
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            tmp = (f"{self.path}.tmp.{os.getpid()}."
-                   f"{threading.get_ident()}")
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(snap, fh)
-            os.replace(tmp, self.path)
+            # fsync=False: the black box flushes on EVERY event — the
+            # crash modes it defends against (SIGKILL, excepthook) keep
+            # the page cache, and an fsync per event would tax the run
+            # it observes.
+            atomic_write_json(self.path, snap, fsync=False)
         except OSError:
             pass
         return self.path

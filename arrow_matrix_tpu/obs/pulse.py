@@ -50,11 +50,13 @@ import dataclasses
 import json
 import os
 import re
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from arrow_matrix_tpu.obs import flight
+from arrow_matrix_tpu.utils.artifacts import atomic_write_json
 from arrow_matrix_tpu.obs.flight import (  # noqa: F401  (re-exports)
     current_request,
     request_context,
@@ -356,6 +358,7 @@ class PulseMonitor:
                  watchdog: Optional[SloWatchdog] = None,
                  hbm_sampler: Optional[
                      Callable[[], Tuple[int, float]]] = None,
+                 ledger_dir: Optional[str] = None,
                  name: str = "pulse"):
         if window_s <= 0:
             raise ValueError(f"window_s must be > 0, got {window_s}")
@@ -365,6 +368,8 @@ class PulseMonitor:
         self.name = name
         self.window_s = float(window_s)
         self.ring_path = ring_path
+        self.ledger_dir = ledger_dir
+        self.ledger_record: Optional[dict] = None
         self.ring_capacity = int(ring_capacity)
         self.clock = clock
         self.watchdog = watchdog
@@ -439,6 +444,32 @@ class PulseMonitor:
             self.closed_reason = reason
         self._dispatch(pending)
         self.flush_ring()
+        self._record_to_ledger()
+
+    def _record_to_ledger(self) -> None:
+        """graft-ledger: one ``kind="pulse"`` summary record per
+        monitor lifetime, emitted at close into the configured
+        (usually run-dir-local) store.  Guarded — telemetry must never
+        take down what it observes."""
+        if self.ledger_dir is None:
+            return
+        try:
+            from arrow_matrix_tpu.ledger import record as _ledger_rec
+
+            totals = self.totals_dict()
+            lat = totals.get("latency_ms") or {}
+            self.ledger_record = _ledger_rec(
+                "pulse", "pulse_p99_ms", lat.get("p99"),
+                directory=self.ledger_dir, unit="ms",
+                knobs={"name": self.name, "window_s": self.window_s},
+                payload={"totals": totals,
+                         "windows": len(self._closed),
+                         "dropped_windows": self.dropped_windows,
+                         "burn_events": len(self.burn_events),
+                         "closed": self.closed_reason})
+        except Exception as e:
+            print(f"[ledger] pulse record not persisted: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
 
     def _fold_totals(self, event: str, data: Dict[str, Any]) -> None:
         tenant = data.get("tenant")
@@ -610,14 +641,11 @@ class PulseMonitor:
             return None
         snap = self.snapshot()
         try:
-            d = os.path.dirname(self.ring_path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            tmp = (f"{self.ring_path}.tmp.{os.getpid()}."
-                   f"{threading.get_ident()}")
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(snap, fh)
-            os.replace(tmp, self.ring_path)
+            # fsync=False: the ring is rewritten every window close —
+            # atomicity (no torn reader) matters, per-window power-cut
+            # durability does not, and the fsync would eat the <5%
+            # overhead budget.
+            atomic_write_json(self.ring_path, snap, fsync=False)
         except OSError:
             pass
         return self.ring_path

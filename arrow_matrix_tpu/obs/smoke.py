@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -254,6 +255,27 @@ def run_smoke(run_dir: str, n: int = 256, width: int = 32, k: int = 4,
                   "iters": iters},
         "algorithms": summary,
     }
+    # graft-ledger: the smoke run's headline (mean step time of the
+    # slowest algorithm) lands in a RUN-DIR-LOCAL store; the record id
+    # rides the summary so tools/obs_gate.py can require it.
+    try:
+        from arrow_matrix_tpu.ledger import record as _ledger_record
+
+        worst = max((alg["step_ms_mean"] for alg in summary.values()),
+                    default=None)
+        rec = _ledger_record(
+            "smoke", "smoke_step_ms", worst,
+            directory=os.path.join(run_dir, "ledger"), unit="ms",
+            knobs=dict(out["scale"]),
+            payload={name: {"step_ms_mean": alg["step_ms_mean"],
+                            "bytes_vs_ideal": alg["bytes_vs_ideal"],
+                            "hbm_vs_predicted": alg["hbm_vs_predicted"]}
+                     for name, alg in summary.items()})
+        out["ledger_record_id"] = rec["record_id"] if rec else None
+    except Exception as e:
+        print(f"[ledger] smoke record not persisted: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        out["ledger_record_id"] = None
     reg.write_jsonl(os.path.join(run_dir, "metrics.jsonl"))
     with open(os.path.join(run_dir, "summary.json"), "w",
               encoding="utf-8") as fh:

@@ -27,15 +27,16 @@ window histograms match the report's quantiles).
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from arrow_matrix_tpu.ledger import store as ledger_store
 from arrow_matrix_tpu.serve import request as rq
 from arrow_matrix_tpu.serve.scheduler import ArrowServer, ExecConfig
+from arrow_matrix_tpu.utils.artifacts import atomic_write_json
 
 
 def synthetic_trace(n_rows: int, *, tenants: int = 4,
@@ -140,10 +141,7 @@ def write_serve_artifacts(run_dir: str, summary: dict,
     ``metrics.jsonl``) under ``run_dir``; returns the summary path."""
     os.makedirs(run_dir, exist_ok=True)
     path = os.path.join(run_dir, "serve_summary.json")
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(summary, fh, indent=2, sort_keys=True)
-    os.replace(tmp, path)
+    atomic_write_json(path, summary, indent=2, sort_keys=True)
     if registry is not None:
         registry.write_jsonl(os.path.join(run_dir, "metrics.jsonl"))
     return path
@@ -227,6 +225,7 @@ def smoke_serve(run_dir: str, *, n: int = 96, width: int = 16,
     monitor = pulse_mod.PulseMonitor(
         window_s=0.25, name="smoke",
         ring_path=os.path.join(run_dir, "pulse_ring.json"),
+        ledger_dir=os.path.join(run_dir, "ledger"),
         watchdog=pulse_mod.SloWatchdog())
     server.attach_pulse(monitor)
     trace = synthetic_trace(n_rows, tenants=tenants,
@@ -240,5 +239,21 @@ def smoke_serve(run_dir: str, *, n: int = 96, width: int = 16,
               encoding="utf-8") as fh:
         fh.write(monitor.exposition_text())
     summary = slo_summary(server, tickets, wall, pulse=monitor)
+    # graft-ledger: the SLO report also lands in a RUN-DIR-LOCAL
+    # store (smoke runs ride gates and tests; they must never append
+    # to the committed ledger).  tools/obs_gate.py requires the id.
+    rec = ledger_store.record(
+        "serve", "requests_per_s", summary.get("requests_per_s"),
+        directory=os.path.join(run_dir, "ledger"),
+        unit="req/s",
+        knobs={"n": n, "width": width, "k": k, "seed": seed,
+               "tenants": tenants, "requests": requests,
+               "iterations": iterations,
+               "max_batch_k": max_batch_k},
+        payload={key: summary[key] for key in
+                 ("requests", "completed", "failed", "shed",
+                  "rejected", "wall_s", "latency_ms", "batches",
+                  "batched_requests") if key in summary})
+    summary["ledger_record_id"] = rec["record_id"] if rec else None
     write_serve_artifacts(run_dir, summary, registry=registry)
     return summary

@@ -28,10 +28,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import tempfile
 import warnings
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
+
+from arrow_matrix_tpu.utils.artifacts import atomic_write_json
 
 #: Bump when the TunePlan schema or knob semantics change; a cached
 #: plan from another version is a loud miss, never a silent apply.
@@ -217,14 +218,7 @@ def save_plans(structure_hash: str, plans: Dict[int, TunePlan],
         "context": context,
         "plans": merged,
     }
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w", encoding="utf-8") as fh:
-            json.dump(record, fh, indent=2, sort_keys=True)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.remove(tmp)
+    atomic_write_json(path, record, indent=2, sort_keys=True)
     return path
 
 

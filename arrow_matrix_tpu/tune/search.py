@@ -231,13 +231,16 @@ def search(source: dict, k: int, *, iters: int = 3,
            allow_int8: bool = False,
            restrict: Optional[List[str]] = None,
            run_dir: Optional[str] = None,
+           ledger_dir: Optional[str] = None,
            quiet: bool = False) -> Tuple[Optional[TunePlan], dict]:
     """Search (or cache-hit) the tuned plan for one (structure, k).
 
     Returns ``(plan, report)``.  ``report["cache_hit"]`` /
     ``report["children_spawned"]`` are the gate's purity evidence: an
     unchanged graph's second search is a pure cache hit with zero
-    children.  ``refresh=True`` forces a re-search.
+    children.  ``refresh=True`` forces a re-search.  ``ledger_dir``
+    redirects the winner's graft-ledger record (smoke runs pass a
+    run-dir-local store).
     """
     from arrow_matrix_tpu.utils.platform import host_load
 
@@ -335,6 +338,28 @@ def search(source: dict, k: int, *, iters: int = 3,
                       context={"source": source, "iters": int(iters)})
     _say(f"winner {winner.name!r}: {w_ms} ms vs default {default_ms} "
          f"(margin {margin}); saved {path}")
+    # graft-ledger: the winner + margin also land in the append-only
+    # store, keyed by the same structure hash as the plan cache.
+    try:
+        from arrow_matrix_tpu.ledger import record as _ledger_record
+
+        _ledger_record(
+            "tune", f"tuned_spmm_ms_k{int(k)}", w_ms, unit="ms",
+            directory=ledger_dir,
+            structure_hash=h, platform=platform,
+            device_kind="host" if platform == "cpu" else platform,
+            host_load=plan.host_load.get("loadavg_1m")
+            if isinstance(plan.host_load, dict) else None,
+            knobs={"k": int(k), "candidate": winner.name,
+                   "kernel": plan.kernel, "fmt": plan.fmt,
+                   "chunk": plan.chunk,
+                   "overlap_slabs": plan.overlap_slabs,
+                   "feature_dtype": plan.feature_dtype},
+            payload={"default_ms": default_ms, "margin": margin,
+                     "bit_identical": True, "evaluator": evaluator,
+                     "source": source, "plan_path": path})
+    except Exception as e:
+        _say(f"ledger record not persisted: {type(e).__name__}: {e}")
     return plan, {
         "structure_hash": h, "k": int(k), "cache_hit": False,
         "children_spawned": len(cands), "results": results,
@@ -362,6 +387,7 @@ def smoke_tune(run_dir: str, *, n: int = 96, width: int = 16,
     plan, report = search(source, k, iters=iters, timeout_s=timeout_s,
                           plan_dir=plan_dir, restrict=restrict,
                           run_dir=os.path.join(run_dir, "tune_runs"),
+                          ledger_dir=os.path.join(run_dir, "ledger"),
                           quiet=quiet)
     report["plan_version"] = PLAN_VERSION
     report["ok"] = plan is not None

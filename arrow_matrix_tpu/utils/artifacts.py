@@ -27,7 +27,9 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Optional
+import tempfile
+import threading
+from typing import Any, Optional
 
 
 #: Filename markers of throwaway verification artifacts.  A driver or
@@ -76,6 +78,93 @@ def load_last_json_line(path: str) -> Optional[dict]:
             return parse_last_json_line(fh.read())
     except (OSError, UnicodeDecodeError):
         return None
+
+
+# ---------------------------------------------------------------------------
+# Atomic JSON persistence (graft-ledger satellite).
+#
+# Five modules grew their own tmp-file + os.replace copy of "write the
+# artifact atomically" (tune/plan.py, serve/loadgen.py, obs/pulse.py,
+# obs/flight.py, io/graphio.py) — none of which fsync'd, so a host
+# power-cut inside the page-cache window could land an EMPTY tmp file
+# over a good artifact.  This is the ONE implementation they all share
+# now, and the crash-window contract is explicit:
+#
+# * serialization happens BEFORE the target is touched — an
+#   unserializable object leaves the existing artifact intact;
+# * the tmp file lives in the target's directory (os.replace must not
+#   cross filesystems) with a pid+thread-unique name, is flushed and
+#   fsync'd before the rename, and the DIRECTORY is fsync'd after it —
+#   the rename itself is not durable until the directory entry is;
+# * any failure removes the tmp file and re-raises: the caller decides
+#   whether persistence is best-effort (flight recorder, pulse ring)
+#   or mandatory (tune plans, the ledger).
+
+
+def _fsync_dir(directory: str) -> None:
+    """Flush a directory entry (the rename durability half of an
+    atomic write).  Platforms whose directories cannot be opened
+    (Windows) skip — there the rename atomicity is all we get."""
+    try:
+        fd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(path: str, obj: Any, *, indent=None,
+                      sort_keys: bool = False,
+                      fsync: bool = True) -> str:
+    """Atomically (and, by default, durably) write ``obj`` as JSON to
+    ``path``; returns ``path``.  See the module comment for the
+    crash-window contract.  ``fsync=False`` keeps the atomicity (a
+    reader never sees a torn file) but trades the power-cut durability
+    for speed — appropriate for high-frequency telemetry rewrites."""
+    text = json.dumps(obj, indent=indent, sort_keys=sort_keys)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=d or ".",
+        prefix=f".{os.path.basename(path)}.{os.getpid()}."
+               f"{threading.get_ident()}.",
+        suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            fh.write(text)
+            if fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        if fsync:
+            _fsync_dir(d)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return path
+
+
+def append_jsonl(path: str, obj: Any, *, fsync: bool = True) -> str:
+    """Append ``obj`` as one JSON line to ``path`` (created if absent);
+    returns the serialized line.  The line is serialized before the
+    file is opened and written in one call, then flushed and fsync'd —
+    a crash can tear at most the line being appended (trailing partial
+    line), never an earlier record: the append-only ledger's
+    durability primitive."""
+    line = json.dumps(obj, sort_keys=False,
+                      separators=(",", ":")) + "\n"
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(line)
+        if fsync:
+            fh.flush()
+            os.fsync(fh.fileno())
+    return line
 
 
 def classify_artifact(path: str) -> str:

@@ -1285,6 +1285,29 @@ def main() -> None:
             result["last_onchip"] = evidence
     except Exception:
         pass   # evidence is auxiliary; never block the JSON line
+    # graft-ledger: the round's headline number ALSO lands in the
+    # append-only store (the single sink every measured number flows
+    # through; BENCH_r*.json rounds are regenerated FROM it by
+    # `graft_ledger export`).  Emission must never block the JSON line.
+    try:
+        from arrow_matrix_tpu.ledger import (
+            bench_metric as _bench_metric,
+            record as _ledger_record,
+        )
+
+        _ledger_record(
+            "bench",
+            _bench_metric(result.get("metric", "spmm_iter_ms"),
+                          result.get("config")),
+            result.get("value"), unit=result.get("unit"),
+            platform=result.get("platform"),
+            device_kind=result.get("device_kind"),
+            knobs={"config": result.get("config", {}),
+                   "fmt_used": result.get("fmt_used")},
+            payload={"parsed": result})
+    except Exception as e:
+        print(f"[ledger] bench record not persisted: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
     print(json.dumps(result), flush=True)
     if result.get("value") is None:
         raise SystemExit(1)

@@ -6,10 +6,17 @@ an 8-device sub-mesh; tests/test_mesh_sizes.py sweeps sub-meshes of
 2..16 devices including non-power-of-two sizes."""
 
 import os
+import tempfile
 
 # Force CPU even when the environment selects a TPU platform: the test
 # suite must be hermetic and must exercise the virtual multi-device mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Redirect the default graft-ledger store to a throwaway directory so
+# no test (or code under test that emits telemetry) ever appends to the
+# committed bench_results/ledger history.
+os.environ.setdefault("AMT_LEDGER_DIR",
+                      tempfile.mkdtemp(prefix="amt_test_ledger_"))
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=16").strip()

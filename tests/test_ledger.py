@@ -1,0 +1,465 @@
+"""graft-ledger: store integrity, drift gate, error probe, export.
+
+Pins the ledger's whole contract surface:
+
+* schema round-trip and per-field validation (``store.schema_problems``);
+* the append-only promise is VERIFIED, not assumed — an edited line
+  fails its own ``record_id``, a deleted line breaks the successor's
+  ``prev``, a torn trailing line is tolerated by readers but reported
+  by ``validate()``;
+* the drift gate's band math: a planted 10% perf regression trips, an
+  in-band value does not, host-load normalization absorbs a loaded
+  host, degraded records never band;
+* accuracy curves: a planted bf16 cliff trips at ``2×`` the baseline,
+  any nonzero f32 point trips the zero-baseline watchdog, a shortened
+  curve is a regression;
+* error-probe determinism at a fixed seed (same source ⇒ identical
+  curves), f32 identically zero by construction;
+* legacy export: re-exporting from the committed store reproduces the
+  checked-in ``BENCH_r06.json`` byte-for-byte, with ``degraded`` and
+  ``backend_probe_class`` surviving the round trip;
+* the committed ``tests/fixtures/ledger`` store gates green (the same
+  fixture the doctor LEDGER probe uses);
+* ``utils/artifacts`` crash-window contract: a failed atomic write
+  leaves the previous artifact intact and no tmp litter.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from arrow_matrix_tpu.ledger import (
+    Ledger,
+    canonical_record_id,
+    schema_problems,
+)
+from arrow_matrix_tpu.ledger import export, gate, store
+from arrow_matrix_tpu.utils.artifacts import (
+    append_jsonl,
+    atomic_write_json,
+    parse_last_json_line,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "ledger")
+COMMITTED_LEDGER = os.path.join(REPO, "bench_results", "ledger")
+BENCH_R06 = os.path.join(REPO, "BENCH_r06.json")
+
+
+def _mk(tmp_path, name="lg"):
+    return Ledger(str(tmp_path / name))
+
+
+def _bench(lg, value, *, host_load=0.2, metric="t_ms", ts=None,
+           payload=None):
+    """One banded ms record with PINNED provenance.  Host loads are
+    held steady on purpose: varying loads spread the normalized values
+    and widen the MAD band (a real effect the gate is designed around,
+    but here the band must stay tight enough for the planted 10%
+    regression to trip)."""
+    return lg.record("bench", metric, value, unit="ms",
+                     structure_hash="s0", platform="cpu",
+                     device_kind="host", host_load=host_load,
+                     git_rev=None, ts_unix=ts,
+                     payload=payload or {})
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip + validation
+
+
+def test_record_round_trip(tmp_path):
+    lg = _mk(tmp_path)
+    rec = lg.record("bench", "spmm_ms", 1.25, unit="ms",
+                    structure_hash="abc", platform="cpu",
+                    device_kind="host", host_load=0.1, git_rev="deadbee",
+                    knobs={"k": 16}, payload={"note": "x"})
+    assert rec["record_id"].startswith("lr")
+    assert rec["prev"] is None
+    back = lg.read_all()
+    assert back == [rec]
+    assert lg.validate() == []
+    # second record chains onto the first
+    rec2 = _bench(lg, 2.0)
+    assert rec2["prev"] == rec["record_id"]
+    assert lg.validate() == []
+
+
+def test_schema_problems_catch_drift(tmp_path):
+    lg = _mk(tmp_path)
+    rec = _bench(lg, 1.0)
+    assert schema_problems(rec) == []
+    bad = dict(rec)
+    bad["kind"] = "vibes"
+    bad["record_id"] = canonical_record_id(bad)
+    assert any("unknown kind" in p for p in schema_problems(bad))
+    bad = dict(rec)
+    bad["schema"] = store.SCHEMA_VERSION + 1
+    bad["record_id"] = canonical_record_id(bad)
+    assert any("schema version" in p for p in schema_problems(bad))
+    bad = dict(rec)
+    del bad["metric"]
+    assert any("missing field 'metric'" in p for p in schema_problems(bad))
+    # bool is an int subclass — it must NOT pass as a numeric value
+    bad = dict(rec)
+    bad["value"] = True
+    assert any("field 'value'" in p for p in schema_problems(bad))
+    assert not isinstance(schema_problems("not a dict"), dict)
+
+
+def test_record_refuses_invalid(tmp_path):
+    lg = _mk(tmp_path)
+    with pytest.raises(ValueError):
+        lg.record("vibes", "m", 1.0)
+    # the refused record must not have been appended
+    assert lg.read_all() == []
+
+
+def test_module_record_disabled_and_redirected(tmp_path, monkeypatch):
+    monkeypatch.setenv("AMT_LEDGER", "0")
+    assert store.record("bench", "m", 1.0,
+                        directory=str(tmp_path / "x")) is None
+    monkeypatch.delenv("AMT_LEDGER")
+    rec = store.record("bench", "m", 1.0, directory=str(tmp_path / "x"),
+                       host_load=None, git_rev=None)
+    assert rec is not None
+    assert Ledger(str(tmp_path / "x")).read_all() == [rec]
+
+
+# ---------------------------------------------------------------------------
+# append-only / tamper evidence
+
+
+def test_edited_line_breaks_own_id(tmp_path):
+    lg = _mk(tmp_path)
+    _bench(lg, 1.0)
+    _bench(lg, 2.0)
+    lines = open(lg.path, encoding="utf-8").read().splitlines()
+    doctored = json.loads(lines[0])
+    doctored["value"] = 0.5  # rewrite history to look faster
+    lines[0] = json.dumps(doctored, separators=(",", ":"))
+    with open(lg.path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    problems = lg.validate()
+    assert any("does not match its content" in p for p in problems)
+
+
+def test_deleted_line_breaks_chain(tmp_path):
+    lg = _mk(tmp_path)
+    _bench(lg, 1.0)
+    _bench(lg, 2.0)
+    _bench(lg, 3.0)
+    lines = open(lg.path, encoding="utf-8").read().splitlines()
+    del lines[1]
+    with open(lg.path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    problems = lg.validate()
+    assert any("breaks the chain" in p for p in problems)
+
+
+def test_torn_trailing_line_tolerated_but_reported(tmp_path):
+    lg = _mk(tmp_path)
+    r1 = _bench(lg, 1.0)
+    with open(lg.path, "a", encoding="utf-8") as fh:
+        fh.write('{"schema": 1, "kind": "ben')  # crash mid-append
+    # readers still see the intact prefix…
+    assert lg.read_all() == [r1]
+    # …and validate() names the torn line
+    assert any("torn trailing line" in p for p in lg.validate())
+    # a non-trailing corrupt line is a different (worse) report
+    with open(lg.path, "w", encoding="utf-8") as fh:
+        fh.write('garbage\n' + json.dumps(r1) + "\n")
+    assert any("edited in place" in p for p in lg.validate())
+
+
+# ---------------------------------------------------------------------------
+# drift gate: bands
+
+
+def _steady_baseline(lg):
+    for i, v in enumerate([10.0, 10.05, 9.95, 10.02]):
+        _bench(lg, v, ts=1000.0 + i)
+    return gate.build_baseline(lg.read_all())
+
+
+def test_planted_10pct_regression_trips(tmp_path):
+    lg = _mk(tmp_path)
+    baseline = _steady_baseline(lg)
+    fresh = _bench(lg, 11.0, ts=2000.0)  # +10%
+    failures, _ = gate.check_records([fresh], baseline)
+    assert any("perf regression" in f for f in failures)
+
+
+def test_in_band_value_does_not_trip(tmp_path):
+    lg = _mk(tmp_path)
+    baseline = _steady_baseline(lg)
+    fresh = _bench(lg, 10.2, ts=2000.0)  # +2%: inside the 5% floor
+    failures, notes = gate.check_records([fresh], baseline)
+    assert failures == []
+
+
+def test_host_load_normalization_absorbs_loaded_host(tmp_path):
+    lg = _mk(tmp_path)
+    baseline = _steady_baseline(lg)
+    # 30% slower wall time on a host with loadavg 0.6 normalizes to
+    # 13.0/1.6 = 8.1 — under the band, not a regression.
+    fresh = _bench(lg, 13.0, host_load=0.6, ts=2000.0)
+    failures, _ = gate.check_records([fresh], baseline)
+    assert failures == []
+    # the same value at the baseline's load IS a regression
+    fresh = _bench(lg, 13.0, ts=2001.0)
+    failures, _ = gate.check_records([fresh], baseline)
+    assert any("perf regression" in f for f in failures)
+
+
+def test_degraded_records_never_band(tmp_path):
+    lg = _mk(tmp_path)
+    baseline = _steady_baseline(lg)
+    # a degraded CPU-fallback round 5x over the band: noted, not failed
+    slow = _bench(lg, 50.0, ts=2000.0,
+                  payload={"parsed": {"degraded": True}})
+    failures, notes = gate.check_records([slow], baseline)
+    assert failures == []
+    assert any("degraded" in n for n in notes)
+    # and degraded history must not widen the band for clean numbers
+    lg2 = _mk(tmp_path, "lg2")
+    for i, v in enumerate([10.0, 10.05]):
+        _bench(lg2, v, ts=1000.0 + i)
+    _bench(lg2, 500.0, ts=1002.0,
+           payload={"parsed": {"degraded": True}})
+    base2 = gate.build_baseline(lg2.read_all())
+    key = "bench|t_ms|s0|cpu"
+    assert base2["metrics"][key]["count"] == 2
+    assert base2["metrics"][key]["median"] < 10.0  # load-normalized
+
+
+def test_new_key_and_unbanded_unit_are_notes(tmp_path):
+    lg = _mk(tmp_path)
+    lg.record("serve", "requests_per_s", 5.0, unit="req/s",
+              platform="cpu", host_load=0.2, git_rev=None,
+              ts_unix=999.0)
+    baseline = _steady_baseline(lg)
+    novel = _bench(lg, 99.0, metric="never_seen_ms", ts=2000.0)
+    # req/s is higher-is-better: the gate has no band for it, so even a
+    # collapsed throughput is a note (the serve SLO gate owns that axis)
+    rps = lg.record("serve", "requests_per_s", 3.0, unit="req/s",
+                    platform="cpu", host_load=0.2, git_rev=None,
+                    ts_unix=2001.0)
+    failures, notes = gate.check_records([novel, rps], baseline)
+    assert failures == []
+    assert any("new metric key" in n for n in notes)
+    assert any("unbanded unit" in n for n in notes)
+
+
+def test_gate_cli_trips_on_chain_tamper(tmp_path):
+    lg = _mk(tmp_path)
+    _steady_baseline(lg)
+    bpath = gate.baseline_path(lg.directory)
+    gate.save_baseline(bpath, gate.build_baseline(lg.read_all()))
+    assert gate.main(["--check", "--ledger-dir", lg.directory]) == 0
+    lines = open(lg.path, encoding="utf-8").read().splitlines()
+    doctored = json.loads(lines[0])
+    doctored["value"] = 0.5
+    lines[0] = json.dumps(doctored, separators=(",", ":"))
+    with open(lg.path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    assert gate.main(["--check", "--ledger-dir", lg.directory]) == 1
+
+
+# ---------------------------------------------------------------------------
+# drift gate: accuracy curves
+
+
+def _curve_record(lg, dtype, rel, ts):
+    return lg.record(
+        "error_curve", f"error_curve_{dtype}", rel[-1],
+        unit="rel_frobenius", structure_hash="s0", platform="cpu",
+        device_kind="host", host_load=None, git_rev=None, ts_unix=ts,
+        knobs={"dtype": dtype, "k": 2, "iterations": len(rel),
+               "seed": 3, "emulated": False, "fmt": "fold"},
+        payload={"frobenius": rel, "rel_frobenius": rel,
+                 "max_abs": rel})
+
+
+def test_bf16_cliff_trips_curve_gate(tmp_path):
+    lg = _mk(tmp_path)
+    _curve_record(lg, "bf16", [1e-3, 1.5e-3, 2e-3], ts=1000.0)
+    baseline = gate.build_baseline(lg.read_all())
+    ok = _curve_record(lg, "bf16", [1.1e-3, 1.6e-3, 2.1e-3], ts=2000.0)
+    failures, _ = gate.check_records([ok], baseline)
+    assert failures == []
+    cliff = _curve_record(lg, "bf16", [1e-3, 1.5e-3, 5e-2], ts=2001.0)
+    failures, _ = gate.check_records([cliff], baseline)
+    assert any("accuracy regression" in f for f in failures)
+
+
+def test_f32_zero_baseline_watchdog(tmp_path):
+    lg = _mk(tmp_path)
+    _curve_record(lg, "f32", [0.0, 0.0, 0.0], ts=1000.0)
+    baseline = gate.build_baseline(lg.read_all())
+    # the absolute floor makes "any f32 error" a bit-identity break
+    broken = _curve_record(lg, "f32", [0.0, 1e-5, 1e-5], ts=2000.0)
+    failures, _ = gate.check_records([broken], baseline)
+    assert any("accuracy regression" in f for f in failures)
+    clean = _curve_record(lg, "f32", [0.0, 0.0, 0.0], ts=2001.0)
+    failures, _ = gate.check_records([clean], baseline)
+    assert failures == []
+
+
+def test_shortened_curve_is_regression(tmp_path):
+    lg = _mk(tmp_path)
+    _curve_record(lg, "bf16", [1e-3, 1.5e-3, 2e-3], ts=1000.0)
+    baseline = gate.build_baseline(lg.read_all())
+    short = _curve_record(lg, "bf16", [1e-3, 1.5e-3], ts=2000.0)
+    failures, _ = gate.check_records([short], baseline)
+    assert any("curve shortened" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# error probe
+
+
+@pytest.mark.slow
+def test_error_probe_deterministic_and_f32_zero():
+    from arrow_matrix_tpu.ledger.probe import error_curves_for_source
+
+    src = {"kind": "ba", "n": 96, "m": 3, "width": 16, "seed": 7}
+    a = error_curves_for_source(src, k=2, iterations=3,
+                                dtypes=("f32", "bf16"))
+    b = error_curves_for_source(src, k=2, iterations=3,
+                                dtypes=("f32", "bf16"))
+    # fixed seed + no ledger ⇒ the records (ids included) are identical
+    assert a == b
+    f32, bf16 = a
+    assert f32["knobs"]["dtype"] == "f32"
+    assert f32["payload"]["rel_frobenius"] == [0.0, 0.0, 0.0]
+    assert f32["value"] == 0.0
+    assert all(p > 0 for p in bf16["payload"]["rel_frobenius"])
+    assert f32["structure_hash"] == bf16["structure_hash"]
+    assert schema_problems(f32) == [] and schema_problems(bf16) == []
+
+
+# ---------------------------------------------------------------------------
+# legacy export bridge
+
+
+def test_legacy_ingest_and_export_round_trip(tmp_path):
+    lg = _mk(tmp_path)
+    parsed = {"metric": "spmm_iter_ms", "value": 120.0, "unit": "ms",
+              "vs_baseline": None, "config": {"n": 64, "width": 8},
+              "platform": "cpu", "device_kind": "host",
+              "degraded": True, "backend_probe_class": "init-hang"}
+    legacy = {"n": 2, "cmd": "python bench.py", "rc": 0,
+              "tail": json.dumps(parsed) + "\n", "parsed": parsed}
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(legacy))
+    # a pre-contract round (parsed null) is skipped with a note
+    p1 = tmp_path / "BENCH_r01.json"
+    p1.write_text(json.dumps({"n": 1, "cmd": "c", "rc": 0,
+                              "tail": "", "parsed": None}))
+    count, notes = export.ingest_legacy_bench(lg, [str(p1), str(p)])
+    assert count == 1
+    assert any("parsed is null" in n for n in notes)
+    rec = lg.read_all()[-1]
+    # shape rides in the metric name so scales never share a band
+    assert rec["metric"] == "spmm_iter_ms_n64_w8"
+    doc = export.compose_round(lg, 3)
+    assert export.validate_legacy(doc) == []
+    # the legacy vocabulary survives the round trip untouched
+    assert doc["parsed"]["degraded"] is True
+    assert doc["parsed"]["backend_probe_class"] == "init-hang"
+    # tail contract: the last line IS the parsed record
+    assert parse_last_json_line(doc["tail"]) == doc["parsed"]
+    assert doc["parsed"]["ledger"]["records"] == 1
+
+
+def test_export_matches_checked_in_bench_r06():
+    """Re-exporting from the committed store must reproduce the
+    checked-in BENCH_r06.json exactly — export reads only committed
+    records and adds no fresh timestamps."""
+    if not os.path.exists(BENCH_R06):
+        pytest.skip("no checked-in BENCH_r06.json")
+    lg = Ledger(COMMITTED_LEDGER)
+    assert lg.validate() == []
+    doc = export.compose_round(lg, 6)
+    committed = json.load(open(BENCH_R06, encoding="utf-8"))
+    # the committed file stores the run-relative ledger path
+    doc["parsed"]["ledger"]["store"] = \
+        committed["parsed"]["ledger"]["store"]
+    doc["tail"] = json.dumps(doc["parsed"], sort_keys=True) + "\n"
+    assert doc == committed
+
+
+def test_export_without_bench_record_raises(tmp_path):
+    lg = _mk(tmp_path)
+    with pytest.raises(ValueError):
+        export.compose_round(lg, 6)
+
+
+# ---------------------------------------------------------------------------
+# committed fixture store (the doctor LEDGER probe's target)
+
+
+def test_fixture_store_gates_green():
+    lg = Ledger(FIXTURE_DIR)
+    assert lg.validate() == []
+    rc, lines = gate.run_gate(
+        FIXTURE_DIR, os.path.join(FIXTURE_DIR, "baseline.json"))
+    assert rc == 0, "\n".join(lines)
+
+
+def test_fixture_planted_regression_trips():
+    lg = Ledger(FIXTURE_DIR)
+    baseline = gate.load_baseline(
+        os.path.join(FIXTURE_DIR, "baseline.json"))
+    planted = None
+    for rec in lg.read_all():
+        if rec.get("unit") == "ms":
+            planted = copy.deepcopy(rec)
+            break
+    assert planted is not None
+    planted["value"] = float(planted["value"]) * 10.0
+    planted["record_id"] = canonical_record_id(planted)
+    failures, _ = gate.check_records([planted], baseline)
+    assert any("perf regression" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# crash-window contract (utils/artifacts)
+
+
+def test_atomic_write_failure_preserves_previous_artifact(tmp_path,
+                                                          monkeypatch):
+    target = tmp_path / "artifact.json"
+    atomic_write_json(str(target), {"v": 1})
+
+    def boom(src, dst):
+        raise OSError("simulated crash inside the replace window")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        atomic_write_json(str(target), {"v": 2})
+    monkeypatch.undo()
+    # the previous artifact is intact and no tmp litter remains
+    assert json.load(open(target)) == {"v": 1}
+    assert [p.name for p in tmp_path.iterdir()] == ["artifact.json"]
+
+
+def test_atomic_write_unserializable_leaves_artifact(tmp_path):
+    target = tmp_path / "artifact.json"
+    atomic_write_json(str(target), {"v": 1})
+    with pytest.raises(TypeError):
+        atomic_write_json(str(target), {"v": object()})
+    assert json.load(open(target)) == {"v": 1}
+    assert [p.name for p in tmp_path.iterdir()] == ["artifact.json"]
+
+
+def test_append_jsonl_serializes_before_touching_file(tmp_path):
+    target = tmp_path / "log.jsonl"
+    append_jsonl(str(target), {"a": 1})
+    with pytest.raises(TypeError):
+        append_jsonl(str(target), {"bad": object()})
+    # the failed append wrote nothing — not even a partial line
+    assert open(target).read() == '{"a":1}\n'

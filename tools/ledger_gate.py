@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Drift gate over the graft-ledger store (thin wrapper).
+
+The engine lives in ``arrow_matrix_tpu/ledger/gate.py``; this wrapper
+exists so CI and the Makefile-style workflow can call every gate as
+``python tools/<name>_gate.py`` uniformly.  Exits nonzero on a perf
+regression (median+MAD band, host-load normalized), an accuracy-curve
+regression (error-vs-iteration point above the committed curve's
+factor), or schema drift (invalid record, broken hash chain).
+
+Usage:
+    python tools/ledger_gate.py [--check] [--rebaseline]
+                                [--ledger-dir DIR] [--baseline FILE]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from arrow_matrix_tpu.ledger.gate import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -10,10 +10,13 @@ the findings (if any) and exits with graft-lint's status: 0 clean,
 audit and refreshes bench_cache/compile_manifest.json; ``--prove``
 additionally runs the HLO collective-contract prover in check mode
 (fails on any violated contract or drift against the checked-in
-bench_cache/hlo_manifest.json — tools/proof_gate.py standalone).
+bench_cache/hlo_manifest.json — tools/proof_gate.py standalone);
+``--ledger`` additionally runs the graft-ledger drift gate in check
+mode against the committed store + baseline (tools/ledger_gate.py
+standalone).
 
 Usage:
-  python tools/lint_gate.py [--audit] [--prove] [paths...]
+  python tools/lint_gate.py [--audit] [--prove] [--ledger] [paths...]
 """
 
 import os
@@ -32,6 +35,9 @@ def main(argv=None) -> int:
     run_prove = "--prove" in argv
     if run_prove:
         argv.remove("--prove")
+    run_ledger = "--ledger" in argv
+    if run_ledger:
+        argv.remove("--ledger")
     rc = graft_lint_main(argv)
     if rc != 0:
         print("lint gate: FAILED (fix the findings or waive them with "
@@ -47,6 +53,14 @@ def main(argv=None) -> int:
         rc = graft_lint_main(["prove", "--check"])
         if rc != 0:
             print("lint gate: HLO contract proof FAILED",
+                  file=sys.stderr)
+            return rc
+    if run_ledger:
+        from arrow_matrix_tpu.ledger.gate import main as ledger_main
+
+        rc = ledger_main(["--check"])
+        if rc != 0:
+            print("lint gate: ledger drift gate FAILED",
                   file=sys.stderr)
             return rc
     print("lint gate: ok", file=sys.stderr)

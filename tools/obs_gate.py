@@ -177,6 +177,35 @@ def pulse_problems(summary: dict) -> list:
     return problems
 
 
+def ledger_problems(smoke_summary: dict, serve_summary: dict) -> list:
+    """Gate problems from the graft-ledger wiring of a smoke run: both
+    the obs smoke summary and the serve SLO report must carry the id
+    of the ledger record their run appended, and the record must
+    actually exist (valid, chained) in the run-dir-local store — a
+    measured number that never reached the ledger is exactly the
+    unaccounted drift the ledger exists to end."""
+    from arrow_matrix_tpu.ledger import Ledger
+
+    problems = []
+    for label, summary in (("smoke", smoke_summary),
+                           ("serve", serve_summary)):
+        rec_id = summary.get("ledger_record_id")
+        if not rec_id:
+            problems.append(f"ledger: {label} summary carries no "
+                            f"ledger_record_id")
+            continue
+        run_dir = summary.get("_run_dir")
+        if not run_dir:
+            continue
+        lg = Ledger(os.path.join(run_dir, "ledger"))
+        recs = {r.get("record_id") for r in lg.read_all()}
+        if rec_id not in recs:
+            problems.append(f"ledger: {label} record {rec_id} absent "
+                            f"from {lg.path}")
+        problems += [f"ledger ({label}): {p}" for p in lg.validate()]
+    return problems
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
@@ -189,6 +218,7 @@ def main(argv=None) -> int:
 
     out = argv[0] if argv else tempfile.mkdtemp(prefix="obs_gate_")
     summary = run_smoke(out, n=128, width=32, k=4, n_dev=4, iters=2)
+    summary["_run_dir"] = out
     problems = validate_run_dir(out)
     max_ratio = float(os.environ.get("OBS_GATE_MAX_HBM_RATIO", "8.0"))
     problems += memory_problems(summary, max_ratio)
@@ -198,6 +228,7 @@ def main(argv=None) -> int:
     s["_run_dir"] = serve_dir
     problems += serve_problems(s)
     problems += pulse_problems(s)
+    problems += ledger_problems(summary, s)
     if problems:
         for p in problems:
             print(f"obs gate: {p}", file=sys.stderr)

@@ -499,6 +499,25 @@ def main() -> None:
                 continue
             results[rung] = new
             print(f"[ladder] {rung}: {results[rung]}", flush=True)
+            # graft-ledger: each measured rung also lands in the
+            # append-only store (the committed scale_ladder.json stays
+            # the human-facing artifact; the ledger is the queryable
+            # history the drift gate bands on).
+            try:
+                from arrow_matrix_tpu.ledger import (
+                    record as _ledger_record,
+                )
+
+                load_after = new.get("host_load", {}).get("after", {})
+                _ledger_record(
+                    "ladder", f"ladder_{rung}_wall_s", wall, unit="s",
+                    host_load=load_after.get("loadavg_1m"),
+                    knobs={"rung": rung},
+                    payload={k: v for k, v in new.items()
+                             if not isinstance(v, (dict, list))})
+            except Exception as e:
+                print(f"[ledger] ladder record not persisted: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
         else:
             failure = {"error": proc.stderr.strip()[-500:],
                        "wall_s": wall}
