@@ -379,6 +379,70 @@ def probe_ledger() -> tuple[bool, str]:
     return True, lines[-1][len("LEDGER ok: "):][:120]
 
 
+def probe_fleet() -> tuple[bool, str]:
+    """graft-fleet round-trip: spawn a 2-worker process fleet, route
+    one request to each worker, SIGKILL one, and require the router to
+    requeue a request aimed at the dead worker onto the survivor — the
+    kill-one-worker-of-N contract in miniature (tools/fleet_gate.py
+    runs the full 3-worker mid-batch version).  Bounded subprocess, as
+    for the other probes."""
+    code = (
+        "import sys, tempfile; sys.argv=[]; "
+        "from arrow_matrix_tpu.utils.platform import "
+        "force_cpu_devices; force_cpu_devices(1); "
+        "import numpy as np; "
+        "from arrow_matrix_tpu.fleet.router import FleetRouter; "
+        "from arrow_matrix_tpu.serve.request import Request; "
+        "d = tempfile.mkdtemp(prefix='fleet_probe_'); "
+        "r = FleetRouter(spawn=2, vertices=64, width=16, seed=3, "
+        "run_dir=d); p = []; "
+        "\n"
+        "try:\n"
+        "    x = np.ones((r.n_rows, 2), dtype=np.float32)\n"
+        "    wids = sorted(r.workers)\n"
+        "    ten = {}\n"
+        "    i = 0\n"
+        "    while len(ten) < 2 and i < 256:\n"
+        "        ten.setdefault(r.ring.lookup(f't{i}'), f't{i}')\n"
+        "        i += 1\n"
+        "    t1 = r.submit(Request('p0', ten[wids[0]], x, 1))\n"
+        "    t2 = r.submit(Request('p1', ten[wids[1]], x, 1))\n"
+        "    r.drain(timeout_s=120)\n"
+        "    if not (t1.status == t2.status == 'completed'):\n"
+        "        p.append('one-request-per-worker warmup failed: '\n"
+        "                 + repr((t1.status, t2.status)))\n"
+        "    victim = wids[0]\n"
+        "    r.kill_worker(victim)\n"
+        "    t3 = r.submit(Request('p2', ten[victim], x, 1))\n"
+        "    r.drain(timeout_s=120)\n"
+        "    if t3.status != 'completed':\n"
+        "        p.append('requeued request did not complete: '\n"
+        "                 + repr((t3.status, t3.reason, t3.error)))\n"
+        "    elif getattr(t3, 'requeues', 0) < 1:\n"
+        "        p.append('dead-worker request was not requeued')\n"
+        "    elif getattr(t3, 'worker_id', None) == victim:\n"
+        "        p.append('request credited to the dead worker')\n"
+        "finally:\n"
+        "    r.shutdown()\n"
+        "print('FLEET ok' if not p else 'FLEET FAIL: ' + str(p[0]))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("FLEET")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "FLEET ok":
+        return False, lines[-1][:120]
+    return True, ("2-worker fleet survives a kill with requeue — run "
+                  "`graft_fleet` / tools/fleet_gate.py for the full "
+                  "matrix")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -457,6 +521,10 @@ def main(argv=None) -> int:
     ledger_ok, detail = probe_ledger()
     ok &= _check("graft-ledger (record + chain + drift gate)",
                  ledger_ok, detail)
+
+    fleet_ok, detail = probe_fleet()
+    ok &= _check("graft-fleet (kill one of 2 workers + requeue)",
+                 fleet_ok, detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

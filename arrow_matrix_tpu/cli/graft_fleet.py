@@ -1,0 +1,176 @@
+"""``graft_fleet`` — run a multi-process ArrowServer fleet end to end.
+
+Spawns N worker processes (each a full ArrowServer: supervisor,
+admission, checkpoint-resume, pulse ring, run-dir ledger), routes a
+deterministic synthetic trace through the
+:class:`~arrow_matrix_tpu.fleet.router.FleetRouter`, and writes the
+merged fleet artifacts into ``--run_dir``:
+
+  * ``fleet_report.json`` — the merged SLO report; ``latency_ms`` is
+    the EXACT pooled-quantile summary over every worker's raw
+    samples, ``host_load`` records the 1-minute loadavg the run saw;
+  * ``pulse_merged.json`` — the workers' pulse rings pooled via
+    ``graft_pulse merge`` semantics (:func:`~arrow_matrix_tpu.obs
+    .pulse.merge_rings`);
+  * ``ledger/ledger.jsonl`` — every worker's run-dir ledger folded
+    into one chained fleet history (kind ``fleet``);
+  * ``<worker-id>/`` — each worker's own ring, ledger, summary, log.
+
+Chaos knobs: ``--fault_worker``/``--fault_plan`` arm EXACTLY ONE
+worker's environment with an ``AMT_FAULT_PLAN`` (e.g. a ``kill`` plan
+on ``*.step`` — the worker SIGKILLs itself mid-batch
+deterministically), which is how tools/fleet_gate.py runs the
+kill-one-worker-of-N survival scenario.  The last stdout line is the
+JSON verdict (the gate/doctor handshake).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from arrow_matrix_tpu.serve import request as rq
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graft_fleet", description=__doc__.splitlines()[0])
+    p.add_argument("--run_dir", required=True)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--vertices", type=int, default=128)
+    p.add_argument("--width", type=int, default=16)
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--fmt", default="fold")
+    p.add_argument("--tenants", type=int, default=4)
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--iterations", type=int, default=4)
+    p.add_argument("--k", type=int, default=2)
+    p.add_argument("--trace_seed", type=int, default=5)
+    p.add_argument("--queue", type=int, default=64)
+    p.add_argument("--hbm_budget_mb", type=float, default=0.0)
+    p.add_argument("--placement", choices=("ring", "pack"),
+                   default="ring")
+    p.add_argument("--window_s", type=float, default=0.25)
+    p.add_argument("--submit_timeout_s", type=float, default=300.0)
+    p.add_argument("--results_npz", default=None,
+                   help="also save completed results (request id -> "
+                        "array) for bit-identity comparisons")
+    p.add_argument("--fault_worker", default=None,
+                   help="worker id whose environment gets "
+                        "--fault_plan (chaos scenarios)")
+    p.add_argument("--fault_plan", default=None,
+                   help="AMT_FAULT_PLAN JSON (or a path to it) for "
+                        "--fault_worker only")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def run_fleet(args) -> dict:
+    from arrow_matrix_tpu.fleet.router import FleetRouter
+    from arrow_matrix_tpu.ledger.store import _default_host_load
+    from arrow_matrix_tpu.obs import pulse as pulse_mod
+    from arrow_matrix_tpu.serve.loadgen import synthetic_trace
+    from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+
+    os.makedirs(args.run_dir, exist_ok=True)
+    worker_env = None
+    if args.fault_worker:
+        plan = args.fault_plan or ""
+        if os.path.exists(plan):
+            with open(plan, encoding="utf-8") as fh:
+                plan = fh.read()
+        worker_env = {args.fault_worker: {"AMT_FAULT_PLAN": plan}}
+    router = FleetRouter(
+        spawn=args.workers, vertices=args.vertices, width=args.width,
+        seed=args.seed, fmt=args.fmt, queue_capacity=args.queue,
+        hbm_budget_mb=args.hbm_budget_mb,
+        checkpoint_dir=os.path.join(args.run_dir, "checkpoints"),
+        run_dir=args.run_dir, window_s=args.window_s,
+        placement=args.placement, worker_env=worker_env,
+        submit_timeout_s=args.submit_timeout_s,
+        verbose=args.verbose)
+    try:
+        trace = synthetic_trace(
+            router.n_rows, tenants=args.tenants,
+            requests=args.requests, k=args.k,
+            iterations=args.iterations, seed=args.trace_seed)
+        if args.placement == "pack":
+            router.plan_packing({r.tenant: r.k for r in trace})
+        tickets = [router.submit(r) for r in trace]
+        router.drain(timeout_s=args.submit_timeout_s)
+        report = router.fleet_summary()
+    finally:
+        router.shutdown()
+    report["host_load"] = _default_host_load()
+    report["tickets"] = [
+        {"request_id": t.request.request_id,
+         "tenant": t.request.tenant, "status": t.status,
+         "reason": t.reason,
+         "worker_id": getattr(t, "worker_id", None),
+         "requeues": getattr(t, "requeues", 0)}
+        for t in tickets]
+    folded = router.fold_ledgers()
+    report["ledger_records_folded"] = folded
+
+    ring_docs = []
+    for wid in sorted(router.workers):
+        handle = router.workers[wid]
+        if not handle.obs_dir:
+            continue
+        ring_path = os.path.join(handle.obs_dir, "pulse_ring.json")
+        if os.path.exists(ring_path):
+            ring_docs.append(pulse_mod.load_ring(ring_path))
+    merged_pulse = pulse_mod.merge_rings(ring_docs)
+    atomic_write_json(os.path.join(args.run_dir,
+                                   "pulse_merged.json"),
+                      merged_pulse, indent=2, sort_keys=True)
+    report["pulse_merged"] = {
+        "rings": merged_pulse["rings"],
+        "totals": merged_pulse["totals"],
+        "problems": merged_pulse["problems"],
+    }
+    if args.results_npz:
+        import numpy as np
+
+        np.savez(args.results_npz,
+                 **{t.request.request_id: t.result for t in tickets
+                    if t.status == rq.COMPLETED
+                    and t.result is not None})
+        report["results_npz"] = args.results_npz
+    atomic_write_json(os.path.join(args.run_dir,
+                                   "fleet_report.json"),
+                      report, indent=2, sort_keys=True)
+    return report
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    report = run_fleet(args)
+    verdict = {
+        "fleet": report["fleet"],
+        "workers": report["num_workers"],
+        "dead_workers": report["dead_workers"],
+        "requests": report["requests"],
+        "completed": report["completed"],
+        "failed": report["failed"],
+        "shed": report["shed"],
+        "rejected": report["rejected"],
+        "shed_reasons": report["shed_reasons"],
+        "requeues": report["requeues"],
+        "requests_per_s": report["requests_per_s"],
+        "latency_ms": {f: report["latency_ms"].get(f)
+                       for f in ("count", "p50", "p90", "p99")},
+        "host_load": report["host_load"],
+        "pulse_problems": report["pulse_merged"]["problems"],
+        "run_dir": args.run_dir,
+    }
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    lost = (report["requests"] - report["completed"]
+            - report["failed"] - report["shed"] - report["rejected"])
+    return 0 if lost == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,12 @@ appended when missing):
   * ``check <source> [--metrics <path-or-url>]`` — validate the ring
     document (and optionally a Prometheus exposition payload) against
     the graft-pulse schema; exit non-zero on any problem — the same
-    validators tools/obs_gate.py and ``amt_doctor probe_pulse`` use.
+    validators tools/obs_gate.py and ``amt_doctor probe_pulse`` use;
+  * ``merge <source...>`` — pool N rings (one per graft-fleet worker)
+    into one merged document via the lossless Histogram.merge: the
+    merged quantiles are EXACT nearest-rank over the union of raw
+    samples, and each source ring's pooled windows are asserted equal
+    to its own streamed totals (exit non-zero on any mismatch).
 
 Pure stdlib + obs/pulse.py: no jax import, so it runs anywhere the
 artifacts land.
@@ -173,6 +178,42 @@ def cmd_check(args) -> int:
     return 0
 
 
+def cmd_merge(args) -> int:
+    docs = []
+    problems = []
+    for source in args.sources:
+        try:
+            docs.append(_load(source))
+        except (OSError, json.JSONDecodeError) as e:
+            problems.append(f"{source}: unreadable ({e})")
+    merged = pulse.merge_rings(docs)
+    problems += merged["problems"]
+    merged["problems"] = problems
+    if args.out:
+        from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+
+        atomic_write_json(args.out, merged, indent=2, sort_keys=True)
+    if args.json:
+        print(json.dumps(merged, indent=2, sort_keys=True))
+    else:
+        t = merged["totals"]
+        lat = t["latency_ms"]
+        print(f"pulse merge: {merged['rings']} ring(s), "
+              f"{lat['count']} pooled samples")
+        for r in merged["per_ring"]:
+            print(f"  {r['name']}: {r['windows']} windows "
+                  f"(+{r['dropped_windows']} dropped), "
+                  f"{r['pooled_samples']} samples")
+        print(f"totals: {t['completed']} completed / {t['failed']} "
+              f"failed / {t['shed']} shed / {t['rejected']} rejected; "
+              f"p50={_fmt_ms(lat['p50'])}ms "
+              f"p90={_fmt_ms(lat['p90'])}ms "
+              f"p99={_fmt_ms(lat['p99'])}ms (exact pooled quantiles)")
+    for p in problems:
+        print(f"graft_pulse merge: PROBLEM: {p}")
+    return 1 if problems else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graft_pulse", description=__doc__.splitlines()[0])
@@ -202,6 +243,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also validate this exposition text "
                          "(pulse_metrics.prom path or /metrics URL)")
     cp.set_defaults(fn=cmd_check)
+
+    mp = sub.add_parser(
+        "merge",
+        help="pool N pulse rings (fleet workers) into one exact "
+             "merged document; asserts pooled == streamed per ring")
+    mp.add_argument("sources", nargs="+",
+                    help="pulse_ring.json paths / run dirs / "
+                         "endpoint URLs")
+    mp.add_argument("--out", default=None,
+                    help="write the merged document here")
+    mp.add_argument("--json", action="store_true",
+                    help="print the merged document as JSON")
+    mp.set_defaults(fn=cmd_merge)
     return p
 
 

@@ -23,6 +23,7 @@ replay-identical in shed/retry counts.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import random
 
 
@@ -73,6 +74,18 @@ class RetryPolicy:
         """All ``max_retries`` delays, for logging/tests."""
         return tuple(self.delay_s(a, salt=salt)
                      for a in range(1, self.max_retries + 1))
+
+    def for_worker(self, worker_id: str) -> "RetryPolicy":
+        """The same policy re-seeded for one fleet worker: the seed is
+        derived from ``(seed, worker_id)`` through sha256 (stable
+        across processes, unlike ``hash()``), so N workers retrying
+        the same dead dependency draw DIFFERENT jittered schedules —
+        no thundering herd — while any one worker's schedule stays
+        bit-reproducible at a fixed base seed."""
+        digest = hashlib.sha256(
+            f"{self.seed}:{worker_id}".encode("utf-8")).digest()
+        derived = int.from_bytes(digest[:8], "big")
+        return dataclasses.replace(self, seed=derived)
 
     @classmethod
     def from_args(cls, args, **overrides) -> "RetryPolicy":

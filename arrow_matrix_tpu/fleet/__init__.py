@@ -1,0 +1,71 @@
+"""graft-fleet: the multi-process ArrowServer fleet.
+
+The reference runtime was inherently multi-process MPI; the repo's
+``shard_map`` pivot collapsed it into one Python process, so every
+subsystem since — scheduler, HBM accountant, pulse monitor, tune
+cache — capped at one GIL and one host.  This package gets the fleet
+back as the CPU rehearsal for process-per-rank serving:
+
+  * :mod:`~arrow_matrix_tpu.fleet.wire` — a stdlib-only
+    length-prefixed JSON wire protocol (ndarrays ride base64), with
+    ``AMT_FAULT_PLAN`` injection seams at ``fleet.wire.send`` /
+    ``fleet.wire.recv``.
+  * :mod:`~arrow_matrix_tpu.fleet.worker` — one spawned process
+    running a FULL :class:`~arrow_matrix_tpu.serve.ArrowServer`
+    (supervisor, admission, checkpoint-resume, pulse ring, run-dir
+    ledger) behind a threaded TCP front; retry jitter is seeded per
+    worker id (``RetryPolicy.for_worker``) so N workers never
+    thunder-herd.  ``jax.distributed`` hooks
+    (:func:`~arrow_matrix_tpu.fleet.worker.maybe_init_distributed`)
+    arm the same shape on real chips.
+  * :mod:`~arrow_matrix_tpu.fleet.health` — heartbeat-based worker
+    health with explicit timeout and per-worker jittered backoff; a
+    worker is declared dead only after ``max_failures`` consecutive
+    missed heartbeats, never on the first wire error.
+  * :mod:`~arrow_matrix_tpu.fleet.placement` — tenant placement over
+    the same ``request_bytes_for`` pricing the admission controller
+    trusts: consistent hashing for shared-graph tenants, first-fit-
+    decreasing bin-packing for per-tenant graphs.
+  * :mod:`~arrow_matrix_tpu.fleet.router` — the front end: places,
+    dispatches, watches, and on a worker death REQUEUES the dead
+    worker's accepted-but-unfinished requests onto survivors —
+    idempotent because every request's progress lives in the shared
+    sha256-verified checkpoint directory, so replayed work is resumed,
+    not recomputed.  Lost capacity sheds EXPLICITLY
+    (``fleet_capacity``), never stalls.  Fleet p99 is exact: the
+    merged report pools every worker's raw latency samples through
+    the mergeable histograms of ``obs/metrics.py``.
+
+Gate: ``tools/fleet_gate.py`` (kill-one-worker-of-N survival, wired
+into ``tools/chaos_gate.py``).  CLI: ``graft_fleet``.
+"""
+
+from arrow_matrix_tpu.fleet.health import HealthMonitor, WorkerHealth
+from arrow_matrix_tpu.fleet.placement import (
+    ConsistentHashRing,
+    pack_tenants,
+)
+from arrow_matrix_tpu.fleet.router import FleetRouter, WorkerHandle
+from arrow_matrix_tpu.fleet.wire import (
+    WireError,
+    decode_payload,
+    encode_payload,
+    recv_msg,
+    request_call,
+    send_msg,
+)
+
+__all__ = [
+    "ConsistentHashRing",
+    "FleetRouter",
+    "HealthMonitor",
+    "WireError",
+    "WorkerHandle",
+    "WorkerHealth",
+    "decode_payload",
+    "encode_payload",
+    "pack_tenants",
+    "recv_msg",
+    "request_call",
+    "send_msg",
+]

@@ -1,0 +1,134 @@
+"""Heartbeat-based worker health with explicit timeout and backoff.
+
+A worker is never declared dead on a single wire error: the monitor
+retries the heartbeat ``max_failures`` times with the per-worker
+jittered backoff schedule of :class:`~arrow_matrix_tpu.faults.policy
+.RetryPolicy` (``for_worker`` seeding — N routers probing N workers
+never thunder-herd on synchronized schedules), each probe bounded by
+``timeout_s``.  Only a full streak of misses flips the verdict, and
+the verdict is recorded with its evidence (consecutive failures, last
+error, last-ok timestamp) so the fleet report can show WHY a worker
+was buried.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, Optional
+
+from arrow_matrix_tpu.faults.policy import RetryPolicy
+from arrow_matrix_tpu.fleet import wire
+
+
+@dataclasses.dataclass
+class WorkerHealth:
+    """The monitor's per-worker verdict + evidence."""
+
+    worker_id: str
+    alive: bool = True
+    consecutive_failures: int = 0
+    last_ok_s: Optional[float] = None
+    last_error: Optional[str] = None
+    declared_dead_s: Optional[float] = None
+
+    def snapshot(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class HealthMonitor:
+    """Heartbeat prober over the fleet wire protocol.
+
+    ``probe(worker_id, host, port)`` performs up to ``max_failures``
+    bounded heartbeat attempts, sleeping the worker's OWN jittered
+    backoff between them, and returns the updated
+    :class:`WorkerHealth`.  ``clock``/``sleep`` are injectable so the
+    unit tests drive the retry ladder deterministically without wall
+    time.
+    """
+
+    def __init__(self, *, policy: Optional[RetryPolicy] = None,
+                 timeout_s: float = 5.0, max_failures: int = 3,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        if max_failures < 1:
+            raise ValueError(f"max_failures must be >= 1, got "
+                             f"{max_failures}")
+        self.policy = policy or RetryPolicy(backoff_s=0.05,
+                                            jitter=0.5)
+        self.timeout_s = float(timeout_s)
+        self.max_failures = int(max_failures)
+        self.clock = clock
+        self.sleep = sleep
+        self.state: Dict[str, WorkerHealth] = {}
+
+    def _health(self, worker_id: str) -> WorkerHealth:
+        h = self.state.get(worker_id)
+        if h is None:
+            h = self.state[worker_id] = WorkerHealth(worker_id)
+        return h
+
+    def record_ok(self, worker_id: str) -> WorkerHealth:
+        """Fold an out-of-band success (e.g. a completed submit) into
+        the health state: any successful op is a heartbeat."""
+        h = self._health(worker_id)
+        if h.alive:
+            h.consecutive_failures = 0
+            h.last_ok_s = float(self.clock())
+            h.last_error = None
+        return h
+
+    def record_failure(self, worker_id: str,
+                       error: str) -> WorkerHealth:
+        """Fold one failed op into the health state; flips ``alive``
+        when the consecutive-failure streak reaches the limit."""
+        h = self._health(worker_id)
+        h.consecutive_failures += 1
+        h.last_error = error
+        if h.alive and h.consecutive_failures >= self.max_failures:
+            h.alive = False
+            h.declared_dead_s = float(self.clock())
+        return h
+
+    def heartbeat_once(self, worker_id: str, host: str,
+                       port: int) -> bool:
+        """One bounded heartbeat round trip; folds the outcome."""
+        try:
+            reply = wire.request_call(host, port, {"op": "health"},
+                                      timeout_s=self.timeout_s)
+            if not (isinstance(reply, dict) and reply.get("ok")):
+                raise wire.WireError(f"bad heartbeat reply: "
+                                     f"{str(reply)[:120]}")
+        except (OSError, wire.WireError) as e:
+            self.record_failure(worker_id,
+                                f"{type(e).__name__}: {e}")
+            return False
+        self.record_ok(worker_id)
+        return True
+
+    def probe(self, worker_id: str, host: str,
+              port: int) -> WorkerHealth:
+        """The death-verdict ladder: retry the heartbeat up to
+        ``max_failures`` times with the worker's own jittered backoff
+        between attempts.  Returns the final health state — callers
+        decide what to do with a dead verdict (the router requeues)."""
+        h = self._health(worker_id)
+        policy = self.policy.for_worker(worker_id)
+        for attempt in range(1, self.max_failures + 1):
+            if self.heartbeat_once(worker_id, host, port):
+                return h
+            if not h.alive:
+                break
+            if attempt < self.max_failures:
+                self.sleep(policy.delay_s(attempt, salt="heartbeat"))
+        return h
+
+    def alive_workers(self) -> list:
+        return sorted(w for w, h in self.state.items() if h.alive)
+
+    def dead_workers(self) -> list:
+        return sorted(w for w, h in self.state.items() if not h.alive)
+
+    def snapshot(self) -> dict:
+        return {w: h.snapshot()
+                for w, h in sorted(self.state.items())}

@@ -1,0 +1,633 @@
+"""The fleet front end: placement, dispatch, health, requeue, report.
+
+:class:`FleetRouter` owns N :class:`WorkerHandle`\\ s — spawned local
+``python -m arrow_matrix_tpu.fleet.worker`` processes (the CPU
+rehearsal; ``jax.distributed`` hooks live in the worker) or attached
+in-process workers — and routes tenant requests over the fleet wire:
+
+* **Placement** uses the pricing admission already trusts:
+  consistent hashing (:class:`~arrow_matrix_tpu.fleet.placement
+  .ConsistentHashRing`) for shared-graph tenants, or first-fit-
+  decreasing bin-packing (:func:`~arrow_matrix_tpu.fleet.placement
+  .pack_tenants`) of ``request_bytes_for`` prices — fetched from the
+  workers' own admission model via the ``price`` op — against worker
+  HBM headroom.  A tenant no worker can host is shed EXPLICITLY
+  (``fleet_capacity``), never queued into a stall.
+* **Dispatch** is one thread per in-flight ticket; the wire's one-
+  connection-per-op discipline means a worker death surfaces as a
+  wire error on exactly the requests it was running.
+* **Death & requeue**: a wire failure is a health QUESTION — the
+  :class:`~arrow_matrix_tpu.fleet.health.HealthMonitor` probes with
+  per-worker jittered backoff, and only a full streak of missed
+  heartbeats buries the worker.  Its accepted-but-unfinished requests
+  then requeue onto ring survivors.  Requeue is idempotent because
+  all workers share one checkpoint directory with per-request keys:
+  the survivor RESUMES the dead worker's sha256-verified checkpoint
+  (prints the same ``resumed request`` line tools/serve_gate.py
+  greps) instead of recomputing, and the result stays bit-identical
+  to a fault-free single-process replay — tools/fleet_gate.py's
+  acceptance bar.
+* **Report**: ``fleet_summary()`` pools every worker's RAW latency
+  samples through the mergeable :class:`~arrow_matrix_tpu.obs.metrics
+  .Histogram`, so fleet p50/p90/p99 are exact pooled quantiles, not
+  approximations; ``fold_ledgers()`` folds each worker's run-dir
+  ledger store into one chained fleet history (kind ``fleet``).
+
+Fault seam: every submit passes ``faults.inject("fleet.router.submit")``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import select
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from arrow_matrix_tpu import faults
+from arrow_matrix_tpu.fleet import wire
+from arrow_matrix_tpu.fleet.health import HealthMonitor
+from arrow_matrix_tpu.fleet.placement import (
+    ConsistentHashRing,
+    pack_tenants,
+)
+from arrow_matrix_tpu.ledger import store as ledger_store
+from arrow_matrix_tpu.obs import flight
+from arrow_matrix_tpu.obs.metrics import Histogram
+from arrow_matrix_tpu.serve import request as rq
+
+#: Explicit-shed reason when no live worker can host a request — the
+#: fleet extension of the degradation ladder: losing capacity sheds,
+#: it never stalls.
+SHED_FLEET_CAPACITY = "fleet_capacity"
+
+
+def _repo_pythonpath(env: Dict[str, str]) -> str:
+    """PYTHONPATH that keeps ``arrow_matrix_tpu`` importable in a
+    spawned worker even when the repo isn't installed."""
+    import arrow_matrix_tpu
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(arrow_matrix_tpu.__file__)))
+    old = env.get("PYTHONPATH", "")
+    parts = [p for p in old.split(os.pathsep) if p]
+    if root not in parts:
+        parts.insert(0, root)
+    return os.pathsep.join(parts)
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    """One fleet worker as the router sees it: an address, optionally
+    the spawned process, and the spawn handshake metadata."""
+
+    worker_id: str
+    host: str
+    port: int
+    proc: Optional[subprocess.Popen] = None
+    log_path: Optional[str] = None
+    obs_dir: Optional[str] = None
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def call(self, obj: Any, *, timeout_s: float = 30.0) -> Any:
+        return wire.request_call(self.host, self.port, obj,
+                                 timeout_s=timeout_s)
+
+    @property
+    def pid(self) -> Optional[int]:
+        if self.proc is not None:
+            return self.proc.pid
+        return self.meta.get("pid")
+
+    def kill(self) -> None:
+        """SIGKILL the spawned process (the chaos scenarios' hammer);
+        a no-op for attached in-process workers."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+
+    def reap(self, timeout_s: float = 10.0) -> Optional[int]:
+        if self.proc is None:
+            return None
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            return self.proc.wait(timeout=timeout_s)
+
+
+def spawn_worker(worker_id: str, *, vertices: int, width: int,
+                 seed: int, fmt: str = "fold",
+                 queue_capacity: int = 64,
+                 hbm_budget_mb: float = 0.0,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 2,
+                 obs_dir: Optional[str] = None,
+                 window_s: float = 0.25,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 ready_timeout_s: float = 120.0) -> WorkerHandle:
+    """Spawn one worker process and complete the stdout handshake.
+
+    The worker announces ``FLEET_WORKER_READY {json}`` once its server
+    is up and its TCP port is bound; everything it prints (including
+    the scheduler's ``resumed request`` lines the gates grep) is
+    copied to ``<obs_dir>/worker.log``.  ``extra_env`` lands ON TOP of
+    the inherited environment — the fleet gate arms exactly one victim
+    worker with an ``AMT_FAULT_PLAN`` kill plan this way.
+    """
+    cmd = [sys.executable, "-m", "arrow_matrix_tpu.fleet.worker",
+           "--worker_id", worker_id,
+           "--vertices", str(int(vertices)),
+           "--width", str(int(width)),
+           "--seed", str(int(seed)),
+           "--fmt", fmt,
+           "--queue", str(int(queue_capacity)),
+           "--hbm_budget_mb", str(float(hbm_budget_mb)),
+           "--checkpoint_every", str(int(checkpoint_every)),
+           "--window_s", str(float(window_s))]
+    if checkpoint_dir:
+        cmd += ["--checkpoint_dir", checkpoint_dir]
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        cmd += ["--obs_dir", obs_dir]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = _repo_pythonpath(env)
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(extra_env or {})
+
+    log_path = (os.path.join(obs_dir, "worker.log")
+                if obs_dir else os.devnull)
+    log_fh = open(log_path, "w", encoding="utf-8")
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=log_fh, text=True)
+    log_fh.close()   # the child holds the stderr fd now
+
+    deadline = time.monotonic() + ready_timeout_s
+    ready = None
+    assert proc.stdout is not None
+    while time.monotonic() < deadline:
+        r, _, _ = select.select([proc.stdout], [], [], 0.25)
+        if not r:
+            if proc.poll() is not None:
+                break
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            break
+        _append_log(log_path, line)
+        if line.startswith("FLEET_WORKER_READY "):
+            ready = json.loads(line[len("FLEET_WORKER_READY "):])
+            break
+    if ready is None:
+        proc.kill()
+        raise RuntimeError(
+            f"worker {worker_id} never announced readiness within "
+            f"{ready_timeout_s:.0f}s (see {log_path})")
+
+    # Keep draining the child's stdout into the log so the pipe never
+    # fills and the resume lines are greppable after the run.
+    def _drain():
+        for line in proc.stdout:
+            _append_log(log_path, line)
+
+    threading.Thread(target=_drain, daemon=True,
+                     name=f"fleet-log-{worker_id}").start()
+    return WorkerHandle(worker_id=worker_id, host="127.0.0.1",
+                        port=int(ready["port"]), proc=proc,
+                        log_path=log_path, obs_dir=obs_dir,
+                        meta=dict(ready))
+
+
+def _append_log(log_path: str, line: str) -> None:
+    if log_path == os.devnull:
+        return
+    with open(log_path, "a", encoding="utf-8") as fh:
+        fh.write(line)
+
+
+class FleetRouter:
+    """Places, dispatches, watches, requeues, reports (see the module
+    docstring).  Construct with ``spawn=`` worker count to spawn local
+    processes, or ``handles=`` to attach workers already serving
+    (tests run :func:`~arrow_matrix_tpu.fleet.worker.serve_worker` on
+    a thread and attach it)."""
+
+    def __init__(self, *, spawn: int = 0,
+                 handles: Optional[List[WorkerHandle]] = None,
+                 vertices: int = 128, width: int = 16, seed: int = 11,
+                 fmt: str = "fold", queue_capacity: int = 64,
+                 hbm_budget_mb: float = 0.0,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 2,
+                 run_dir: Optional[str] = None,
+                 window_s: float = 0.25,
+                 placement: str = "ring",
+                 health: Optional[HealthMonitor] = None,
+                 worker_env: Optional[Dict[str, Dict[str, str]]] = None,
+                 submit_timeout_s: float = 300.0,
+                 max_dispatch_attempts: Optional[int] = None,
+                 name: str = "fleet",
+                 verbose: bool = False):
+        if placement not in ("ring", "pack"):
+            raise ValueError(f"placement must be 'ring' or 'pack', "
+                             f"got {placement!r}")
+        if spawn and handles:
+            raise ValueError("pass spawn= or handles=, not both")
+        self.name = name
+        self.verbose = verbose
+        self.run_dir = run_dir
+        self.placement = placement
+        self.submit_timeout_s = float(submit_timeout_s)
+        self.health = health or HealthMonitor(timeout_s=5.0,
+                                              max_failures=3)
+        self._lock = threading.RLock()
+        self._dead: set = set()
+        self._deaths: List[dict] = []
+        self._tickets: List[rq.Ticket] = []
+        self._threads: List[threading.Thread] = []
+        self._pack_assignment: Dict[str, str] = {}
+        self._pack_unplaced: set = set()
+        self._counts: Dict[str, int] = {}
+        self.requeues = 0
+        self.started_s = time.perf_counter()
+
+        self.workers: Dict[str, WorkerHandle] = {}
+        if handles:
+            for h in handles:
+                self.workers[h.worker_id] = h
+        else:
+            n = max(int(spawn), 1)
+            env_map = worker_env or {}
+            for i in range(n):
+                wid = f"worker-{i}"
+                obs_dir = (os.path.join(run_dir, wid)
+                           if run_dir else None)
+                self.workers[wid] = spawn_worker(
+                    wid, vertices=vertices, width=width, seed=seed,
+                    fmt=fmt, queue_capacity=queue_capacity,
+                    hbm_budget_mb=hbm_budget_mb,
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                    obs_dir=obs_dir, window_s=window_s,
+                    extra_env=env_map.get(wid))
+        if not self.workers:
+            raise ValueError("a fleet needs at least one worker")
+        self.ring = ConsistentHashRing(self.workers)
+        self.n_rows = None
+        for h in self.workers.values():
+            n_rows = h.meta.get("n_rows")
+            if n_rows is None:
+                try:
+                    hello = h.call({"op": "hello"}, timeout_s=30.0)
+                    h.meta.update(hello)
+                    n_rows = hello.get("n_rows")
+                except (OSError, wire.WireError):
+                    continue
+            self.n_rows = int(n_rows)
+        flight.record("fleet", "router_up", fleet=self.name,
+                      workers=sorted(self.workers),
+                      placement=self.placement)
+
+    # -- placement ---------------------------------------------------------
+
+    def plan_packing(self, tenant_ks: Dict[str, int]) -> dict:
+        """Bin-pack per-tenant graphs: price each tenant's width-k
+        request with the workers' OWN admission model (the ``price``
+        op → ``request_bytes_for``), pack against per-worker HBM
+        headroom, and pin the assignment for subsequent submits.
+        Unplaced tenants shed explicitly at submit time."""
+        pricer = self._any_live_handle()
+        if pricer is None:
+            raise RuntimeError("no live worker to price tenants")
+        tenant_bytes = {}
+        for tenant, k in sorted(tenant_ks.items()):
+            reply = pricer.call({"op": "price", "k": int(k)})
+            tenant_bytes[tenant] = int(reply.get("bytes", 0))
+        capacities = {}
+        for wid, h in self.workers.items():
+            if wid in self._dead:
+                continue
+            reply = h.call({"op": "hello"})
+            capacities[wid] = int(reply.get("headroom_bytes", 0))
+        assignment, unplaced = pack_tenants(tenant_bytes, capacities)
+        with self._lock:
+            self._pack_assignment = dict(assignment)
+            self._pack_unplaced = set(unplaced)
+        flight.record("fleet", "packing_planned",
+                      assignment=assignment, unplaced=list(unplaced),
+                      tenant_bytes=tenant_bytes,
+                      capacities=capacities)
+        return {"assignment": assignment, "unplaced": list(unplaced),
+                "tenant_bytes": tenant_bytes,
+                "capacities": capacities}
+
+    def _any_live_handle(self) -> Optional[WorkerHandle]:
+        for wid in sorted(self.workers):
+            if wid not in self._dead:
+                return self.workers[wid]
+        return None
+
+    def _place(self, tenant: str) -> Optional[str]:
+        with self._lock:
+            dead = set(self._dead)
+            if self.placement == "pack":
+                wid = self._pack_assignment.get(tenant)
+                if wid is None or wid in dead:
+                    # A packed tenant whose worker died re-homes via
+                    # the ring like everyone else; a tenant that never
+                    # packed sheds.
+                    if tenant in self._pack_unplaced:
+                        return None
+                    return self.ring.lookup(tenant, exclude=dead)
+                return wid
+        return self.ring.lookup(tenant, exclude=dead)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def submit(self, request: rq.Request) -> rq.Ticket:
+        """Route one request into the fleet; returns immediately with
+        a ticket that completes (or sheds/fails, explicitly) from the
+        dispatch thread."""
+        faults.inject("fleet.router.submit", target=request.tenant)
+        ticket = rq.Ticket(request)
+        ticket.submitted_s = time.monotonic()
+        with self._lock:
+            self._tickets.append(ticket)
+        t = threading.Thread(target=self._dispatch, args=(ticket,),
+                             daemon=True,
+                             name=f"fleet-dispatch-"
+                                  f"{request.request_id}")
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+        return ticket
+
+    def _count(self, key: str) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def _dispatch(self, ticket: rq.Ticket) -> None:
+        req = ticket.request
+        max_attempts = (3 * len(self.workers) + 1)
+        attempt = 0
+        while True:
+            attempt += 1
+            if attempt > max_attempts:
+                ticket._finish(rq.FAILED, reason="fleet_retry_"
+                                                 "exhausted")
+                self._count("failed")
+                flight.record("fleet", "retry_exhausted",
+                              request=req.request_id,
+                              tenant=req.tenant, attempts=attempt - 1)
+                return
+            wid = self._place(req.tenant)
+            if wid is None:
+                # The degradation-ladder extension: lost capacity is
+                # an explicit shed, never a stall.
+                ticket._finish(rq.SHED, reason=SHED_FLEET_CAPACITY)
+                self._count("shed")
+                flight.record("fleet", "shed_capacity",
+                              request=req.request_id,
+                              tenant=req.tenant)
+                return
+            handle = self.workers[wid]
+            ticket.worker_id = wid
+            try:
+                reply = handle.call(
+                    {"op": "submit",
+                     "request": {"request_id": req.request_id,
+                                 "tenant": req.tenant, "x": req.x,
+                                 "iterations": req.iterations,
+                                 "deadline_s": req.deadline_s}},
+                    timeout_s=self.submit_timeout_s)
+            except (OSError, wire.WireError) as e:
+                self._on_worker_failure(wid, f"{type(e).__name__}: "
+                                             f"{e}")
+                with self._lock:
+                    self.requeues += 1
+                ticket.requeues = getattr(ticket, "requeues", 0) + 1
+                flight.record("fleet", "requeue",
+                              request=req.request_id,
+                              tenant=req.tenant, from_worker=wid)
+                continue
+            if not (isinstance(reply, dict) and reply.get("ok")):
+                err = (reply or {}).get("error") \
+                    if isinstance(reply, dict) else str(reply)
+                self.health.record_failure(wid, f"op error: {err}")
+                ticket.requeues = getattr(ticket, "requeues", 0) + 1
+                continue
+            self.health.record_ok(wid)
+            status = reply.get("status")
+            ticket.faults_seen = int(reply.get("faults_seen") or 0)
+            ticket.recoveries = int(reply.get("recoveries") or 0)
+            ticket.resumed_step = reply.get("resumed_step")
+            ticket.worker_latency_s = reply.get("latency_s")
+            if status == rq.COMPLETED:
+                ticket.result = reply.get("result")
+                ticket._finish(rq.COMPLETED)
+                self._count("completed")
+                return
+            if status in (rq.SHED, rq.REJECTED, rq.FAILED):
+                ticket._finish(status, reason=reply.get("reason"),
+                               error=reply.get("error"))
+                self._count(status)
+                return
+            ticket._finish(rq.FAILED, reason="worker_protocol",
+                           error=f"unexpected status {status!r}")
+            self._count("failed")
+            return
+
+    def _on_worker_failure(self, worker_id: str, error: str) -> None:
+        """A wire failure is a health question: probe with the
+        worker's jittered backoff; only a dead verdict buries it and
+        re-homes its tenants."""
+        with self._lock:
+            if worker_id in self._dead:
+                return
+        handle = self.workers[worker_id]
+        h = self.health.probe(worker_id, handle.host, handle.port)
+        if h.alive:
+            return
+        with self._lock:
+            if worker_id in self._dead:
+                return
+            self._dead.add(worker_id)
+            death = {"worker_id": worker_id,
+                     "error": error,
+                     "health": h.snapshot(),
+                     "exit_code": (handle.proc.poll()
+                                   if handle.proc else None)}
+            self._deaths.append(death)
+        flight.record("fleet", "worker_dead", worker=worker_id,
+                      error=error)
+        if self.verbose:
+            print(f"[graft-fleet {self.name}] worker {worker_id} "
+                  f"declared dead ({error}); requeueing its work "
+                  f"onto survivors", flush=True)
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Wait until every submitted ticket reaches a terminal
+        state."""
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            left = (None if deadline is None
+                    else max(deadline - time.monotonic(), 0.0))
+            t.join(timeout=left)
+
+    # -- chaos helpers -----------------------------------------------------
+
+    def kill_worker(self, worker_id: str) -> None:
+        """SIGKILL one spawned worker (tests/gates); the death is
+        DISCOVERED through the wire + heartbeats like any real crash,
+        not short-circuited here."""
+        self.workers[worker_id].kill()
+
+    def live_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(set(self.workers) - self._dead)
+
+    # -- reporting ---------------------------------------------------------
+
+    def fleet_summary(self) -> dict:
+        """The merged fleet SLO report.  Quantiles are EXACT: every
+        worker ships its raw per-request latency samples (``summary``
+        op) and they are pooled through one mergeable Histogram —
+        ``latency_ms.p99`` is the nearest-rank p99 of the union of
+        samples, the acceptance bar tools/fleet_gate.py checks."""
+        worker_reports: Dict[str, dict] = {}
+        pooled = Histogram(name="fleet_latency_ms")
+        for wid in sorted(self.workers):
+            handle = self.workers[wid]
+            with self._lock:
+                dead = wid in self._dead
+            if dead:
+                worker_reports[wid] = {
+                    "alive": False,
+                    "health": self.health.state[wid].snapshot()
+                    if wid in self.health.state else None}
+                continue
+            try:
+                reply = handle.call({"op": "summary"},
+                                    timeout_s=30.0)
+            except (OSError, wire.WireError) as e:
+                worker_reports[wid] = {"alive": False,
+                                       "error": f"{type(e).__name__}"
+                                                f": {e}"}
+                continue
+            samples = [float(v) for v in
+                       reply.get("latency_samples_ms") or []]
+            h = Histogram(name=f"latency_ms:{wid}")
+            h.values.extend(samples)
+            pooled.merge(h)
+            worker_reports[wid] = {
+                "alive": True,
+                "summary": reply.get("summary"),
+                "latency_samples_ms": samples,
+                "pulse_ring": reply.get("pulse_ring"),
+                "ledger_dir": reply.get("ledger_dir"),
+            }
+        with self._lock:
+            tickets = list(self._tickets)
+            counts = dict(self._counts)
+            deaths = [dict(d) for d in self._deaths]
+            requeues = self.requeues
+        wall = time.perf_counter() - self.started_s
+        completed = counts.get("completed", 0)
+        shed_reasons: Dict[str, int] = {}
+        for t in tickets:
+            if t.status in (rq.SHED, rq.REJECTED) and t.reason:
+                shed_reasons[t.reason] = \
+                    shed_reasons.get(t.reason, 0) + 1
+        router_lat = Histogram(name="router_latency_ms")
+        router_lat.values.extend(
+            [t.latency_s * 1e3 for t in tickets
+             if t.status == rq.COMPLETED and t.latency_s is not None])
+        return {
+            "fleet": self.name,
+            "placement": self.placement,
+            "num_workers": len(self.workers),
+            "live_workers": self.live_workers(),
+            "dead_workers": sorted(self._dead),
+            "deaths": deaths,
+            "requests": len(tickets),
+            "completed": completed,
+            "failed": counts.get("failed", 0),
+            "shed": counts.get("shed", 0),
+            "rejected": counts.get("rejected", 0),
+            "shed_reasons": shed_reasons,
+            "requeues": requeues,
+            "wall_s": wall,
+            "requests_per_s": (completed / wall) if wall > 0
+            else None,
+            # Exact pooled quantiles over every worker's raw samples.
+            "latency_ms": pooled.summary(),
+            "router_latency_ms": router_lat.summary(),
+            "health": self.health.snapshot(),
+            "workers": worker_reports,
+        }
+
+    def fold_ledgers(self, directory: Optional[str] = None) -> int:
+        """Fold every worker's run-dir-local ledger store into ONE
+        chained fleet history (kind ``fleet``) under ``directory``
+        (default ``<run_dir>/ledger``); returns the number of folded
+        records.  Each folded record keeps the origin worker, kind,
+        and record id in its payload, so the per-worker provenance
+        survives the merge."""
+        if directory is None:
+            if not self.run_dir:
+                raise ValueError("fold_ledgers needs a directory "
+                                 "(router has no run_dir)")
+            directory = os.path.join(self.run_dir, "ledger")
+        target = ledger_store.Ledger(directory)
+        folded = 0
+        for wid in sorted(self.workers):
+            handle = self.workers[wid]
+            if not handle.obs_dir:
+                continue
+            src_dir = os.path.join(handle.obs_dir, "ledger")
+            src = ledger_store.Ledger(src_dir)
+            for recd in src.read_all():
+                if not isinstance(recd, dict):
+                    continue
+                target.record(
+                    "fleet", str(recd.get("metric")),
+                    recd.get("value"),
+                    unit=recd.get("unit"),
+                    structure_hash=recd.get("structure_hash"),
+                    host_load=recd.get("host_load"),
+                    git_rev=recd.get("git_rev"),
+                    knobs={"origin_worker": wid,
+                           **(recd.get("knobs") or {})},
+                    payload={"origin_kind": recd.get("kind"),
+                             "origin_record_id":
+                                 recd.get("record_id"),
+                             **(recd.get("payload") or {})})
+                folded += 1
+        return folded
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        """Graceful stop: shutdown op to every live worker (closing
+        their pulse rings + run-dir ledgers + worker summaries), then
+        reap; SIGKILL anything that lingers."""
+        for wid in sorted(self.workers):
+            handle = self.workers[wid]
+            with self._lock:
+                dead = wid in self._dead
+            if not dead:
+                try:
+                    handle.call({"op": "shutdown"},
+                                timeout_s=timeout_s)
+                except (OSError, wire.WireError):
+                    pass
+            handle.reap(timeout_s=timeout_s)
+        flight.record("fleet", "router_down", fleet=self.name,
+                      dead=sorted(self._dead))

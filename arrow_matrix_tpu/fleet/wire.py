@@ -1,0 +1,125 @@
+"""The fleet wire protocol: length-prefixed JSON over TCP, stdlib only.
+
+One frame is an 8-byte big-endian unsigned length followed by that
+many bytes of UTF-8 JSON.  Messages are plain dicts; numpy arrays ride
+inside them as ``{"__nd__": 1, "dtype": ..., "shape": [...],
+"data": <base64>}`` envelopes (:func:`encode_payload` /
+:func:`decode_payload` walk nested containers), so the protocol needs
+nothing beyond the stdlib and the byte layout is exact — a decoded
+array is bit-identical to the encoded one, which is what lets the
+fleet gate compare fleet results byte-for-byte against a
+single-process replay.
+
+Fault seams: every frame send/receive passes through
+``faults.inject("fleet.wire.send")`` / ``("fleet.wire.recv")``, so an
+``AMT_FAULT_PLAN`` can hang, error, or SIGKILL a process AT the wire —
+the seam where a real network partition or a dying peer shows up.  A
+torn or oversized frame raises :class:`WireError`, never a silent
+truncation; the router treats any wire failure as a worker-health
+question, not an answer.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+from arrow_matrix_tpu import faults
+
+#: Frame header: one 8-byte big-endian unsigned payload length.
+_HEADER = struct.Struct(">Q")
+
+#: Refuse frames beyond this (a corrupted header would otherwise ask
+#: for exabytes and wedge the reader in recv).
+MAX_FRAME_BYTES = 1 << 30
+
+
+class WireError(RuntimeError):
+    """A framing-level failure: torn frame, oversized length, closed
+    peer mid-frame, or undecodable payload."""
+
+
+def encode_payload(obj: Any) -> Any:
+    """Recursively replace ndarrays with base64 envelopes (lists,
+    tuples, and dict values are walked; everything else passes
+    through for ``json.dumps`` to judge)."""
+    if isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        return {"__nd__": 1, "dtype": str(a.dtype),
+                "shape": list(a.shape),
+                "data": base64.b64encode(a.tobytes()).decode("ascii")}
+    if isinstance(obj, dict):
+        return {k: encode_payload(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_payload(v) for v in obj]
+    return obj
+
+
+def decode_payload(obj: Any) -> Any:
+    """Inverse of :func:`encode_payload`: rebuild ndarrays
+    bit-identically from their envelopes."""
+    if isinstance(obj, dict):
+        if obj.get("__nd__") == 1:
+            raw = base64.b64decode(obj["data"])
+            return np.frombuffer(raw, dtype=np.dtype(obj["dtype"])) \
+                .reshape(obj["shape"]).copy()
+        return {k: decode_payload(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_payload(v) for v in obj]
+    return obj
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise WireError(f"peer closed mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    """Send one framed message (arrays encoded automatically)."""
+    faults.inject("fleet.wire.send",
+                  target=str(obj.get("op")) if isinstance(obj, dict)
+                  else None)
+    blob = json.dumps(encode_payload(obj)).encode("utf-8")
+    if len(blob) > MAX_FRAME_BYTES:
+        raise WireError(f"frame of {len(blob)} B exceeds the "
+                        f"{MAX_FRAME_BYTES} B wire limit")
+    sock.sendall(_HEADER.pack(len(blob)) + blob)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    """Receive one framed message (arrays decoded automatically)."""
+    faults.inject("fleet.wire.recv")
+    header = _recv_exact(sock, _HEADER.size)
+    (length,) = _HEADER.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise WireError(f"frame header asks for {length} B (> "
+                        f"{MAX_FRAME_BYTES} B) — corrupted stream")
+    blob = _recv_exact(sock, int(length))
+    try:
+        return decode_payload(json.loads(blob.decode("utf-8")))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"undecodable frame payload: {e}") from e
+
+
+def request_call(host: str, port: int, obj: Any, *,
+                 timeout_s: Optional[float] = 30.0) -> Any:
+    """One request/response round trip on a fresh connection (the
+    router's unit of interaction: connection state never outlives an
+    operation, so a dead worker surfaces as a connect/recv error on
+    the NEXT op, not as a half-open socket wedge)."""
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as sock:
+        send_msg(sock, obj)
+        return recv_msg(sock)

@@ -44,7 +44,7 @@ import sys
 import time
 from typing import Any, Dict, Iterable, List, Optional
 
-from arrow_matrix_tpu.utils.artifacts import append_jsonl
+from arrow_matrix_tpu.utils.artifacts import append_jsonl, locked_file
 
 #: Bump when the record shape changes; old records then fail
 #: validation LOUDLY instead of being silently reinterpreted.
@@ -53,7 +53,7 @@ SCHEMA_VERSION = 1
 #: The emitter families.  A record's ``kind`` names which subsystem
 #: measured it — the coarse query axis (`graft_ledger report --kind`).
 KINDS = ("bench", "tune", "serve", "pulse", "ladder", "smoke",
-         "error_curve", "probe")
+         "error_curve", "probe", "fleet")
 
 DEFAULT_LEDGER_DIR = os.path.join("bench_results", "ledger")
 LEDGER_BASENAME = "ledger.jsonl"
@@ -197,31 +197,37 @@ class Ledger:
         (unknown kind, unserializable knobs/payload): a ledger line is
         a contract, not a log line.
         """
-        rec: Dict[str, Any] = {
-            "schema": SCHEMA_VERSION,
-            "kind": kind,
-            "record_id": "",
-            "prev": (self.last_record() or {}).get("record_id"),
-            "ts_unix": round(time.time(), 3) if ts_unix is None
-            else ts_unix,
-            "metric": metric,
-            "value": value,
-            "unit": unit,
-            "structure_hash": structure_hash,
-            "platform": platform,
-            "device_kind": device_kind,
-            "host_load": (_default_host_load()
-                          if host_load is _UNSET else host_load),
-            "git_rev": _git_rev() if git_rev is _UNSET else git_rev,
-            "knobs": dict(knobs or {}),
-            "payload": dict(payload or {}),
-        }
-        rec["record_id"] = canonical_record_id(rec)
-        problems = schema_problems(rec)
-        if problems:
-            raise ValueError(f"refusing to append an invalid ledger "
-                             f"record: {problems}")
-        append_jsonl(self.path, rec)
+        # The prev-link read and the append are ONE critical section
+        # under the cross-process advisory lock: two fleet workers
+        # recording concurrently would otherwise both read the same
+        # tail and fork the hash chain (one torn `prev` link).
+        with locked_file(self.path):
+            rec: Dict[str, Any] = {
+                "schema": SCHEMA_VERSION,
+                "kind": kind,
+                "record_id": "",
+                "prev": (self.last_record() or {}).get("record_id"),
+                "ts_unix": round(time.time(), 3) if ts_unix is None
+                else ts_unix,
+                "metric": metric,
+                "value": value,
+                "unit": unit,
+                "structure_hash": structure_hash,
+                "platform": platform,
+                "device_kind": device_kind,
+                "host_load": (_default_host_load()
+                              if host_load is _UNSET else host_load),
+                "git_rev": _git_rev() if git_rev is _UNSET
+                else git_rev,
+                "knobs": dict(knobs or {}),
+                "payload": dict(payload or {}),
+            }
+            rec["record_id"] = canonical_record_id(rec)
+            problems = schema_problems(rec)
+            if problems:
+                raise ValueError(f"refusing to append an invalid "
+                                 f"ledger record: {problems}")
+            append_jsonl(self.path, rec, lock=False)
         return rec
 
     # -- reading -------------------------------------------------------

@@ -93,20 +93,31 @@ _COUNTED_EVENTS = frozenset({
 _MAX_GAP_FILL = 8
 
 
-def latency_dict(hist: Histogram) -> Dict[str, Optional[float]]:
+def latency_dict(hist: Histogram, *,
+                 samples: bool = False) -> Dict[str, Optional[float]]:
     """The shared latency summary shape (:data:`LATENCY_FIELDS`) from
-    a mergeable histogram; all-None quantiles when empty."""
+    a mergeable histogram; all-None quantiles when empty.
+
+    ``samples=True`` additionally carries the RAW observations under
+    ``"samples"`` (an additive key — every validator checks the named
+    fields, not exhaustive shape), which is what lets
+    :func:`merge_rings` pool windows from many workers' rings into
+    EXACT fleet quantiles instead of approximating from summaries."""
     if not hist.values:
-        return {"count": 0, "p50": None, "p90": None, "p99": None,
-                "mean": None, "max": None}
-    return {
-        "count": len(hist.values),
-        "p50": hist.quantile(0.5),
-        "p90": hist.quantile(0.9),
-        "p99": hist.quantile(0.99),
-        "mean": sum(hist.values) / len(hist.values),
-        "max": max(hist.values),
-    }
+        out: Dict[str, Any] = {"count": 0, "p50": None, "p90": None,
+                               "p99": None, "mean": None, "max": None}
+    else:
+        out = {
+            "count": len(hist.values),
+            "p50": hist.quantile(0.5),
+            "p90": hist.quantile(0.9),
+            "p99": hist.quantile(0.99),
+            "mean": sum(hist.values) / len(hist.values),
+            "max": max(hist.values),
+        }
+    if samples:
+        out["samples"] = [float(v) for v in hist.values]
+    return out
 
 
 class PulseWindow:
@@ -186,7 +197,9 @@ class PulseWindow:
             "degraded": self.counts.get("degraded", 0),
             "resumed": self.counts.get("resumed", 0),
             "requests_per_s": (completed / dur) if dur > 0 else None,
-            "latency_ms": latency_dict(self.latency),
+            # Raw samples ride in the window dict so N workers' rings
+            # can be pooled into exact fleet quantiles (merge_rings).
+            "latency_ms": latency_dict(self.latency, samples=True),
             "queue_depth": {"last": self.queue_depth_last,
                             "max": self.queue_depth_max},
             "hbm": {"in_use_bytes": self.hbm_in_use_bytes,
@@ -818,6 +831,109 @@ def load_ring(path: str) -> dict:
     only ever renames complete documents into place)."""
     with open(path, encoding="utf-8") as fh:
         return json.load(fh)
+
+
+# -- multi-ring pooling (graft-fleet) ---------------------------------------
+
+
+def ring_latency_histogram(doc: dict) -> Tuple[Histogram, List[str]]:
+    """Pool one ring's window-level RAW latency samples into a
+    mergeable Histogram.  Returns ``(histogram, problems)`` — a window
+    that counted completions but carries no ``samples`` list (a ring
+    written before samples rode the window dicts) is a problem: its
+    latencies cannot be pooled exactly, only approximated."""
+    hist = Histogram()
+    problems = []
+    for w in doc.get("windows") or []:
+        lat = w.get("latency_ms") or {}
+        samples = lat.get("samples")
+        if samples is None:
+            if lat.get("count"):
+                problems.append(
+                    f"window {w.get('window')}: {lat.get('count')} "
+                    f"completions but no raw samples — exact pooling "
+                    f"impossible")
+            continue
+        hist.values.extend(float(v) for v in samples)
+    return hist, problems
+
+
+#: Count fields summed across rings by :func:`merge_rings`.
+_MERGE_COUNT_FIELDS = (
+    "submitted", "admitted", "completed", "failed", "shed",
+    "rejected", "degraded", "resumed", "faults_seen", "recoveries",
+)
+
+
+def merge_rings(docs: List[dict]) -> dict:
+    """Pool N pulse rings (one per fleet worker) into ONE exact
+    fleet-level document.
+
+    For every source ring the pooled-from-windows histogram is checked
+    against the ring's own streamed totals — count and p50/p90/p99
+    must match EXACTLY (Histogram.merge is lossless and both sides use
+    the same nearest-rank quantile), which only holds when the ring
+    dropped no windows; any mismatch, drop, or sample-less window
+    lands in ``problems``.  The merged ``totals.latency_ms`` is the
+    nearest-rank summary of the UNION of all workers' raw samples —
+    fleet p99 with no approximation — and the count fields are sums.
+    """
+    problems: List[str] = []
+    pooled = Histogram()
+    counts = collections.Counter()
+    per_ring = []
+    for i, doc in enumerate(docs):
+        name = str((doc.get("meta") or {}).get("name")
+                   or f"ring{i}")
+        for p in validate_ring(doc):
+            problems.append(f"{name}: {p}")
+        dropped = int(doc.get("dropped_windows") or 0)
+        if dropped:
+            problems.append(
+                f"{name}: {dropped} dropped windows — the retained "
+                f"windows under-count the stream; pooled != streamed")
+        hist, ring_problems = ring_latency_histogram(doc)
+        problems += [f"{name}: {p}" for p in ring_problems]
+        totals = doc.get("totals") or {}
+        tlat = totals.get("latency_ms") or {}
+        if not dropped and not ring_problems:
+            # pooled == streamed, the satellite's assertion: the
+            # window samples re-pooled must reproduce the monitor's
+            # own streamed run-total histogram exactly.
+            streamed_count = int(tlat.get("count") or 0)
+            if len(hist.values) != streamed_count:
+                problems.append(
+                    f"{name}: pooled sample count {len(hist.values)}"
+                    f" != streamed totals count {streamed_count}")
+            else:
+                for q, field in ((0.5, "p50"), (0.9, "p90"),
+                                 (0.99, "p99")):
+                    got, want = hist.quantile(q), tlat.get(field)
+                    if got != want:
+                        problems.append(
+                            f"{name}: pooled {field} {got!r} != "
+                            f"streamed {want!r}")
+        for f in _MERGE_COUNT_FIELDS:
+            counts[f] += int(totals.get(f) or 0)
+        pooled.merge(hist)
+        per_ring.append({
+            "name": name,
+            "windows": len(doc.get("windows") or []),
+            "dropped_windows": dropped,
+            "pooled_samples": len(hist.values),
+            "streamed_latency_ms": {f: tlat.get(f)
+                                    for f in LATENCY_FIELDS},
+        })
+    merged_totals = {f: counts.get(f, 0) for f in _MERGE_COUNT_FIELDS}
+    merged_totals["latency_ms"] = latency_dict(pooled)
+    return {
+        "schema": SCHEMA_VERSION,
+        "kind": "pulse_merge",
+        "rings": len(docs),
+        "per_ring": per_ring,
+        "totals": merged_totals,
+        "problems": problems,
+    }
 
 
 # -- the stdlib HTTP scrape endpoint ---------------------------------------

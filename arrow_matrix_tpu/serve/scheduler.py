@@ -738,6 +738,14 @@ class ArrowServer:
         with self._lock:
             return dict(self._counts)
 
+    def latency_samples_ms(self) -> List[float]:
+        """Every completed request's latency in ms, in completion
+        order — the raw samples graft-fleet ships over the wire so
+        the router's merged fleet quantiles are pooled over ALL
+        workers' samples exactly, not approximated from summaries."""
+        with self._lock:
+            return [lat * 1e3 for lat in self._latencies_s]
+
     def summary(self) -> dict:
         with self._lock:
             counts = dict(self._counts)

@@ -32,7 +32,10 @@ import warnings
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
-from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+from arrow_matrix_tpu.utils.artifacts import (
+    atomic_write_json,
+    locked_file,
+)
 
 #: Bump when the TunePlan schema or knob semantics change; a cached
 #: plan from another version is a loud miss, never a silent apply.
@@ -205,20 +208,27 @@ def save_plans(structure_hash: str, plans: Dict[int, TunePlan],
     d = plan_dir(directory)
     os.makedirs(d, exist_ok=True)
     path = plan_path(structure_hash, directory)
-    existing = load_plan_file(structure_hash, directory)
-    merged: Dict[str, dict] = {}
-    if existing and int(existing.get("version", -1)) == PLAN_VERSION:
-        merged.update(existing.get("plans") or {})
-    for k, p in plans.items():
-        merged[str(int(k))] = p.to_dict()
-    record = {
-        "version": PLAN_VERSION,
-        "structure_hash": structure_hash,
-        "fingerprint": fingerprint,
-        "context": context,
-        "plans": merged,
-    }
-    atomic_write_json(path, record, indent=2, sort_keys=True)
+    # The read-merge-write is one critical section under the advisory
+    # cross-process lock: atomic_write_json alone keeps readers safe,
+    # but two fleet workers merging different k entries concurrently
+    # would each rewrite the file from their own stale read and drop
+    # the other's entry.
+    with locked_file(path):
+        existing = load_plan_file(structure_hash, directory)
+        merged: Dict[str, dict] = {}
+        if existing and int(existing.get("version", -1)) == \
+                PLAN_VERSION:
+            merged.update(existing.get("plans") or {})
+        for k, p in plans.items():
+            merged[str(int(k))] = p.to_dict()
+        record = {
+            "version": PLAN_VERSION,
+            "structure_hash": structure_hash,
+            "fingerprint": fingerprint,
+            "context": context,
+            "plans": merged,
+        }
+        atomic_write_json(path, record, indent=2, sort_keys=True)
     return path
 
 

@@ -25,11 +25,17 @@ The contract:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import tempfile
 import threading
 from typing import Any, Optional
+
+try:                            # POSIX; absent on some platforms —
+    import fcntl                # locking degrades to a no-op there
+except ImportError:             # pragma: no cover
+    fcntl = None
 
 
 #: Filename markers of throwaway verification artifacts.  A driver or
@@ -147,23 +153,60 @@ def atomic_write_json(path: str, obj: Any, *, indent=None,
     return path
 
 
-def append_jsonl(path: str, obj: Any, *, fsync: bool = True) -> str:
+@contextlib.contextmanager
+def locked_file(path: str):
+    """Advisory cross-process exclusive lock scoped to ``path``
+    (graft-fleet satellite): ``fcntl.flock`` on a sidecar
+    ``<path>.lock`` file, so N worker PROCESSES mutating one shared
+    artifact — a tune-plan merge-write, a hash-chained ledger append —
+    serialize instead of losing each other's updates.  The sidecar
+    (not the artifact itself) is locked because the artifact is
+    replaced by ``os.replace`` during atomic writes, which would
+    orphan a lock held on the old inode.
+
+    NOT reentrant: flock blocks between file descriptors even within
+    one process, so a holder must not re-acquire (``append_jsonl``'s
+    ``lock=False`` exists for exactly that).  On platforms without
+    ``fcntl`` this degrades to a no-op — single-process behavior
+    there is unchanged.
+    """
+    if fcntl is None:           # pragma: no cover
+        yield
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        os.close(fd)            # close releases the flock
+
+
+def append_jsonl(path: str, obj: Any, *, fsync: bool = True,
+                 lock: bool = True) -> str:
     """Append ``obj`` as one JSON line to ``path`` (created if absent);
     returns the serialized line.  The line is serialized before the
     file is opened and written in one call, then flushed and fsync'd —
     a crash can tear at most the line being appended (trailing partial
     line), never an earlier record: the append-only ledger's
-    durability primitive."""
+    durability primitive.  The write holds the :func:`locked_file`
+    advisory lock so two processes cannot interleave partial lines;
+    callers already inside the lock (``Ledger.record`` serializes its
+    read-chain-then-append critical section) pass ``lock=False``."""
     line = json.dumps(obj, sort_keys=False,
                       separators=(",", ":")) + "\n"
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "a", encoding="utf-8") as fh:
-        fh.write(line)
-        if fsync:
-            fh.flush()
-            os.fsync(fh.fileno())
+    ctx = locked_file(path) if lock else contextlib.nullcontext()
+    with ctx:
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(line)
+            if fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
     return line
 
 

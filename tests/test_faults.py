@@ -347,3 +347,35 @@ def test_chaos_gate_full(tmp_path):
     assert problems == []
     assert "kill" in scenarios
     assert "serve_kill" in scenarios
+    assert "fleet_kill" in scenarios
+
+
+# ---------------------------------------------------------------------------
+# Per-worker retry jitter seeding (graft-fleet satellite)
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_for_worker_reseeds_deterministically():
+    """``for_worker`` must give every fleet worker its OWN
+    reproducible jitter schedule: same (seed, worker_id) -> identical
+    delays across processes and reruns; different worker ids ->
+    different delays (no thundering herd on a shared dependency)."""
+    from arrow_matrix_tpu.faults import RetryPolicy
+
+    base = RetryPolicy(max_retries=4, backoff_s=0.05, jitter=0.5,
+                       seed=7)
+    w0 = base.for_worker("worker-0")
+    assert w0 == base.for_worker("worker-0")          # frozen + stable
+    assert w0.schedule("heartbeat") == \
+        base.for_worker("worker-0").schedule("heartbeat")
+    # Only the seed is re-derived; the knobs are untouched.
+    assert (w0.max_retries, w0.backoff_s, w0.jitter) == \
+        (base.max_retries, base.backoff_s, base.jitter)
+    assert w0.seed != base.seed
+    schedules = {base.for_worker(f"worker-{i}").schedule("heartbeat")
+                 for i in range(8)}
+    assert len(schedules) == 8                        # all distinct
+    # A different BASE seed moves every worker's schedule too.
+    other = RetryPolicy(max_retries=4, backoff_s=0.05, jitter=0.5,
+                        seed=8)
+    assert other.for_worker("worker-0").schedule("heartbeat") != \
+        w0.schedule("heartbeat")

@@ -1,0 +1,271 @@
+"""graft-fleet unit + integration tests: wire framing (bit-identical
+ndarray round trips, torn/oversized frames loud), consistent-hash
+placement (deterministic, surgical re-homing on death), first-fit
+bin packing with explicit unplaced tenants, the heartbeat death
+verdict (streak-gated, per-worker deterministic backoff), and an
+in-process two-worker fleet end to end — every request completed,
+fleet quantiles EXACTLY the pooled nearest-rank over all workers'
+raw samples, and a request aimed at a dead worker requeued onto the
+survivor.  The full multi-process SIGKILL scenario lives in
+tools/fleet_gate.py (run by the slow chaos-gate tier)."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu import faults
+from arrow_matrix_tpu.fleet import health as health_mod
+from arrow_matrix_tpu.fleet import wire
+from arrow_matrix_tpu.fleet.health import HealthMonitor
+from arrow_matrix_tpu.fleet.placement import (
+    ConsistentHashRing,
+    pack_tenants,
+)
+from arrow_matrix_tpu.fleet.router import FleetRouter, WorkerHandle
+from arrow_matrix_tpu.fleet.worker import FleetWorker, serve_worker
+from arrow_matrix_tpu.obs.metrics import Histogram
+from arrow_matrix_tpu.serve.loadgen import synthetic_trace
+from arrow_matrix_tpu.serve.request import Request
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_is_bit_identical():
+    a, b = socket.socketpair()
+    try:
+        x = (np.arange(24, dtype=np.float32).reshape(6, 4)
+             * np.float32(0.1))
+        msg = {"op": "submit", "x": x,
+               "nested": [{"y": x[:2].astype(np.float64)}, 3, "s"],
+               "f": 0.125, "none": None}
+        wire.send_msg(a, msg)
+        got = wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert got["x"].dtype == x.dtype and got["x"].shape == x.shape
+    assert got["x"].tobytes() == x.tobytes()
+    y = got["nested"][0]["y"]
+    assert y.dtype == np.float64
+    assert y.tobytes() == x[:2].astype(np.float64).tobytes()
+    assert got["nested"][1:] == [3, "s"]
+    assert got["f"] == 0.125 and got["none"] is None
+
+
+def test_wire_torn_frame_is_loud():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00\x00\x00")       # 3 of 8 header bytes
+        a.close()
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            wire.recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_wire_oversized_header_is_refused():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire._HEADER.pack(wire.MAX_FRAME_BYTES + 1))
+        with pytest.raises(wire.WireError, match="corrupted"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Placement
+# ---------------------------------------------------------------------------
+
+def test_ring_is_deterministic_and_rehoming_is_surgical():
+    tenants = [f"t{i}" for i in range(64)]
+    ring = ConsistentHashRing(["w0", "w1", "w2"])
+    again = ConsistentHashRing(["w2", "w0", "w1"])   # order-free
+    before = {t: ring.lookup(t) for t in tenants}
+    assert before == {t: again.lookup(t) for t in tenants}
+    assert len(set(before.values())) == 3            # all workers used
+    # Removing one worker re-homes ONLY its tenants; exclude= (the
+    # requeue path) agrees with actual removal.
+    excluded = {t: ring.lookup(t, exclude=("w1",)) for t in tenants}
+    ring.remove("w1")
+    after = {t: ring.lookup(t) for t in tenants}
+    assert after == excluded
+    for t in tenants:
+        if before[t] != "w1":
+            assert after[t] == before[t]
+        else:
+            assert after[t] in ("w0", "w2")
+
+
+def test_empty_ring_and_full_exclusion_return_none():
+    assert ConsistentHashRing().lookup("t") is None
+    ring = ConsistentHashRing(["w0", "w1"])
+    assert ring.lookup("t", exclude=("w0", "w1")) is None
+
+
+def test_pack_tenants_first_fit_decreasing_with_explicit_unplaced():
+    assignment, unplaced = pack_tenants(
+        {"big": 80, "mid": 60, "small": 30},
+        {"w0": 100, "w1": 64})
+    assert assignment == {"big": "w0", "mid": "w1"}
+    assert unplaced == ["small"]          # fits NO remaining budget
+    # Deterministic under dict-order permutation.
+    a2, u2 = pack_tenants({"small": 30, "big": 80, "mid": 60},
+                          {"w1": 64, "w0": 100})
+    assert (a2, u2) == (assignment, unplaced)
+
+
+# ---------------------------------------------------------------------------
+# Health: streak-gated death verdict, deterministic per-worker backoff
+# ---------------------------------------------------------------------------
+
+def test_health_death_needs_a_full_streak_and_is_sticky():
+    clock = [0.0]
+    hm = HealthMonitor(max_failures=3, clock=lambda: clock[0],
+                       sleep=lambda s: None)
+    hm.record_failure("w0", "boom")
+    hm.record_failure("w0", "boom")
+    assert hm.alive_workers() == ["w0"]   # 2 < 3: still alive
+    hm.record_ok("w0")                    # success resets the streak
+    assert hm.state["w0"].consecutive_failures == 0
+    clock[0] = 7.0
+    for _ in range(3):
+        hm.record_failure("w0", "down")
+    assert hm.dead_workers() == ["w0"]
+    assert hm.state["w0"].declared_dead_s == 7.0
+    hm.record_ok("w0")                    # dead is sticky
+    assert hm.dead_workers() == ["w0"]
+
+
+def test_health_probe_backoff_is_per_worker_deterministic(monkeypatch):
+    def down(host, port, obj, *, timeout_s=None):
+        raise wire.WireError("connection refused")
+
+    monkeypatch.setattr(health_mod.wire, "request_call", down)
+
+    def ladder(worker_id):
+        sleeps = []
+        hm = HealthMonitor(max_failures=3, sleep=sleeps.append)
+        h = hm.probe(worker_id, "127.0.0.1", 1)
+        assert not h.alive and h.consecutive_failures == 3
+        return sleeps
+
+    s0 = ladder("worker-0")
+    assert s0 == ladder("worker-0")       # reproducible per worker
+    assert s0 != ladder("worker-1")       # but not herd-synchronized
+    assert len(s0) == 2                   # sleeps BETWEEN 3 attempts
+
+
+# ---------------------------------------------------------------------------
+# In-process fleet: serve_worker on threads + FleetRouter(handles=...)
+# ---------------------------------------------------------------------------
+
+def _start_worker(worker_id, checkpoint_dir):
+    worker = FleetWorker(worker_id, vertices=64, width=16, seed=5,
+                         checkpoint_dir=checkpoint_dir,
+                         checkpoint_every=1)
+    ready = threading.Event()
+    box = {}
+
+    def announce(port):
+        box["port"] = port
+        ready.set()
+
+    th = threading.Thread(target=serve_worker, args=(worker,),
+                          kwargs={"port": 0, "announce": announce},
+                          daemon=True)
+    th.start()
+    assert ready.wait(120), f"{worker_id} never bound"
+    return worker, WorkerHandle(worker_id, "127.0.0.1", box["port"])
+
+
+def test_fleet_completes_pools_exactly_and_requeues(tmp_path):
+    """One in-process fleet exercises the whole contract: routed
+    requests complete with results, the fleet summary's quantiles are
+    EXACTLY the pooled nearest-rank over the workers' raw samples,
+    and after one worker goes dark a request aimed at it is requeued
+    onto the survivor (same shared checkpoint dir — the idempotent
+    resume path)."""
+    ckpt = str(tmp_path / "ckpt")
+    w0, h0 = _start_worker("w0", ckpt)
+    w1, h1 = _start_worker("w1", ckpt)
+    router = FleetRouter(
+        handles=[h0, h1],
+        health=HealthMonitor(timeout_s=5.0, max_failures=3))
+    try:
+        trace = synthetic_trace(router.n_rows, tenants=3, requests=6,
+                                k=2, iterations=2, seed=7)
+        tickets = [router.submit(r) for r in trace]
+        router.drain(timeout_s=180)
+        assert [t.status for t in tickets] == ["completed"] * 6
+        assert all(t.result is not None for t in tickets)
+
+        report = router.fleet_summary()
+        assert report["completed"] == 6
+        assert report["shed"] == 0 and report["failed"] == 0
+        pooled = Histogram()
+        n_samples = 0
+        for rec in report["workers"].values():
+            for v in rec["latency_samples_ms"]:
+                pooled.observe(v)
+                n_samples += 1
+        lat = report["latency_ms"]
+        assert lat["count"] == n_samples == 6
+        for q, field in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            assert lat[field] == pooled.quantile(q)
+
+        # Kill w0's wire front; a request for one of its tenants must
+        # be requeued onto w1 — not lost, not failed.
+        victim_tenant = next(t for t in (f"t{i}" for i in range(256))
+                             if router.ring.lookup(t) == "w0")
+        wire.request_call(h0.host, h0.port, {"op": "shutdown"})
+        x = np.ones((router.n_rows, 2), dtype=np.float32)
+        t = router.submit(Request("rq-dead", victim_tenant, x, 1))
+        router.drain(timeout_s=180)
+        assert t.status == "completed"
+        assert getattr(t, "requeues", 0) >= 1
+        assert t.worker_id == "w1"
+        assert router.live_workers() == ["w1"]
+        assert not router.health.snapshot()["w0"]["alive"]
+    finally:
+        router.shutdown()
+        for w in (w0, w1):
+            try:
+                w.close()
+            except Exception:
+                pass
+
+
+@pytest.mark.slow
+def test_fleet_spawned_processes_roundtrip(tmp_path):
+    """The real subprocess path: spawn 2 worker processes, route a
+    trace, fold their run-dir ledgers, and shut down cleanly.  (The
+    SIGKILL-mid-batch scenario is tools/fleet_gate.py.)"""
+    router = FleetRouter(spawn=2, vertices=64, width=16, seed=5,
+                         run_dir=str(tmp_path))
+    try:
+        trace = synthetic_trace(router.n_rows, tenants=2, requests=4,
+                                k=2, iterations=2, seed=3)
+        tickets = [router.submit(r) for r in trace]
+        router.drain(timeout_s=240)
+        assert [t.status for t in tickets] == ["completed"] * 4
+    finally:
+        router.shutdown()
+    # Workers write their run-dir ledgers on close, so fold AFTER the
+    # graceful shutdown (as graft_fleet does).
+    assert router.fold_ledgers() > 0
+    from arrow_matrix_tpu.ledger import Ledger
+
+    assert Ledger(str(tmp_path / "ledger")).validate() == []

@@ -463,3 +463,67 @@ def test_append_jsonl_serializes_before_touching_file(tmp_path):
         append_jsonl(str(target), {"bad": object()})
     # the failed append wrote nothing — not even a partial line
     assert open(target).read() == '{"a":1}\n'
+
+
+def test_append_jsonl_concurrent_appends_lose_nothing(tmp_path):
+    """Cross-fd serialization: concurrent appenders (the shape of N
+    fleet workers sharing one artifact) interleave whole lines, never
+    tear them."""
+    import threading
+
+    path = str(tmp_path / "rows.jsonl")
+    writers, rows = 6, 20
+    errors = []
+
+    def write(i):
+        try:
+            for j in range(rows):
+                append_jsonl(path, {"w": i, "j": j})
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=write, args=(i,))
+               for i in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == writers * rows
+    seen = {(d["w"], d["j"]) for d in map(json.loads, lines)}
+    assert len(seen) == writers * rows
+
+
+def test_concurrent_records_keep_the_chain_valid(tmp_path):
+    """``Ledger.record``'s read-prev + append is one critical section
+    under the file lock: concurrent recorders (fleet workers folding
+    into one store) must leave a fully linked chain — every record
+    present, ``validate()`` green."""
+    import threading
+
+    lg = Ledger(str(tmp_path))
+    writers, rows = 6, 6
+    errors = []
+
+    def write(i):
+        try:
+            for j in range(rows):
+                lg.record("probe", f"writer{i}_ms", float(j),
+                          unit="ms", host_load=0.0, git_rev=None)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=write, args=(i,))
+               for i in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert lg.validate() == []
+    recs = lg.read_all()
+    assert len(recs) == writers * rows
+    for i in range(writers):
+        assert sum(r["metric"] == f"writer{i}_ms" for r in recs) == rows

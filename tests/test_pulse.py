@@ -325,3 +325,98 @@ def test_pulse_series_matches_slo_report(factory):
     assert pulse.validate_ring(mon.snapshot()) == []
     # HBM was sampled from the live accountant via attach_pulse.
     assert pt["totals"]["hbm"]["occupancy"] is not None
+
+
+# ---------------------------------------------------------------------------
+# Ring merging (graft-fleet: per-worker rings -> one exact fleet view)
+# ---------------------------------------------------------------------------
+
+def test_window_dicts_carry_raw_samples():
+    """Window-level latency serializes its raw samples — the payload
+    that makes cross-process ring merging lossless."""
+    m, now = _mon()
+    for i, ms in enumerate([3.0, 1.0, 4.0]):
+        now[0] = float(i)
+        m.observe("completed", latency_ms=ms)
+    m.close()
+    doc = m.snapshot()
+    pooled = sorted(v for w in doc["windows"]
+                    for v in w["latency_ms"]["samples"])
+    assert pooled == [1.0, 3.0, 4.0]
+    assert pulse.validate_ring(doc) == []
+
+
+def _ring_doc(latencies, shed=0):
+    m, now = _mon()
+    for i, ms in enumerate(latencies):
+        now[0] = float(i)
+        m.observe("completed", latency_ms=ms)
+    for _ in range(shed):
+        m.observe("shed")
+    m.close()
+    return m.snapshot()
+
+
+def test_merge_rings_is_exactly_pooled_and_asserts_per_ring():
+    a = [3.0, 1.0, 4.0, 1.5]
+    b = [9.0, 2.6, 5.3]
+    merged = pulse.merge_rings([_ring_doc(a, shed=2), _ring_doc(b)])
+    assert merged["problems"] == []
+    assert merged["rings"] == 2
+    assert merged["totals"]["completed"] == 7
+    assert merged["totals"]["shed"] == 2
+    pooled = Histogram()
+    for v in a + b:
+        pooled.observe(v)
+    lat = merged["totals"]["latency_ms"]
+    assert lat["count"] == 7
+    for q, field in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        assert lat[field] == pooled.quantile(q)
+    assert [r["pooled_samples"] for r in merged["per_ring"]] == [4, 3]
+
+
+def test_merge_rings_flags_sample_less_windows():
+    doc = _ring_doc([3.0, 1.0])
+    victim = next(w for w in doc["windows"]
+                  if w["latency_ms"]["count"])
+    del victim["latency_ms"]["samples"]
+    merged = pulse.merge_rings([doc])
+    assert any("sample" in p for p in merged["problems"])
+
+
+def test_merge_rings_flags_pooled_streamed_mismatch():
+    doc = _ring_doc([3.0, 1.0, 4.0])
+    victim = next(w for w in doc["windows"]
+                  if w["latency_ms"]["count"])
+    victim["latency_ms"]["samples"] = [999.0]   # tampered window
+    merged = pulse.merge_rings([doc])
+    assert any("pooled" in p and "streamed" in p
+               for p in merged["problems"])
+
+
+def test_graft_pulse_merge_cli_round_trips(tmp_path, capsys):
+    from arrow_matrix_tpu.cli import graft_pulse
+
+    paths = []
+    for i, lats in enumerate(([3.0, 1.0, 4.0, 1.5], [9.0, 2.6, 5.3])):
+        p = tmp_path / f"ring{i}.json"
+        with open(p, "w", encoding="utf-8") as fh:
+            json.dump(_ring_doc(lats), fh)
+        paths.append(str(p))
+    out = str(tmp_path / "merged.json")
+    assert graft_pulse.main(["merge", *paths, "--out", out]) == 0
+    text = capsys.readouterr().out
+    assert "2 ring(s), 7 pooled samples" in text
+    with open(out, encoding="utf-8") as fh:
+        merged = json.load(fh)
+    assert merged["kind"] == "pulse_merge"
+    assert merged["problems"] == []
+    assert merged["totals"]["latency_ms"]["count"] == 7
+    # A tampered source makes the CLI exit non-zero, loudly.
+    with open(paths[0], encoding="utf-8") as fh:
+        doc = json.load(fh)
+    next(w for w in doc["windows"]
+         if w["latency_ms"]["count"])["latency_ms"]["samples"] = [1e9]
+    with open(paths[0], "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    assert graft_pulse.main(["merge", *paths]) == 1

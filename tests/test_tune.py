@@ -366,3 +366,36 @@ def test_tune_gate_empty_cache_is_failure(tmp_path):
     from arrow_matrix_tpu.tune.gate import run_gate
 
     assert run_gate(directory=str(tmp_path / "nothing")) == 1
+
+
+def test_save_plans_concurrent_writers_drop_no_entry(tmp_path):
+    """The fleet-workers race: N writers merge DIFFERENT k entries
+    into the same plan file concurrently.  Without the advisory file
+    lock around the read-merge-write, two writers read the same stale
+    file and the slower rewrite drops the faster one's entry; with it,
+    every entry survives."""
+    import threading
+
+    d = str(tmp_path / "plans")
+    h = "f" * 16
+    ks = list(range(1, 9))
+    errors = []
+
+    def write(k):
+        try:
+            save_plans(h, {k: TunePlan(h, k)}, directory=d)
+        except Exception as e:          # surfaced below, not swallowed
+            errors.append(e)
+
+    threads = [threading.Thread(target=write, args=(k,)) for k in ks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    from arrow_matrix_tpu.tune.plan import load_plan_file
+
+    doc = load_plan_file(h, d)
+    assert sorted(int(s) for s in doc["plans"]) == ks
+    for k in ks:                        # every entry loads cleanly too
+        assert load_plan(h, k, directory=d).k == k

@@ -36,6 +36,13 @@ ArrowServer — mid-request faults detected and recovered (or cleanly,
 explicitly shed), surviving requests bit-identical to a fault-free
 replay, the server never restarted externally.
 
+And the graft-fleet matrix (tools/fleet_gate.py, full mode only):
+fleet_baseline + fleet_kill — SIGKILL one worker process of N=3
+mid-batch; the router must bury exactly the victim, requeue its
+accepted-but-unfinished requests onto survivors (checkpoint-resumed,
+not recomputed), lose zero accepted requests, and report EXACT pooled
+fleet quantiles.
+
 Exits 0 when every scenario passes, 1 otherwise.  Determinism is the
 whole contract: recovery re-runs the same compiled step from the same
 state on CPU, so equality is exact (``tobytes()``), not approximate.
@@ -348,6 +355,15 @@ def run_gate(workdir, fast=False):
             workdir, fast=fast)
         problems += serve_problems
         scenarios += serve_scenarios
+        # And the fleet matrix (tools/fleet_gate.py): kill one worker
+        # process of N and require zero accepted-request loss with
+        # bit-identical surviving results.
+        import fleet_gate
+
+        fleet_problems, fleet_scenarios = fleet_gate.run_fleet_scenarios(
+            workdir, fast=fast)
+        problems += fleet_problems
+        scenarios += fleet_scenarios
         kinds = {e.get("kind") for e in rec.events}
         if "fault" not in kinds or "heal" not in kinds:
             problems.append(f"flight recorder saw kinds {sorted(kinds)}"

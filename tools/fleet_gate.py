@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Fleet chaos gate: kill-one-worker-of-N survival (graft-fleet).
+
+The acceptance bar for the multi-process fleet, run as real spawned
+worker processes through the ``graft_fleet`` CLI:
+
+* **fleet_baseline** — N=2 workers, no faults: every request
+  completes, every result is bit-identical to a fault-free
+  single-process ArrowServer replay of the same deterministic trace,
+  the merged pulse document is problem-free, and the report's fleet
+  p99 EQUALS the nearest-rank pooled quantile over all workers' raw
+  samples (recomputed here independently — no approximation).
+* **fleet_kill** — N=3 workers with >=4 tenants in flight; one victim
+  worker is armed (via its spawn environment only) with an
+  ``AMT_FAULT_PLAN`` kill plan on ``*.step`` and SIGKILLs itself
+  mid-batch.  Required outcome: the router buries exactly that worker
+  after health probes, ZERO accepted requests are lost (everything
+  not explicitly shed/rejected completes), at least one request was
+  requeued onto a survivor, a survivor RESUMED the victim's
+  checkpoint (the ``resumed request`` line in its log — replayed work
+  is resumed, not recomputed), and every surviving result is
+  bit-identical to the fault-free single-process replay.
+
+Registered in tools/chaos_gate.py's matrix (subprocess scenarios skip
+under ``--fast``, like serve_kill).  Standalone:
+``python tools/fleet_gate.py [workdir]``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Small enough for the CPU gate budget, big enough that a mid-batch
+# SIGKILL leaves several accepted-but-unfinished requests to requeue.
+N, WIDTH, K = 96, 16, 2
+TENANTS, REQUESTS, ITERS = 5, 10, 4
+SEED, TRACE_SEED = 11, 5
+#: *.step hits before the armed worker SIGKILLs itself: late enough
+#: that it accepted work, early enough that the work is unfinished.
+KILL_AFTER = 6
+
+
+def _nearest_rank(vals, q):
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def _reference_results(workdir):
+    """Fault-free single-process replay of the gate trace: the
+    bit-identity reference both scenarios compare against."""
+    from arrow_matrix_tpu.serve.loadgen import (
+        ba_executor_factory,
+        run_trace,
+        synthetic_trace,
+    )
+    from arrow_matrix_tpu.serve.scheduler import ArrowServer, ExecConfig
+
+    factory, n_rows = ba_executor_factory(N, WIDTH, SEED, fmt="fold")
+    server = ArrowServer(factory, ExecConfig(), name="fleet-ref")
+    trace = synthetic_trace(n_rows, tenants=TENANTS,
+                            requests=REQUESTS, k=K, iterations=ITERS,
+                            seed=TRACE_SEED)
+    tickets = run_trace(server, trace)
+    out = {}
+    for t in tickets:
+        if t.result is None:
+            return None
+        out[t.request.request_id] = t.result.tobytes()
+    return out
+
+
+def _run_fleet_cli(workdir, tag, workers, extra):
+    """One ``graft_fleet`` subprocess run; returns
+    (completed_process, verdict_dict_or_None, run_dir, npz_path)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("AMT_FAULT_PLAN", None)
+    run_dir = os.path.join(workdir, f"fleet_{tag}")
+    npz = os.path.join(workdir, f"fleet_{tag}.npz")
+    cmd = [sys.executable, "-m", "arrow_matrix_tpu.cli.graft_fleet",
+           "--run_dir", run_dir, "--workers", str(workers),
+           "--vertices", str(N), "--width", str(WIDTH),
+           "--seed", str(SEED), "--k", str(K),
+           "--tenants", str(TENANTS), "--requests", str(REQUESTS),
+           "--iterations", str(ITERS),
+           "--trace_seed", str(TRACE_SEED),
+           # Coarse pulse windows: on a loaded 1-core CI host the
+           # 0.25 s default can idle-gap past the ring's bounded gap
+           # fill and drop windows, which (correctly) fails the
+           # pooled==streamed merge assertion for a reason that is
+           # host speed, not fleet behavior.
+           "--window_s", "2.0",
+           "--results_npz", npz] + extra
+    r = subprocess.run(cmd, env=env, cwd=workdir,
+                       capture_output=True, text=True, timeout=900)
+    verdict = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            verdict = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    return r, verdict, run_dir, npz
+
+
+def _check_bit_identity(tag, npz, ref, expect_ids=None):
+    import numpy as np
+
+    problems = []
+    if not os.path.exists(npz):
+        return [f"{tag}: no results npz written"]
+    with np.load(npz) as got:
+        ids = sorted(got.files)
+        want = sorted(expect_ids if expect_ids is not None else ref)
+        if ids != want:
+            problems.append(f"{tag}: completed set {ids} != "
+                            f"expected {want}")
+        for rid in ids:
+            if rid in ref and got[rid].tobytes() != ref[rid]:
+                problems.append(
+                    f"{tag}: request {rid} is not bit-identical to "
+                    f"the fault-free single-process replay")
+    return problems
+
+
+def _check_exact_pooled_p99(tag, run_dir):
+    """Recompute the pooled quantiles from the workers' RAW samples in
+    fleet_report.json and require the report's merged latency to
+    equal them exactly."""
+    path = os.path.join(run_dir, "fleet_report.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            report = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{tag}: fleet_report.json unreadable: {e}"]
+    samples = []
+    for rec in (report.get("workers") or {}).values():
+        if rec.get("alive"):
+            samples.extend(rec.get("latency_samples_ms") or [])
+    lat = report.get("latency_ms") or {}
+    problems = []
+    if len(samples) != lat.get("count"):
+        problems.append(f"{tag}: merged latency count "
+                        f"{lat.get('count')} != pooled sample count "
+                        f"{len(samples)}")
+        return problems
+    if not samples:
+        return [f"{tag}: no latency samples in the fleet report"]
+    for q, field in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        want = _nearest_rank(samples, q)
+        if lat.get(field) != want:
+            problems.append(
+                f"{tag}: merged {field} {lat.get(field)!r} != exact "
+                f"pooled quantile {want!r} over all workers' raw "
+                f"samples")
+    return problems
+
+
+def scenario_fleet_baseline(workdir, ref):
+    """No-fault fleet run: complete, bit-identical, exact quantiles,
+    clean merged pulse."""
+    r, verdict, run_dir, npz = _run_fleet_cli(workdir, "baseline", 2,
+                                              [])
+    if r.returncode != 0 or verdict is None:
+        return [f"fleet_baseline: run failed rc={r.returncode}: "
+                f"{r.stderr[-500:]}"]
+    problems = []
+    if verdict["completed"] != REQUESTS:
+        problems.append(f"fleet_baseline: {verdict['completed']}/"
+                        f"{REQUESTS} completed")
+    if verdict["dead_workers"]:
+        problems.append(f"fleet_baseline: unexpected deaths "
+                        f"{verdict['dead_workers']}")
+    if verdict["pulse_problems"]:
+        problems.append(f"fleet_baseline: merged pulse problems: "
+                        f"{verdict['pulse_problems']}")
+    problems += _check_bit_identity("fleet_baseline", npz, ref)
+    problems += _check_exact_pooled_p99("fleet_baseline", run_dir)
+    return problems
+
+
+def scenario_fleet_kill(workdir, ref):
+    """Kill-one-worker-of-N survival (the acceptance scenario)."""
+    plan = json.dumps({"scenario": "kill", "site": "*.step",
+                       "after": KILL_AFTER})
+    r, verdict, run_dir, npz = _run_fleet_cli(
+        workdir, "kill", 3,
+        ["--fault_worker", "worker-1", "--fault_plan", plan])
+    if r.returncode != 0 or verdict is None:
+        return [f"fleet_kill: run failed rc={r.returncode}: "
+                f"{r.stderr[-500:]}"]
+    problems = []
+    if verdict["dead_workers"] != ["worker-1"]:
+        problems.append(f"fleet_kill: dead workers "
+                        f"{verdict['dead_workers']} != ['worker-1'] "
+                        f"(the armed victim, and only it)")
+    accounted = (verdict["completed"] + verdict["failed"]
+                 + verdict["shed"] + verdict["rejected"])
+    if accounted != REQUESTS:
+        problems.append(f"fleet_kill: {REQUESTS - accounted} "
+                        f"request(s) LOST (no terminal state)")
+    if verdict["failed"]:
+        problems.append(f"fleet_kill: {verdict['failed']} request(s) "
+                        f"failed instead of being requeued")
+    shed_explicit = sum((verdict.get("shed_reasons") or {}).values())
+    if shed_explicit != verdict["shed"] + verdict["rejected"]:
+        problems.append(
+            f"fleet_kill: {verdict['shed'] + verdict['rejected']} "
+            f"shed/rejected but only {shed_explicit} carry an "
+            f"explicit reason in the SLO report")
+    if verdict["completed"] + shed_explicit != REQUESTS:
+        problems.append(
+            f"fleet_kill: zero-loss violated — "
+            f"{verdict['completed']} completed + {shed_explicit} "
+            f"explicitly shed != {REQUESTS} accepted")
+    if verdict["requeues"] < 1:
+        problems.append("fleet_kill: the victim died with no request "
+                        "requeued — the kill landed outside the "
+                        "in-flight window (retune KILL_AFTER)")
+    # Survivors must RESUME the victim's checkpointed work, not
+    # recompute it: the scheduler's resume line in a survivor log.
+    resumed = False
+    for wid in ("worker-0", "worker-2"):
+        log = os.path.join(run_dir, wid, "worker.log")
+        try:
+            with open(log, encoding="utf-8") as fh:
+                if "resumed request" in fh.read():
+                    resumed = True
+        except OSError:
+            continue
+    if not resumed:
+        problems.append("fleet_kill: no survivor resumed a "
+                        "checkpointed request (requeued work was "
+                        "recomputed, not resumed)")
+    # Bit-identity of every completed request vs the fault-free
+    # single-process replay.
+    with open(os.path.join(run_dir, "fleet_report.json"),
+              encoding="utf-8") as fh:
+        report = json.load(fh)
+    completed_ids = sorted(t["request_id"] for t in report["tickets"]
+                           if t["status"] == "completed")
+    problems += _check_bit_identity("fleet_kill", npz, ref,
+                                    expect_ids=completed_ids)
+    problems += _check_exact_pooled_p99("fleet_kill", run_dir)
+    return problems
+
+
+def run_fleet_scenarios(workdir, fast=False):
+    """Run the fleet matrix; returns (problems, scenarios_run).
+    Subprocess scenarios (all of them — the fleet IS processes) skip
+    under ``--fast``, like serve_kill."""
+    if fast:
+        return [], []
+    ref = _reference_results(workdir)
+    if ref is None:
+        return (["fleet reference: fault-free single-process replay "
+                 "did not complete every request"], [])
+    problems = []
+    scenarios = ["fleet_baseline", "fleet_kill"]
+    problems += scenario_fleet_baseline(workdir, ref)
+    problems += scenario_fleet_kill(workdir, ref)
+    return problems, scenarios
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fast = "--fast" in argv
+    argv = [a for a in argv if a != "--fast"]
+
+    from arrow_matrix_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(4)
+
+    import tempfile
+
+    workdir = argv[0] if argv else tempfile.mkdtemp(prefix="fleet_gate_")
+    os.makedirs(workdir, exist_ok=True)
+    problems, scenarios = run_fleet_scenarios(workdir, fast=fast)
+    if problems:
+        for p in problems:
+            print(f"fleet gate: {p}", file=sys.stderr)
+        print("fleet gate: FAILED", file=sys.stderr)
+        return 1
+    print(f"fleet gate: ok — scenarios {'+'.join(scenarios) or '(fast: skipped)'} "
+          f"survived, zero loss, bit-identical ({workdir})",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
