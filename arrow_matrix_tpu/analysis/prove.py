@@ -18,7 +18,12 @@ structured ``CollectiveSummary``, and checks six rules:
   the deferred psum merge prices EXACTLY ``reduce_comm_bytes``;
 * **H4** no silent dtype upcasts: no f64 anywhere in the lowered step
   and no float-widening ``convert`` ops beyond the benign index/mask
-  allowlist;
+  allowlist.  graft-classes relaxes this *per-class* into **H4'**: a
+  reduced-precision contract (dtype bf16/int8, the approx traffic
+  class) declares its carriage->f32 accumulator widening — that
+  convert is benign — but in exchange every collective operand must
+  actually carry the reduced dtype (an approx program whose exchanges
+  still move f32 never earned its smaller byte band);
 * **H5** donated inputs are actually aliased — the lowered stablehlo
   carries ``jax.buffer_donor``/``tf.aliasing_output`` and the compiled
   HLO header carries ``input_output_alias`` for the declared
@@ -64,6 +69,11 @@ _CONVERT_RE = re.compile(r"=\s*(\w+)\[[0-9,]*\]\S*\s+convert\(\s*(\w+)\[")
 
 _FLOAT_BYTES = {"f16": 2, "bf16": 2, "f32": 4, "f64": 8}
 
+#: Carriage itemsize by contract dtype name, for the H4' operand
+#: check (HLO spells int8 "s8"; contracts use the numpy name).
+_CARRIAGE_BYTES = {"s8": 1, "u8": 1, "int8": 1, "uint8": 1,
+                   "f16": 2, "bf16": 2, "f32": 4, "f64": 8}
+
 #: (src, dst) convert pairs that are benign on every backend: index
 #: widening and mask materialization, not a carried-value upcast.
 BENIGN_CONVERTS = frozenset({
@@ -82,6 +92,11 @@ class CollectiveSummary:
     total_bytes: int
     #: Leading dimension of every collective output shape, in order.
     leading_dims: List[int]
+    #: Element dtype of every collective output shape, in order
+    #: (tuple shapes contribute one entry per element) — the H4'
+    #: evidence that an approx program's exchanges really carry the
+    #: reduced carriage dtype.
+    collective_dtypes: List[str]
     #: (src_dtype, dst_dtype) of every convert op.
     converts: List[Tuple[str, str]]
     has_f64: bool
@@ -96,8 +111,11 @@ class CollectiveSummary:
                          if self.kinds[k]["count"])
 
 
-def _collective_leading_dims(text: str) -> List[int]:
+def _collective_shapes(text: str) -> Tuple[List[int], List[str]]:
+    """(leading dims, element dtypes) of every collective output
+    shape, in program order."""
     dims: List[int] = []
+    dtypes: List[str] = []
     for line in text.splitlines():
         s = line.strip()
         if "=" not in s:
@@ -105,12 +123,13 @@ def _collective_leading_dims(text: str) -> List[int]:
         for kind in commstats.COLLECTIVE_OPS:
             m = re.search(rf"=\s*(.+?)\s{re.escape(kind)}(?:-start)?\(", s)
             if m:
-                for _, d in commstats._SHAPE_RE.findall(m.group(1)):
+                for dt, d in commstats._SHAPE_RE.findall(m.group(1)):
+                    dtypes.append(dt)
                     first = d.split(",")[0]
                     if first:
                         dims.append(int(first))
                 break
-    return dims
+    return dims, dtypes
 
 
 def _computation_blocks(text: str) -> Dict[str, List[str]]:
@@ -160,10 +179,12 @@ def summarize_hlo(text: str) -> CollectiveSummary:
     """Parse one HLO program text into a CollectiveSummary."""
     stats = commstats._parse_hlo_collectives(text)
     copies, transposes = _while_body_ops(text)
+    dims, coll_dtypes = _collective_shapes(text)
     return CollectiveSummary(
         kinds={k: dict(stats[k]) for k in commstats.COLLECTIVE_OPS},
         total_bytes=int(stats["total_bytes"]),
-        leading_dims=_collective_leading_dims(text),
+        leading_dims=dims,
+        collective_dtypes=coll_dtypes,
         converts=[(src, dst) for dst, src in _CONVERT_RE.findall(text)],
         has_f64=bool(re.search(r"\bf64\[", text)),
         while_copies=copies,
@@ -256,18 +277,46 @@ def check_h3(lowered: CollectiveSummary, contract: CollectiveContract,
 
 def check_h4(lowered: CollectiveSummary,
              contract: CollectiveContract) -> dict:
-    """No silent dtype upcasts in the lowered (dtype-honest) step."""
+    """No silent dtype upcasts in the lowered (dtype-honest) step.
+
+    The exact (f32) class gets the original H4.  A reduced-precision
+    contract (graft-classes approx carriage: bf16 or int8) gets H4':
+    the carriage->f32 accumulator widening is *declared* by the
+    contract's dtype, so that one convert is benign — but in exchange
+    every collective operand must actually carry a dtype no wider
+    than the carriage, otherwise the program is paying exact-class
+    exchange bytes while claiming the approx byte band."""
+    carriage = contract.dtype
+    approx = carriage in _CARRIAGE_BYTES and _CARRIAGE_BYTES[carriage] < 4
     bad = []
-    if lowered.has_f64 and contract.dtype != "f64":
-        bad.append(f"f64 shapes in a {contract.dtype}-carriage program "
+    if lowered.has_f64 and carriage != "f64":
+        bad.append(f"f64 shapes in a {carriage}-carriage program "
                    f"(weak-type promotion or a float64 literal)")
     for src, dst in lowered.converts:
+        if approx and src == carriage and dst == "f32":
+            continue   # H4': the declared accumulator widening
         if (src in _FLOAT_BYTES and dst in _FLOAT_BYTES
                 and _FLOAT_BYTES[dst] > _FLOAT_BYTES[src]
                 and (src, dst) not in BENIGN_CONVERTS):
             bad.append(f"float-widening convert {src}->{dst}")
+    if approx:
+        limit = _CARRIAGE_BYTES[carriage]
+        wide = sorted({dt for dt in lowered.collective_dtypes
+                       if _CARRIAGE_BYTES.get(dt, 0) > limit})
+        if wide:
+            bad.append(f"{carriage}-class collectives carry "
+                       f"full-precision operands {wide} — the approx "
+                       f"byte band was never earned")
     if bad:
         return _res("fail", "; ".join(sorted(set(bad))))
+    if approx:
+        n_acc = sum(1 for src, dst in lowered.converts
+                    if src == carriage and dst == "f32")
+        kinds = sorted(set(lowered.collective_dtypes)) or ["none"]
+        return _res("pass",
+                    f"H4'({carriage}): collective operands {kinds}, "
+                    f"{n_acc} declared accumulator widening(s), no "
+                    f"other upcasts")
     n_benign = len(lowered.converts)
     return _res("pass",
                 f"no f64, no widening converts "
@@ -514,6 +563,36 @@ def _entries(n: int, width: int, k: int, n_dev: int):
                    "step": (mf._step, args, {}),
                    "scan": (mf._scan_steps_donated, args, {"n": 2}),
                })
+
+    # -- graft-classes approx carriage (H4') ---------------------------
+    # The traffic-class entries: the mesh executors at bf16 (real
+    # reduced-precision collectives, ideal bands halved by the contract
+    # itemsize) and the single-chip fold at int8 (zero-comm quantized
+    # (q, scale) carriage).  One grid cell each — the dtype is the
+    # variable, the (c, S) sweep above already covers the schedules.
+    smb = SellMultiLevel(levels, width,
+                         make_mesh((n_dev,), ("blocks",), devices=devs),
+                         routing="a2a", feature_dtype="bf16")
+    xsb = smb.set_features(random_dense(smb.n, k, seed=5))
+    args = (xsb,) + smb.step_operands()
+    yield ("sell_multi[c=1,S=1,bf16]", smb.collective_contract(k), {
+        "step": (smb._step, args, {}),
+        "scan": (smb._scan_donated, args, {"n": 2}),
+    })
+
+    yield ("multi_level_a2a[c=1,S=1,bf16]", None,
+           "MultiLevelArrow carries feature_dtype on fmt='fold' only; "
+           "the mesh approx carriage is SellMultiLevel's "
+           "(feature-major, the executor graft-tune promotes)")
+
+    mfi = MultiLevelArrow(levels, width, mesh=None, fmt="fold",
+                          feature_dtype="int8")
+    xfi = mfi.set_features(x_host[:ba.shape[0]])
+    args = (xfi,) + mfi.step_operands()
+    yield ("multi_level_fold[c=1,S=1,int8]", mfi.collective_contract(k), {
+        "step": (mfi._step, args, {}),
+        "scan": (mfi._scan_steps_donated, args, {"n": 2}),
+    })
 
 
 def _auto_bytes(lowered: CollectiveSummary,

@@ -285,6 +285,59 @@ def probe_pulse() -> tuple[bool, str]:
                   "`graft_serve --pulse` for the live series")
 
 
+def probe_classes() -> tuple[bool, str]:
+    """graft-classes round-trip: probe a bf16 error curve on a tiny BA
+    structure, derive the certificate, and serve one approx request
+    beside one exact request against it — the approx ticket must be
+    served approx with a certified bound and a smaller admission price
+    than the exact ticket at the same k.  Bounded subprocess, as for
+    the SERVE probe."""
+    code = (
+        "import sys, dataclasses; sys.argv=[]; "
+        "from arrow_matrix_tpu.utils.platform import "
+        "force_cpu_devices; force_cpu_devices(1); "
+        "from arrow_matrix_tpu.classes import certificate_from_record; "
+        "from arrow_matrix_tpu.ledger.probe import "
+        "error_curves_for_source; "
+        "from arrow_matrix_tpu.serve import ArrowServer, ExecConfig, "
+        "ba_executor_factory, run_trace, synthetic_trace; "
+        "src = {'kind': 'ba', 'n': 64, 'm': 3, 'width': 16, "
+        "'seed': 3}; "
+        "recs = error_curves_for_source(src, k=2, iterations=2, "
+        "seed=3, dtypes=('bf16',)); "
+        "cert = certificate_from_record(recs[0]); "
+        "fac, n = ba_executor_factory(64, 16, 3, fmt='fold'); "
+        "srv = ArrowServer(fac, ExecConfig(), name='class-probe', "
+        "certificates=[cert]); "
+        "trace = [dataclasses.replace(r, traffic_class=c) for r, c "
+        "in zip(synthetic_trace(n, tenants=1, requests=2, k=2, "
+        "iterations=2, seed=3), ('approx', 'exact'))]; "
+        "a, e = run_trace(srv, trace); "
+        "ok = (cert is not None and cert.covers(2) and "
+        "a.status == 'completed' and a.served_class == 'approx' and "
+        "a.certified_bound is not None and "
+        "a.predicted_bytes < e.predicted_bytes and "
+        "e.status == 'completed' and e.served_class == 'exact'); "
+        "print('CLASS ok' if ok else 'CLASS FAIL: ' + "
+        "repr((a.summary(), e.summary())))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("CLASS")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "CLASS ok":
+        return False, lines[-1][:120]
+    return True, ("bf16 certificate + approx round trip, priced "
+                  "below exact — run `graft_ledger probe` for full "
+                  "error curves")
+
+
 def probe_tune() -> tuple[bool, str]:
     """graft-tune round-trip: one tiny smoke search races its
     subprocess children and persists a plan, and an immediate second
@@ -513,6 +566,10 @@ def main(argv=None) -> int:
     pulse_ok, detail = probe_pulse()
     ok &= _check("graft-pulse (endpoint scrape + schema)", pulse_ok,
                  detail)
+
+    class_ok, detail = probe_classes()
+    ok &= _check("graft-classes (certificate + approx round trip)",
+                 class_ok, detail)
 
     tune_ok, detail = probe_tune()
     ok &= _check("graft-tune (smoke search + cache hit)", tune_ok,

@@ -58,6 +58,11 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--round", type=int, required=True)
     e.add_argument("--out", default=None,
                    help="output path (default BENCH_r0<N>.json)")
+    e.add_argument("--upto", default=None, metavar="RECORD_ID",
+                   help="pin the export to the chain prefix ending at "
+                        "this record id (default: an existing round "
+                        "file's recorded parsed.ledger.head, else the "
+                        "whole store)")
 
     i = sub.add_parser("ingest", help="load committed history into "
                                       "the store")
@@ -210,7 +215,8 @@ def _cmd_export(args) -> int:
     from arrow_matrix_tpu.ledger.export import export_legacy_round
 
     out = args.out or f"BENCH_r{args.round:02d}.json"
-    doc = export_legacy_round(Ledger(args.ledger_dir), args.round, out)
+    doc = export_legacy_round(Ledger(args.ledger_dir), args.round, out,
+                              head=args.upto)
     print(f"graft_ledger: wrote {out} (metric "
           f"{doc['parsed'].get('metric')!r}, "
           f"{len(doc['parsed'].get('tuned', []))} tuned entries, "

@@ -76,7 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--refresh", action="store_true",
                    help="re-search even on a cache hit")
     s.add_argument("--allow-int8", action="store_true",
-                   help="include the opt-in int8 carriage diagnostic")
+                   help="include the opt-in int8 carriage candidate")
+    s.add_argument("--traffic-class", choices=("exact", "approx"),
+                   default="exact",
+                   help="winner gate: exact = f32 bit-identity "
+                        "(default); approx = class tolerance with a "
+                        "probed error-curve certificate")
     s.add_argument("--restrict", type=str, action="append",
                    default=None,
                    help="race only these candidate names (repeatable)")
@@ -117,6 +122,7 @@ def _cmd_search(args) -> int:
                               refresh=args.refresh,
                               allow_int8=args.allow_int8,
                               restrict=args.restrict,
+                              traffic_class=args.traffic_class,
                               quiet=args.quiet)
         reports.append(report)
         if plan is None:

@@ -159,11 +159,31 @@ def _newest(records: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     return records[-1] if records else None
 
 
-def compose_round(ledger: store.Ledger, round_n: int) -> Dict[str, Any]:
+def compose_round(ledger: store.Ledger, round_n: int,
+                  head: Optional[str] = None) -> Dict[str, Any]:
     """Build the legacy round document from the store (pure read — no
     timestamps, no environment).  Raises ``ValueError`` when the store
-    has no bench record to anchor the parsed section on."""
-    bench = _newest(ledger.query(kind="bench"))
+    has no bench record to anchor the parsed section on.
+
+    ``head`` pins the export to the chain PREFIX ending at that record
+    id — the provenance pointer every exported round records under
+    ``parsed.ledger.head``.  Re-exporting a historical round through
+    its own recorded head is byte-identical even after the store has
+    grown past it (the chain is append-only, so the prefix below a
+    record id never changes); without ``head`` the round snapshots the
+    whole store."""
+    records = ledger.read_all()
+    if head is not None:
+        ids = [r.get("record_id") for r in records]
+        if head not in ids:
+            raise ValueError(f"head record {head!r} is not in the "
+                             f"store chain at {ledger.path}")
+        records = records[:ids.index(head) + 1]
+
+    def view(kind: str) -> List[Dict[str, Any]]:
+        return [r for r in records if r.get("kind") == kind]
+
+    bench = _newest(view("bench"))
     if bench is None:
         raise ValueError("export needs at least one bench record in "
                          "the ledger (run `graft_ledger ingest` or a "
@@ -175,7 +195,7 @@ def compose_round(ledger: store.Ledger, round_n: int) -> Dict[str, Any]:
                          f"payload")
 
     tuned: List[Dict[str, Any]] = []
-    for rec in ledger.query(kind="tune"):
+    for rec in view("tune"):
         payload = rec.get("payload", {})
         tuned.append({
             "structure_hash": rec.get("structure_hash"),
@@ -188,7 +208,7 @@ def compose_round(ledger: store.Ledger, round_n: int) -> Dict[str, Any]:
         })
 
     serving = None
-    serve = _newest(ledger.query(kind="serve"))
+    serve = _newest(view("serve"))
     if serve is not None:
         sp = serve.get("payload", {})
         serving = {
@@ -204,7 +224,7 @@ def compose_round(ledger: store.Ledger, round_n: int) -> Dict[str, Any]:
         }
 
     error_curves: List[Dict[str, Any]] = []
-    for rec in ledger.query(kind="error_curve"):
+    for rec in view("error_curve"):
         error_curves.append({
             "metric": rec.get("metric"),
             "dtype": rec.get("knobs", {}).get("dtype"),
@@ -217,7 +237,6 @@ def compose_round(ledger: store.Ledger, round_n: int) -> Dict[str, Any]:
             "record_id": rec.get("record_id"),
         })
 
-    records = ledger.read_all()
     parsed["tuned"] = tuned
     parsed["serving"] = serving
     parsed["error_curves"] = error_curves
@@ -236,9 +255,19 @@ def compose_round(ledger: store.Ledger, round_n: int) -> Dict[str, Any]:
 
 
 def export_legacy_round(ledger: store.Ledger, round_n: int,
-                        out_path: str) -> Dict[str, Any]:
-    """Compose + validate + atomically write one legacy round file."""
-    doc = compose_round(ledger, round_n)
+                        out_path: str,
+                        head: Optional[str] = None) -> Dict[str, Any]:
+    """Compose + validate + atomically write one legacy round file.
+    When ``head`` is omitted and ``out_path`` already exists, the
+    export pins itself to the existing file's recorded
+    ``parsed.ledger.head`` — regenerating a round is byte-identical by
+    construction, never silently rebased onto a grown store."""
+    if head is None and os.path.exists(out_path):
+        with open(out_path, encoding="utf-8") as fh:
+            prior = json.load(fh)
+        head = ((prior.get("parsed") or {}).get("ledger")
+                or {}).get("head")
+    doc = compose_round(ledger, round_n, head=head)
     problems = validate_legacy(doc)
     if problems:
         raise ValueError(f"composed round fails the legacy schema: "

@@ -21,13 +21,16 @@ regression.
 
 Dtypes:
 
-* ``f32`` / ``bf16`` — real executors (``feature_dtype`` carriage,
-  ``parallel/multi_level.py``);
-* ``int8`` — EMULATED: ``resolve_block_dtype`` supports only f32/bf16,
-  so the probe round-trips the carried host state through a symmetric
-  per-tensor int8 quantize-dequantize between steps and marks the
-  record ``"emulated": true``.  The curve is still the honest answer
-  to "what would int8 carriage cost?" at the state-precision level.
+* ``f32`` / ``bf16`` / ``int8`` — ALL real executors
+  (``feature_dtype`` carriage, ``parallel/multi_level.py``).  int8
+  carriage became real in graft-classes — the fold step carries a
+  symmetric per-feature-row ``(q, scale)`` pair and requantizes each
+  iteration on device — so its records now say ``"emulated": false``
+  and a certificate derived from them (``arrow_matrix_tpu/classes.py``)
+  describes the carriage the executor actually serves.  The old
+  host-side quantize-dequantize emulation survives behind
+  ``emulate_int8=True`` for A/B-ing the device path against the
+  state-precision model.
 
 Each curve is one ledger record: ``kind="error_curve"``,
 ``metric=f"error_curve_{dtype}"`` (dtype in the metric keeps baseline
@@ -120,7 +123,9 @@ def error_curves_for_source(source: Dict[str, Any], *, k: int = 4,
                             iterations: int = DEFAULT_ITERATIONS,
                             seed: int = DEFAULT_SEED,
                             dtypes: Sequence[str] = ("f32", "bf16"),
-                            ledger=None) -> List[Dict[str, Any]]:
+                            ledger=None,
+                            emulate_int8: bool = False
+                            ) -> List[Dict[str, Any]]:
     """Probe one structure (a ``tune/search.py`` levels source) at each
     dtype; returns the ledger records (appended to ``ledger`` when one
     is given, otherwise built with ``ts_unix=0``/pinned provenance so
@@ -151,7 +156,7 @@ def error_curves_for_source(source: Dict[str, Any], *, k: int = 4,
         if dtype not in PROBE_DTYPES:
             raise ValueError(f"unknown probe dtype {dtype!r}; "
                              f"expected one of {PROBE_DTYPES}")
-        emulated = dtype == "int8"
+        emulated = dtype == "int8" and emulate_int8
         if emulated:
             probed = _trajectory(_build(levels, width, None), x0,
                                  iterations, quantize=True)

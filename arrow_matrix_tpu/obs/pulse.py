@@ -15,7 +15,8 @@ run exits.  graft-pulse is the streaming counterpart for
     scheduler's event stream into sliding time windows (req/s,
     p50/p90/p99 latency via mergeable histograms, queue depth, HBM
     occupancy sampled from the live accountant, shed/reject/degrade
-    counts, per-tenant breakdown), flushes the closed-window series to
+    counts, per-tenant and per-traffic-class breakdowns), flushes the
+    closed-window series to
     a bounded on-disk ring (atomic rewrite, crash-readable like
     ``obs/flight.py``), and renders Prometheus-style exposition text —
     served by :class:`PulseEndpoint` (stdlib ``http.server``) and the
@@ -75,7 +76,7 @@ SLO_SERIES_FIELDS = (
     "submitted", "admitted", "completed", "failed", "shed", "rejected",
     "degraded", "resumed", "requests_per_s", "latency_ms",
     "queue_depth", "hbm", "faults_seen", "recoveries", "slo_burns",
-    "per_tenant",
+    "per_tenant", "per_class",
 )
 
 #: Latency sub-dict fields (identical to ``latency_summary_ms``).
@@ -120,6 +121,24 @@ def latency_dict(hist: Histogram, *,
     return out
 
 
+def _breakdown(counts_map: Dict[str, collections.Counter],
+               latency_map: Dict[str, Histogram]) -> Dict[str, dict]:
+    """The shared per-key (tenant / traffic class) breakdown shape of
+    window dicts and run totals."""
+    out: Dict[str, dict] = {}
+    for key in sorted(set(counts_map) | set(latency_map)):
+        counts = counts_map.get(key, {})
+        out[key] = {
+            "completed": counts.get("completed", 0),
+            "failed": counts.get("failed", 0),
+            "shed": counts.get("shed", 0),
+            "rejected": counts.get("rejected", 0),
+            "latency_ms": latency_dict(
+                latency_map.get(key, Histogram())),
+        }
+    return out
+
+
 class PulseWindow:
     """One sliding-window accumulator (mutable while current)."""
 
@@ -131,6 +150,10 @@ class PulseWindow:
         self.latency = Histogram()
         self.tenant_latency: Dict[str, Histogram] = {}
         self.tenant_counts: Dict[str, collections.Counter] = {}
+        # graft-classes: the same breakdown keyed by the class actually
+        # served (events stamp "traffic_class" post-fallback).
+        self.class_latency: Dict[str, Histogram] = {}
+        self.class_counts: Dict[str, collections.Counter] = {}
         self.queue_depth_last: Optional[int] = None
         self.queue_depth_max = 0
         self.hbm_in_use_bytes: Optional[int] = None
@@ -141,11 +164,15 @@ class PulseWindow:
 
     def observe(self, event: str, data: Dict[str, Any]) -> None:
         tenant = data.get("tenant")
+        klass = data.get("traffic_class")
         if event in _COUNTED_EVENTS:
             self.counts[event] += 1
             if tenant is not None:
                 self.tenant_counts.setdefault(
                     tenant, collections.Counter())[event] += 1
+            if klass is not None:
+                self.class_counts.setdefault(
+                    klass, collections.Counter())[event] += 1
         elif event == "resumed_request":
             self.counts["resumed"] += 1
         elif event == "supervised":
@@ -157,6 +184,9 @@ class PulseWindow:
             if tenant is not None:
                 self.tenant_latency.setdefault(
                     tenant, Histogram()).observe(ms)
+            if klass is not None:
+                self.class_latency.setdefault(
+                    klass, Histogram()).observe(ms)
         if data.get("queue_depth") is not None:
             d = int(data["queue_depth"])
             self.queue_depth_last = d
@@ -172,18 +202,6 @@ class PulseWindow:
         window so ``requests_per_s`` stays honest."""
         dur = self.duration_s if duration_s is None else duration_s
         completed = self.counts.get("completed", 0)
-        per_tenant = {}
-        for tenant in sorted(set(self.tenant_counts)
-                             | set(self.tenant_latency)):
-            counts = self.tenant_counts.get(tenant, {})
-            per_tenant[tenant] = {
-                "completed": counts.get("completed", 0),
-                "failed": counts.get("failed", 0),
-                "shed": counts.get("shed", 0),
-                "rejected": counts.get("rejected", 0),
-                "latency_ms": latency_dict(
-                    self.tenant_latency.get(tenant, Histogram())),
-            }
         return {
             "window": self.index,
             "start_s": self.start_s,
@@ -207,7 +225,10 @@ class PulseWindow:
             "faults_seen": self.faults_seen,
             "recoveries": self.recoveries,
             "slo_burns": self.slo_burns,
-            "per_tenant": per_tenant,
+            "per_tenant": _breakdown(self.tenant_counts,
+                                     self.tenant_latency),
+            "per_class": _breakdown(self.class_counts,
+                                    self.class_latency),
         }
 
 
@@ -399,6 +420,8 @@ class PulseMonitor:
         self.total_latency = Histogram()
         self._tenant_totals: Dict[str, collections.Counter] = {}
         self._tenant_latency: Dict[str, Histogram] = {}
+        self._class_totals: Dict[str, collections.Counter] = {}
+        self._class_latency: Dict[str, Histogram] = {}
         self.burn_events: List[dict] = []
         self.meta = {"pid": os.getpid(), "name": name,
                      "window_s": self.window_s,
@@ -486,11 +509,15 @@ class PulseMonitor:
 
     def _fold_totals(self, event: str, data: Dict[str, Any]) -> None:
         tenant = data.get("tenant")
+        klass = data.get("traffic_class")
         if event in _COUNTED_EVENTS:
             self.totals[event] += 1
             if tenant is not None:
                 self._tenant_totals.setdefault(
                     tenant, collections.Counter())[event] += 1
+            if klass is not None:
+                self._class_totals.setdefault(
+                    klass, collections.Counter())[event] += 1
         elif event == "resumed_request":
             self.totals["resumed"] += 1
         elif event == "supervised":
@@ -503,6 +530,9 @@ class PulseMonitor:
             if tenant is not None:
                 self._tenant_latency.setdefault(
                     tenant, Histogram()).observe(ms)
+            if klass is not None:
+                self._class_latency.setdefault(
+                    klass, Histogram()).observe(ms)
 
     def _rotate_locked(self, now: float
                        ) -> List[Tuple[PulseWindow, dict]]:
@@ -568,18 +598,6 @@ class PulseMonitor:
         with self._lock:
             elapsed = max(self._last_now - self._t0, 0.0)
             completed = self.totals.get("completed", 0)
-            per_tenant = {}
-            for tenant in sorted(set(self._tenant_totals)
-                                 | set(self._tenant_latency)):
-                counts = self._tenant_totals.get(tenant, {})
-                per_tenant[tenant] = {
-                    "completed": counts.get("completed", 0),
-                    "failed": counts.get("failed", 0),
-                    "shed": counts.get("shed", 0),
-                    "rejected": counts.get("rejected", 0),
-                    "latency_ms": latency_dict(
-                        self._tenant_latency.get(tenant, Histogram())),
-                }
             burn_counts: collections.Counter = collections.Counter(
                 e["rule"] for e in self.burn_events
                 if e["event"] == "slo_burn")
@@ -623,7 +641,10 @@ class PulseMonitor:
                     "occupancy": hbm_occ,
                 },
                 "slo_burns": dict(sorted(burn_counts.items())),
-                "per_tenant": per_tenant,
+                "per_tenant": _breakdown(self._tenant_totals,
+                                         self._tenant_latency),
+                "per_class": _breakdown(self._class_totals,
+                                        self._class_latency),
             }
 
     def snapshot(self) -> dict:
@@ -708,6 +729,23 @@ class PulseMonitor:
             "Live HBM occupancy vs the admission budget.")
         lines.append(f"pulse_hbm_occupancy "
                      f"{num(t['hbm']['occupancy'] or 0.0)}")
+        per_class = t.get("per_class") or {}
+        if per_class:
+            fam("pulse_class_completed_total", "counter",
+                "Completed requests by served traffic class.")
+            for klass, rec in sorted(per_class.items()):
+                lines.append(
+                    f'pulse_class_completed_total'
+                    f'{{traffic_class="{klass}"}} '
+                    f'{num(rec["completed"])}')
+            fam("pulse_class_latency_ms", "summary",
+                "Latency quantiles by served traffic class.")
+            for klass, rec in sorted(per_class.items()):
+                for q, key in (("0.5", "p50"), ("0.99", "p99")):
+                    lines.append(
+                        f'pulse_class_latency_ms{{traffic_class='
+                        f'"{klass}",quantile="{q}"}} '
+                        f'{num(rec["latency_ms"][key])}')
         fam("pulse_degraded_total", "counter",
             "Tenant ladder degradations.")
         lines.append(f"pulse_degraded_total {num(t['degraded'])}")
@@ -818,7 +856,7 @@ def validate_ring(doc: dict) -> List[str]:
         problems.append("totals missing")
     else:
         for f in ("completed", "shed", "rejected", "latency_ms",
-                  "per_tenant"):
+                  "per_tenant", "per_class"):
             if f not in totals:
                 problems.append(f"totals missing {f}")
     if not isinstance(doc.get("burn_events"), list):

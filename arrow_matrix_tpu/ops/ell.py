@@ -339,6 +339,10 @@ def dense_pack_stack(mats: list[sparse.spmatrix], dtype=np.float32,
     for i, m in enumerate(mats):
         if m is None or m.nnz == 0:
             continue
+        # scipy cannot densify extension dtypes (a bf16 CSR raises in
+        # csr_todense even targeting bf16); densify at f32 and round.
+        if m.dtype.kind not in "fiub":
+            m = m.astype(np.float32)
         out[i] = m.toarray().astype(dtype)
     return out
 

@@ -109,9 +109,19 @@ def resolve_feature_dtype(feature_dtype):
     ``set_features`` (operators are dtype-independent), so retargeting
     the attribute between calls measures both carriages against one
     build — bench.py's k128 rerun and tools/gather_probe.py rely on
-    this."""
+    this.
+
+    "int8" (graft-classes, fold path only) quantizes the carriage to a
+    symmetric per-feature-row int8 ``(q, scale)`` pair — 4× fewer
+    carriage bytes; SpMM column-separability makes the per-row scale
+    exact (see ``_finalize_folded``), so the only error is the
+    per-step requantization round."""
     if feature_dtype is None:
         return None
+    if feature_dtype == "int8" or (not isinstance(feature_dtype, str)
+                                   and np.dtype(feature_dtype)
+                                   == np.dtype(np.int8)):
+        return np.int8
     resolved = resolve_block_dtype(feature_dtype)
     return None if resolved == np.float32 else resolved
 
@@ -663,7 +673,15 @@ class MultiLevelArrow:
         # reads inside the jitted step (lint R9).
         kopts = dict(getattr(self, "kernel_opts", None) or {})
 
+        int8_carry = (self.feature_dtype is not None
+                      and np.dtype(self.feature_dtype)
+                      == np.dtype(np.int8))
+
         def fold_slab(xt, blocks):
+            if xt.dtype == jnp.int8:
+                # Per-slab f32 transient: the FULL carriage stays int8
+                # in HBM; only one overlap/repl slab widens at a time.
+                xt = xt.astype(jnp.float32)
             if kernel == "pallas_sell":
                 # Fused gather->FMA kernel: no materialized gather
                 # intermediate, so no chunk/gather_budget tiling.
@@ -707,11 +725,29 @@ class MultiLevelArrow:
                                            blocks))
             return jnp.concatenate(outs, axis=0)
 
-        self._step = jax.jit(fold_step)
+        def fold_step_q(carry, fwd, bwd, blocks):
+            # int8 carriage (graft-classes): the carry is a symmetric
+            # per-feature-row quantized pair — q int8 (k, total), scale
+            # f32 (k, 1).  Feature-major layout means carriage row f is
+            # one feature column of X, and SpMM is column-separable, so
+            # fold(q * scale) == fold(q) * scale EXACTLY: the scale
+            # rides outside the (f32-accumulated) operator and the only
+            # approximation is the requantization round below.
+            q, scale = carry
+            z = fold_step(q, fwd, bwd, blocks) * scale
+            amax = jnp.max(jnp.abs(z), axis=1, keepdims=True)
+            safe = jnp.where(amax > 0.0, amax, 1.0)
+            q2 = jnp.clip(jnp.round(z * (127.0 / safe)),
+                          -127.0, 127.0).astype(jnp.int8)
+            s2 = jnp.where(amax > 0.0, amax / 127.0, 0.0)
+            return q2, s2
+
+        step_fn = fold_step_q if int8_carry else fold_step
+        self._step = jax.jit(step_fn)
 
         def fold_scan(xt, fwd, bwd, blocks, n):
             def body(xc, _):
-                return fold_step(xc, fwd, bwd, blocks), None
+                return step_fn(xc, fwd, bwd, blocks), None
 
             out, _ = jax.lax.scan(body, xt, None, length=n)
             return out
@@ -833,6 +869,19 @@ class MultiLevelArrow:
         padded[:n] = x_original
         if self.folded:
             feat = padded[self.perm0]
+            if self.feature_dtype is not None \
+                    and np.dtype(self.feature_dtype) == np.dtype(np.int8):
+                # graft-classes int8 carriage: symmetric per-feature-row
+                # quantization into the (q, scale) carry pair the int8
+                # fold step requantizes each iteration.
+                xt = np.ascontiguousarray(feat.T).astype(np.float32)
+                amax = np.max(np.abs(xt), axis=1, keepdims=True)
+                safe = np.where(amax > 0.0, amax, 1.0)
+                q = np.clip(np.rint(xt * (127.0 / safe)),
+                            -127.0, 127.0).astype(np.int8)
+                scale = np.where(amax > 0.0, amax / 127.0,
+                                 0.0).astype(np.float32)
+                return (chunked_asarray(q), chunked_asarray(scale))
             if self.feature_dtype is not None:
                 feat = feat.astype(self.feature_dtype)  # before the big
                 # transpose copy: half the bytes at 2^24-row scale
@@ -857,6 +906,12 @@ class MultiLevelArrow:
         """Device result (level-0 order, flat) -> host (n, k) array in
         original row order (reference allgather_result analog)."""
         if self.folded:
+            if isinstance(c, tuple):
+                # int8 (q, scale) carry: dequantize on host.
+                q, scale = c
+                arr = (np.asarray(q, dtype=np.float32)
+                       * np.asarray(scale, dtype=np.float32))
+                return arr.T[self.inv_perm0][:self.n]
             # bf16-carried results come back as f32 numpy (downstream
             # host math — goldens, norms — has no bf16 arithmetic).
             return np.asarray(c, dtype=np.float32).T[
@@ -897,7 +952,13 @@ class MultiLevelArrow:
         output are flat (total_rows, k) arrays in level-0 order."""
         from arrow_matrix_tpu.faults import on_step as _fault_hook
 
-        x = _fault_hook("multi_level.step", x)
+        if isinstance(x, tuple):
+            # int8 (q, scale) carry: the fault hook reads shapes and
+            # poisons floats, so it rides on the f32 scale component.
+            q, scale = x
+            x = (q, _fault_hook("multi_level.step", scale))
+        else:
+            x = _fault_hook("multi_level.step", x)
         return self._step(x, self.fwd, self.bwd, self.blocks)
 
     def ideal_comm_bytes(self, k: int, itemsize: int = 4) -> int:
@@ -914,9 +975,14 @@ class MultiLevelArrow:
         SellMultiLevel.reduce_comm_bytes for the mesh scheme)."""
         return 0
 
-    def collective_contract(self, k: int, itemsize: int = 4):
+    def collective_contract(self, k: int, itemsize: int = None):
         """Static communication promise for graft-prove, by execution
-        mode: the a2a routing writes explicit all-to-alls (GSPMD's
+        mode.  ``itemsize`` defaults to the carried feature dtype's
+        (graft-classes: a bf16 carriage contract promises HALF the
+        ideal exchange bytes — the band scales with the class), and
+        can be pinned explicitly for what-if pricing.
+
+        The a2a routing writes explicit all-to-alls (GSPMD's
         partitioning of the sharded level compute may additionally
         lower to all-reduce/collective-permute — declared, so H1 trips
         only on a genuine surprise all-gather); the gather routing
@@ -926,6 +992,8 @@ class MultiLevelArrow:
         entry carries the features as flat param 0 (H5)."""
         from arrow_matrix_tpu.analysis.contracts import CollectiveContract
 
+        if itemsize is None:
+            itemsize = np.dtype(self.feature_dtype or np.float32).itemsize
         single_chip = self.mesh is None or getattr(
             self, "routing", "none") == "none"
         if single_chip:

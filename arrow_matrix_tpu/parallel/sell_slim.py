@@ -985,6 +985,12 @@ class SellSlim:
         src = _SliceSource(matrix, mesh.shape[axis], width)
         is_binary = src.resolve_binary(binary)
         self.feature_dtype = resolve_feature_dtype(feature_dtype)
+        if self.feature_dtype is not None and \
+                np.dtype(self.feature_dtype) == np.dtype(np.int8):
+            raise ValueError(
+                "int8 carriage is a fold-path capability (its (q, "
+                "scale) carry pair has no sharded exchange story yet); "
+                "the mesh executors carry f32 or bf16")
         self.n = src.n
         self.binary = is_binary
         self.mesh = mesh
@@ -1083,15 +1089,19 @@ class SellSlim:
             return 0
         return self.rows_out * k * itemsize
 
-    def collective_contract(self, k: int, itemsize: int = 4):
+    def collective_contract(self, k: int, itemsize: int = None):
         """Static communication promise for graft-prove (analysis/
         contracts.py): the slim step's only exchange is the head-partial
         psum (all-reduce) over the block axis, carrying the k/(c·S)
         feature slab; the measured/ideal band covers the HLO accountant
         counting per-device padded output shapes against the paper's
-        logical O(width) row bound."""
+        logical O(width) row bound.  ``itemsize`` defaults to the
+        carried feature dtype's (graft-classes: an approx-carriage
+        contract promises proportionally fewer ideal bytes)."""
         from arrow_matrix_tpu.analysis.contracts import CollectiveContract
 
+        if itemsize is None:
+            itemsize = np.dtype(self.feature_dtype or np.float32).itemsize
         return CollectiveContract(
             algorithm="sell_slim",
             step_bytes=self.ideal_comm_bytes(k, itemsize),
@@ -1211,6 +1221,12 @@ class SellMultiLevel:
                 "across replica groups (verified corrupt, not just "
                 "reordered f32)")
         self.feature_dtype = resolve_feature_dtype(feature_dtype)
+        if self.feature_dtype is not None and \
+                np.dtype(self.feature_dtype) == np.dtype(np.int8):
+            raise ValueError(
+                "int8 carriage is a fold-path capability (its (q, "
+                "scale) carry pair has no sharded exchange story yet); "
+                "the mesh executors carry f32 or bf16")
 
         if not levels:
             raise ValueError("empty decomposition")
@@ -1462,15 +1478,19 @@ class SellMultiLevel:
             return 0
         return self.ops[0].rows_out * k * itemsize
 
-    def collective_contract(self, k: int, itemsize: int = 4):
+    def collective_contract(self, k: int, itemsize: int = None):
         """Static communication promise for graft-prove: the a2a
         routing tables exchange inter-level rows (all-to-all) and each
         level's head partials psum over the block axis (all-reduce),
         every collective carrying the k/(c·S) feature slab.  The scan
         entry point donates the carried features (flat param 0), so
-        the prover additionally demands input-output aliasing (H5)."""
+        the prover additionally demands input-output aliasing (H5).
+        ``itemsize`` defaults to the carried feature dtype's
+        (graft-classes: a bf16 carriage halves the promised band)."""
         from arrow_matrix_tpu.analysis.contracts import CollectiveContract
 
+        if itemsize is None:
+            itemsize = np.dtype(self.feature_dtype or np.float32).itemsize
         return CollectiveContract(
             algorithm="sell_multi",
             step_bytes=self.ideal_comm_bytes(k, itemsize),

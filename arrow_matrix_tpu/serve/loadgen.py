@@ -103,6 +103,15 @@ def slo_summary(server: ArrowServer, tickets: List[rq.Ticket],
         rec = dict(rec)
         rec["latency_ms"] = latency_summary_ms(mine)
         per_tenant[name] = rec
+    # graft-classes: the per-class mirror of per_tenant — latency
+    # quantiles keyed by the class actually served (post-fallback), so
+    # an SLO read can tell approx tail latency from exact.
+    per_class = {}
+    for klass, rec in (base.get("classes") or {}).items():
+        mine = [t for t in tickets if t.served_class == klass]
+        rec = dict(rec)
+        rec["latency_ms"] = latency_summary_ms(mine)
+        per_class[klass] = rec
     completed = base["completed"]
     pulse_section = None
     if pulse is not None:
@@ -131,6 +140,9 @@ def slo_summary(server: ArrowServer, tickets: List[rq.Ticket],
         "recoveries": base["recoveries"],
         "checkpoint_corruptions": base["checkpoint_corruptions"],
         "per_tenant": per_tenant,
+        "per_class": per_class,
+        "class_fallback": base.get("class_fallback", 0),
+        "certificates": base.get("certificates", {}),
         "pulse": pulse_section,
     }
 
@@ -187,6 +199,11 @@ def ba_executor_factory(n: int, width: int, seed: int,
                           fold_align=bk["fold_align"],
                           feature_dtype=bk["feature_dtype"])
             kernel_opts = resolved.kernel_opts()
+        # graft-classes: the rung's class carriage wins over both the
+        # factory default and the plan — an approx batch must build a
+        # reduced-precision executor even under an exact-tuned plan.
+        if getattr(cfg, "feature_dtype", None) is not None:
+            kwargs["feature_dtype"] = cfg.feature_dtype
         return MultiLevelArrow(levels, width, mesh=mesh,
                                kernel=cfg.kernel,
                                overlap_slabs=cfg.overlap_slabs,

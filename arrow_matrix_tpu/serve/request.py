@@ -51,6 +51,12 @@ class Request:
     x: np.ndarray
     iterations: int
     deadline_s: Optional[float] = None
+    # graft-classes: the accuracy class the tenant is asking for.
+    # "exact" (default) is f32 bit-identity, today's contract; "approx"
+    # asks for certified reduced-precision carriage — granted only when
+    # the server holds a covering certificate for this iteration count,
+    # otherwise served exact with a loud class_fallback event.
+    traffic_class: str = "exact"
 
     @property
     def k(self) -> int:
@@ -74,6 +80,12 @@ class Ticket:
         self.attempts = 0             # executions (1 + degraded reruns)
         self.exec_config = None       # ExecConfig the result came from
         self.resumed_step: Optional[int] = None
+        # graft-classes: the class actually served (may differ from
+        # request.traffic_class on a certificate-miss fallback) and,
+        # when it does differ, why — never a silent substitution.
+        self.served_class: str = request.traffic_class
+        self.class_fallback: Optional[str] = None
+        self.certified_bound: Optional[float] = None
         self._done = threading.Event()
 
     @property
@@ -102,6 +114,10 @@ class Ticket:
             "iterations": self.request.iterations,
             "status": self.status,
             "reason": self.reason,
+            "traffic_class": self.request.traffic_class,
+            "served_class": self.served_class,
+            "class_fallback": self.class_fallback,
+            "certified_bound": self.certified_bound,
             "predicted_bytes": self.predicted_bytes,
             "latency_s": self.latency_s,
             "faults_seen": self.faults_seen,

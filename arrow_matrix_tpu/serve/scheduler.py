@@ -61,11 +61,19 @@ class ExecConfig:
     """One rung of the execution ladder: the three knobs graceful
     degradation can trade away (fused kernel, 2.5D column replication,
     overlap sub-slabs) without changing the result's row order or the
-    carriage layout — a degraded rerun resumes the same checkpoints."""
+    carriage layout — a degraded rerun resumes the same checkpoints.
+
+    ``feature_dtype`` (graft-classes) is NOT a degradation knob: it is
+    the carriage dtype of the traffic class a request is served under
+    (None = f32 exact, "bf16" = certified approx), constant along a
+    ticket's ladder walk.  It lives here because it is part of the
+    executor cache key — an approx batch must never share an executor
+    (or a batch) with an exact one."""
 
     kernel: str = "xla"
     repl: int = 1
     overlap_slabs: int = 1
+    feature_dtype: Optional[str] = None
 
     def accepts_k(self, k: int) -> bool:
         """Whether a feature width is schedulable under this config
@@ -95,12 +103,17 @@ def degradation_ladder(base: ExecConfig) -> Tuple[ExecConfig, ...]:
 
 
 class _Tenant:
-    __slots__ = ("rung", "fault_score", "degradations")
+    __slots__ = ("rung", "fault_score", "degradations",
+                 "allow_approx", "class_degraded")
 
     def __init__(self):
         self.rung = 0
         self.fault_score = 0
         self.degradations: List[dict] = []
+        # graft-classes: exact -> approx is one more (opt-in) rung
+        # below the terminal config rung; never taken silently.
+        self.allow_approx = False
+        self.class_degraded = False
 
 
 class ArrowServer:
@@ -132,7 +145,11 @@ class ArrowServer:
                  tracer=None,
                  name: str = "serve",
                  verbose: bool = False,
-                 tune_plan=None):
+                 tune_plan=None,
+                 certificates=None,
+                 structure_hash: Optional[str] = None,
+                 cert_ledger_dir: Optional[str] = None,
+                 approx_opt_in=()):
         # graft-tune pickup: a cached TunePlan (or its dict) becomes
         # the BASE ladder rung — admitted requests run the tuned
         # kernel/repl/overlap at zero search cost, and the degradation
@@ -149,6 +166,13 @@ class ArrowServer:
             if resolved is not None:
                 self.tune_plan = resolved
                 base_config = resolved.exec_config()
+        if base_config.feature_dtype is not None:
+            # The BASE rung serves the exact class; a carriage dtype
+            # on it (e.g. an approx-class tune plan) is a class
+            # property, applied per ticket by _effective_config, never
+            # a default every tenant silently inherits.
+            base_config = dataclasses.replace(base_config,
+                                              feature_dtype=None)
         if queue_capacity < 1:
             raise ValueError(f"queue_capacity must be >= 1, got "
                              f"{queue_capacity}")
@@ -167,8 +191,41 @@ class ArrowServer:
         self._factory = executor_factory
         self.base_config = base_config
         self.ladder = degradation_ladder(base_config)
+        # graft-classes: the approx class serves bf16 carriage only
+        # (the int8 (q, scale) carry is an executor/bench capability —
+        # its tuple pytree has no serving checkpoint story), and only
+        # for structures holding a covering certificate.  Certificates
+        # come from (priority order) the explicit argument, an
+        # approx-class tune plan, or a ledger lookup by structure hash.
+        from arrow_matrix_tpu.classes import (
+            Certificate,
+            find_certificate,
+        )
+
+        self.approx_dtype = "bf16"
+        self._certificates: Dict[str, Certificate] = {}
+        certs = certificates or ()
+        if isinstance(certs, dict):   # {dtype: cert} or an iterable
+            certs = certs.values()
+        for c in certs:
+            cert = (c if isinstance(c, Certificate)
+                    else Certificate.from_dict(dict(c)))
+            self._certificates[cert.dtype] = cert
+        if self.tune_plan is not None and self.tune_plan.certificate:
+            cert = Certificate.from_dict(self.tune_plan.certificate)
+            self._certificates.setdefault(cert.dtype, cert)
+        shash = structure_hash or (self.tune_plan.structure_hash
+                                   if self.tune_plan else None)
+        if shash and cert_ledger_dir is not None \
+                and self.approx_dtype not in self._certificates:
+            cert = find_certificate(shash, self.approx_dtype,
+                                    ledger_dir=cert_ledger_dir)
+            if cert is not None:
+                self._certificates[cert.dtype] = cert
         self._executors: Dict[ExecConfig, Any] = {}
         self._tenants: Dict[str, _Tenant] = {}
+        for t in approx_opt_in or ():
+            self._tenant(t).allow_approx = True
         self._queue: collections.deque = collections.deque()
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -177,6 +234,7 @@ class ArrowServer:
         self._counts = collections.Counter()
         self._latencies_s: List[float] = []
         self._tenant_latencies_s: Dict[str, List[float]] = {}
+        self._class_latencies_s: Dict[str, List[float]] = {}
         self.batches = 0
         self.batched_requests = 0
         self.faults_seen = 0
@@ -198,6 +256,15 @@ class ArrowServer:
         self._event("started", resident_bytes=resident,
                     budget_bytes=self.accountant.budget_bytes,
                     ladder=[dataclasses.asdict(c) for c in self.ladder])
+        if self._certificates:
+            self._event("certificates_loaded",
+                        structure_hash=shash,
+                        certificates={
+                            dt: {"iterations": c.iterations,
+                                 "tolerance": c.tolerance,
+                                 "bound": c.bound_at(c.iterations)}
+                            for dt, c in
+                            sorted(self._certificates.items())})
         if self.tune_plan is not None:
             self._event("tune_plan_applied",
                         structure_hash=self.tune_plan.structure_hash,
@@ -233,14 +300,18 @@ class ArrowServer:
         return self.tracer.span(name, **attrs)
 
     def _count(self, what: str, tenant: Optional[str] = None,
-               **labels) -> None:
+               klass: Optional[str] = None, **labels) -> None:
         self._counts[what] += 1
         if tenant is not None:
             self._counts[f"{what}:{tenant}"] += 1
+        if klass is not None:
+            self._counts[f"{what}:class:{klass}"] += 1
         if self.registry is not None:
             lb = dict(labels)
             if tenant is not None:
                 lb["tenant"] = tenant
+            if klass is not None:
+                lb["traffic_class"] = klass
             self.registry.counter(f"serve_{what}", server=self.name,
                                   **lb).inc()
 
@@ -260,11 +331,50 @@ class ArrowServer:
         """The ladder rung this ticket runs on: its tenant's current
         rung, or the terminal rung when the request's feature width
         fails the rung's divisibility contract (repl/overlap need
-        c | k and S | k/c; the terminal rung accepts every k)."""
-        cfg = self.ladder[self._tenant(ticket.request.tenant).rung]
+        c | k and S | k/c; the terminal rung accepts every k).
+        Approx-served tickets get the class carriage dtype stamped on
+        the rung — a distinct executor cache key, so exact and approx
+        never share a compiled step or a batch."""
+        tenant = self._tenant(ticket.request.tenant)
+        cfg = self.ladder[tenant.rung]
         if not cfg.accepts_k(ticket.request.k):
-            return self.ladder[-1]
+            cfg = self.ladder[-1]
+        if ticket.served_class == "exact" and tenant.class_degraded:
+            # Opt-in class degradation (never silent): the tenant
+            # consented via approx_opt_in and its ladder is exhausted.
+            cert = self._certificates.get(self.approx_dtype)
+            if cert is not None and cert.covers(
+                    ticket.request.iterations):
+                ticket.served_class = "approx"
+                ticket.class_fallback = "degraded_opt_in"
+                ticket.certified_bound = cert.bound_at(
+                    ticket.request.iterations)
+                self._event("class_degraded_applied",
+                            request=ticket.request.request_id,
+                            tenant=ticket.request.tenant,
+                            traffic_class="approx",
+                            certified_bound=ticket.certified_bound)
+        if ticket.served_class == "approx":
+            cfg = dataclasses.replace(cfg,
+                                      feature_dtype=self.approx_dtype)
         return cfg
+
+    def _resolve_class(self, request: rq.Request):
+        """Admission-time class decision: ``(served_class,
+        fallback_reason, certificate)``.  An approx request without a
+        covering certificate is served EXACT — the loud fallback the
+        class contract promises (never silent approx)."""
+        if request.traffic_class == "exact":
+            return "exact", None, None
+        cert = self._certificates.get(self.approx_dtype)
+        if cert is None:
+            return "exact", "no_certificate", None
+        if not cert.covers(request.iterations):
+            reason = ("curve_shorter_than_request"
+                      if cert.bound_at(request.iterations) is None
+                      else "certified_bound_exceeds_tolerance")
+            return "exact", reason, None
+        return "approx", None, cert
 
     # -- admission ---------------------------------------------------------
 
@@ -282,12 +392,47 @@ class ArrowServer:
             return self._submit(request)
 
     def _submit(self, request: rq.Request) -> rq.Ticket:
+        from arrow_matrix_tpu.classes import (
+            TRAFFIC_CLASSES,
+            class_itemsize,
+        )
+
         ticket = rq.Ticket(request)
         ticket.submitted_s = time.monotonic()
         self._count("submitted", request.tenant)
+        if request.traffic_class not in TRAFFIC_CLASSES:
+            ticket._finish(
+                rq.REJECTED, reason="unknown_class",
+                error=f"unknown traffic class "
+                      f"{request.traffic_class!r} (expected one of "
+                      f"{TRAFFIC_CLASSES})")
+            self._count("rejected", request.tenant,
+                        reason="unknown_class")
+            self._event("rejected", request=request.request_id,
+                        tenant=request.tenant, reason="unknown_class",
+                        traffic_class=request.traffic_class)
+            return ticket
+        served, fallback, cert = self._resolve_class(request)
+        ticket.served_class = served
+        ticket.class_fallback = fallback
+        if cert is not None:
+            ticket.certified_bound = cert.bound_at(request.iterations)
+        if fallback is not None:
+            self._count("class_fallback", request.tenant,
+                        reason=fallback)
+            self._event("class_fallback", request=request.request_id,
+                        tenant=request.tenant,
+                        requested_class=request.traffic_class,
+                        traffic_class=served, reason=fallback)
+            self._log(f"class fallback {request.request_id}: "
+                      f"approx -> exact ({fallback})")
+        # Approx carriage is priced at its TRUE (smaller) itemsize —
+        # the admitted-requests-per-GB lever the class exists for.
+        itemsize = (class_itemsize(self.approx_dtype)
+                    if served == "approx" else self.itemsize)
         price = request_price_bytes(
             self._build_executor(self.base_config), request.k,
-            itemsize=self.itemsize, repl=self.base_config.repl)
+            itemsize=itemsize, repl=self.base_config.repl)
         ticket.predicted_bytes = price
         with self._cond:
             if self._stop:
@@ -305,9 +450,11 @@ class ArrowServer:
                           f"headroom "
                           f"{self.accountant.headroom_bytes()} B")
                 self._count("rejected", request.tenant,
+                            klass=ticket.served_class,
                             reason="hbm_budget")
                 self._event("rejected", request=request.request_id,
                             tenant=request.tenant, reason="hbm_budget",
+                            traffic_class=ticket.served_class,
                             predicted_bytes=price,
                             headroom_bytes=self.accountant
                             .headroom_bytes())
@@ -328,10 +475,12 @@ class ArrowServer:
                 return ticket
             ticket.status = rq.ADMITTED
             self._queue.append(ticket)
-            self._count("admitted", request.tenant)
+            self._count("admitted", request.tenant,
+                        klass=ticket.served_class)
             self._event("admitted", request=request.request_id,
                         tenant=request.tenant, k=request.k,
                         predicted_bytes=price,
+                        traffic_class=ticket.served_class,
                         queue_depth=len(self._queue))
             self._cond.notify_all()
         return ticket
@@ -375,8 +524,13 @@ class ArrowServer:
                 keep: List[rq.Ticket] = []
                 for t in list(self._queue):
                     k2 = t.request.k
+                    # Class separation: config equality already
+                    # differs on feature_dtype, but the served-class
+                    # check is the explicit contract — a batch never
+                    # mixes accuracy classes.
                     if (t.request.iterations == head.request.iterations
                             and self._effective_config(t) == cfg
+                            and t.served_class == head.served_class
                             and k_total + k2 <= self.max_batch_k
                             and cfg.accepts_k(k_total + k2)
                             and not self._shed_expired(t)):
@@ -448,8 +602,16 @@ class ArrowServer:
         """Build (or fetch) the executor for a rung, walking further
         down the ladder when a rung's build itself fails; returns
         ``(executor, actual_cfg)`` or ``(None, cfg)``."""
-        start = self.ladder.index(cfg) if cfg in self.ladder else 0
-        for rung in list(self.ladder[start:]) or [cfg]:
+        if cfg in self.ladder:
+            rungs = list(self.ladder[self.ladder.index(cfg):])
+        else:
+            # A class-stamped rung (feature_dtype set by
+            # _effective_config) is not a ladder member: try it
+            # first, and only degrade into the exact ladder — losing
+            # the carriage dtype, loudly, via rung_build_failed —
+            # when the class rung itself cannot build.
+            rungs = [cfg] + list(self.ladder)
+        for rung in rungs:
             try:
                 return self._build_executor(rung), rung
             except Exception as e:  # noqa: BLE001 — a rung that cannot
@@ -608,6 +770,24 @@ class ArrowServer:
         if t.fault_score < self.degrade_after:
             return False
         if t.rung + 1 >= len(self.ladder):
+            # graft-classes: one more rung exists below the terminal
+            # config — exact -> approx — but ONLY for tenants that
+            # opted in, and only with a certificate to serve under.
+            if (t.allow_approx and not t.class_degraded
+                    and self.approx_dtype in self._certificates):
+                t.class_degraded = True
+                t.fault_score = 0
+                rec = {"tenant": tenant,
+                       "from": {"traffic_class": "exact"},
+                       "to": {"traffic_class": "approx",
+                              "feature_dtype": self.approx_dtype},
+                       "reason": f"{reason}:class_opt_in"}
+                t.degradations.append(rec)
+                self._count("degraded", tenant, reason=reason)
+                self._event("degraded", **rec)
+                self._log(f"degraded tenant {tenant} to the approx "
+                          f"class ({reason}; explicit opt-in)")
+                return True
             return False
         frm, t.rung = t.rung, t.rung + 1
         t.fault_score = 0
@@ -670,20 +850,27 @@ class ArrowServer:
             t.exec_config = cfg
             self.accountant.release(t.predicted_bytes)
             t._finish(rq.COMPLETED)
-            self._count("completed", t.request.tenant)
+            self._count("completed", t.request.tenant,
+                        klass=t.served_class)
             lat_ms = (t.latency_s or 0.0) * 1e3
             with self._lock:
                 self._latencies_s.append(t.latency_s or 0.0)
                 self._tenant_latencies_s.setdefault(
                     t.request.tenant, []).append(t.latency_s or 0.0)
+                self._class_latencies_s.setdefault(
+                    t.served_class, []).append(t.latency_s or 0.0)
             if self.registry is not None:
                 self.registry.record("serve_latency_ms", lat_ms,
                                      server=self.name)
                 self.registry.record("serve_latency_ms", lat_ms,
                                      server=self.name,
                                      tenant=t.request.tenant)
+                self.registry.record("serve_latency_ms", lat_ms,
+                                     server=self.name,
+                                     traffic_class=t.served_class)
             self._event("completed", request=t.request.request_id,
                         tenant=t.request.tenant,
+                        traffic_class=t.served_class,
                         latency_ms=round(lat_ms, 3),
                         faults_seen=t.faults_seen)
 
@@ -746,6 +933,21 @@ class ArrowServer:
         with self._lock:
             return [lat * 1e3 for lat in self._latencies_s]
 
+    def class_latency_samples_ms(self) -> Dict[str, List[float]]:
+        """Completed-request latencies (ms) keyed by served class —
+        the per-class half of the SLO report."""
+        with self._lock:
+            return {cls: [lat * 1e3 for lat in vals]
+                    for cls, vals in
+                    sorted(self._class_latencies_s.items())}
+
+    def opt_in_approx(self, tenant: str) -> None:
+        """Record a tenant's explicit consent to exact -> approx class
+        degradation (the ladder rung below the terminal config; never
+        taken without this)."""
+        with self._lock:
+            self._tenant(tenant).allow_approx = True
+
     def summary(self) -> dict:
         with self._lock:
             counts = dict(self._counts)
@@ -754,6 +956,8 @@ class ArrowServer:
                     "rung": t.rung,
                     "config": dataclasses.asdict(self.ladder[t.rung]),
                     "fault_score": t.fault_score,
+                    "allow_approx": t.allow_approx,
+                    "class_degraded": t.class_degraded,
                     "completed": counts.get(f"completed:{name}", 0),
                     "failed": counts.get(f"failed:{name}", 0),
                     "shed": counts.get(f"shed:{name}", 0),
@@ -761,6 +965,16 @@ class ArrowServer:
                     "degradations": list(t.degradations),
                 }
                 for name, t in sorted(self._tenants.items())
+            }
+            classes = {
+                cls: {
+                    "admitted": counts.get(f"admitted:class:{cls}", 0),
+                    "completed": counts.get(
+                        f"completed:class:{cls}", 0),
+                    "requests": len(self._class_latencies_s.get(
+                        cls, ())),
+                }
+                for cls in ("exact", "approx")
             }
         return {
             "server": self.name,
@@ -770,6 +984,7 @@ class ArrowServer:
             "failed": counts.get("failed", 0),
             "shed": counts.get("shed", 0),
             "rejected": counts.get("rejected", 0),
+            "class_fallback": counts.get("class_fallback", 0),
             "batches": self.batches,
             "batched_requests": self.batched_requests,
             "faults_seen": self.faults_seen,
@@ -777,4 +992,12 @@ class ArrowServer:
             "checkpoint_corruptions": self.checkpoint_corruptions,
             "hbm": self.accountant.snapshot(),
             "tenants": tenants,
+            "classes": classes,
+            "certificates": {
+                dt: {"iterations": c.iterations,
+                     "tolerance": c.tolerance,
+                     "bound": c.bound_at(c.iterations),
+                     "record_id": c.record_id}
+                for dt, c in sorted(self._certificates.items())
+            },
         }

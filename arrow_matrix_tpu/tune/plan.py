@@ -98,6 +98,15 @@ class TunePlan:
     evaluator: Optional[str] = None         # e.g. "cpu-interpret"
     created_unix: Optional[float] = None
 
+    # --- accuracy class (graft-classes) ---
+    # "exact" plans win on bit-identity (today's contract, and the
+    # default every pre-class cached plan file deserializes to);
+    # "approx" plans win on the class tolerance and carry their
+    # accuracy certificate (arrow_matrix_tpu/classes.py
+    # Certificate.to_dict) as provenance.
+    traffic_class: str = "exact"
+    certificate: Optional[dict] = None
+
     def build_kwargs(self) -> Dict[str, Any]:
         """Executor construction overrides (``MultiLevelArrow``
         argument names)."""
@@ -128,7 +137,8 @@ class TunePlan:
         from arrow_matrix_tpu.serve.scheduler import ExecConfig
 
         return ExecConfig(kernel=self.kernel, repl=self.repl,
-                          overlap_slabs=self.overlap_slabs)
+                          overlap_slabs=self.overlap_slabs,
+                          feature_dtype=self.feature_dtype)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -10,14 +10,21 @@ races formats — each candidate in its own timeout-guarded process
 with the flight recorder installed — and persist the winner as a
 versioned :class:`~arrow_matrix_tpu.tune.plan.TunePlan`.
 
-Eligibility: a candidate may only WIN if its full-precision output is
-bit-identical (``np.array_equal``, f32) to the golden ``ops/sell.py``
-fold path — computed once in the parent as the default executor's
-``gather_result(step(x))`` on a seeded input, in original row order.
-The default configuration is itself always raced (and is trivially
-bit-identical), so a winner always exists; candidates that lose
-bit-identity (or are dtype experiments) are still timed and recorded
-as diagnostics in the report.
+Eligibility is per traffic class (graft-classes).  For the default
+``exact`` class a candidate may only WIN if its full-precision output
+is bit-identical (``np.array_equal``, f32) to the golden
+``ops/sell.py`` fold path — computed once in the parent as the default
+executor's ``gather_result(step(x))`` on a seeded input, in original
+row order.  The default configuration is itself always raced (and is
+trivially bit-identical), so a winner always exists; candidates that
+lose bit-identity (or are dtype experiments) are still timed and
+recorded as diagnostics in the report.  For ``traffic_class="approx"``
+a reduced-precision candidate may also win when its measured
+single-step rel-Frobenius error is within the class tolerance
+(``arrow_matrix_tpu/classes.py``) — and before such a winner is
+persisted, its full error-vs-iteration curve is probed
+(``ledger/probe.py``) and must certify (every point within tolerance);
+the resulting certificate rides in the TunePlan.
 
 Children are real subprocesses on purpose: a wedged compile or a
 device grab costs ONE candidate its timeout, never the search; a
@@ -159,16 +166,24 @@ def candidate_child_main(cfg: dict) -> dict:
     x = multi.set_features(x_host)
 
     bit_identical = None
+    rel_frobenius = None
     golden_path = cfg.get("golden_path")
     if golden_path:
         golden = np.load(golden_path)
         mine = np.asarray(multi.gather_result(multi.step(x)),
                           dtype=np.float32)
         bit_identical = bool(np.array_equal(mine, golden))
+        # Single-step rel-Frobenius vs the golden: the approx-class
+        # eligibility screen (the full curve certifies the winner).
+        gn = float(np.linalg.norm(golden.astype(np.float64)))
+        diff = float(np.linalg.norm(mine.astype(np.float64)
+                                    - golden.astype(np.float64)))
+        rel_frobenius = diff / gn if gn > 0 else diff
 
     ms = chained_iteration_ms(multi.run, x, int(cfg.get("iters", 3)))
     return {"name": name, "ms": round(float(ms), 4),
-            "bit_identical": bit_identical}
+            "bit_identical": bit_identical,
+            "rel_frobenius": rel_frobenius}
 
 
 def _spawn_tune_candidate(cand: Candidate, cfg: dict,
@@ -216,6 +231,29 @@ def _spawn_tune_candidate(cand: Candidate, cfg: dict,
     return rec
 
 
+def _certify_candidate(source: dict, dtype: str, k: int,
+                       ledger_dir: Optional[str], say) :
+    """Probe the full error-vs-iteration curve for one carriage dtype
+    and derive its :class:`~arrow_matrix_tpu.classes.Certificate`
+    (recorded in the ledger when one is configured); None when the
+    probe fails."""
+    from arrow_matrix_tpu.classes import certificate_from_record
+    from arrow_matrix_tpu.ledger.probe import error_curves_for_source
+
+    try:
+        ledger = None
+        if ledger_dir is not None:
+            from arrow_matrix_tpu.ledger.store import Ledger
+
+            ledger = Ledger(ledger_dir)
+        recs = error_curves_for_source(source, k=int(k),
+                                       dtypes=(dtype,), ledger=ledger)
+        return certificate_from_record(recs[0])
+    except Exception as e:  # noqa: BLE001 — a failed probe fails the
+        say(f"certificate probe failed: {type(e).__name__}: {e}")
+        return None         # candidate, never the search
+
+
 def _plan_from_candidate(cand: Candidate, h: str, k: int) -> TunePlan:
     """Fold a candidate's overrides over the default knob set."""
     base = TunePlan(structure_hash=h, k=int(k)).to_dict()
@@ -232,6 +270,7 @@ def search(source: dict, k: int, *, iters: int = 3,
            restrict: Optional[List[str]] = None,
            run_dir: Optional[str] = None,
            ledger_dir: Optional[str] = None,
+           traffic_class: str = "exact",
            quiet: bool = False) -> Tuple[Optional[TunePlan], dict]:
     """Search (or cache-hit) the tuned plan for one (structure, k).
 
@@ -241,7 +280,15 @@ def search(source: dict, k: int, *, iters: int = 3,
     children.  ``refresh=True`` forces a re-search.  ``ledger_dir``
     redirects the winner's graft-ledger record (smoke runs pass a
     run-dir-local store).
+
+    ``traffic_class="approx"`` admits tolerance-gated reduced-precision
+    winners (module docstring); the cached plan records the class, so
+    an exact consumer never silently inherits an approx plan
+    (``load_plan`` keys on k within one structure file — approx
+    searches should use a distinct ``plan_dir`` or consume the plan
+    object directly, as ``serve/scheduler.ArrowServer`` does).
     """
+    from arrow_matrix_tpu.classes import tolerance_for
     from arrow_matrix_tpu.utils.platform import host_load
 
     def _say(msg: str) -> None:
@@ -257,6 +304,10 @@ def search(source: dict, k: int, *, iters: int = 3,
 
     if not refresh:
         cached = load_plan(h, k, plan_dir, quiet=True)
+        if cached is not None and cached.traffic_class != traffic_class:
+            _say(f"cached plan is {cached.traffic_class!r}, search "
+                 f"wants {traffic_class!r}: re-searching")
+            cached = None
         if cached is not None:
             _say(f"cache HIT for k={k}: candidate "
                  f"{cached.candidate!r} ({cached.measured_ms} ms, "
@@ -279,7 +330,7 @@ def search(source: dict, k: int, *, iters: int = 3,
 
     cands, pruned = enumerate_candidates(
         fp, k, platform=platform, allow_int8=allow_int8,
-        restrict=restrict)
+        restrict=restrict, traffic_class=traffic_class)
     for name, why in pruned.items():
         _say(f"pruned {name}: {why}")
 
@@ -304,19 +355,50 @@ def search(source: dict, k: int, *, iters: int = 3,
              f"err={r.get('error')}")
 
     default_ms = results.get("default", {}).get("ms")
-    eligible = [c for c in cands
-                if c.eligible
-                and results[c.name].get("error") is None
-                and results[c.name].get("ms") is not None
-                and results[c.name].get("bit_identical") is True]
-    if not eligible:
+
+    def _class_ok(c: Candidate) -> bool:
+        r = results[c.name]
+        if (r.get("error") is not None or r.get("ms") is None):
+            return False
+        if r.get("bit_identical") is True:
+            return True
+        if traffic_class != "approx":
+            return False
+        # Approx class: a reduced-precision candidate passes the
+        # screen when its single-step error is within the class
+        # tolerance; the full curve still has to certify below.
+        fd = c.build.get("feature_dtype")
+        rel = r.get("rel_frobenius")
+        return (fd is not None and rel is not None
+                and rel <= tolerance_for(fd))
+
+    eligible = [c for c in cands if c.eligible and _class_ok(c)]
+    certificate = None
+    winner = None
+    while eligible:
+        pick = min(eligible, key=lambda c: results[c.name]["ms"])
+        fd = pick.build.get("feature_dtype")
+        if (traffic_class != "approx" or fd is None
+                or results[pick.name].get("bit_identical") is True):
+            winner = pick
+            break
+        # Reduced-precision approx winner: probe the full
+        # error-vs-iteration curve before persisting — the curve IS
+        # the certificate a serve-time admission decision trusts.
+        cert = _certify_candidate(source, fd, k, ledger_dir, _say)
+        if cert is not None and cert.covers(cert.iterations):
+            winner, certificate = pick, cert
+            break
+        _say(f"{pick.name}: curve failed to certify "
+             f"(tolerance {tolerance_for(fd)}) — dropping candidate")
+        eligible.remove(pick)
+    if winner is None:
         _say("no eligible candidate (default failed?) — no plan saved")
         return None, {
             "structure_hash": h, "k": int(k), "cache_hit": False,
             "children_spawned": len(cands), "results": results,
             "pruned": pruned, "error": "no eligible candidate",
         }
-    winner = min(eligible, key=lambda c: results[c.name]["ms"])
     w_ms = float(results[winner.name]["ms"])
     margin = (None if not default_ms
               else round((float(default_ms) - w_ms) / float(default_ms),
@@ -327,11 +409,14 @@ def search(source: dict, k: int, *, iters: int = 3,
         "measured_ms": w_ms,
         "default_ms": default_ms,
         "margin": margin,
-        "bit_identical": True,
+        "bit_identical":
+            results[winner.name].get("bit_identical") is True,
         "host_load": host_load(),
         "platform": platform,
         "evaluator": evaluator,
         "created_unix": round(time.time(), 3),
+        "traffic_class": traffic_class,
+        "certificate": certificate.to_dict() if certificate else None,
     })
     path = save_plans(h, {int(k): plan}, fingerprint=fp,
                       directory=plan_dir,
@@ -354,9 +439,11 @@ def search(source: dict, k: int, *, iters: int = 3,
                    "kernel": plan.kernel, "fmt": plan.fmt,
                    "chunk": plan.chunk,
                    "overlap_slabs": plan.overlap_slabs,
-                   "feature_dtype": plan.feature_dtype},
+                   "feature_dtype": plan.feature_dtype,
+                   "traffic_class": traffic_class},
             payload={"default_ms": default_ms, "margin": margin,
-                     "bit_identical": True, "evaluator": evaluator,
+                     "bit_identical": plan.bit_identical,
+                     "evaluator": evaluator,
                      "source": source, "plan_path": path})
     except Exception as e:
         _say(f"ledger record not persisted: {type(e).__name__}: {e}")

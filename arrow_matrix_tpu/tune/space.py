@@ -25,9 +25,15 @@ already trusts:
   ``k % 16 == 0`` on a real chip; DMA-ring variants are stream-only
   so they are pruned on the interpret (CPU) evaluator.
 
-Carriage-dtype candidates are marked ``eligible=False``: they cannot
-be bit-identical to the f32 golden by construction, so they are timed
-as diagnostics but can never be persisted as the winner.
+Carriage-dtype eligibility is per traffic class (graft-classes): for
+``traffic_class="exact"`` (the default, today's contract) bf16/int8
+are marked ``eligible=False`` — they cannot be bit-identical to the
+f32 golden by construction, so they are timed as diagnostics but can
+never be persisted as the winner.  For ``traffic_class="approx"`` the
+same candidates become ``eligible=True``: the winner gate is the class
+tolerance (measured rel-Frobenius vs the golden,
+``arrow_matrix_tpu/classes.py``), not bit-identity, and the winning
+plan records its accuracy certificate.
 """
 
 from __future__ import annotations
@@ -66,7 +72,8 @@ def enumerate_candidates(fp: dict, k: int, *,
                          platform: str = "cpu",
                          allow_int8: bool = False,
                          budget_bytes: Optional[int] = None,
-                         restrict: Optional[List[str]] = None
+                         restrict: Optional[List[str]] = None,
+                         traffic_class: str = "exact"
                          ) -> Tuple[List[Candidate], Dict[str, str]]:
     """The candidate list for one (fingerprint, k), already pruned.
 
@@ -76,7 +83,17 @@ def enumerate_candidates(fp: dict, k: int, *,
 
     ``restrict`` (names) narrows the space — the smoke/doctor path
     races 3 candidates instead of ~12.
+
+    ``traffic_class="approx"`` flips the carriage-dtype candidates to
+    ``eligible=True`` (tolerance-gated winners, see module docstring);
+    int8 still needs the explicit ``allow_int8`` opt-in even there.
     """
+    from arrow_matrix_tpu.classes import TRAFFIC_CLASSES
+
+    if traffic_class not in TRAFFIC_CLASSES:
+        raise ValueError(f"unknown traffic class {traffic_class!r} "
+                         f"(expected one of {TRAFFIC_CLASSES})")
+    approx = traffic_class == "approx"
     from arrow_matrix_tpu.obs.comm import hbm_budget_bytes, repl_predict_ms
     from arrow_matrix_tpu.obs.memview import largest_fitting_repl
 
@@ -126,14 +143,18 @@ def enumerate_candidates(fp: dict, k: int, *,
                   build={"repl": 2},
                   note="2.5D column groups, c=2"),
         Candidate("bf16",
-                  build={"feature_dtype": "bf16"}, eligible=False,
-                  note="bf16 carriage diagnostic (never f32 "
-                       "bit-identical; cannot win)"),
+                  build={"feature_dtype": "bf16"}, eligible=approx,
+                  note=("bf16 carriage: approx-class candidate "
+                        "(tolerance-gated winner)" if approx else
+                        "bf16 carriage diagnostic (never f32 "
+                        "bit-identical; cannot win)")),
     ]
     if allow_int8:
         raw.append(Candidate(
-            "int8", build={"feature_dtype": "int8"}, eligible=False,
-            note="opt-in int8-carriage experiment (diagnostic only)"))
+            "int8", build={"feature_dtype": "int8"}, eligible=approx,
+            note=("opt-in int8 (q, scale) carriage: approx-class "
+                  "candidate" if approx else
+                  "opt-in int8-carriage experiment (diagnostic only)")))
 
     budget = hbm_budget_bytes(budget_bytes)
     base_bytes = predicted_operator_bytes(fp, k)

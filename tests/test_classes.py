@@ -1,0 +1,519 @@
+"""graft-classes tests: tolerance-certified traffic classes.
+
+Covers the class model (``arrow_matrix_tpu/classes.py`` — itemsizes,
+tolerances, certificate derivation and lookup), class-aware admission
+(approx priced below exact at the same (structure, k);
+exactly-at-budget admits; the per-GB economics), the loud-fallback
+contract (certificate miss / short curve -> served exact with an
+explicit reason, unknown class -> rejected), class-pure batching, the
+reduced-precision executors (bf16 carriage, int8 ``(q, scale)`` fold
+carriage), the real-int8 error probe, and the H4' prover relaxation
+(declared accumulator widening allowed, reduced collective operands
+required).  The end-to-end chaos form lives in tools/serve_gate.py's
+``serve_classes`` scenario.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu import classes as cls
+from arrow_matrix_tpu.classes import (
+    BF16_TOLERANCE,
+    INT8_TOLERANCE,
+    Certificate,
+    certificate_from_record,
+    class_itemsize,
+    find_certificate,
+    resolve_class,
+    tolerance_for,
+)
+from arrow_matrix_tpu.serve import (
+    ArrowServer,
+    ExecConfig,
+    ba_executor_factory,
+    request_price_bytes,
+    run_trace,
+    slo_summary,
+    synthetic_trace,
+)
+
+N, WIDTH, K, SEED = 64, 16, 2, 5
+CURVE_ITERS = 4
+
+
+@pytest.fixture(scope="module")
+def factory():
+    """One BA decomposition shared by every server in this module."""
+    return ba_executor_factory(N, WIDTH, SEED, fmt="fold")
+
+
+@pytest.fixture(scope="module")
+def curves():
+    """Real probed error curves for the module's structure — the
+    certificate source (never hand-declared)."""
+    from arrow_matrix_tpu.ledger.probe import error_curves_for_source
+
+    source = {"kind": "ba", "n": N, "m": 3, "width": WIDTH,
+              "seed": SEED}
+    return error_curves_for_source(source, k=K,
+                                   iterations=CURVE_ITERS, seed=SEED,
+                                   dtypes=("f32", "bf16", "int8"))
+
+
+@pytest.fixture(scope="module")
+def cert(curves):
+    c = certificate_from_record(
+        next(r for r in curves if r["knobs"]["dtype"] == "bf16"))
+    assert c is not None and c.covers(CURVE_ITERS)
+    return c
+
+
+def _trace(n_rows, requests=2, iterations=2, traffic_class="exact"):
+    trace = synthetic_trace(n_rows, tenants=1, requests=requests,
+                            k=K, iterations=iterations, seed=SEED)
+    return [dataclasses.replace(r, traffic_class=traffic_class)
+            for r in trace]
+
+
+# ---------------------------------------------------------------------------
+# The class model (classes.py)
+# ---------------------------------------------------------------------------
+
+def test_resolve_class_and_itemsize():
+    assert resolve_class("exact").itemsize == 4
+    assert resolve_class("exact").feature_dtype is None
+    assert not resolve_class("exact").needs_certificate
+    bf16 = resolve_class("approx")
+    assert (bf16.feature_dtype, bf16.itemsize,
+            bf16.tolerance) == ("bf16", 2, BF16_TOLERANCE)
+    int8 = resolve_class("approx", int8=True)
+    assert (int8.feature_dtype, int8.itemsize,
+            int8.tolerance) == ("int8", 1, INT8_TOLERANCE)
+    with pytest.raises(ValueError, match="unknown traffic class"):
+        resolve_class("bogus")
+    assert class_itemsize(None) == class_itemsize("f32") == 4
+    assert class_itemsize("bf16") == 2 and class_itemsize("int8") == 1
+    with pytest.raises(ValueError, match="no class itemsize"):
+        class_itemsize("f64")
+    assert tolerance_for(None) == tolerance_for("f32") == 0.0
+    with pytest.raises(ValueError):
+        tolerance_for("f16")
+
+
+def test_certificate_bound_is_prefix_max_and_never_extrapolates():
+    c = Certificate(structure_hash="s", dtype="bf16",
+                    rel_frobenius=(1e-3, 5e-3, 2e-3),
+                    tolerance=BF16_TOLERANCE)
+    assert c.iterations == 3
+    assert c.bound_at(1) == 1e-3
+    assert c.bound_at(3) == 5e-3          # max over the prefix
+    assert c.bound_at(0) is None          # degenerate
+    assert c.bound_at(4) is None          # measured, not modeled
+    assert c.covers(3) and not c.covers(4)
+    tight = Certificate(structure_hash="s", dtype="bf16",
+                        rel_frobenius=(1e-3, 3e-2),
+                        tolerance=BF16_TOLERANCE)
+    assert tight.covers(1) and not tight.covers(2)
+
+
+def test_certificate_from_record_rejects_noncurves_and_f32():
+    rec = {"kind": "bench", "payload": {"rel_frobenius": [1e-3]},
+           "knobs": {"dtype": "bf16"}}
+    assert certificate_from_record(rec) is None
+    rec = {"kind": "error_curve", "payload": {"rel_frobenius": [0.0]},
+           "knobs": {"dtype": "f32"}, "structure_hash": "s"}
+    assert certificate_from_record(rec) is None   # golden certifies nothing
+    rec = {"kind": "error_curve", "payload": {},
+           "knobs": {"dtype": "bf16"}, "structure_hash": "s"}
+    assert certificate_from_record(rec) is None   # no curve payload
+
+
+def _curve_record(shash, dtype, curve, emulated=False, rid="r"):
+    return {"kind": "error_curve", "structure_hash": shash,
+            "record_id": rid,
+            "knobs": {"dtype": dtype, "emulated": emulated, "seed": 0},
+            "payload": {"rel_frobenius": list(curve)}}
+
+
+def test_find_certificate_newest_wins_and_emulated_rejected():
+    recs = [
+        _curve_record("s", "bf16", [1e-3], rid="old"),
+        _curve_record("s", "bf16", [2e-3], rid="new"),
+        _curve_record("other", "bf16", [9e-1], rid="other"),
+        _curve_record("s", "int8", [5e-2], emulated=True, rid="emu"),
+    ]
+    c = find_certificate("s", "bf16", records=recs)
+    assert c is not None and c.record_id == "new"
+    # An emulated curve never certifies the real carriage by default.
+    assert find_certificate("s", "int8", records=recs) is None
+    emu = find_certificate("s", "int8", records=recs,
+                           allow_emulated=True)
+    assert emu is not None and emu.emulated
+    assert find_certificate("missing", "bf16", records=recs) is None
+
+
+# ---------------------------------------------------------------------------
+# Class-aware admission (the per-GB economics)
+# ---------------------------------------------------------------------------
+
+def test_approx_priced_below_exact_same_structure_k(factory, cert):
+    """Approx admission reserves the TRUE (bf16) carriage bytes —
+    exactly half the exact price at the same (structure, k)."""
+    fac, n_rows = factory
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      name="price")
+    tickets = run_trace(
+        srv, _trace(n_rows, traffic_class="approx")
+        + _trace(n_rows, traffic_class="exact"))
+    approx, exact = tickets[0], tickets[-1]
+    assert approx.served_class == "approx"
+    assert exact.served_class == "exact"
+    assert 0 < approx.predicted_bytes < exact.predicted_bytes
+    assert approx.predicted_bytes * 2 == exact.predicted_bytes
+    ex = fac(ExecConfig())
+    assert exact.predicted_bytes == request_price_bytes(ex, K)
+    assert approx.predicted_bytes == request_price_bytes(ex, K,
+                                                         itemsize=2)
+
+
+def test_approx_admits_exactly_at_budget_and_more_per_gb(factory,
+                                                         cert):
+    """A budget with headroom for exactly one EXACT request admits two
+    concurrent approx requests (<=, not <) — and the same budget
+    admits only one exact + one explicit rejection."""
+    from arrow_matrix_tpu.obs.memview import predicted_bytes_for
+
+    fac, n_rows = factory
+    ex = fac(ExecConfig())
+    resident = predicted_bytes_for(ex, 0) or 0
+    exact_price = request_price_bytes(ex, K)
+    budget = resident + exact_price
+
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      hbm_budget_bytes=budget, name="budget-approx")
+    tickets = [srv.submit(r) for r in
+               _trace(n_rows, requests=2, traffic_class="approx")]
+    srv.drain()
+    s = srv.summary()
+    assert (s["admitted"], s["rejected"]) == (2, 0)
+    assert all(t.status == "completed" for t in tickets)
+    assert s["hbm"]["peak_in_use_bytes"] <= budget
+
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      hbm_budget_bytes=budget, name="budget-exact")
+    tickets = [srv.submit(r) for r in
+               _trace(n_rows, requests=2, traffic_class="exact")]
+    srv.drain()
+    s = srv.summary()
+    assert (s["admitted"], s["rejected"]) == (1, 1)
+    assert tickets[1].status == "rejected"
+    assert tickets[1].reason == "hbm_budget"
+
+
+def test_unknown_class_rejected_explicitly(factory):
+    fac, n_rows = factory
+    srv = ArrowServer(fac, ExecConfig(), name="unknown")
+    t = srv.submit(dataclasses.replace(
+        _trace(n_rows, requests=1)[0], traffic_class="turbo"))
+    srv.drain()
+    assert t.status == "rejected"
+    assert t.reason == "unknown_class"
+
+
+# ---------------------------------------------------------------------------
+# The loud-fallback contract: never silent approx, never silent exact
+# ---------------------------------------------------------------------------
+
+def test_certificate_miss_falls_back_exact_loudly(factory):
+    """No certificate -> the approx request is served EXACT with an
+    explicit reason and bit-identical results — never silently served
+    reduced precision."""
+    fac, n_rows = factory
+    ref_srv = ArrowServer(fac, ExecConfig(), name="ref")
+    ref = run_trace(ref_srv, _trace(n_rows))
+
+    srv = ArrowServer(fac, ExecConfig(), name="nocert")   # no certs
+    tickets = run_trace(srv, _trace(n_rows, traffic_class="approx"))
+    for t, r in zip(tickets, ref):
+        assert t.status == "completed"
+        assert t.served_class == "exact"
+        assert t.class_fallback == "no_certificate"
+        assert t.certified_bound is None
+        assert t.result.tobytes() == r.result.tobytes()
+    assert srv.summary()["class_fallback"] == len(tickets)
+
+
+def test_curve_shorter_than_request_falls_back_exact(factory, cert):
+    fac, n_rows = factory
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      name="short")
+    deep = _trace(n_rows, requests=1, iterations=CURVE_ITERS + 2,
+                  traffic_class="approx")
+    t = run_trace(srv, deep)[0]
+    assert t.status == "completed"
+    assert t.served_class == "exact"
+    assert t.class_fallback == "curve_shorter_than_request"
+
+
+def test_exact_requests_never_served_approx(factory, cert):
+    """Certificates present is not permission: exact traffic on a
+    certificate-holding server stays bit-identical f32."""
+    fac, n_rows = factory
+    ref = run_trace(ArrowServer(fac, ExecConfig(), name="ref2"),
+                    _trace(n_rows))
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      name="exact-beside-cert")
+    tickets = run_trace(srv, _trace(n_rows))
+    for t, r in zip(tickets, ref):
+        assert t.served_class == "exact" and t.class_fallback is None
+        assert t.result.tobytes() == r.result.tobytes()
+
+
+def test_approx_served_within_tolerance_not_bitwise(factory, cert):
+    """A certified approx request actually runs the bf16 carriage:
+    the result drifts from the f32 replay (nonzero) but stays within
+    the class tolerance, and the ticket carries the certified bound."""
+    fac, n_rows = factory
+    ref = run_trace(ArrowServer(fac, ExecConfig(), name="ref3"),
+                    _trace(n_rows))
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      name="approx")
+    tickets = run_trace(srv, _trace(n_rows, traffic_class="approx"))
+    for t, r in zip(tickets, ref):
+        assert t.status == "completed"
+        assert t.served_class == "approx"
+        assert t.class_fallback is None
+        assert t.certified_bound == cert.bound_at(2)
+        assert t.exec_config.feature_dtype == "bf16"
+        d = t.result.astype(np.float64) - r.result.astype(np.float64)
+        rel = float(np.linalg.norm(d)
+                    / np.linalg.norm(r.result.astype(np.float64)))
+        assert 0.0 < rel <= cert.tolerance
+
+
+# ---------------------------------------------------------------------------
+# Class-pure batching
+# ---------------------------------------------------------------------------
+
+def test_mixed_class_batch_never_merged(factory, cert):
+    """With feature-axis batching on and both classes queued, batches
+    stay class-pure: same-class neighbors merge, classes never do —
+    every exact result stays bit-identical beside approx traffic."""
+    fac, n_rows = factory
+    ref = run_trace(ArrowServer(fac, ExecConfig(), name="ref4"),
+                    _trace(n_rows))
+
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      max_batch_k=2 * K, name="batch")
+    trace = (_trace(n_rows, traffic_class="approx")
+             + _trace(n_rows, traffic_class="exact"))
+    tickets = [srv.submit(r) for r in trace]    # burst, then drain
+    srv.drain()
+    s = srv.summary()
+    # Same-class neighbors DID merge (batching is on and working)...
+    assert s["batches"] >= 1 and s["batched_requests"] >= 2
+    # ...but across classes never: exact results are f32-bit-identical
+    # and approx results drifted (each class ran its own carriage).
+    for t, r in zip(tickets[2:], ref):
+        assert t.result.tobytes() == r.result.tobytes()
+    for t, r in zip(tickets[:2], ref):
+        assert t.served_class == "approx"
+        assert t.result.tobytes() != r.result.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# SLO report + pulse: the class dimension
+# ---------------------------------------------------------------------------
+
+def test_slo_summary_and_pulse_carry_per_class(factory, cert):
+    from arrow_matrix_tpu.obs import pulse as pulse_mod
+
+    fac, n_rows = factory
+    srv = ArrowServer(fac, ExecConfig(), certificates=[cert],
+                      name="slo")
+    mon = pulse_mod.PulseMonitor(window_s=60.0, name="slo")
+    srv.attach_pulse(mon)
+    tickets = run_trace(
+        srv, _trace(n_rows, traffic_class="approx") + _trace(n_rows))
+    mon.close()
+    summary = slo_summary(srv, tickets, wall_s=1.0, pulse=mon)
+    pc = summary["per_class"]
+    assert set(pc) == {"exact", "approx"}
+    assert pc["approx"]["completed"] == 2
+    assert pc["exact"]["completed"] == 2
+    assert pc["approx"]["latency_ms"]["count"] == 2
+    assert summary["class_fallback"] == 0
+    assert "bf16" in summary["certificates"]
+    totals = mon.totals_dict()
+    assert totals["per_class"]["approx"]["completed"] == 2
+    assert totals["per_class"]["exact"]["completed"] == 2
+    assert pulse_mod.validate_exposition(mon.exposition_text()) == []
+
+
+# ---------------------------------------------------------------------------
+# Reduced-precision executors (the carriage the classes serve)
+# ---------------------------------------------------------------------------
+
+def _fold_pair(feature_dtype):
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    a = barabasi_albert(N, 3, seed=SEED)
+    levels = arrow_decomposition(a, WIDTH, max_levels=6,
+                                 block_diagonal=True, seed=SEED)
+    f32 = MultiLevelArrow(levels, WIDTH, mesh=None, fmt="fold")
+    probed = MultiLevelArrow(levels, WIDTH, mesh=None, fmt="fold",
+                             feature_dtype=feature_dtype)
+    return f32, probed
+
+
+def _run_steps(multi, x_host, steps):
+    import jax
+
+    x = multi.set_features(x_host)
+    for _ in range(steps):
+        x = multi.step(x)
+    jax.block_until_ready(x)
+    return multi.gather_result(x), x
+
+
+def test_bf16_fold_carriage_halves_bytes_within_tolerance():
+    f32, bf16 = _fold_pair("bf16")
+    x_host = np.random.default_rng(SEED).standard_normal(
+        (f32.n, K)).astype(np.float32)
+    gold, xg = _run_steps(f32, x_host, 2)
+    got, xb = _run_steps(bf16, x_host, 2)
+    assert xb.dtype.itemsize * 2 == xg.dtype.itemsize
+    assert got.dtype == np.float32 and got.shape == gold.shape
+    rel = np.linalg.norm(got.astype(np.float64) - gold.astype(
+        np.float64)) / np.linalg.norm(gold.astype(np.float64))
+    assert 0.0 < rel <= BF16_TOLERANCE
+
+
+def test_int8_fold_carriage_is_quantized_pair_within_tolerance():
+    f32, int8 = _fold_pair("int8")
+    x_host = np.random.default_rng(SEED).standard_normal(
+        (f32.n, K)).astype(np.float32)
+    gold, _ = _run_steps(f32, x_host, 2)
+    got, carry = _run_steps(int8, x_host, 2)
+    assert isinstance(carry, tuple) and len(carry) == 2
+    q, scale = carry
+    assert q.dtype == np.int8
+    assert scale.dtype == np.float32
+    # 4x fewer carriage bytes than f32 (+ the per-row f32 scale).
+    assert q.size == np.prod(np.asarray(
+        (int8.total_rows if hasattr(int8, "total_rows")
+         else q.shape[0], K)))
+    assert got.dtype == np.float32 and got.shape == gold.shape
+    rel = np.linalg.norm(got.astype(np.float64) - gold.astype(
+        np.float64)) / np.linalg.norm(gold.astype(np.float64))
+    assert 0.0 < rel <= INT8_TOLERANCE
+
+
+def test_sell_slim_rejects_int8_carriage():
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.parallel import make_mesh
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    a = barabasi_albert(N, 3, seed=SEED)
+    levels = arrow_decomposition(a, WIDTH, max_levels=4,
+                                 block_diagonal=True, seed=SEED)
+    mesh = make_mesh((4,), ("blocks",))
+    with pytest.raises(ValueError, match="int8"):
+        SellMultiLevel(levels, WIDTH, mesh, routing="a2a",
+                       feature_dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# The probe: real int8, golden-zero f32
+# ---------------------------------------------------------------------------
+
+def test_error_curves_real_int8_and_golden_zero(curves):
+    by_dtype = {r["knobs"]["dtype"]: r for r in curves}
+    assert set(by_dtype) == {"f32", "bf16", "int8"}
+    # The f32 curve is identically zero BY CONSTRUCTION.
+    assert all(p == 0.0
+               for p in by_dtype["f32"]["payload"]["rel_frobenius"])
+    # int8 records the REAL device carriage, not the emulation.
+    assert by_dtype["int8"]["knobs"]["emulated"] is False
+    bf16_curve = by_dtype["bf16"]["payload"]["rel_frobenius"]
+    assert len(bf16_curve) == CURVE_ITERS
+    assert all(0.0 < p <= BF16_TOLERANCE for p in bf16_curve)
+
+
+# ---------------------------------------------------------------------------
+# H4' (analysis/prove.py): declared widening, reduced operands
+# ---------------------------------------------------------------------------
+
+_BF16_STEP = """\
+HloModule classed_step
+ENTRY %main (p0: bf16[4,8]) -> bf16[4,8] {
+  %p0 = bf16[4,8]{1,0} parameter(0)
+  %acc = f32[4,8]{1,0} convert(bf16[4,8]{1,0} %p0)
+  ROOT %a2a = bf16[4,8]{1,0} all-to-all(bf16[4,8]{1,0} %p0), replica_groups={{0,1}}
+}
+"""
+
+_BF16_STEP_F32_COLLECTIVE = _BF16_STEP.replace(
+    "ROOT %a2a = bf16[4,8]{1,0} all-to-all(bf16[4,8]{1,0} %p0)",
+    "ROOT %a2a = f32[4,8]{1,0} all-to-all(f32[4,8]{1,0} %acc)")
+
+
+def _contract(dtype):
+    from arrow_matrix_tpu.analysis.contracts import CollectiveContract
+
+    return CollectiveContract(
+        algorithm="t", step_bytes=64, reduce_bytes=0, repl=1,
+        overlap_slabs=1, dtype=dtype, lowered_kinds=("all-to-all",),
+        compiled_kinds=("all-to-all",), ratio_band=(0.1, 4.0))
+
+
+def test_h4_prime_allows_declared_accumulator_widening():
+    from arrow_matrix_tpu.analysis import prove
+
+    summ = prove.summarize_hlo(_BF16_STEP)
+    assert summ.collective_dtypes == ["bf16"]
+    r = prove.check_h4(summ, _contract("bf16"))
+    assert r["status"] == "pass", r
+    assert "H4'" in r["detail"]
+    # The SAME program under an exact contract: the bf16->f32 convert
+    # is an undeclared widening — original H4 still trips.
+    r = prove.check_h4(summ, _contract("f32"))
+    assert r["status"] == "fail"
+    assert "bf16->f32" in r["detail"]
+
+
+def test_h4_prime_requires_reduced_collective_operands():
+    from arrow_matrix_tpu.analysis import prove
+
+    summ = prove.summarize_hlo(_BF16_STEP_F32_COLLECTIVE)
+    r = prove.check_h4(summ, _contract("bf16"))
+    assert r["status"] == "fail"
+    assert "never earned" in r["detail"]
+
+
+def test_contract_ideal_bytes_scale_with_carriage_dtype():
+    """The executor contract's ideal band halves at bf16 by default
+    (itemsize resolves to the carried dtype), and the explicit
+    itemsize override still wins."""
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.parallel import make_mesh
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    a = barabasi_albert(N, 3, seed=SEED)
+    levels = arrow_decomposition(a, WIDTH, max_levels=4,
+                                 block_diagonal=True, seed=SEED)
+    mesh = make_mesh((4,), ("blocks",))
+    f32 = SellMultiLevel(levels, WIDTH, mesh, routing="a2a")
+    bf16 = SellMultiLevel(levels, WIDTH, mesh, routing="a2a",
+                          feature_dtype="bf16")
+    cf, cb = f32.collective_contract(K), bf16.collective_contract(K)
+    assert cf.dtype == "f32" and cb.dtype == "bf16"
+    assert cf.step_bytes == 2 * cb.step_bytes > 0
+    assert bf16.collective_contract(K, itemsize=4).step_bytes \
+        == cf.step_bytes

@@ -335,7 +335,7 @@ def test_chaos_gate_fast_scenarios(tmp_path):
     assert problems == []
     assert scenarios == ["nan", "hang", "corrupt", "serve_hang",
                          "serve_corrupt", "serve_overflow", "serve_hbm",
-                         "slo_burn_degrade"]
+                         "slo_burn_degrade", "serve_classes"]
 
 
 @pytest.mark.slow

@@ -283,7 +283,8 @@ def test_repeated_faults_degrade_then_complete(factory):
     tenant = s["tenants"][trace[0].tenant]
     assert tenant["rung"] == 1
     assert tenant["config"] == {"kernel": "xla", "repl": 1,
-                                "overlap_slabs": 1}
+                                "overlap_slabs": 1,
+                                "feature_dtype": None}
     assert tenant["degradations"]
     assert tickets[0].result.tobytes() == ref[0].result.tobytes()
     assert tickets[0].attempts == 2

@@ -1,0 +1,270 @@
+"""graft-classes round-7 bench: f32 vs bf16 vs int8 carriage at scale.
+
+The class benchmark behind BENCH_r07.json: one Barabasi-Albert
+operator at the r06 scale point (n=2^20, width=2048), decomposed and
+folded once per carriage dtype — f32 (the exact class), bf16 and int8
+(the approx classes) — timing iter_ms and measuring each class's final
+relative-Frobenius drift against the f32 run.  A second, trace-time
+section accounts the a2a exchange bytes of the mesh executor
+(``SellMultiLevel`` over forced host devices) at f32 vs bf16 on a
+committed bench_cache structure: the measured byte-reduction number of
+the graft-classes PR (the issue's acceptance bar is >= 1.8x at the
+same (structure, k, c)).  The lowered HLO module is the byte source —
+it is dtype-honest, where the CPU backend's compiled module legalizes
+bf16 collectives back to f32 (obs/comm docstring).
+
+Appends ONE ``kind="bench"`` ledger record whose parsed payload keeps
+the r02–r06 vocabulary (metric / value / unit / vs_baseline / config /
+platform / device_kind) and adds the per-class sections;
+``BENCH_r07.json`` is then ``graft_ledger export --round 7``, never
+hand-written.
+
+Usage: python tools/class_bench.py [--n 1048576] [--width 2048] ...
+Prints ONE JSON line (the parsed payload) as its last stdout line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from arrow_matrix_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+#: Carriage dtypes benched, in class order (None = f32 exact).
+CLASS_DTYPES = (("f32", None), ("bf16", "bf16"), ("int8", "int8"))
+
+
+def _carriage_bytes(x) -> int:
+    """On-device bytes of one carried feature state — a single array
+    for f32/bf16, the (q, scale) pair for int8."""
+    if isinstance(x, tuple):
+        return sum(int(part.size) * part.dtype.itemsize for part in x)
+    return int(x.size) * x.dtype.itemsize
+
+
+def bench_fold_classes(levels, width: int, *, k: int, iterations: int,
+                       seed: int) -> dict:
+    """iter_ms + final drift per carriage dtype on the fold executor
+    (single chip — the serving path)."""
+    import jax
+    import numpy as np
+
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    rng = np.random.default_rng(seed)
+    out: dict = {}
+    golden = None
+    x0_host = None
+    for name, fd in CLASS_DTYPES:
+        t0 = time.perf_counter()
+        multi = MultiLevelArrow(levels, width, mesh=None, fmt="fold",
+                                feature_dtype=fd)
+        build_s = time.perf_counter() - t0
+        if x0_host is None:   # every dtype iterates the same input
+            x0_host = rng.standard_normal(
+                (multi.n, k)).astype(np.float32)
+        x = multi.set_features(x0_host)
+        x = jax.block_until_ready(multi.step(x))   # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            x = multi.step(x)
+        jax.block_until_ready(x)
+        iter_ms = (time.perf_counter() - t0) / iterations * 1e3
+        got = multi.gather_result(x)
+        if golden is None:
+            golden = got.astype(np.float64)
+            rel = 0.0
+        else:
+            d = got.astype(np.float64) - golden
+            rel = float(np.linalg.norm(d) / np.linalg.norm(golden))
+        out[name] = {
+            "iter_ms": round(iter_ms, 3),
+            "build_s": round(build_s, 2),
+            "carriage_bytes": _carriage_bytes(x),
+            "rel_frobenius_vs_f32": rel,
+        }
+        del multi, x, got
+    return out
+
+
+def bench_exchange_bytes(base: str, *, k: int, n_dev: int,
+                         exchange_width=None) -> dict:
+    """Trace-time a2a exchange bytes of the mesh executor at f32 vs
+    bf16 over one committed structure — same (structure, k, c), only
+    the carriage dtype moves."""
+    import numpy as np
+
+    from arrow_matrix_tpu.obs.comm import (
+        account_collectives,
+        ideal_bytes_for,
+    )
+    from arrow_matrix_tpu.parallel.mesh import make_mesh
+    from arrow_matrix_tpu.parallel.sell_slim import SellMultiLevel
+    from arrow_matrix_tpu.tune.search import load_levels_from_source
+
+    source = {"kind": "dir", "base": base}
+    if exchange_width:
+        source["width"] = int(exchange_width)
+    levels, width = load_levels_from_source(source)
+    mesh = make_mesh((n_dev,), ("blocks",))
+    rng = np.random.default_rng(0)
+    x_host = None
+
+    out: dict = {"source": source, "k": k, "n_dev": n_dev, "repl": 1}
+    for name, fd in (("f32", None), ("bf16", "bf16")):
+        sm = SellMultiLevel(levels, width, mesh, routing="a2a",
+                            feature_dtype=fd)
+        if x_host is None:
+            x_host = rng.standard_normal((sm.n, k)).astype(np.float32)
+        xt = sm.set_features(x_host)
+        itemsize = 2 if fd == "bf16" else 4
+        rep = account_collectives(
+            f"sell_a2a_{name}", sm.step_fn, xt, *sm.step_operands(),
+            ideal_bytes=ideal_bytes_for(sm, k, itemsize=itemsize),
+            mode="lowered", overlap_slabs=sm.overlap_slabs,
+            repl=sm.repl)
+        out[name] = {
+            "measured_bytes": rep["measured_bytes"],
+            "ideal_bytes": rep["ideal_bytes"],
+            "ratio_vs_ideal": rep["ratio"],
+            "source": rep["source"],
+        }
+        del sm, xt
+    f32_b = out["f32"]["measured_bytes"]
+    bf16_b = out["bf16"]["measured_bytes"]
+    out["byte_reduction_f32_over_bf16"] = (
+        round(f32_b / bf16_b, 4) if bf16_b else None)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=1 << 20)
+    ap.add_argument("--ba_m", type=int, default=8)
+    ap.add_argument("--width", type=int, default=2048)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--max_levels", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--scipy_iters", type=int, default=2,
+                    help="iterations of the scipy per-iter baseline")
+    ap.add_argument("--exchange_base", default=os.path.join(
+        REPO, "bench_cache", "ba_16384_8_w512_s7_L12"),
+        help="committed graphio artifact base for the a2a byte "
+             "accounting")
+    ap.add_argument("--exchange_width", type=int, default=512)
+    ap.add_argument("--exchange_k", type=int, default=16)
+    ap.add_argument("--devices", type=int, default=4)
+    ap.add_argument("--ledger-dir", default=None)
+    ap.add_argument("--no-ledger", action="store_true")
+    args = ap.parse_args(argv)
+
+    # Virtual host devices for the mesh section; must precede any
+    # backend initialization.
+    force_cpu_devices(args.devices)
+    import jax
+    import numpy as np
+
+    from arrow_matrix_tpu.decomposition import arrow_decomposition
+    from arrow_matrix_tpu.utils import barabasi_albert
+
+    t0 = time.perf_counter()
+    a = barabasi_albert(args.n, args.ba_m, seed=args.seed)
+    gen_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    levels = arrow_decomposition(a, args.width,
+                                 max_levels=args.max_levels,
+                                 block_diagonal=True, seed=args.seed)
+    decompose_s = time.perf_counter() - t0
+    print(f"[class_bench] graph {gen_s:.1f}s decompose "
+          f"{decompose_s:.1f}s levels={len(levels)} "
+          f"nnz={a.nnz}", flush=True)
+
+    # scipy per-iteration baseline (the r02-r06 vs_baseline anchor).
+    acsr = a.tocsr()
+    x = np.random.default_rng(args.seed).standard_normal(
+        (args.n, args.k)).astype(np.float32)
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(args.scipy_iters):
+        y = acsr @ y
+    scipy_ms = (time.perf_counter() - t0) / args.scipy_iters * 1e3
+    del y
+    print(f"[class_bench] scipy {scipy_ms:.1f} ms/iter", flush=True)
+
+    classes = bench_fold_classes(levels, args.width, k=args.k,
+                                 iterations=args.iterations,
+                                 seed=args.seed)
+    for name, rec in classes.items():
+        print(f"[class_bench] {name}: {rec['iter_ms']} ms/iter "
+              f"carriage={rec['carriage_bytes']} rel_frob="
+              f"{rec['rel_frobenius_vs_f32']:.3e}", flush=True)
+    del a, acsr, levels, x
+
+    exchange = bench_exchange_bytes(args.exchange_base,
+                                    k=args.exchange_k,
+                                    n_dev=args.devices,
+                                    exchange_width=args.exchange_width)
+    print(f"[class_bench] exchange f32/bf16 = "
+          f"{exchange['byte_reduction_f32_over_bf16']}x", flush=True)
+
+    value = classes["f32"]["iter_ms"]
+    dev = jax.devices()[0]
+    parsed = {
+        "metric": "spmm_iter_ms",
+        "value": value,
+        "unit": "ms",
+        "vs_baseline": round(scipy_ms / value, 3) if value else None,
+        "scipy_cpu_ms": round(scipy_ms, 3),
+        "platform": jax.default_backend(),
+        "device_kind": "host" if jax.default_backend() == "cpu"
+        else getattr(dev, "device_kind", dev.platform),
+        "config": {
+            "n": args.n, "ba_neighbors": args.ba_m,
+            "width": args.width, "features": args.k,
+            "iterations": args.iterations, "levels": args.max_levels,
+            "fmts": ["fold"], "seed": args.seed,
+            "decompose_s": round(decompose_s, 2),
+            "build_s": classes["f32"]["build_s"],
+        },
+        # graft-classes: the round's reason to exist — one fold
+        # timing + drift row per carriage class, and the mesh a2a
+        # byte accounting at f32 vs bf16.
+        "classes": classes,
+        "exchange_bytes": exchange,
+        # Host-backend round: no on-chip capture attempted (the class
+        # comparison is dtype-relative, not an absolute-speed claim).
+        "degraded": True,
+        "backend_probe_class": "not-attempted",
+    }
+
+    if not args.no_ledger:
+        from arrow_matrix_tpu.ledger import store
+
+        rec = store.record(
+            "bench",
+            store.bench_metric(parsed["metric"], parsed["config"]),
+            parsed["value"], directory=args.ledger_dir,
+            unit=parsed["unit"], platform=parsed["platform"],
+            device_kind=parsed["device_kind"],
+            knobs={"config": parsed["config"],
+                   "classes": sorted(classes)},
+            payload={"parsed": parsed,
+                     "cmd": "python tools/class_bench.py",
+                     "rc": 0})
+        if rec is not None:
+            print(f"[class_bench] ledger {rec['record_id']}",
+                  flush=True)
+
+    print(json.dumps(parsed, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,30 @@ def serve_problems(summary: dict) -> list:
     if not summary.get("per_tenant"):
         problems.append("serve: SLO report lacks the per-tenant "
                         "breakdown")
+    # graft-classes: the report must band exact and approx separately
+    # — per-class admission/completion counts plus latency quantiles
+    # keyed by the class actually served — and carry the loud
+    # fallback counter and the certificate registry it admitted
+    # against.
+    per_class = summary.get("per_class")
+    if not per_class:
+        problems.append("serve: SLO report lacks the per-class "
+                        "breakdown")
+    else:
+        for cls in ("exact", "approx"):
+            rec = per_class.get(cls)
+            if rec is None:
+                problems.append(f"serve: per_class lacks the {cls} "
+                                f"class")
+            elif not {"completed", "latency_ms"} <= set(rec):
+                problems.append(f"serve: per_class[{cls}] lacks "
+                                f"completed/latency_ms")
+    if summary.get("class_fallback") is None:
+        problems.append("serve: SLO report lacks the class_fallback "
+                        "counter")
+    if summary.get("certificates") is None:
+        problems.append("serve: SLO report lacks the certificates "
+                        "section")
     if summary.get("completed", 0) < 1:
         problems.append("serve: smoke serve completed no requests")
     run_dir = summary.get("_run_dir")
@@ -154,6 +178,9 @@ def pulse_problems(summary: dict) -> list:
                             f"fields {missing}")
             break
     totals = pt.get("totals") or {}
+    if "per_class" not in totals:
+        problems.append("pulse: window totals lack the per-class "
+                        "breakdown (graft-classes)")
     if totals.get("completed") != summary.get("completed"):
         problems.append(
             f"pulse: window totals completed="

@@ -24,6 +24,13 @@ and the server process never needing an external restart:
                    request's carriage: admission rejects the rest
                    429-style with zero over-budget admissions
                    (verified against the memview price).
+  serve_classes  — graft-classes: approx (bf16) tenants under a real
+                   probed certificate serve reduced-precision carriage
+                   within the class tolerance of the f32 replay, exact
+                   tenants in the same run stay bit-identical, approx
+                   admission is priced below exact, and an
+                   uncertifiable request (deeper than the curve) falls
+                   back to exact with an explicit reason.
   serve_kill     — (subprocess; skipped under ``--fast``) SIGKILL
                    lands mid-request in a checkpointing graft_serve
                    CLI run; the rerun resumes in-flight requests from
@@ -365,6 +372,130 @@ def scenario_slo_burn_degrade(factory, n_rows):
     return problems
 
 
+def scenario_serve_classes(factory, n_rows, ref):
+    """graft-classes: approx (bf16) tenants under a REAL probed
+    certificate serve reduced-precision carriage — their results land
+    within the class tolerance of the f32 replay and are NOT the f32
+    bits (the cheaper carriage actually ran) — while exact tenants in
+    the same run stay bit-identical, approx admission is priced below
+    exact at the same k, an uncertifiable request (iterations beyond
+    the curve) falls back to exact LOUDLY, and the whole pass is
+    replay-deterministic."""
+    import dataclasses
+
+    import numpy as np
+
+    from arrow_matrix_tpu.classes import certificate_from_record
+    from arrow_matrix_tpu.ledger.probe import error_curves_for_source
+    from arrow_matrix_tpu.serve import run_trace
+
+    # The certificate comes from the probe, never from hand: the same
+    # (structure, seed) the gate's factory builds, probed at bf16.
+    source = {"kind": "ba", "n": N, "m": 3, "width": WIDTH,
+              "seed": SEED}
+    recs = error_curves_for_source(source, k=K, iterations=ITERS,
+                                   seed=SEED, dtypes=("bf16",))
+    cert = certificate_from_record(recs[0])
+    if cert is None or not cert.covers(ITERS):
+        return [f"serve_classes: the probed bf16 curve does not "
+                f"certify {ITERS} iterations "
+                f"(curve={None if cert is None else cert.rel_frobenius})"]
+
+    def classed(trace):
+        return [dataclasses.replace(r, traffic_class="approx")
+                if r.tenant in ("tenant0", "tenant1") else r
+                for r in trace]
+
+    def one_pass():
+        srv = _server(factory, certificates=[cert])
+        tickets = run_trace(srv, classed(_trace(n_rows)))
+        return srv, tickets
+
+    srv, tickets = one_pass()
+    problems = []
+    s = srv.summary()
+    if s["completed"] != REQUESTS:
+        problems.append(f"serve_classes: {s['completed']}/{REQUESTS} "
+                        f"requests completed")
+    approx = [t for t in tickets if t.request.traffic_class == "approx"]
+    exact = [t for t in tickets if t.request.traffic_class == "exact"]
+    if not approx or not exact:
+        return [f"serve_classes: trace split degenerate "
+                f"({len(approx)} approx / {len(exact)} exact)"]
+    for t in approx:
+        if t.served_class != "approx" or t.class_fallback is not None:
+            problems.append(
+                f"serve_classes: certified approx request "
+                f"{t.request.request_id} was not served approx "
+                f"(served={t.served_class}, "
+                f"fallback={t.class_fallback})")
+            continue
+        if t.certified_bound != cert.bound_at(ITERS):
+            problems.append(f"serve_classes: ticket "
+                            f"{t.request.request_id} carries bound "
+                            f"{t.certified_bound}, certificate says "
+                            f"{cert.bound_at(ITERS)}")
+        gold = np.frombuffer(ref[t.request.request_id],
+                             dtype=np.float32).reshape(t.result.shape)
+        d = t.result.astype(np.float64) - gold.astype(np.float64)
+        rel = float(np.linalg.norm(d) / np.linalg.norm(
+            gold.astype(np.float64)))
+        if rel > cert.tolerance:
+            problems.append(
+                f"serve_classes: approx result "
+                f"{t.request.request_id} drifted rel={rel:.3e} past "
+                f"the class tolerance {cert.tolerance:.0e}")
+        if rel == 0.0:
+            problems.append(
+                f"serve_classes: approx request "
+                f"{t.request.request_id} returned the f32 bits — the "
+                f"reduced carriage never ran")
+    for t in exact:
+        if t.request.request_id in ref and (
+                t.result is None
+                or t.result.tobytes() != ref[t.request.request_id]):
+            problems.append(f"serve_classes: exact request "
+                            f"{t.request.request_id} is not "
+                            f"bit-identical beside approx traffic")
+    # Class economics: approx reserved fewer bytes than exact at the
+    # same (structure, k) — the admitted-requests-per-GB lever.
+    if approx[0].predicted_bytes >= exact[0].predicted_bytes:
+        problems.append(
+            f"serve_classes: approx admission price "
+            f"{approx[0].predicted_bytes} B is not below exact "
+            f"{exact[0].predicted_bytes} B")
+    # Uncertifiable: iterations beyond the measured curve must fall
+    # back to exact with the explicit reason — never silent approx.
+    deep = dataclasses.replace(_trace(n_rows)[0], iterations=ITERS + 2,
+                               traffic_class="approx")
+    t_deep = srv.submit(deep)
+    srv.drain()
+    if t_deep.status != "completed" or t_deep.served_class != "exact" \
+            or t_deep.class_fallback != "curve_shorter_than_request":
+        problems.append(
+            f"serve_classes: beyond-curve approx request ended "
+            f"{t_deep.status}/{t_deep.served_class} with fallback "
+            f"{t_deep.class_fallback!r} (want completed/exact/"
+            f"curve_shorter_than_request)")
+    if s["classes"]["approx"]["completed"] != len(approx):
+        problems.append(
+            f"serve_classes: summary counts "
+            f"{s['classes']['approx']['completed']} approx "
+            f"completions, trace had {len(approx)}")
+    # Replay determinism — approx carriage included.
+    srv2, tickets2 = one_pass()
+    if [(t.status, t.served_class, t.class_fallback)
+            for t in tickets] != \
+            [(t.status, t.served_class, t.class_fallback)
+             for t in tickets2]:
+        problems.append("serve_classes: the class census is not "
+                        "replay-deterministic")
+    if _result_bytes(tickets) != _result_bytes(tickets2):
+        problems.append("serve_classes: approx results are not "
+                        "replay-deterministic")
+    return problems
+
+
 def scenario_serve_kill(workdir):
     """SIGKILL mid-request in a checkpointing graft_serve CLI run; the
     rerun resumes and the result set is bit-identical to a never-
@@ -446,12 +577,13 @@ def run_serve_scenarios(workdir, fast=False):
     ref = _result_bytes(ref_tickets)
     problems = []
     scenarios = ["serve_hang", "serve_corrupt", "serve_overflow",
-                 "serve_hbm", "slo_burn_degrade"]
+                 "serve_hbm", "slo_burn_degrade", "serve_classes"]
     problems += scenario_serve_hang(factory, n_rows, ref)
     problems += scenario_serve_corrupt(factory, n_rows, ref, workdir)
     problems += scenario_serve_overflow(factory, n_rows, ref)
     problems += scenario_serve_hbm(factory, n_rows, ref)
     problems += scenario_slo_burn_degrade(factory, n_rows)
+    problems += scenario_serve_classes(factory, n_rows, ref)
     if not fast:
         scenarios.append("serve_kill")
         problems += scenario_serve_kill(workdir)
