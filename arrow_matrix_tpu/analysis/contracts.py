@@ -62,6 +62,10 @@ class CollectiveContract:
     #: Non-empty exempts H3 with this rationale (e.g. 1.5D replication
     #: reduces broadcast rounds instead of slab width).
     h3_exempt: str = ""
+    #: graft-reshard: declared per-device per-stage send+recv scratch
+    #: ceiling for a staged exchange (0 = not a staged program; H7
+    #: skips).
+    scratch_budget_bytes: int = 0
     #: Free-text pricing notes surfaced in the manifest.
     notes: str = ""
 
@@ -75,7 +79,8 @@ class CollectiveContract:
         if not (0 <= lo <= hi):
             raise ValueError(f"ratio_band must be 0 <= lo <= hi, "
                              f"got {self.ratio_band}")
-        if self.step_bytes < 0 or self.reduce_bytes < 0:
+        if self.step_bytes < 0 or self.reduce_bytes < 0 \
+                or self.scratch_budget_bytes < 0:
             raise ValueError("byte counts must be non-negative")
 
     def expected_slab(self, k: int) -> int:

@@ -51,7 +51,7 @@ from typing import Dict, List, Optional, Tuple
 from arrow_matrix_tpu.analysis.contracts import CollectiveContract
 from arrow_matrix_tpu.utils import commstats
 
-RULE_IDS = ("H1", "H2", "H3", "H4", "H5", "H6")
+RULE_IDS = ("H1", "H2", "H3", "H4", "H5", "H6", "H7")
 
 DEFAULT_MANIFEST = os.path.join("bench_cache", "hlo_manifest.json")
 
@@ -349,6 +349,39 @@ def check_h5(donor_attrs: bool, compiled_scan: Optional[CollectiveSummary],
                 f"the compiled HLO (input_output_alias)")
 
 
+def check_h7(stage_summaries: Optional[List[CollectiveSummary]],
+             contract: CollectiveContract) -> dict:
+    """graft-reshard's bounded-scratch law, statically: every stage of
+    a staged exchange keeps its per-device send+recv collective
+    buffers within the declared scratch budget.  The HLO accountant
+    counts each all-to-all's per-device recv shape once; the send
+    payload is the same size, so a stage's scratch is 2x its counted
+    collective bytes.  An over-budget stage in the LOWERED HLO means
+    the plan compiler emitted exactly the memory cliff the staging
+    exists to remove."""
+    if contract.scratch_budget_bytes <= 0:
+        return _res("skip", "no staged scratch budget declared")
+    if not stage_summaries:
+        return _res("fail",
+                    "contract declares a scratch budget of "
+                    f"{contract.scratch_budget_bytes} B but no stage "
+                    f"programs were provided to the prover")
+    budget = contract.scratch_budget_bytes
+    over = []
+    peak = 0
+    for i, s in enumerate(stage_summaries):
+        scratch = 2 * s.total_bytes
+        peak = max(peak, scratch)
+        if scratch > budget:
+            over.append(f"stage {i} carries {scratch} B send+recv "
+                        f"> budget {budget} B")
+    if over:
+        return _res("fail", "; ".join(over))
+    return _res("pass",
+                f"{len(stage_summaries)} stage(s), peak per-device "
+                f"send+recv {peak} B <= budget {budget} B")
+
+
 def check_h6(compiled: CollectiveSummary,
              contract: CollectiveContract) -> dict:
     """No layout-thrash copy/transpose ops in the hot loop."""
@@ -594,6 +627,75 @@ def _entries(n: int, width: int, k: int, n_dev: int):
         "scan": (mfi._scan_steps_donated, args, {"n": 2}),
     })
 
+    # -- graft-reshard staged redistribution (H7) ----------------------
+    # Two (src, dst) layout pairs, including a repl c change: the plan
+    # compiler's bounded-scratch promise, proved from each stage's
+    # lowered all-to-all buffers.  The one-shot route is the entry's
+    # "step" (H1/H2 price its full exchange); the staged sub-routes are
+    # the "stages" H7 audits against the declared budget.
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from arrow_matrix_tpu.parallel import routing as routing_mod
+    from arrow_matrix_tpu.parallel.mesh import put_global
+    from arrow_matrix_tpu.parallel.reshard import (
+        Layout,
+        plan_route_table,
+        redistribution_plan,
+    )
+
+    reshard_budget = 2048
+    rng = np.random.default_rng(13)
+    pairs = [
+        ("reshard[shuffle,d4]",
+         Layout(n, n_dev=n_dev, tag="prove_src"),
+         Layout(n, n_dev=n_dev, tag="prove_dst"),
+         rng.permutation(n).astype(np.int64)),
+        ("reshard[repl1to2,d4]",
+         Layout(n, n_dev=n_dev, repl=1, tag="prove_src"),
+         Layout(n, n_dev=n_dev, repl=2, tag="prove_dst"),
+         None),
+    ]
+    mesh_r = make_mesh((n_dev,), ("blocks",), devices=devs)
+    x_r = put_global(x_host.astype(np.float32),
+                     NamedSharding(mesh_r, PartitionSpec("blocks")))
+
+    def _route_fn(rt):
+        return jax.jit(lambda xx: routing_mod.routed_take(
+            xx, rt, mesh_r, "blocks"))
+
+    for rname, src_lay, dst_lay, perm in pairs:
+        plan = redistribution_plan(src_lay, dst_lay, reshard_budget,
+                                   k=k, perm_map=perm)
+        tbl, mask = plan_route_table(plan)
+        route = routing_mod.build_route(
+            tbl, n_dev, src_total=src_lay.stored_rows, pad_mask=mask)
+        sroute = routing_mod.split_route_stages(route, k,
+                                                reshard_budget)
+        contract = CollectiveContract(
+            algorithm=rname,
+            step_bytes=route.device_bytes_per_exchange(k, 4),
+            reduce_bytes=0, repl=1, overlap_slabs=1, dtype="f32",
+            lowered_kinds=("all-to-all",),
+            compiled_kinds=("all-to-all",),
+            ratio_band=(0.99, 1.01),
+            scratch_budget_bytes=reshard_budget,
+            h3_exempt="redistribution carries full-k rows, not "
+                      "replica slabs",
+            notes=f"staged (src={src_lay.total_rows}x{src_lay.repl}"
+                  f"c -> dst={dst_lay.total_rows}x{dst_lay.repl}c on "
+                  f"{n_dev} devices): plan {plan.n_stages} host "
+                  f"stage(s), route {sroute.n_stages} device "
+                  f"stage(s)")
+        yield (rname, contract, {
+            "step": (_route_fn(routing_mod.shard_route(
+                route, mesh_r, "blocks")), (x_r,), {}),
+            "stages": [
+                (_route_fn(routing_mod.shard_route(st, mesh_r,
+                                                   "blocks")),
+                 (x_r,), {})
+                for st in sroute.stages],
+        })
+
 
 def _auto_bytes(lowered: CollectiveSummary,
                 compiled: CollectiveSummary) -> Tuple[int, str]:
@@ -634,6 +736,14 @@ def prove_entry(name: str, contract: CollectiveContract,
         scan_compiled = summarize_hlo(s_low.compile().as_text())
         hot = scan_compiled
 
+    stage_summaries = None
+    if "stages" in programs:
+        stage_summaries = []
+        for g_fn, g_args, g_kwargs in programs["stages"]:
+            g_low = g_fn.lower(*g_args, **g_kwargs)
+            stage_summaries.append(
+                summarize_hlo(g_low.as_text(dialect="hlo")))
+
     measured, source = _auto_bytes(lowered, compiled)
     rules = {
         "H1": check_h1(lowered, compiled, contract),
@@ -642,6 +752,7 @@ def prove_entry(name: str, contract: CollectiveContract,
         "H4": check_h4(lowered, contract),
         "H5": check_h5(donor_attrs, scan_compiled, contract),
         "H6": check_h6(hot, contract),
+        "H7": check_h7(stage_summaries, contract),
     }
     return {
         "entry": name,
@@ -660,6 +771,9 @@ def prove_entry(name: str, contract: CollectiveContract,
             "hot_loop_transposes": hot.while_transposes,
             "aliased_params": (list(scan_compiled.aliased_params)
                                if scan_compiled is not None else None),
+            "stage_scratch_bytes": (
+                [2 * s.total_bytes for s in stage_summaries]
+                if stage_summaries is not None else None),
         },
         "rules": rules,
         "ok": all(r["status"] in ("pass", "skip")

@@ -496,6 +496,77 @@ def probe_fleet() -> tuple[bool, str]:
                   "matrix")
 
 
+def probe_reshard() -> tuple[bool, str]:
+    """graft-reshard round-trip: seed one mid-flight checkpoint on a
+    2-device layout, grow the server onto 4 devices (the checkpoint
+    replayed through a staged redistribution plan), and require the
+    request to resume from the migrated checkpoint and complete — the
+    kill-mid-migration contract in miniature, minus the kill
+    (tools/reshard_gate.py runs the full armed version).  Bounded
+    subprocess, as for the other probes."""
+    code = (
+        "import os, sys, tempfile; sys.argv=[]; "
+        "from arrow_matrix_tpu.utils.platform import "
+        "force_cpu_devices; force_cpu_devices(4); "
+        "import jax; import numpy as np; "
+        "from arrow_matrix_tpu.parallel.mesh import make_mesh; "
+        "from arrow_matrix_tpu.serve.loadgen import "
+        "ba_executor_factory, synthetic_trace; "
+        "from arrow_matrix_tpu.serve.scheduler import "
+        "ArrowServer, ExecConfig; "
+        "from arrow_matrix_tpu.utils.checkpoint import save_state; "
+        "\n"
+        "d = tempfile.mkdtemp(prefix='reshard_probe_')\n"
+        "devs = jax.devices()\n"
+        "m2 = make_mesh((2,), ('blocks',), devices=np.asarray(devs[:2]))\n"
+        "m4 = make_mesh((4,), ('blocks',), devices=np.asarray(devs))\n"
+        "fac2, n_rows = ba_executor_factory(96, 16, 3, fmt='auto', "
+        "mesh=m2)\n"
+        "fac4, _ = ba_executor_factory(96, 16, 3, fmt='auto', mesh=m4)\n"
+        "req = synthetic_trace(n_rows, tenants=1, requests=1, k=2, "
+        "iterations=2, seed=7)[0]\n"
+        "ex2 = fac2(ExecConfig())\n"
+        "x = ex2.step(ex2.set_features(req.x))\n"
+        "save_state(os.path.join(d, 'ck_' + req.request_id), "
+        "np.asarray(x), 1, layout='serve/' + req.request_id "
+        "+ '/k2/it2')\n"
+        "srv = ArrowServer(fac2, ExecConfig(), name='probe', "
+        "checkpoint_dir=d, checkpoint_every=1, max_batch_k=0, "
+        "grow_factory=fac4, reshard_budget_bytes=1024)\n"
+        "p = []\n"
+        "if not srv.grow(reason='probe'):\n"
+        "    p.append('grow refused')\n"
+        "elif srv.checkpoints_resharded != 1:\n"
+        "    p.append('expected 1 resharded checkpoint, got '\n"
+        "             + str(srv.checkpoints_resharded))\n"
+        "t = srv.submit(req)\n"
+        "srv.drain()\n"
+        "if t.result is None:\n"
+        "    p.append('migrated request did not complete: '\n"
+        "             + repr((t.status, t.error)))\n"
+        "elif t.resumed_step != 1:\n"
+        "    p.append('request recomputed instead of resuming the '\n"
+        "             'migrated checkpoint (resumed_step='\n"
+        "             + repr(t.resumed_step) + ')')\n"
+        "print('RESHARD ok' if not p else 'RESHARD FAIL: ' + str(p[0]))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("RESHARD")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "RESHARD ok":
+        return False, lines[-1][:120]
+    return True, ("2-dev -> 4-dev grow migrated a live checkpoint "
+                  "through a staged plan and resumed it — "
+                  "tools/reshard_gate.py runs the armed version")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -553,7 +624,7 @@ def main(argv=None) -> int:
     ok &= _check("graft-lint (static analysis, R1-R9)", lint_ok, detail)
 
     prove_ok, detail = probe_prove()
-    ok &= _check("graft-prove (HLO collective contracts, H1-H6)",
+    ok &= _check("graft-prove (HLO collective contracts, H1-H7)",
                  prove_ok, detail)
 
     obs_ok, detail = probe_obs()
@@ -582,6 +653,10 @@ def main(argv=None) -> int:
     fleet_ok, detail = probe_fleet()
     ok &= _check("graft-fleet (kill one of 2 workers + requeue)",
                  fleet_ok, detail)
+
+    reshard_ok, detail = probe_reshard()
+    ok &= _check("graft-reshard (grow-migration round trip)",
+                 reshard_ok, detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

@@ -145,7 +145,150 @@ def run_fleet(args) -> dict:
     return report
 
 
+def build_migrate_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graft_fleet migrate",
+        description="Rebalance one tenant between fleet workers via "
+                    "staged checkpoint handoff on the shared "
+                    "checkpoint dir (FleetRouter.migrate).")
+    p.add_argument("--run_dir", required=True)
+    p.add_argument("--tenant", default="tenant1",
+                   help="synthetic_trace tenant id to rebalance "
+                        "(tenant1 owns phase-1 requests at the "
+                        "default trace_seed, so its checkpoints are "
+                        "on the shared dir when the handoff runs)")
+    p.add_argument("--to_worker", default=None,
+                   help="destination worker id (default: the ring's "
+                        "next live candidate)")
+    p.add_argument("--dry-run", dest="dry_run", action="store_true",
+                   help="print the staged handoff plan and per-stage "
+                        "bytes without rewriting checkpoints or "
+                        "moving the tenant")
+    p.add_argument("--scratch_budget_kb", type=float, default=64.0,
+                   help="per-endpoint per-stage handoff scratch "
+                        "budget")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--vertices", type=int, default=128)
+    p.add_argument("--width", type=int, default=16)
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--fmt", default="fold")
+    p.add_argument("--tenants", type=int, default=2)
+    p.add_argument("--requests", type=int, default=4,
+                   help="requests per phase (pre- and post-migration)")
+    p.add_argument("--iterations", type=int, default=4)
+    p.add_argument("--k", type=int, default=2)
+    p.add_argument("--trace_seed", type=int, default=5)
+    p.add_argument("--submit_timeout_s", type=float, default=300.0)
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def run_migrate(args) -> dict:
+    """The tenant-rebalance path end to end: phase 1 routes requests
+    (writing per-request checkpoints onto the shared dir), the router
+    migrates the tenant — staged handoff plans over those checkpoints,
+    then a placement pin — and phase 2 proves every subsequent request
+    of that tenant lands on the destination worker.  ``--dry-run``
+    stops after printing the plans: nothing is rewritten or repinned.
+    """
+    from arrow_matrix_tpu.fleet.router import FleetRouter
+    from arrow_matrix_tpu.serve.loadgen import synthetic_trace
+    from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+
+    os.makedirs(args.run_dir, exist_ok=True)
+    router = FleetRouter(
+        spawn=args.workers, vertices=args.vertices, width=args.width,
+        seed=args.seed, fmt=args.fmt,
+        checkpoint_dir=os.path.join(args.run_dir, "checkpoints"),
+        run_dir=args.run_dir,
+        submit_timeout_s=args.submit_timeout_s,
+        verbose=args.verbose)
+    try:
+        trace = synthetic_trace(
+            router.n_rows, tenants=args.tenants,
+            requests=2 * args.requests, k=args.k,
+            iterations=args.iterations, seed=args.trace_seed)
+        phase1, phase2 = trace[:args.requests], trace[args.requests:]
+        t1 = [router.submit(r) for r in phase1]
+        router.drain(timeout_s=args.submit_timeout_s)
+
+        migration = router.migrate(
+            args.tenant, args.to_worker,
+            scratch_budget_bytes=int(args.scratch_budget_kb * 1024),
+            dry_run=args.dry_run)
+        for h in migration["checkpoints"]:
+            print(h["plan"], flush=True)
+        if not migration["checkpoints"]:
+            print(f"[graft-fleet] tenant {args.tenant} has no "
+                  f"checkpoints on the shared dir (phase 1 routed "
+                  f"none of its requests?)", flush=True)
+
+        t2 = []
+        if not args.dry_run:
+            t2 = [router.submit(r) for r in phase2]
+            router.drain(timeout_s=args.submit_timeout_s)
+        tickets = t1 + t2
+        summary = router.fleet_summary()
+    finally:
+        router.shutdown()
+
+    post = [t for t in t2 if t.request.tenant == args.tenant]
+    on_dst = [t for t in post
+              if getattr(t, "worker_id", None)
+              == migration["to_worker"]]
+    report = {
+        "migration": migration,
+        "phase1_completed": sum(t.status == rq.COMPLETED for t in t1),
+        "phase2_completed": sum(t.status == rq.COMPLETED for t in t2),
+        "post_migration_tenant_requests": len(post),
+        "post_migration_on_destination": len(on_dst),
+        "requests": len(tickets),
+        "completed": summary["completed"],
+        "failed": summary["failed"],
+        "shed": summary["shed"],
+        "rejected": summary["rejected"],
+        "migrations": summary["migrations"],
+        "tenant_pins": summary["tenant_pins"],
+        "run_dir": args.run_dir,
+    }
+    atomic_write_json(os.path.join(args.run_dir,
+                                   "migrate_report.json"),
+                      report, indent=2, sort_keys=True)
+    return report
+
+
+def main_migrate(argv=None) -> int:
+    args = build_migrate_parser().parse_args(argv)
+    report = run_migrate(args)
+    verdict = {key: report[key] for key in
+               ("phase1_completed", "phase2_completed",
+                "post_migration_tenant_requests",
+                "post_migration_on_destination", "requests",
+                "completed", "failed", "shed", "rejected",
+                "migrations", "tenant_pins", "run_dir")}
+    verdict["migration"] = {
+        key: report["migration"][key] for key in
+        ("tenant", "from_worker", "to_worker", "dry_run",
+         "total_stages", "moved_bytes", "scratch_budget_bytes")}
+    verdict["migration"]["checkpoints"] = [
+        {key: h[key] for key in
+         ("checkpoint", "rows", "k", "n_stages", "stage_bytes",
+          "moved_bytes")}
+        for h in report["migration"]["checkpoints"]]
+    print(json.dumps(verdict, sort_keys=True), flush=True)
+    lost = (report["requests"] - report["completed"]
+            - report["failed"] - report["shed"] - report["rejected"])
+    strayed = (report["post_migration_tenant_requests"]
+               - report["post_migration_on_destination"])
+    if report["migration"]["dry_run"]:
+        strayed = 0
+    return 0 if (lost == 0 and strayed == 0) else 1
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "migrate":
+        return main_migrate(argv[1:])
     args = build_parser().parse_args(argv)
     report = run_fleet(args)
     verdict = {

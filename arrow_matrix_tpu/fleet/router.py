@@ -241,6 +241,7 @@ class FleetRouter:
         self.verbose = verbose
         self.run_dir = run_dir
         self.placement = placement
+        self.checkpoint_dir = checkpoint_dir
         self.submit_timeout_s = float(submit_timeout_s)
         self.health = health or HealthMonitor(timeout_s=5.0,
                                               max_failures=3)
@@ -251,8 +252,10 @@ class FleetRouter:
         self._threads: List[threading.Thread] = []
         self._pack_assignment: Dict[str, str] = {}
         self._pack_unplaced: set = set()
+        self._pins: Dict[str, str] = {}
         self._counts: Dict[str, int] = {}
         self.requeues = 0
+        self.migrations = 0
         self.started_s = time.perf_counter()
 
         self.workers: Dict[str, WorkerHandle] = {}
@@ -334,6 +337,11 @@ class FleetRouter:
     def _place(self, tenant: str) -> Optional[str]:
         with self._lock:
             dead = set(self._dead)
+            # A migrate() pin wins over ring and packing; a pin whose
+            # worker died falls through to normal re-homing.
+            pin = self._pins.get(tenant)
+            if pin is not None and pin not in dead:
+                return pin
             if self.placement == "pack":
                 wid = self._pack_assignment.get(tenant)
                 if wid is None or wid in dead:
@@ -493,6 +501,135 @@ class FleetRouter:
         with self._lock:
             return sorted(set(self.workers) - self._dead)
 
+    # -- tenant migration --------------------------------------------------
+
+    def migrate(self, tenant: str, to_worker: Optional[str] = None,
+                *, scratch_budget_bytes: int = 1 << 20,
+                dry_run: bool = False) -> dict:
+        """Rebalance ``tenant`` onto ``to_worker`` (default: the ring's
+        next live candidate) via checkpoint handoff on the shared
+        sha256-verified checkpoint dir.
+
+        Every checkpoint the tenant's requests have written is handed
+        off through a staged :func:`~arrow_matrix_tpu.parallel.reshard
+        .handoff_plan` — loaded (sha-verified), copied stage by stage
+        under the scratch budget (each stage crossing the
+        ``reshard.stage`` fault seam, so kill-mid-migration is a
+        testable scenario), and re-saved atomically under its original
+        layout tag.  A kill anywhere leaves the source checkpoint
+        intact; rerunning the migration lands bit-identical (pure row
+        copies).  Then the tenant is PINNED to ``to_worker`` — every
+        subsequent placement (new submits and requeues alike) lands
+        there, and the destination resumes the handed-off checkpoints
+        instead of recomputing.
+
+        ``dry_run`` builds and describes the staged plans (per-stage
+        bytes included) without rewriting any checkpoint or moving the
+        pin — the ``graft_fleet migrate --dry-run`` output.
+        """
+        from arrow_matrix_tpu.parallel.reshard import (
+            apply_plan_host,
+            handoff_plan,
+        )
+        from arrow_matrix_tpu.utils.checkpoint import (
+            checkpoint_layout_tag,
+            list_checkpoints,
+            load_state,
+            save_state,
+        )
+
+        import numpy as np
+
+        from_worker = self._place(tenant)
+        if from_worker is None:
+            raise ValueError(f"tenant {tenant!r} has no live "
+                             f"placement to migrate from")
+        if to_worker is None:
+            with self._lock:
+                exclude = set(self._dead) | {from_worker}
+            to_worker = self.ring.lookup(tenant, exclude=exclude)
+        if to_worker is None:
+            raise ValueError(f"no live destination worker for tenant "
+                             f"{tenant!r} (fleet of "
+                             f"{len(self.workers)}, "
+                             f"{len(self._dead)} dead)")
+        if to_worker not in self.workers:
+            raise ValueError(f"unknown worker {to_worker!r}")
+        with self._lock:
+            if to_worker in self._dead:
+                raise ValueError(f"destination worker {to_worker!r} "
+                                 f"is dead")
+        if to_worker == from_worker:
+            raise ValueError(f"tenant {tenant!r} already lives on "
+                             f"{to_worker!r}")
+
+        with self._lock:
+            request_ids = sorted({
+                t.request.request_id for t in self._tickets
+                if t.request.tenant == tenant})
+        handoffs: List[dict] = []
+        total_stages = 0
+        if self.checkpoint_dir and request_ids:
+            want = {f"ck_{rid}" for rid in request_ids}
+            for stem in list_checkpoints(self.checkpoint_dir):
+                if os.path.basename(stem) not in want:
+                    continue
+                tag = checkpoint_layout_tag(stem)
+                try:
+                    got = load_state(stem, layout=tag)
+                except Exception as e:  # noqa: BLE001 — a corrupt
+                    # checkpoint must not strand the tenant; the
+                    # destination recomputes that request instead.
+                    flight.record("fleet", "migrate_checkpoint_skipped",
+                                  tenant=tenant, path=stem,
+                                  error=f"{type(e).__name__}: {e}")
+                    continue
+                if got is None:
+                    continue
+                x, step = got
+                x = np.asarray(x)
+                rows = int(x.shape[0])
+                k = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+                plan = handoff_plan(
+                    rows, k, scratch_budget_bytes,
+                    itemsize=int(x.dtype.itemsize),
+                    src_tag=from_worker, dst_tag=to_worker)
+                if not dry_run:
+                    y = apply_plan_host(plan, x)
+                    save_state(stem, y, step, layout=tag)
+                handoffs.append({
+                    "checkpoint": os.path.basename(stem),
+                    "rows": rows, "k": k, "step": int(step),
+                    "n_stages": plan.n_stages,
+                    "stage_bytes": [plan.stage_device_bytes(i)
+                                    for i in range(plan.n_stages)],
+                    "moved_bytes": plan.moved_bytes,
+                    "max_stage_scratch_bytes":
+                        plan.max_stage_scratch_bytes,
+                    "plan": plan.describe(),
+                })
+                total_stages += plan.n_stages
+
+        if not dry_run:
+            with self._lock:
+                self._pins[tenant] = to_worker
+                self.migrations += 1
+            flight.record("fleet", "tenant_migrated", tenant=tenant,
+                          from_worker=from_worker, to_worker=to_worker,
+                          checkpoints=len(handoffs),
+                          stages=total_stages)
+            print(f"[graft-fleet {self.name}] migrated tenant "
+                  f"{tenant}: {from_worker} -> {to_worker}, "
+                  f"{len(handoffs)} checkpoint(s) handed off through "
+                  f"{total_stages} staged plan step(s)", flush=True)
+        return {"tenant": tenant, "from_worker": from_worker,
+                "to_worker": to_worker, "dry_run": bool(dry_run),
+                "scratch_budget_bytes": int(scratch_budget_bytes),
+                "checkpoints": handoffs,
+                "total_stages": total_stages,
+                "moved_bytes": sum(h["moved_bytes"]
+                                   for h in handoffs)}
+
     # -- reporting ---------------------------------------------------------
 
     def fleet_summary(self) -> dict:
@@ -538,6 +675,8 @@ class FleetRouter:
             counts = dict(self._counts)
             deaths = [dict(d) for d in self._deaths]
             requeues = self.requeues
+            migrations = self.migrations
+            pins = dict(self._pins)
         wall = time.perf_counter() - self.started_s
         completed = counts.get("completed", 0)
         shed_reasons: Dict[str, int] = {}
@@ -563,6 +702,8 @@ class FleetRouter:
             "rejected": counts.get("rejected", 0),
             "shed_reasons": shed_reasons,
             "requeues": requeues,
+            "migrations": migrations,
+            "tenant_pins": pins,
             "wall_s": wall,
             "requests_per_s": (completed / wall) if wall > 0
             else None,

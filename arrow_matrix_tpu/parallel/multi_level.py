@@ -208,7 +208,9 @@ class MultiLevelArrow:
                  fold_align: Optional[int] = None,
                  overlap_slabs: int = 1, repl: int = 1,
                  plan=None, plan_k: Optional[int] = None,
-                 kernel_opts: Optional[dict] = None):
+                 kernel_opts: Optional[dict] = None,
+                 exchange_scratch_budget: int = 0,
+                 exchange_k: Optional[int] = None):
         """``routing`` selects the inter-level exchange lowering:
         "gather" leaves the permutation gathers to GSPMD (which may
         all-gather the whole feature array per exchange), "a2a" compiles
@@ -281,6 +283,23 @@ class MultiLevelArrow:
                 "— sp2cp.py:6-16); use 'auto'/'dense'/'ell' on a mesh")
         if routing == "a2a" and mesh is None:
             raise ValueError("routing='a2a' requires a mesh")
+        # graft-reshard consumer (b): a positive budget splits every
+        # a2a exchange into bounded-scratch stages
+        # (routing.split_route_stages) instead of one full-width
+        # all_to_all.  Stage sizing needs the feature width at build
+        # time — ``exchange_k`` (or the tune plan's ``plan_k``).
+        self.exchange_scratch_budget = int(exchange_scratch_budget)
+        self._exchange_k = exchange_k if exchange_k is not None else plan_k
+        if self.exchange_scratch_budget > 0:
+            if routing != "a2a":
+                raise ValueError(
+                    "exchange_scratch_budget bounds the explicit a2a "
+                    "exchange; routing='gather' leaves the exchange to "
+                    "GSPMD where no budget can be enforced")
+            if self._exchange_k is None:
+                raise ValueError(
+                    "exchange_scratch_budget needs the feature width to "
+                    "size stages — pass exchange_k (or plan_k)")
         # Wide layout: per-level SpMM on a (2, t) mesh with disjoint
         # row-arm / column-arm device groups (the reference composes
         # the wide ArrowMPI into ArrowDecompositionMPI the same way,
@@ -529,13 +548,24 @@ class MultiLevelArrow:
                 from arrow_matrix_tpu.parallel.routing import (
                     build_route,
                     shard_route,
+                    split_route_stages,
                 )
 
                 n_dev = mesh.shape[axis]
-                self.fwd = [shard_route(build_route(t, n_dev), mesh, axis)
-                            for t in fwd]
-                self.bwd = [shard_route(build_route(t, n_dev), mesh, axis)
-                            for t in bwd]
+
+                def compile_route(t):
+                    r = build_route(t, n_dev)
+                    if self.exchange_scratch_budget > 0:
+                        r = split_route_stages(
+                            r, int(self._exchange_k),
+                            self.exchange_scratch_budget,
+                            itemsize=np.dtype(
+                                self.feature_dtype
+                                or np.float32).itemsize)
+                    return shard_route(r, mesh, axis)
+
+                self.fwd = [compile_route(t) for t in fwd]
+                self.bwd = [compile_route(t) for t in bwd]
             else:
                 # Routing tables replicated (they index global rows).
                 repl = NamedSharding(mesh, P())
@@ -1031,25 +1061,61 @@ class MultiLevelArrow:
                   "(rows, k) slices, so the ÷c slab law lives in the "
                   "SELL feature-major executors")
 
+    def exchange_scratch_bytes(self, k: int, itemsize: int = 4) -> int:
+        """Peak per-device send+recv scratch of ONE routing exchange at
+        feature width ``k`` — the a2a payload the carriage-only HBM
+        model used to miss (graft-reshard satellite): a one-shot
+        exchange holds both the padded send payload and the received
+        copy live; a :class:`~arrow_matrix_tpu.parallel.routing
+        .StagedRoute` bounds it to one stage's slice (<= the declared
+        budget).  Zero for routing='gather' (GSPMD owns the exchange —
+        its all-gather scratch is judged by obs/comm, not priced here)
+        and on a single chip / fmt='fold' (no exchange at all)."""
+        if getattr(self, "routing", "none") != "a2a" or not self.fwd:
+            return 0
+        return max(2 * r.device_bytes_per_exchange(k, itemsize)
+                   for r in list(self.fwd) + list(self.bwd))
+
     def predicted_hbm_bytes(self, k: int, itemsize: int = 4,
                             repl: int = 1) -> int:
         """Static per-shard HBM model for one step at feature width
         ``k``: this device's slice of every level's block stacks and
         route tables, plus the carried feature input and output
-        (total_rows / n_dev rows each).  obs/memview judges the
-        compiled executable against this.  ``repl`` is the 2.5D
-        planning multiplier (operator + carriage grow exactly ×c per
-        device at replication c on a mesh; the single-chip column
-        schedule is footprint-neutral but keeps the uniform ×c
-        planning convention)."""
+        (total_rows / n_dev rows each), plus the peak routing-exchange
+        scratch (``exchange_scratch_bytes`` — the a2a send+recv
+        payload; bounded by the declared budget when staged).
+        obs/memview judges the compiled executable against this.
+        ``repl`` is the 2.5D planning multiplier (operator + carriage
+        grow exactly ×c per device at replication c on a mesh; the
+        single-chip column schedule is footprint-neutral but keeps the
+        uniform ×c planning convention)."""
         from arrow_matrix_tpu.obs.memview import tree_device_bytes
 
         n_dev = self.mesh.shape[self.axis] if self.mesh is not None else 1
         ops_bytes = sum(b.device_nbytes() for b in self.blocks)
         ops_bytes += tree_device_bytes(self.fwd, self.bwd)
         base = (ops_bytes // n_dev
-                + 2 * (self.total_rows // n_dev) * k * itemsize)
+                + 2 * (self.total_rows // n_dev) * k * itemsize
+                + self.exchange_scratch_bytes(k, itemsize))
         return base * max(int(repl), 1)
+
+    def reshard_layout(self, repl: int = 1, tag_base: str = "multi_level"):
+        """This executor's carriage as a graft-reshard
+        :class:`~arrow_matrix_tpu.parallel.reshard.Layout`: padded rows
+        in level-0 order, sharded over the mesh's block axis.  ``repl``
+        is the replica-expanded view for planned 2.5D growth (the
+        single-chip fold column schedule carries ONE copy, so its
+        honest layout is always repl=1).  The carried row order is
+        ``self.perm0`` — redistribution_plan's ``perm_map`` between two
+        executors of the same problem is
+        ``inv_perm0_src[perm0_dst]`` masked to real rows."""
+        from arrow_matrix_tpu.parallel.reshard import Layout, layout_tag
+
+        n_dev = self.mesh.shape[self.axis] if self.mesh is not None else 1
+        lay = Layout(total_rows=int(self.total_rows), n_dev=int(n_dev),
+                     repl=max(int(repl), 1))
+        return Layout(total_rows=lay.total_rows, n_dev=lay.n_dev,
+                      repl=lay.repl, tag=layout_tag(tag_base, lay))
 
     def carriage_hbm_bytes(self, k: int, itemsize: int = 4,
                            repl: int = 1) -> int:

@@ -350,21 +350,33 @@ def shard_route(route: RouteTables, mesh: Mesh,
 
 def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
                 axis: str = "blocks",
-                feat_axis: Optional[str] = None) -> jax.Array:
+                feat_axis: Optional[str] = None,
+                init: Optional[jax.Array] = None) -> jax.Array:
     """``out[j] = x[table[j]]`` via the compiled route (jit-safe).
 
     ``x`` is (total, k) sharded on rows over ``axis`` (and optionally on
     columns over ``feat_axis``); the exchange is one fixed-shape
     all_to_all + local gather/scatter per device.
+
+    ``init`` seeds the output carriage instead of zeros: a staged
+    sub-exchange (graft-reshard) scatters its disjoint slice of rows
+    straight into the running accumulator — no per-stage full-size
+    zeros buffer and no add, so the staged path's peak temp stays one
+    accumulator plus ONE stage's bounded payload.
     """
     r_src, r_dst = route.rows_src, route.rows_dst
 
-    def local_fn(xl, local_src, local_dst, send_idx, recv_dst):
+    def local_fn(xl, accl, local_src, local_dst, send_idx, recv_dst):
         # Per-device operands (leading device axis stripped to size 1).
         xl = xl.reshape(r_src, -1)
         xe = jnp.concatenate(
             [xl, jnp.zeros((1, xl.shape[1]), xl.dtype)], axis=0)
-        out = jnp.zeros((r_dst + 1, xl.shape[1]), xl.dtype)
+        if accl is None:
+            out = jnp.zeros((r_dst + 1, xl.shape[1]), xl.dtype)
+        else:
+            out = jnp.concatenate(
+                [accl.reshape(r_dst, -1),
+                 jnp.zeros((1, xl.shape[1]), xl.dtype)], axis=0)
         # Rows that stay local.
         out = out.at[local_dst[0]].set(xe[local_src[0]])
         # Rows that cross devices: device p sends payload[d] to d and
@@ -379,12 +391,116 @@ def routed_take(x: jax.Array, route: RouteTables, mesh: Mesh,
 
     spec = P(axis)
     x_spec = P(axis, feat_axis) if feat_axis else spec
+    if init is None:
+        fn = shard_map(
+            lambda xl, a, b, c, d: local_fn(xl, None, a, b, c, d),
+            mesh=mesh, in_specs=(x_spec, spec, spec, spec, spec),
+            out_specs=x_spec, **shard_map_check_kwargs())
+        return fn(x, route.local_src, route.local_dst, route.send_idx,
+                  route.recv_dst)
     fn = shard_map(local_fn, mesh=mesh,
-                   in_specs=(x_spec, spec, spec, spec, spec),
+                   in_specs=(x_spec, x_spec, spec, spec, spec, spec),
                    out_specs=x_spec,
                    **shard_map_check_kwargs())
-    return fn(x, route.local_src, route.local_dst, route.send_idx,
-              route.recv_dst)
+    return fn(x, init, route.local_src, route.local_dst,
+              route.send_idx, route.recv_dst)
+
+
+@struct.dataclass
+class StagedRoute:
+    """A permutation exchange split into S bounded-scratch
+    sub-exchanges (graft-reshard consumer b): each stage is a valid
+    :class:`RouteTables` whose all_to_all payload (send + recv) fits
+    ``scratch_budget_bytes`` at feature width ``budget_k``.  Stage 0
+    carries the local gather; later stages have empty local tables and
+    a disjoint slice of the cross-device slots.  Every destination row
+    is written by exactly ONE stage (the exchange is a partial
+    permutation and unwritten rows stay zero), so the staged result is
+    the f32-exact SUM of the per-stage outputs — bit-identical to the
+    one-shot exchange."""
+
+    stages: tuple   # tuple[RouteTables, ...] (pytree)
+
+    rows_src: int = struct.field(pytree_node=False, default=0)
+    rows_dst: int = struct.field(pytree_node=False, default=0)
+    n_dev: int = struct.field(pytree_node=False, default=0)
+    scratch_budget_bytes: int = struct.field(pytree_node=False, default=0)
+    budget_k: int = struct.field(pytree_node=False, default=0)
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def device_bytes_per_exchange(self, k: int, itemsize: int = 4) -> int:
+        """Peak per-stage all_to_all payload bytes per device."""
+        return max((s.device_bytes_per_exchange(k, itemsize)
+                    for s in self.stages), default=0)
+
+
+def split_route_stages(route: RouteTables, k: int,
+                       scratch_budget_bytes: int,
+                       itemsize: int = 4) -> StagedRoute:
+    """Split one compiled route into bounded-scratch stages.
+
+    One stage's scratch is its send payload plus its received payload:
+    ``2 x n_dev x S_stage x k x itemsize`` per device.  Raises loudly
+    when the budget cannot carry even ONE slot per device pair — an
+    over-budget stage is never emitted (the H7 contract,
+    analysis/prove.py).  Slots are already padded per device pair, so
+    slicing the slot axis keeps send/recv sides aligned; dummy slots
+    stay dummy in whichever stage they land.
+    """
+    n_dev = route.n_dev
+    S = int(route.send_idx.shape[-1])
+    slot_bytes = 2 * n_dev * k * itemsize
+    s_stage = int(scratch_budget_bytes) // slot_bytes
+    if s_stage < 1:
+        raise ValueError(
+            f"scratch budget {scratch_budget_bytes} B cannot carry one "
+            f"exchange slot per device pair at k={k} (needs "
+            f"{slot_bytes} B: n_dev={n_dev} rows sent + received) — "
+            f"raise the budget or narrow k; refusing to emit an "
+            f"over-budget stage")
+
+    def sub(lo: int, hi: int, with_local: bool) -> RouteTables:
+        width = 0 if with_local else int(route.local_src.shape[-1])
+        return RouteTables(
+            local_src=route.local_src[:, width:],
+            local_dst=route.local_dst[:, width:],
+            send_idx=route.send_idx[:, :, lo:hi],
+            recv_dst=route.recv_dst[:, :, lo:hi],
+            rows_src=route.rows_src, rows_dst=route.rows_dst,
+            n_dev=n_dev)
+
+    bounds = list(range(0, max(S, 1), s_stage)) or [0]
+    stages = tuple(
+        sub(lo, min(lo + s_stage, S), with_local=(i == 0))
+        for i, lo in enumerate(bounds))
+    return StagedRoute(stages=stages, rows_src=route.rows_src,
+                       rows_dst=route.rows_dst, n_dev=n_dev,
+                       scratch_budget_bytes=int(scratch_budget_bytes),
+                       budget_k=int(k))
+
+
+def staged_routed_take(x: jax.Array, sroute: StagedRoute, mesh: Mesh,
+                       axis: str = "blocks",
+                       feat_axis: Optional[str] = None) -> jax.Array:
+    """Run a :class:`StagedRoute` as S sequential sub-exchanges.
+
+    Each destination row is written by exactly one stage, and later
+    stages scatter their disjoint rows straight into the running
+    accumulator (``init=``) — pure row copies, no arithmetic at all,
+    so the staged result is bit-identical to the one-shot
+    ``routed_take``.  ``optimization_barrier`` pins stage order so the
+    compiler cannot hoist all payloads live at once: peak collective
+    scratch stays one stage's send+recv (proven per stage by H7)."""
+    acc = routed_take(x, sroute.stages[0], mesh, axis,
+                      feat_axis=feat_axis)
+    for st in sroute.stages[1:]:
+        acc, x = jax.lax.optimization_barrier((acc, x))
+        acc = routed_take(x, st, mesh, axis, feat_axis=feat_axis,
+                          init=acc)
+    return acc
 
 
 def overlap_slices(k: int, overlap_slabs: int) -> list:
@@ -549,8 +665,19 @@ def routed_take_t(xt: jax.Array, route: RouteTables, mesh: Mesh,
 
 def take(x: jax.Array, table_or_route, mesh: Optional[Mesh] = None,
          axis: str = "blocks") -> jax.Array:
-    """Dispatch: RouteTables -> routed all_to_all exchange; plain index
+    """Dispatch: RouteTables -> routed all_to_all exchange; StagedRoute
+    -> bounded-scratch staged exchange (graft-reshard); plain index
     array -> jnp.take (GSPMD decides — may all-gather)."""
+    if isinstance(table_or_route, StagedRoute):
+        return staged_routed_take(x, table_or_route, mesh, axis)
     if isinstance(table_or_route, RouteTables):
         return routed_take(x, table_or_route, mesh, axis)
-    return jnp.take(x, table_or_route, axis=0)
+    out = jnp.take(x, table_or_route, axis=0)
+    if mesh is not None and x.ndim == 2 and len(mesh.axis_names) > 1:
+        # On a multi-axis mesh, jax 0.4.37's partitioner miscompiles the
+        # fused gather chain unless the output's spec pins *every* dim
+        # (row-only or UNCONSTRAINED specs still produce wrong rows).
+        feat = tuple(a for a in mesh.axis_names if a != axis)
+        out = jax.lax.with_sharding_constraint(
+            out, jax.sharding.NamedSharding(mesh, P(axis, feat)))
+    return out

@@ -92,6 +92,31 @@ class HBMAccountant:
         self._gauges()
         return True
 
+    def swap_resident(self, old_bytes: int, new_bytes: int) -> None:
+        """Atomically replace part of the permanent resident charge —
+        graft-reshard's grow direction retires the old operator as the
+        grown one lands.  Raises :class:`ServeCapacityError` (leaving
+        the ledger untouched) when the swap would overrun the budget:
+        both operators are briefly live during a migration, but the
+        steady state must fit."""
+        old_bytes = max(int(old_bytes), 0)
+        new_bytes = max(int(new_bytes), 0)
+        with self._lock:
+            grown = self.in_use_bytes - old_bytes + new_bytes
+            if grown > self.budget_bytes:
+                raise ServeCapacityError(
+                    f"grown resident operator needs {new_bytes} B "
+                    f"(replacing {old_bytes} B) but the HBM budget is "
+                    f"{self.budget_bytes} B (in use "
+                    f"{self.in_use_bytes} B) — refusing to grow past "
+                    f"the certificate")
+            self.resident_bytes = max(
+                self.resident_bytes - old_bytes, 0) + new_bytes
+            self.in_use_bytes = max(grown, 0)
+            self.peak_in_use_bytes = max(self.peak_in_use_bytes,
+                                         self.in_use_bytes)
+        self._gauges()
+
     def release(self, nbytes: int) -> None:
         nbytes = max(int(nbytes), 0)
         with self._lock:

@@ -51,6 +51,7 @@ from arrow_matrix_tpu.obs import flight
 from arrow_matrix_tpu.serve import request as rq
 from arrow_matrix_tpu.serve.admission import (
     HBMAccountant,
+    ServeCapacityError,
     request_price_bytes,
 )
 from arrow_matrix_tpu.utils.checkpoint import CheckpointIntegrityError
@@ -149,7 +150,11 @@ class ArrowServer:
                  certificates=None,
                  structure_hash: Optional[str] = None,
                  cert_ledger_dir: Optional[str] = None,
-                 approx_opt_in=()):
+                 approx_opt_in=(),
+                 grow_config: Optional[ExecConfig] = None,
+                 grow_factory: Optional[
+                     Callable[[ExecConfig], Any]] = None,
+                 reshard_budget_bytes: int = 1 << 20):
         # graft-tune pickup: a cached TunePlan (or its dict) becomes
         # the BASE ladder rung — admitted requests run the tuned
         # kernel/repl/overlap at zero search cost, and the degradation
@@ -240,6 +245,18 @@ class ArrowServer:
         self.faults_seen = 0
         self.recoveries = 0
         self.checkpoint_corruptions = 0
+        # graft-reshard grow direction: a declared grow target (config
+        # and/or a factory building the grown layout — e.g. more mesh
+        # blocks) note_slo_pressure can cut over to WITHOUT a cold
+        # restart, migrating per-request checkpoints through a staged
+        # redistribution plan whose per-stage scratch is bounded by
+        # ``reshard_budget_bytes``.
+        self.grow_config = grow_config
+        self.grow_factory = grow_factory
+        self.reshard_budget_bytes = int(reshard_budget_bytes)
+        self._grown: Optional[Tuple[Any, ExecConfig]] = None
+        self.grows = 0
+        self.checkpoints_resharded = 0
 
         base = self._build_executor(base_config)
         if hbm_budget_bytes is None:
@@ -602,6 +619,12 @@ class ArrowServer:
         """Build (or fetch) the executor for a rung, walking further
         down the ladder when a rung's build itself fails; returns
         ``(executor, actual_cfg)`` or ``(None, cfg)``."""
+        if self._grown is not None and cfg in (self.base_config,
+                                               self._grown[1]):
+            # Post-grow, base-rung traffic runs the grown layout (its
+            # checkpoints were migrated by grow()); degraded rungs and
+            # class-stamped configs keep their own executors.
+            return self._grown
         if cfg in self.ladder:
             rungs = list(self.ladder[self.ladder.index(cfg):])
         else:
@@ -904,11 +927,23 @@ class ArrowServer:
 
     def note_slo_pressure(self, reason: str,
                           tenants: Optional[List[str]] = None,
-                          score: Optional[int] = None) -> List[str]:
+                          score: Optional[int] = None,
+                          direction: str = "drop") -> List[str]:
         """Feed measured SLO pressure into the degradation ladder:
         each named tenant (default: every known tenant) takes
         ``score`` fault-score points (default: enough to force one
-        rung immediately).  Returns the tenants that degraded."""
+        rung immediately).  Returns the tenants that degraded.
+
+        ``direction="grow"`` (graft-reshard) spends pressure the other
+        way: instead of shedding features, cut the base rung over to
+        the declared grow target (``grow_config`` / ``grow_factory``)
+        via :meth:`grow` — returns ``["*"]`` when the cutover
+        happened."""
+        if direction == "grow":
+            return ["*"] if self.grow(reason=reason) else []
+        if direction != "drop":
+            raise ValueError(f"unknown pressure direction "
+                             f"{direction!r} (expected 'drop'/'grow')")
         degraded = []
         with self._lock:
             names = (list(tenants) if tenants is not None
@@ -918,6 +953,169 @@ class ArrowServer:
                 if self._degrade_tenant(tenant, pts, reason=reason):
                     degraded.append(tenant)
         return degraded
+
+    # -- graft-reshard: live elasticity (grow direction) -------------------
+
+    def grow(self, reason: str = "slo_pressure") -> bool:
+        """Cut the base rung over to the grown layout without a cold
+        restart: build the grow target, migrate every per-request
+        checkpoint onto its carriage through a staged redistribution
+        plan (per-stage scratch <= ``reshard_budget_bytes``;
+        parallel/reshard.py), swap the resident HBM charge, then route
+        base-rung traffic to the grown executor.  Idempotent — a
+        second call (e.g. a rerun resuming after a kill mid-migration)
+        re-migrates only checkpoints still on the old layout.  Returns
+        whether the server is serving the grown layout afterwards."""
+        if self._grown is not None:
+            return True
+        if self.grow_config is None and self.grow_factory is None:
+            self._event("grow_unavailable", reason=reason)
+            self._log(f"grow requested ({reason}) but no grow target "
+                      f"is declared")
+            return False
+        cfg = self.grow_config or self.base_config
+        factory = self.grow_factory or self._factory
+        try:
+            new_exec = factory(cfg)
+        except Exception as e:  # noqa: BLE001 — a grow target that
+            # cannot build must not take the serving rung down with it.
+            self._event("grow_failed", reason=reason,
+                        error=f"{type(e).__name__}: {e}")
+            self._log(f"grow target failed to build "
+                      f"({type(e).__name__}: {e}); staying put")
+            return False
+        old_exec = self._build_executor(self.base_config)
+        from arrow_matrix_tpu.obs.memview import predicted_bytes_for
+
+        old_res = predicted_bytes_for(
+            old_exec, 0, itemsize=self.itemsize,
+            repl=self.base_config.repl) or 0
+        new_res = predicted_bytes_for(
+            new_exec, 0, itemsize=self.itemsize, repl=cfg.repl) or 0
+        try:
+            self.accountant.swap_resident(old_res, new_res)
+        except ServeCapacityError as e:
+            self._event("grow_failed", reason=reason, error=str(e))
+            self._log(f"grow refused: {e}")
+            return False
+        try:
+            migrated, stages = self._migrate_checkpoints(old_exec,
+                                                         new_exec)
+        except Exception:
+            # Leave the ledger honest before surfacing the failure.
+            self.accountant.swap_resident(new_res, old_res)
+            raise
+        with self._lock:
+            self._grown = (new_exec, cfg)
+            self.grows += 1
+        self._event("grown", reason=reason,
+                    config=dataclasses.asdict(cfg),
+                    resident_bytes={"old": old_res, "new": new_res},
+                    checkpoints_migrated=migrated,
+                    plan_stages=stages)
+        # The reshard gate greps this line; print it regardless of
+        # verbosity (like the resumed-request marker).
+        print(f"[graft-serve {self.name}] grew to {cfg} ({reason}): "
+              f"{migrated} checkpoint(s) migrated through {stages} "
+              f"staged plan step(s)", flush=True)
+        return True
+
+    def _migrate_checkpoints(self, old_exec, new_exec
+                             ) -> Tuple[int, int]:
+        """Replay every per-request checkpoint still on the old layout
+        through a staged plan onto the grown layout, in place (atomic
+        save; a SIGKILL mid-migration leaves each checkpoint either on
+        the old or the new layout, never torn — the rerun's grow()
+        finishes the stragglers).  Returns (migrated, total stages)."""
+        import os
+
+        if not self.checkpoint_dir \
+                or not os.path.isdir(self.checkpoint_dir):
+            return 0, 0
+        src_fn = getattr(old_exec, "reshard_layout", None)
+        dst_fn = getattr(new_exec, "reshard_layout", None)
+        if src_fn is None or dst_fn is None:
+            self._event("grow_migration_skipped",
+                        error="executor pair exposes no reshard_layout")
+            return 0, 0
+        from arrow_matrix_tpu.parallel.reshard import (
+            apply_plan_host,
+            redistribution_plan,
+        )
+        from arrow_matrix_tpu.utils.checkpoint import (
+            checkpoint_layout_tag,
+            list_checkpoints,
+            load_state,
+            save_state,
+        )
+
+        src_lay, dst_lay = src_fn(), dst_fn()
+        ps = np.asarray(old_exec.perm0)
+        pd = np.asarray(new_exec.perm0)
+        if (src_lay.stored_rows == dst_lay.stored_rows
+                and np.array_equal(ps, pd)):
+            return 0, 0   # identical carriage: nothing to migrate
+        if src_lay.stored_rows == dst_lay.stored_rows:
+            # Equal-size relayout cannot be told apart from an
+            # already-migrated file by shape — refusing beats silently
+            # double-permuting a checkpoint on a rerun.
+            raise ValueError(
+                "grow between equal-size layouts with different row "
+                "orders is not idempotently resumable; grow must "
+                "change total_rows/n_dev/repl")
+        inv_s = np.asarray(old_exec.inv_perm0)
+        n = int(new_exec.n)
+        perm_map = np.where(pd < n, inv_s[np.minimum(pd, len(inv_s) - 1)],
+                            np.int64(-1))
+        migrated = stages = 0
+        for stem in list_checkpoints(self.checkpoint_dir):
+            key = os.path.basename(stem)[len("ck_"):]
+            tag = checkpoint_layout_tag(stem)
+            try:
+                got = load_state(stem, layout=tag)
+            except Exception as e:  # noqa: BLE001 — unreadable file:
+                # the normal resume path already discards it loudly.
+                self._event("grow_migration_skipped", request=key,
+                            error=f"{type(e).__name__}: {e}")
+                continue
+            if got is None:
+                continue
+            x, step = got
+            x = np.asarray(x)
+            if x.ndim != 2:
+                self._event("grow_migration_skipped", request=key,
+                            error=f"unmigratable carriage shape "
+                                  f"{x.shape}")
+                continue
+            # Orientation: flat carriage is (rows, k), folded carriage
+            # is feature-major (k, rows).
+            if x.shape[0] == src_lay.stored_rows:
+                transpose = False
+            elif x.shape[1] == src_lay.stored_rows:
+                transpose = True
+            elif dst_lay.stored_rows in x.shape:
+                continue   # already on the grown layout (rerun)
+            else:
+                self._event("grow_migration_skipped", request=key,
+                            error=f"carriage shape {x.shape} matches "
+                                  f"neither layout")
+                continue
+            k = int(x.shape[0] if transpose else x.shape[1])
+            plan = redistribution_plan(src_lay, dst_lay,
+                                       self.reshard_budget_bytes, k=k,
+                                       perm_map=perm_map)
+            y = (apply_plan_host(plan, x.T).T if transpose
+                 else apply_plan_host(plan, x))
+            save_state(stem, y, step, layout=tag)
+            migrated += 1
+            stages += plan.n_stages
+            self.checkpoints_resharded += 1
+            self._event("checkpoint_resharded", request=key, step=step,
+                        stages=plan.n_stages,
+                        max_stage_scratch_bytes=
+                        plan.max_stage_scratch_bytes,
+                        budget_bytes=plan.scratch_budget_bytes)
+        return migrated, stages
 
     # -- reporting ---------------------------------------------------------
 

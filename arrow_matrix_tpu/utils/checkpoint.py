@@ -80,6 +80,45 @@ def _read_meta(path: str) -> Optional[dict]:
         return None
 
 
+def list_checkpoints(ckpt_dir: str, prefix: str = "ck_") -> list:
+    """Stems of every checkpoint under ``ckpt_dir`` with ``prefix``,
+    across both backends (orbax directories and ``.npz`` files),
+    sorted.  A stem is what ``load_state``/``save_state`` take as
+    ``path`` — graft-reshard's checkpoint migration enumerates these."""
+    stems = set()
+    try:
+        entries = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    for e in entries:
+        p = os.path.join(ckpt_dir, e)
+        if not e.startswith(prefix):
+            continue
+        if e.endswith(".npz"):
+            stems.add(p[: -len(".npz")])
+        elif os.path.isdir(p):
+            stems.add(p)
+    return sorted(stems)
+
+
+def checkpoint_layout_tag(path: str) -> Optional[str]:
+    """The layout tag the checkpoint at ``path`` (a stem) was saved
+    with, without loading the state; None for untagged/legacy."""
+    path = os.path.abspath(path)
+    meta = _read_meta(path)
+    if meta is not None:
+        return meta.get("layout") or None
+    npz = path + ".npz"
+    if os.path.exists(npz):
+        try:
+            with np.load(npz) as z:
+                if "layout" in z.files:
+                    return str(z["layout"]) or None
+        except (OSError, ValueError):
+            return None
+    return None
+
+
 def _sha_path(npz_path: str) -> str:
     return npz_path + ".sha256"
 

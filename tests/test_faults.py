@@ -335,7 +335,8 @@ def test_chaos_gate_fast_scenarios(tmp_path):
     assert problems == []
     assert scenarios == ["nan", "hang", "corrupt", "serve_hang",
                          "serve_corrupt", "serve_overflow", "serve_hbm",
-                         "slo_burn_degrade", "serve_classes"]
+                         "slo_burn_degrade", "serve_classes",
+                         "reshard_h7"]
 
 
 @pytest.mark.slow

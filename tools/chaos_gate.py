@@ -364,6 +364,15 @@ def run_gate(workdir, fast=False):
             workdir, fast=fast)
         problems += fleet_problems
         scenarios += fleet_scenarios
+        # And the reshard matrix (tools/reshard_gate.py): H7 bounded-
+        # scratch staging plus SIGKILL mid staged-migration with zero
+        # accepted-request loss and bit-identical resumed results.
+        import reshard_gate
+
+        reshard_problems, reshard_scenarios = \
+            reshard_gate.run_reshard_scenarios(workdir, fast=fast)
+        problems += reshard_problems
+        scenarios += reshard_scenarios
         kinds = {e.get("kind") for e in rec.events}
         if "fault" not in kinds or "heal" not in kinds:
             problems.append(f"flight recorder saw kinds {sorted(kinds)}"
