@@ -1,6 +1,7 @@
-"""graft-lint + graft-prove: static analysis for the JAX/TPU hot paths.
+"""graft-lint + graft-prove + graft-sync: static analysis for the
+JAX/TPU hot paths and the serving stack's concurrency discipline.
 
-Three complementary engines guard the invariants the benches depend on
+Four complementary engines guard the invariants the benches depend on
 (PERFORMANCE.md measurement discipline):
 
 * **AST pass** (`core` + `rules`): a visitor-based linter over the
@@ -15,20 +16,39 @@ Three complementary engines guard the invariants the benches depend on
   ``bench_cache/`` so compile-cache regressions diff in review.
 * **HLO contract prover** (`prove` + `contracts`): lowers every
   distributed executor on a virtual mesh, parses the optimized HLO,
-  and checks six static rules (H1-H6) against the executor's declared
+  and checks seven static rules (H1-H7) against the executor's declared
   ``collective_contract`` — no unattributed collectives, bytes within
   tolerance of the ideal model, the repl=c ÷c slab law plus exactly
   the priced psum merge, no silent dtype upcasts, donated buffers
   actually aliased, no layout thrash in the hot loop.  Verdicts land
   in the checked-in ``bench_cache/hlo_manifest.json``.
+* **Lock-discipline analyzer** (`sync`, graft-sync): reads the
+  runtime ``@guarded_by`` contracts (arrow_matrix_tpu/sync.py) off
+  the AST and proves five concurrency rules over the serving stack —
+  RC1 guarded attributes are mutated only under their declared lock,
+  RC2 the lock-acquisition graph (including flock file-lock sites) is
+  acyclic against the declared partial order, RC3 no user callback
+  runs under a lock, RC4 no blocking call (socket accept/recv,
+  subprocess wait, untimed ``Event.wait``) runs under a lock, RC5
+  mutable module state reachable from two thread entry points is
+  guarded.  Verdicts land in the checked-in
+  ``bench_cache/sync_manifest.json`` (the hlo_manifest drift
+  discipline), and the same contracts arm the runtime lock-order
+  witness under ``AMT_LOCK_WITNESS=1``.
+
+Together R1-R9 (lint), H1-H7 (prove), and RC1-RC5 (sync) are one
+rule family: every id is unique, every verdict is drift-gated, and
+every engine exits non-zero on an unwaived finding.
 
 Run ``python -m arrow_matrix_tpu.analysis <paths>`` to lint,
-``python -m arrow_matrix_tpu.analysis audit`` for the trace audit, and
-``python -m arrow_matrix_tpu.analysis prove`` for the HLO proof;
-``graft_lint`` / ``graft_prove`` are the installed console scripts
-(tools/lint_gate.py and tools/proof_gate.py are the CI wrappers).
-Findings are suppressed inline with ``# graft-lint: disable=R1``
-(see core.WAIVER_RE).
+``python -m arrow_matrix_tpu.analysis audit`` for the trace audit,
+``python -m arrow_matrix_tpu.analysis prove`` for the HLO proof, and
+``python -m arrow_matrix_tpu.analysis sync`` for the lock proof;
+``graft_lint`` / ``graft_prove`` / ``graft_sync`` are the installed
+console scripts (tools/lint_gate.py, tools/proof_gate.py, and
+tools/sync_gate.py are the CI wrappers).  Findings are suppressed
+inline with ``# graft-lint: disable=R1`` (core.WAIVER_RE) and
+``# graft-sync: disable=RC1`` (sync waivers).
 """
 
 from arrow_matrix_tpu.analysis.contracts import CollectiveContract

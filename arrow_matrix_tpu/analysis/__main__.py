@@ -5,6 +5,7 @@ Usage:
   python -m arrow_matrix_tpu.analysis lint <paths...> lint, explicitly
   python -m arrow_matrix_tpu.analysis audit           trace-time audit
   python -m arrow_matrix_tpu.analysis prove           HLO contract proof
+  python -m arrow_matrix_tpu.analysis sync            lock-discipline proof
   python -m arrow_matrix_tpu.analysis --list-rules    rule table
 
 Exit status: 0 when no (unwaived) findings, 1 otherwise — the CI gate
@@ -60,6 +61,10 @@ def main(argv=None) -> int:
         from arrow_matrix_tpu.analysis.prove import main as prove_main
 
         return prove_main(argv[1:])
+    if argv and argv[0] == "sync":
+        from arrow_matrix_tpu.analysis.sync import main as sync_main
+
+        return sync_main(argv[1:])
     if argv and argv[0] == "lint":
         argv = argv[1:]
 

@@ -15,6 +15,12 @@ against by convention), grounded at the call sites named in its
 docstring.  Rules are registered with ``core.register`` and receive a
 ``ModuleContext``; they yield ``(line, message)`` pairs.  Suppress a
 deliberate violation inline with ``# graft-lint: disable=Rn``.
+
+The R rules are one third of the package's static-rule family: H1-H7
+(analysis/prove.py) prove HLO collective contracts, and RC1-RC5
+(analysis/sync.py, graft-sync) prove the serving stack's lock
+discipline.  Ids are unique across all three engines so one finding
+line always names one rule.
 """
 
 from __future__ import annotations

@@ -170,6 +170,59 @@ def probe_prove() -> tuple[bool, str]:
         return False, f"{type(e).__name__}: {str(e)[:100]}"
 
 
+def probe_sync() -> tuple[bool, str]:
+    """graft-sync health: the RC1-RC5 analyzer must trip on its
+    broken twins and the runtime witness must raise on an inverted
+    acquisition order (in-process selftest, host-only); then one
+    serve round trip runs in a bounded subprocess with
+    AMT_LOCK_WITNESS=1 so every lock the request path takes is
+    order-checked live.  The full static proof over the package is
+    the lint_gate/--sync and tier-1 job, not a doctor probe."""
+    try:
+        from arrow_matrix_tpu.analysis import sync as graft_sync
+
+        ok, lines = graft_sync.selftest()
+        if not ok:
+            bad = [ln for ln in lines if "fail" in ln.lower()]
+            return False, ("selftest failed: "
+                           + (bad[0] if bad else lines[-1]))[:140]
+    except Exception as e:  # the doctor must never crash on a probe
+        return False, f"{type(e).__name__}: {str(e)[:100]}"
+    code = ("import sys, os, tempfile; sys.argv=[]; "
+            "from arrow_matrix_tpu.utils.platform import "
+            "force_cpu_devices; force_cpu_devices(1); "
+            "from arrow_matrix_tpu import sync; "
+            "assert sync.witness_registry() is not None, "
+            "'witness did not arm from AMT_LOCK_WITNESS=1'; "
+            "from arrow_matrix_tpu.serve import smoke_serve; "
+            "d = tempfile.mkdtemp(prefix='sync_probe_'); "
+            "s = smoke_serve(d, n=64, width=16, k=2, tenants=1, "
+            "requests=1, iterations=1); "
+            "reg = sync.witness_registry(); snap = reg.snapshot(); "
+            "ok = (s['completed'] == 1 and s['failed'] == 0 and "
+            "snap['acquisitions'] > 0 and not snap['violations']); "
+            "print('SYNC ok ' + str(snap['acquisitions']) if ok "
+            "else 'SYNC FAIL: ' + repr(snap))")
+    env = dict(os.environ)
+    env["AMT_LOCK_WITNESS"] = "1"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240, env=env)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("SYNC")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if not lines[-1].startswith("SYNC ok"):
+        return False, lines[-1][:120]
+    acq = lines[-1].rsplit(" ", 1)[-1]
+    return True, (f"twins trip, witness-on serve round-trips "
+                  f"({acq} order-checked acquisitions, 0 violations)")
+
+
 def probe_obs() -> tuple[bool, str]:
     """graft-scope round-trip: the obs layer imports and a minimal
     smoke trace (one algorithm, 2 devices) produces a valid run
@@ -626,6 +679,10 @@ def main(argv=None) -> int:
     prove_ok, detail = probe_prove()
     ok &= _check("graft-prove (HLO collective contracts, H1-H7)",
                  prove_ok, detail)
+
+    sync_ok, detail = probe_sync()
+    ok &= _check("graft-sync (lock discipline RC1-RC5 + witness)",
+                 sync_ok, detail)
 
     obs_ok, detail = probe_obs()
     ok &= _check("graft-scope (obs smoke trace)", obs_ok, detail)

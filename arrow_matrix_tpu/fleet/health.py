@@ -9,16 +9,26 @@ never thunder-herd on synchronized schedules), each probe bounded by
 the verdict is recorded with its evidence (consecutive failures, last
 error, last-ok timestamp) so the fleet report can show WHY a worker
 was buried.
+
+Concurrency (graft-sync): every FleetRouter ``_dispatch`` thread folds
+outcomes into one shared monitor, so the verdict state is guarded by
+``_lock`` — the read-modify-write of ``consecutive_failures`` and the
+alive flip must be atomic or two racing failures can each observe
+streak N-1 and neither bury the worker.  Wire I/O and backoff sleeps
+happen strictly OUTSIDE the lock (RC4): a probe in its retry ladder
+must not stall every other thread's health bookkeeping.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Callable, Dict, Optional
 
 from arrow_matrix_tpu.faults.policy import RetryPolicy
 from arrow_matrix_tpu.fleet import wire
+from arrow_matrix_tpu.sync import guarded_by, witnessed
 
 
 @dataclasses.dataclass
@@ -36,6 +46,8 @@ class WorkerHealth:
         return dataclasses.asdict(self)
 
 
+@guarded_by("_lock", node="health_monitor", attrs=("state",),
+            callbacks=("sleep",))
 class HealthMonitor:
     """Heartbeat prober over the fleet wire protocol.
 
@@ -60,9 +72,10 @@ class HealthMonitor:
         self.max_failures = int(max_failures)
         self.clock = clock
         self.sleep = sleep
+        self._lock = witnessed("health_monitor", threading.Lock())
         self.state: Dict[str, WorkerHealth] = {}
 
-    def _health(self, worker_id: str) -> WorkerHealth:
+    def _health_locked(self, worker_id: str) -> WorkerHealth:
         h = self.state.get(worker_id)
         if h is None:
             h = self.state[worker_id] = WorkerHealth(worker_id)
@@ -71,28 +84,35 @@ class HealthMonitor:
     def record_ok(self, worker_id: str) -> WorkerHealth:
         """Fold an out-of-band success (e.g. a completed submit) into
         the health state: any successful op is a heartbeat."""
-        h = self._health(worker_id)
-        if h.alive:
-            h.consecutive_failures = 0
-            h.last_ok_s = float(self.clock())
-            h.last_error = None
-        return h
+        now = float(self.clock())
+        with self._lock:
+            h = self._health_locked(worker_id)
+            if h.alive:
+                h.consecutive_failures = 0
+                h.last_ok_s = now
+                h.last_error = None
+            return h
 
     def record_failure(self, worker_id: str,
                        error: str) -> WorkerHealth:
         """Fold one failed op into the health state; flips ``alive``
-        when the consecutive-failure streak reaches the limit."""
-        h = self._health(worker_id)
-        h.consecutive_failures += 1
-        h.last_error = error
-        if h.alive and h.consecutive_failures >= self.max_failures:
-            h.alive = False
-            h.declared_dead_s = float(self.clock())
-        return h
+        when the consecutive-failure streak reaches the limit.  The
+        streak increment and the flip happen under the lock in one
+        critical section — two racing failures must count as two."""
+        now = float(self.clock())
+        with self._lock:
+            h = self._health_locked(worker_id)
+            h.consecutive_failures += 1
+            h.last_error = error
+            if h.alive and h.consecutive_failures >= self.max_failures:
+                h.alive = False
+                h.declared_dead_s = now
+            return h
 
     def heartbeat_once(self, worker_id: str, host: str,
                        port: int) -> bool:
-        """One bounded heartbeat round trip; folds the outcome."""
+        """One bounded heartbeat round trip; folds the outcome.  The
+        wire call runs with no lock held (RC4)."""
         try:
             reply = wire.request_call(host, port, {"op": "health"},
                                       timeout_s=self.timeout_s)
@@ -111,24 +131,36 @@ class HealthMonitor:
         """The death-verdict ladder: retry the heartbeat up to
         ``max_failures`` times with the worker's own jittered backoff
         between attempts.  Returns the final health state — callers
-        decide what to do with a dead verdict (the router requeues)."""
-        h = self._health(worker_id)
+        decide what to do with a dead verdict (the router requeues).
+        Backoff sleeps hold no lock (RC4)."""
         policy = self.policy.for_worker(worker_id)
+        h = self.record_noop(worker_id)
         for attempt in range(1, self.max_failures + 1):
             if self.heartbeat_once(worker_id, host, port):
                 return h
-            if not h.alive:
+            with self._lock:
+                alive = h.alive
+            if not alive:
                 break
             if attempt < self.max_failures:
                 self.sleep(policy.delay_s(attempt, salt="heartbeat"))
         return h
 
+    def record_noop(self, worker_id: str) -> WorkerHealth:
+        """Materialize (without modifying) the worker's health entry."""
+        with self._lock:
+            return self._health_locked(worker_id)
+
     def alive_workers(self) -> list:
-        return sorted(w for w, h in self.state.items() if h.alive)
+        with self._lock:
+            return sorted(w for w, h in self.state.items() if h.alive)
 
     def dead_workers(self) -> list:
-        return sorted(w for w, h in self.state.items() if not h.alive)
+        with self._lock:
+            return sorted(w for w, h in self.state.items()
+                          if not h.alive)
 
     def snapshot(self) -> dict:
-        return {w: h.snapshot()
-                for w, h in sorted(self.state.items())}
+        with self._lock:
+            return {w: h.snapshot()
+                    for w, h in sorted(self.state.items())}

@@ -58,6 +58,7 @@ from arrow_matrix_tpu.fleet.placement import (
 from arrow_matrix_tpu.ledger import store as ledger_store
 from arrow_matrix_tpu.obs import flight
 from arrow_matrix_tpu.obs.metrics import Histogram
+from arrow_matrix_tpu.sync import guarded_by, witnessed
 from arrow_matrix_tpu.serve import request as rq
 
 #: Explicit-shed reason when no live worker can host a request — the
@@ -209,12 +210,23 @@ def _append_log(log_path: str, line: str) -> None:
         fh.write(line)
 
 
+@guarded_by("_lock", node="fleet_router",
+            attrs=("_dead", "_deaths", "_tickets", "_threads",
+                   "_pack_assignment", "_pack_unplaced", "_pins",
+                   "_counts", "requeues", "migrations"))
 class FleetRouter:
     """Places, dispatches, watches, requeues, reports (see the module
     docstring).  Construct with ``spawn=`` worker count to spawn local
     processes, or ``handles=`` to attach workers already serving
     (tests run :func:`~arrow_matrix_tpu.fleet.worker.serve_worker` on
-    a thread and attach it)."""
+    a thread and attach it).
+
+    Concurrency (graft-sync): every submit spawns a ``_dispatch``
+    daemon thread, so all routing state is guarded by ``_lock``.
+    Health folds, wire calls, and worker probes run with the lock
+    released — ``fleet_router -> health_monitor`` is a declared edge,
+    and a probe's backoff sleeps must never serialize the fleet (RC4).
+    """
 
     def __init__(self, *, spawn: int = 0,
                  handles: Optional[List[WorkerHandle]] = None,
@@ -245,7 +257,7 @@ class FleetRouter:
         self.submit_timeout_s = float(submit_timeout_s)
         self.health = health or HealthMonitor(timeout_s=5.0,
                                               max_failures=3)
-        self._lock = threading.RLock()
+        self._lock = witnessed("fleet_router", threading.RLock())
         self._dead: set = set()
         self._deaths: List[dict] = []
         self._tickets: List[rq.Ticket] = []
@@ -329,8 +341,13 @@ class FleetRouter:
                 "capacities": capacities}
 
     def _any_live_handle(self) -> Optional[WorkerHandle]:
+        # Snapshot under the lock: _dispatch threads mutate _dead
+        # concurrently, and iterating a set while another thread adds
+        # to it raises RuntimeError.
+        with self._lock:
+            dead = set(self._dead)
         for wid in sorted(self.workers):
-            if wid not in self._dead:
+            if wid not in dead:
                 return self.workers[wid]
         return None
 
@@ -645,10 +662,10 @@ class FleetRouter:
             with self._lock:
                 dead = wid in self._dead
             if dead:
+                health = self.health.snapshot()
                 worker_reports[wid] = {
                     "alive": False,
-                    "health": self.health.state[wid].snapshot()
-                    if wid in self.health.state else None}
+                    "health": health.get(wid)}
                 continue
             try:
                 reply = handle.call({"op": "summary"},
@@ -677,6 +694,7 @@ class FleetRouter:
             requeues = self.requeues
             migrations = self.migrations
             pins = dict(self._pins)
+            dead_workers = sorted(self._dead)
         wall = time.perf_counter() - self.started_s
         completed = counts.get("completed", 0)
         shed_reasons: Dict[str, int] = {}
@@ -693,7 +711,7 @@ class FleetRouter:
             "placement": self.placement,
             "num_workers": len(self.workers),
             "live_workers": self.live_workers(),
-            "dead_workers": sorted(self._dead),
+            "dead_workers": dead_workers,
             "deaths": deaths,
             "requests": len(tickets),
             "completed": completed,

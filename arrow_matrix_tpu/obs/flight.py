@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from arrow_matrix_tpu.sync import guarded_by, witnessed
 from arrow_matrix_tpu.utils.artifacts import atomic_write_json
 
 #: Default ring capacity: enough for every phase span + per-iteration
@@ -77,6 +78,9 @@ def request_context(request_id: str,
         _REQUEST_CTX.reset(token)
 
 
+@guarded_by("_lock", node="flight_recorder",
+            attrs=("events", "dropped", "sealed",
+                   "last_memory_report"))
 class FlightRecorder:
     """Bounded in-memory ring of obs events with eager disk flush."""
 
@@ -95,7 +99,7 @@ class FlightRecorder:
         # dropped accounting, and the snapshot-for-flush must be
         # mutually exclusive or a flush can serialize a half-updated
         # ring.  (RLock: seal() flushes while already holding it.)
-        self._lock = threading.RLock()
+        self._lock = witnessed("flight_recorder", threading.RLock())
         self.meta = {
             "pid": os.getpid(),
             "argv": list(sys.argv),

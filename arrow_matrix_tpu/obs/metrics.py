@@ -24,6 +24,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from arrow_matrix_tpu.obs import flight
+from arrow_matrix_tpu.sync import guarded_by, witnessed
 
 
 def _label_key(labels: Dict[str, Any]) -> Tuple:
@@ -132,6 +133,8 @@ class Histogram(_Instrument):
         }
 
 
+@guarded_by("_lock", node="metrics_registry",
+            attrs=("events", "_instruments"))
 class MetricsRegistry:
     """Instrument factory + time-ordered event log.
 
@@ -145,7 +148,7 @@ class MetricsRegistry:
         self.run_dir = run_dir
         self.events: List[dict] = []
         self._instruments: Dict[Tuple, _Instrument] = {}
-        self._lock = threading.Lock()
+        self._lock = witnessed("metrics_registry", threading.Lock())
 
     # -- instruments -------------------------------------------------------
 

@@ -63,6 +63,7 @@ from arrow_matrix_tpu.obs.flight import (  # noqa: F401  (re-exports)
     request_context,
 )
 from arrow_matrix_tpu.obs.metrics import Histogram
+from arrow_matrix_tpu.sync import guarded_by, witnessed
 
 SCHEMA_VERSION = 1
 
@@ -304,6 +305,9 @@ def default_rules(target_p99_ms: Optional[float] = None,
     return rules
 
 
+@guarded_by("_lock", node="slo_watchdog",
+            attrs=("events", "_streak", "_burning"),
+            callbacks=("on_burn",))
 class SloWatchdog:
     """Evaluates burn rules on each closed window — a pure function of
     the window series, so replays are bit-identical.  A rule that has
@@ -319,7 +323,7 @@ class SloWatchdog:
         self.events: List[dict] = []
         self._streak: Dict[str, int] = {r.name: 0 for r in self.rules}
         self._burning: set = set()
-        self._lock = threading.Lock()
+        self._lock = witnessed("slo_watchdog", threading.Lock())
 
     def on_window(self, window: dict) -> List[dict]:
         """Evaluate every rule against one closed window dict; returns
@@ -371,6 +375,12 @@ class SloWatchdog:
 # -- the streaming aggregator ----------------------------------------------
 
 
+@guarded_by("_lock", node="pulse_monitor",
+            attrs=("_current", "_closed", "_last_now",
+                   "dropped_windows", "closed_reason", "totals",
+                   "total_latency", "_tenant_totals", "_tenant_latency",
+                   "_class_totals", "_class_latency", "burn_events"),
+            callbacks=("hbm_sampler",))
 class PulseMonitor:
     """Sliding-window telemetry aggregator for one ArrowServer.
 
@@ -408,7 +418,7 @@ class PulseMonitor:
         self.clock = clock
         self.watchdog = watchdog
         self.hbm_sampler = hbm_sampler
-        self._lock = threading.Lock()
+        self._lock = witnessed("pulse_monitor", threading.Lock())
         self._t0 = float(clock())
         self._last_now = self._t0
         self._current = PulseWindow(0, self._t0, self.window_s)
@@ -432,6 +442,20 @@ class PulseMonitor:
     def observe(self, event: str, **data) -> None:
         """Fold one serve event into the current window (rotating any
         windows that ended before it).  No-op after :meth:`close`."""
+        # The HBM sampler is a user callback that takes the
+        # accountant's lock — it runs BEFORE this monitor's lock is
+        # taken (RC3), so a slow or re-entrant sampler can never hold
+        # telemetry ingest hostage.  The unlocked closed_reason
+        # pre-check only skips a pointless sample; the authoritative
+        # check happens under the lock below.
+        sample = None
+        if self.hbm_sampler is not None and self.closed_reason is None:
+            try:
+                sample = self.hbm_sampler()
+            except Exception:  # graft-lint: disable=R8 — telemetry
+                # must never take down the server it observes; a
+                # failing sampler just leaves the gauge unsampled.
+                sample = None
         with self._lock:
             if self.closed_reason is not None:
                 return
@@ -439,14 +463,8 @@ class PulseMonitor:
             w = self._current
             w.observe(event, data)
             self._fold_totals(event, data)
-            if self.hbm_sampler is not None:
-                try:
-                    in_use, occ = self.hbm_sampler()
-                    w.sample_hbm(in_use, occ)
-                except Exception:  # graft-lint: disable=R8 — telemetry
-                    # must never take down the server it observes; a
-                    # failing sampler just leaves the gauge unsampled.
-                    pass
+            if sample is not None:
+                w.sample_hbm(sample[0], sample[1])
         self._dispatch(pending)
 
     def advance(self, now: Optional[float] = None) -> List[dict]:
@@ -573,7 +591,13 @@ class PulseMonitor:
                 events = self.watchdog.on_window(d)
                 d["slo_burns"] = sum(
                     1 for e in events if e["event"] == "slo_burn")
-                self.burn_events.extend(events)
+                if events:
+                    # Re-take the monitor lock just for the append:
+                    # burn_events is read (snapshot/totals) from other
+                    # threads, and list.extend from two dispatchers
+                    # could interleave with a concurrent iteration.
+                    with self._lock:
+                        self.burn_events.extend(events)
         self.flush_ring()
 
     # -- views ---------------------------------------------------------

@@ -22,6 +22,8 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from arrow_matrix_tpu.sync import guarded_by, witnessed
+
 
 class ServeCapacityError(RuntimeError):
     """The configured HBM budget cannot even host the resident
@@ -29,6 +31,9 @@ class ServeCapacityError(RuntimeError):
     is not graceful degradation)."""
 
 
+@guarded_by("_lock", node="hbm_accountant",
+            attrs=("in_use_bytes", "peak_in_use_bytes",
+                   "resident_bytes"))
 class HBMAccountant:
     """Thread-safe reserve/release ledger against one byte budget.
 
@@ -48,7 +53,7 @@ class HBMAccountant:
         self.in_use_bytes = 0
         self.peak_in_use_bytes = 0
         self.resident_bytes = 0
-        self._lock = threading.Lock()
+        self._lock = witnessed("hbm_accountant", threading.Lock())
         self._registry = registry
         self._name = name
 

@@ -54,6 +54,7 @@ from arrow_matrix_tpu.serve.admission import (
     ServeCapacityError,
     request_price_bytes,
 )
+from arrow_matrix_tpu.sync import guarded_by, witnessed
 from arrow_matrix_tpu.utils.checkpoint import CheckpointIntegrityError
 
 
@@ -117,6 +118,14 @@ class _Tenant:
         self.class_degraded = False
 
 
+@guarded_by(
+    "_lock", node="arrow_server", aliases=("_cond",),
+    callbacks=("_factory",),
+    attrs=("_queue", "_counts", "_executors", "_tenants",
+           "_latencies_s", "_tenant_latencies_s",
+           "_class_latencies_s", "batches", "batched_requests",
+           "faults_seen", "recoveries", "checkpoint_corruptions",
+           "checkpoints_resharded", "_grown", "grows", "_stop"))
 class ArrowServer:
     """Long-lived multi-tenant server over one resident arrow operator.
 
@@ -232,7 +241,11 @@ class ArrowServer:
         for t in approx_opt_in or ():
             self._tenant(t).allow_approx = True
         self._queue: collections.deque = collections.deque()
-        self._lock = threading.RLock()
+        # graft-sync: the worker thread, N submitter threads, and the
+        # pulse/flight observers all meet on this one RLock; _cond is
+        # an alias view of it (declared on the contract) so a
+        # ``with self._cond:`` region counts as holding ``_lock``.
+        self._lock = witnessed("arrow_server", threading.RLock())
         self._cond = threading.Condition(self._lock)
         self._thread: Optional[threading.Thread] = None
         self._stop = False
@@ -318,11 +331,15 @@ class ArrowServer:
 
     def _count(self, what: str, tenant: Optional[str] = None,
                klass: Optional[str] = None, **labels) -> None:
-        self._counts[what] += 1
-        if tenant is not None:
-            self._counts[f"{what}:{tenant}"] += 1
-        if klass is not None:
-            self._counts[f"{what}:class:{klass}"] += 1
+        # Counter.__iadd__ is read-modify-write: two unlocked bumps
+        # from the worker and a submitter can lose one (RC1).  The
+        # registry dispatch stays outside the critical section.
+        with self._lock:
+            self._counts[what] += 1
+            if tenant is not None:
+                self._counts[f"{what}:{tenant}"] += 1
+            if klass is not None:
+                self._counts[f"{what}:class:{klass}"] += 1
         if self.registry is not None:
             lb = dict(labels)
             if tenant is not None:
@@ -339,9 +356,16 @@ class ArrowServer:
         return t
 
     def _build_executor(self, cfg: ExecConfig):
-        ex = self._executors.get(cfg)
+        with self._lock:
+            ex = self._executors.get(cfg)
         if ex is None:
-            ex = self._executors[cfg] = self._factory(cfg)
+            # The factory is a user callback — it compiles kernels and
+            # can take seconds, so it runs with NO lock held (RC3).
+            # Two racing builders both build; the first to publish
+            # wins and the loser's executor is dropped.
+            built = self._factory(cfg)
+            with self._lock:
+                ex = self._executors.setdefault(cfg, built)
         return ex
 
     def _effective_config(self, ticket: rq.Ticket) -> ExecConfig:
@@ -619,12 +643,13 @@ class ArrowServer:
         """Build (or fetch) the executor for a rung, walking further
         down the ladder when a rung's build itself fails; returns
         ``(executor, actual_cfg)`` or ``(None, cfg)``."""
-        if self._grown is not None and cfg in (self.base_config,
-                                               self._grown[1]):
+        with self._lock:
+            grown = self._grown
+        if grown is not None and cfg in (self.base_config, grown[1]):
             # Post-grow, base-rung traffic runs the grown layout (its
             # checkpoints were migrated by grow()); degraded rungs and
             # class-stamped configs keep their own executors.
-            return self._grown
+            return grown
         if cfg in self.ladder:
             rungs = list(self.ladder[self.ladder.index(cfg):])
         else:
@@ -658,7 +683,8 @@ class ArrowServer:
                             err: Exception) -> None:
         import os
 
-        self.checkpoint_corruptions += 1
+        with self._lock:
+            self.checkpoint_corruptions += 1
         self._count("checkpoint_corrupt")
         self._event("checkpoint_corrupt_discarded", request=key,
                     path=path, error=f"{type(err).__name__}: {err}")
@@ -695,8 +721,9 @@ class ArrowServer:
         for t in batch:
             t.status = rq.RUNNING
             t.attempts += 1
-        self.batches += 1
-        self.batched_requests += len(batch)
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += len(batch)
         if self.registry is not None:
             self.registry.counter("serve_batches",
                                   server=self.name).inc()
@@ -756,8 +783,9 @@ class ArrowServer:
             # unexpected executor error: the request fails/degrades,
             # the server survives.
             err = e
-        self.faults_seen += sup.faults_seen
-        self.recoveries += sup.recoveries
+        with self._lock:
+            self.faults_seen += sup.faults_seen
+            self.recoveries += sup.recoveries
         for t in batch:
             t.faults_seen += sup.faults_seen
             t.recoveries += sup.recoveries
@@ -1109,7 +1137,8 @@ class ArrowServer:
             save_state(stem, y, step, layout=tag)
             migrated += 1
             stages += plan.n_stages
-            self.checkpoints_resharded += 1
+            with self._lock:
+                self.checkpoints_resharded += 1
             self._event("checkpoint_resharded", request=key, step=step,
                         stages=plan.n_stages,
                         max_stage_scratch_bytes=
@@ -1174,28 +1203,33 @@ class ArrowServer:
                 }
                 for cls in ("exact", "approx")
             }
-        return {
-            "server": self.name,
-            "submitted": counts.get("submitted", 0),
-            "admitted": counts.get("admitted", 0),
-            "completed": counts.get("completed", 0),
-            "failed": counts.get("failed", 0),
-            "shed": counts.get("shed", 0),
-            "rejected": counts.get("rejected", 0),
-            "class_fallback": counts.get("class_fallback", 0),
-            "batches": self.batches,
-            "batched_requests": self.batched_requests,
-            "faults_seen": self.faults_seen,
-            "recoveries": self.recoveries,
-            "checkpoint_corruptions": self.checkpoint_corruptions,
-            "hbm": self.accountant.snapshot(),
-            "tenants": tenants,
-            "classes": classes,
-            "certificates": {
-                dt: {"iterations": c.iterations,
-                     "tolerance": c.tolerance,
-                     "bound": c.bound_at(c.iterations),
-                     "record_id": c.record_id}
-                for dt, c in sorted(self._certificates.items())
-            },
-        }
+            # The bare fault/batch counters are read under the same
+            # lock their writers hold — a summary taken mid-batch is
+            # a consistent cut, not a torn one.  The accountant
+            # snapshot nests its own lock inside ours: the declared
+            # arrow_server -> hbm_accountant order.
+            return {
+                "server": self.name,
+                "submitted": counts.get("submitted", 0),
+                "admitted": counts.get("admitted", 0),
+                "completed": counts.get("completed", 0),
+                "failed": counts.get("failed", 0),
+                "shed": counts.get("shed", 0),
+                "rejected": counts.get("rejected", 0),
+                "class_fallback": counts.get("class_fallback", 0),
+                "batches": self.batches,
+                "batched_requests": self.batched_requests,
+                "faults_seen": self.faults_seen,
+                "recoveries": self.recoveries,
+                "checkpoint_corruptions": self.checkpoint_corruptions,
+                "hbm": self.accountant.snapshot(),
+                "tenants": tenants,
+                "classes": classes,
+                "certificates": {
+                    dt: {"iterations": c.iterations,
+                         "tolerance": c.tolerance,
+                         "bound": c.bound_at(c.iterations),
+                         "record_id": c.record_id}
+                    for dt, c in sorted(self._certificates.items())
+                },
+            }

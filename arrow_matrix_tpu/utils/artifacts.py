@@ -37,6 +37,8 @@ try:                            # POSIX; absent on some platforms —
 except ImportError:             # pragma: no cover
     fcntl = None
 
+from arrow_matrix_tpu import sync
+
 
 #: Filename markers of throwaway verification artifacts.  A driver or
 #: doctor probe exercising the bench pipeline tags its output (e.g.
@@ -153,6 +155,33 @@ def atomic_write_json(path: str, obj: Any, *, indent=None,
     return path
 
 
+def flock_acquire(handle, *, shared: bool = False,
+                  nonblocking: bool = False) -> bool:
+    """The package's single audited ``fcntl.flock`` call site — every
+    flock discipline (the sidecar lock below, the preemption registry
+    in ``utils/platform.py``) routes through here so graft-sync's RC2
+    can flag any raw call it cannot see.  ``handle`` is a file object
+    or fd; returns whether the lock was taken (always True for a
+    blocking acquire, and trivially True where ``fcntl`` is absent —
+    locking degrades to a no-op there).  A nonblocking miss returns
+    False instead of raising.  The lock is released when the handle is
+    closed (the callers' existing discipline) — pair the held region
+    with ``sync.flock_witness(<node>)`` so the runtime witness sees it.
+    """
+    if fcntl is None:           # pragma: no cover
+        return True
+    flags = fcntl.LOCK_SH if shared else fcntl.LOCK_EX
+    if nonblocking:
+        flags |= fcntl.LOCK_NB
+    try:
+        fcntl.flock(handle, flags)  # graft-sync: flock-primitive
+    except OSError:
+        if nonblocking:
+            return False
+        raise
+    return True
+
+
 @contextlib.contextmanager
 def locked_file(path: str):
     """Advisory cross-process exclusive lock scoped to ``path``
@@ -178,8 +207,9 @@ def locked_file(path: str):
         os.makedirs(d, exist_ok=True)
     fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
     try:
-        fcntl.flock(fd, fcntl.LOCK_EX)
-        yield
+        flock_acquire(fd)
+        with sync.flock_witness("sidecar"):
+            yield
     finally:
         os.close(fd)            # close releases the flock
 

@@ -300,9 +300,13 @@ def proc_starttime(pid: int) -> str | None:
 def register_preemptible() -> None:
     """Append this process as ``pid:starttime`` (flocked append;
     removal via atexit, also flocked — a concurrent registrant's token
-    must never be lost to a read-filter-write race)."""
+    must never be lost to a read-filter-write race).  Locking goes
+    through the audited ``artifacts.flock_acquire`` primitive and is
+    registered with the graft-sync witness as ``flock:preempt_registry``."""
     import atexit
-    import fcntl
+
+    from arrow_matrix_tpu.sync import flock_witness
+    from arrow_matrix_tpu.utils.artifacts import flock_acquire
 
     path = preempt_registry_path()
     pid = os.getpid()
@@ -313,19 +317,21 @@ def register_preemptible() -> None:
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "a") as f:
-            fcntl.flock(f, fcntl.LOCK_EX)
-            f.write(token + "\n")
+            flock_acquire(f)
+            with flock_witness("preempt_registry"):
+                f.write(token + "\n")
     except OSError:
         return
 
     def _cleanup():
         try:
             with open(path, "r+") as f:
-                fcntl.flock(f, fcntl.LOCK_EX)
-                toks = [t for t in f.read().split() if t != token]
-                f.seek(0)
-                f.truncate()
-                f.write("\n".join(toks) + ("\n" if toks else ""))
+                flock_acquire(f)
+                with flock_witness("preempt_registry"):
+                    toks = [t for t in f.read().split() if t != token]
+                    f.seek(0)
+                    f.truncate()
+                    f.write("\n".join(toks) + ("\n" if toks else ""))
         except OSError:
             pass
 
@@ -341,18 +347,19 @@ def read_preemptible(log=None) -> list[int]:
     empty file — but a LOCK_EX holder that got SIGSTOPped mid-cleanup
     must not block this reader forever either; after the retries the
     unlocked read is accepted)."""
-    import fcntl
     import time as _time
+
+    from arrow_matrix_tpu.sync import flock_witness
+    from arrow_matrix_tpu.utils.artifacts import flock_acquire
 
     try:
         with open(preempt_registry_path()) as f:
             for _ in range(10):
-                try:
-                    fcntl.flock(f, fcntl.LOCK_SH | fcntl.LOCK_NB)
+                if flock_acquire(f, shared=True, nonblocking=True):
                     break
-                except OSError:
-                    _time.sleep(0.2)
-            raw = f.read().split()
+                _time.sleep(0.2)
+            with flock_witness("preempt_registry"):
+                raw = f.read().split()
     except OSError:
         return []
     pids = []

@@ -1,0 +1,31 @@
+"""Planted RC1 violation: a guarded attribute mutated off-lock.
+
+``pending`` and ``completed`` are declared guarded by ``_lock``, but
+``finish`` bumps ``completed`` without taking it — the lost-update
+race the HealthMonitor fix closed for real.  tools/sync_gate.py
+--fixture must exit nonzero on this file.
+"""
+
+import threading
+
+from arrow_matrix_tpu.sync import guarded_by
+
+
+@guarded_by("_lock", node="fixture_rc1",
+            attrs=("pending", "completed"))
+class RequestLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+        self.completed = 0
+
+    def add(self, req):
+        with self._lock:
+            self.pending.append(req)
+
+    def finish(self, req):
+        # BUG: read-modify-write of a guarded counter with no lock.
+        self.completed += 1
+        with self._lock:
+            if req in self.pending:
+                self.pending.remove(req)

@@ -1,0 +1,29 @@
+"""Planted RC2 violation: two locks acquired in both orders.
+
+``flush`` holds the ring lock while taking the index lock;
+``compact`` holds the index lock while taking the ring lock.  Two
+threads running one of each deadlock — the acquisition graph has the
+cycle ring_lock -> index_lock -> ring_lock.  tools/sync_gate.py
+--fixture must exit nonzero on this file.
+"""
+
+import threading
+
+RING_LOCK = threading.Lock()
+INDEX_LOCK = threading.Lock()
+
+RING = []
+INDEX = {}
+
+
+def flush():
+    with RING_LOCK:
+        with INDEX_LOCK:
+            INDEX.clear()
+            RING.clear()
+
+
+def compact():
+    with INDEX_LOCK:
+        with RING_LOCK:
+            del RING[: len(RING) // 2]

@@ -1,0 +1,27 @@
+"""Planted RC3 violation: a user callback invoked under the lock.
+
+``on_burn`` is a declared callback — user code that may re-enter this
+class (the SloWatchdog ladder does exactly that) or block
+indefinitely.  ``trip`` fires it while still holding ``_lock``:
+hold-and-wait on arbitrary user code.  tools/sync_gate.py --fixture
+must exit nonzero on this file.
+"""
+
+import threading
+
+from arrow_matrix_tpu.sync import guarded_by
+
+
+@guarded_by("_lock", node="fixture_rc3", attrs=("trips",),
+            callbacks=("on_burn",))
+class Watchdog:
+    def __init__(self, on_burn):
+        self._lock = threading.Lock()
+        self.on_burn = on_burn
+        self.trips = []
+
+    def trip(self, rule):
+        with self._lock:
+            self.trips.append(rule)
+            # BUG: user callback runs inside the critical section.
+            self.on_burn(rule)

@@ -1,0 +1,27 @@
+"""Planted RC4 violation: blocking I/O inside a critical section.
+
+``recv_reply`` holds ``_lock`` across ``socket.recv`` — a stalled
+peer wedges every thread that needs the lock, which is how one dead
+worker used to freeze a whole router before the health-monitor
+probes moved their wire I/O off-lock.  tools/sync_gate.py --fixture
+must exit nonzero on this file.
+"""
+
+import threading
+
+from arrow_matrix_tpu.sync import guarded_by
+
+
+@guarded_by("_lock", node="fixture_rc4", attrs=("replies",))
+class WireFront:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+        self.replies = []
+
+    def recv_reply(self):
+        with self._lock:
+            # BUG: unbounded socket read while holding the lock.
+            data = self.sock.recv(4096)
+            self.replies.append(data)
+        return data

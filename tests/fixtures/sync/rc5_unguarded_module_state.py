@@ -1,0 +1,29 @@
+"""Planted RC5 violation: mutable module state shared across threads.
+
+``_RESULTS`` is a module-level dict mutated by ``worker`` (a Thread
+target — one entry point) and by ``harvest`` (registered with atexit
+— a second entry point) with no lock anywhere.  tools/sync_gate.py
+--fixture must exit nonzero on this file.
+"""
+
+import atexit
+import threading
+
+_RESULTS = {}
+
+
+def worker(job_id):
+    _RESULTS[job_id] = "done"
+
+
+def harvest():
+    _RESULTS.clear()
+
+
+def start(job_id):
+    t = threading.Thread(target=worker, args=(job_id,), daemon=True)
+    t.start()
+    return t
+
+
+atexit.register(harvest)

@@ -321,6 +321,22 @@ def scenario_kill_repl(workdir):
     return problems
 
 
+def scenario_sync():
+    """graft-sync: the static RC1-RC5 proof must hold over the shipped
+    package (no drift check here — tools/sync_gate.py owns that) and
+    the analyzer's own broken twins must still trip."""
+    from arrow_matrix_tpu.analysis import sync as graft_sync
+
+    problems = []
+    ok, lines = graft_sync.selftest()
+    if not ok:
+        problems += [f"sync: {ln}" for ln in lines]
+    report = graft_sync.analyze_package()
+    for f in report.findings:
+        problems.append(f"sync: {f.format()}")
+    return problems
+
+
 def run_gate(workdir, fast=False):
     """Run the matrix; returns (problems, scenarios_run)."""
     from arrow_matrix_tpu import faults
@@ -345,6 +361,12 @@ def run_gate(workdir, fast=False):
             problems += scenario_kill(workdir)
             scenarios.append("kill_repl")
             problems += scenario_kill_repl(workdir)
+        # graft-sync rides the fast list: the static lock-discipline
+        # proof is host-only AST work, and the serving scenarios below
+        # all run under the runtime lock-order witness when
+        # AMT_LOCK_WITNESS=1 is exported around this gate.
+        scenarios.append("sync")
+        problems += scenario_sync()
         # The serving matrix rides the same gate (tools/serve_gate.py):
         # chaos under multi-tenant load with the same detected/
         # recovered/bit-identical contract.

@@ -278,9 +278,23 @@ def main(argv=None) -> int:
 
     import tempfile
 
+    from arrow_matrix_tpu import sync
+
+    # Arm the lock-order witness before any router is constructed; the
+    # worker subprocesses inherit AMT_LOCK_WITNESS from the
+    # environment, so exporting it witnesses both sides of the fleet.
+    registry = sync.enable_witness()
+
     workdir = argv[0] if argv else tempfile.mkdtemp(prefix="fleet_gate_")
     os.makedirs(workdir, exist_ok=True)
     problems, scenarios = run_fleet_scenarios(workdir, fast=fast)
+    snap = registry.snapshot()
+    if snap["violations"]:
+        problems.extend(f"lock witness: {v}" for v in snap["violations"])
+    print(f"fleet gate: lock witness — {snap['acquisitions']} "
+          f"acquisitions, {len(snap['threads'])} threads, "
+          f"{len(snap['observed_edges'])} observed edges, "
+          f"{len(snap['violations'])} violations", file=sys.stderr)
     if problems:
         for p in problems:
             print(f"fleet gate: {p}", file=sys.stderr)

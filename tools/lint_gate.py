@@ -13,10 +13,14 @@ additionally runs the HLO collective-contract prover in check mode
 bench_cache/hlo_manifest.json — tools/proof_gate.py standalone);
 ``--ledger`` additionally runs the graft-ledger drift gate in check
 mode against the committed store + baseline (tools/ledger_gate.py
-standalone).
+standalone); ``--sync`` additionally runs the graft-sync
+lock-discipline proof in check mode (fails on any RC1-RC5 violation
+or drift against the checked-in bench_cache/sync_manifest.json —
+tools/sync_gate.py standalone).
 
 Usage:
-  python tools/lint_gate.py [--audit] [--prove] [--ledger] [paths...]
+  python tools/lint_gate.py [--audit] [--prove] [--ledger] [--sync]
+                            [paths...]
 """
 
 import os
@@ -38,6 +42,9 @@ def main(argv=None) -> int:
     run_ledger = "--ledger" in argv
     if run_ledger:
         argv.remove("--ledger")
+    run_sync = "--sync" in argv
+    if run_sync:
+        argv.remove("--sync")
     rc = graft_lint_main(argv)
     if rc != 0:
         print("lint gate: FAILED (fix the findings or waive them with "
@@ -61,6 +68,12 @@ def main(argv=None) -> int:
         rc = ledger_main(["--check"])
         if rc != 0:
             print("lint gate: ledger drift gate FAILED",
+                  file=sys.stderr)
+            return rc
+    if run_sync:
+        rc = graft_lint_main(["sync", "--check"])
+        if rc != 0:
+            print("lint gate: lock-discipline proof FAILED",
                   file=sys.stderr)
             return rc
     print("lint gate: ok", file=sys.stderr)

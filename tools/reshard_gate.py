@@ -359,6 +359,15 @@ def main(argv=None) -> int:
     force_cpu_devices(4)
     fast = "--fast" in argv
     argv = [a for a in argv if a != "--fast"]
+
+    from arrow_matrix_tpu import sync
+
+    # Arm the lock-order witness so the migration scenarios (flock'd
+    # preemption registry + live-grow server) run order-checked; the
+    # kill_mid_migration driver subprocess inherits AMT_LOCK_WITNESS
+    # from the environment.
+    registry = sync.enable_witness()
+
     if argv:
         workdir = argv[0]
         os.makedirs(workdir, exist_ok=True)
@@ -367,6 +376,12 @@ def main(argv=None) -> int:
 
         workdir = tempfile.mkdtemp(prefix="reshard_gate_")
     problems, scenarios = run_reshard_scenarios(workdir, fast=fast)
+    snap = registry.snapshot()
+    if snap["violations"]:
+        problems.extend(f"lock witness: {v}" for v in snap["violations"])
+    print(f"reshard gate: lock witness — {snap['acquisitions']} "
+          f"acquisitions, {len(snap['threads'])} threads, "
+          f"{len(snap['violations'])} violations")
     print(f"reshard gate scenarios: {scenarios}")
     if problems:
         print("RESHARD GATE: FAIL")

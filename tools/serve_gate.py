@@ -601,7 +601,14 @@ def main(argv=None) -> int:
 
     import tempfile
 
+    from arrow_matrix_tpu import sync
     from arrow_matrix_tpu.obs import flight
+
+    # Arm the lock-order witness before any server is constructed so
+    # every scenario below doubles as a lock-order execution test
+    # (sync.py module docstring).  An inverted acquisition raises
+    # LockOrderViolation inside the scenario and fails the gate.
+    registry = sync.enable_witness()
 
     workdir = argv[0] if argv else tempfile.mkdtemp(prefix="serve_gate_")
     os.makedirs(workdir, exist_ok=True)
@@ -617,6 +624,18 @@ def main(argv=None) -> int:
     finally:
         rec.seal("serve gate done")
         flight.set_recorder(None)
+    snap = registry.snapshot()
+    if snap["violations"]:
+        problems.extend(f"lock witness: {v}" for v in snap["violations"])
+    if not snap["acquisitions"]:
+        problems.append("lock witness: zero witnessed acquisitions — "
+                        "the serving stack stopped routing its locks "
+                        "through sync.witnessed()")
+    print(f"serve gate: lock witness — {snap['acquisitions']} "
+          f"acquisitions, {snap['reentries']} reentries, "
+          f"{len(snap['threads'])} threads, "
+          f"{len(snap['observed_edges'])} observed edges, "
+          f"{len(snap['violations'])} violations", file=sys.stderr)
     if problems:
         for p in problems:
             print(f"serve gate: {p}", file=sys.stderr)

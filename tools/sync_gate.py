@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Tier-1 sync gate: re-run graft-sync and fail on any lock-discipline
+violation OR on drift against the checked-in
+bench_cache/sync_manifest.json.
+
+This is the CI wrapper around ``python -m arrow_matrix_tpu.analysis
+sync --check`` (the pytest suite runs the same invariant in
+tests/test_sync.py): it reads every ``@guarded_by`` contract off the
+AST and proves RC1-RC5 over the serving stack — guarded attributes
+mutated only under their lock, an acyclic lock/flock acquisition
+graph, no user callback and no blocking call under a lock, and no
+unguarded module state reachable from two thread entries — so a
+deadlock or lost-update regression fails the push before any thread
+runs.
+
+Usage:
+  python tools/sync_gate.py                 prove + drift check (CI)
+  python tools/sync_gate.py --refresh       prove + rewrite manifest
+  python tools/sync_gate.py --fixture F     verify a planted-violation
+                                            fixture (tests/fixtures/
+                                            sync/rcN_*.py) fires its
+                                            expected rule; exits
+                                            nonzero when it does NOT —
+                                            how tests demonstrate the
+                                            gate trips on each planted
+                                            discipline break
+  python tools/sync_gate.py --fixtures      run every shipped fixture
+  python tools/sync_gate.py --paths F...    analyze arbitrary files and
+                                            exit nonzero on ANY
+                                            finding (feeding a planted
+                                            fixture here fails the
+                                            gate, per rule)
+  python tools/sync_gate.py --selftest      verify the analyzer itself
+                                            trips on broken twins and
+                                            the runtime witness raises
+                                            on an inverted order
+"""
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FIXTURE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "sync")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--refresh", action="store_true",
+                    help="rewrite bench_cache/sync_manifest.json "
+                         "instead of drift-checking against it")
+    ap.add_argument("--fixture", action="append", default=[],
+                    help="verify this planted-violation fixture fires "
+                         "its expected rule (repeatable)")
+    ap.add_argument("--fixtures", action="store_true",
+                    help="verify every tests/fixtures/sync/rc*_*.py")
+    ap.add_argument("--paths", nargs="+", default=None,
+                    help="analyze these files and exit nonzero on any "
+                         "finding (a planted fixture fails the gate)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the analyzer trips on its broken "
+                         "twins (host-only, no jax)")
+    args = ap.parse_args(argv)
+
+    from arrow_matrix_tpu.analysis import sync as graft_sync
+
+    if args.selftest:
+        return graft_sync.main(["--selftest"])
+
+    if args.paths:
+        report = graft_sync.analyze_paths(args.paths)
+        for f in report.findings:
+            print(f.format())
+        if report.findings:
+            print(f"sync gate: {len(report.findings)} finding(s) in "
+                  f"{len(args.paths)} file(s)", file=sys.stderr)
+            return 1
+        print("sync gate: paths clean", file=sys.stderr)
+        return 0
+
+    fixtures = list(args.fixture)
+    if args.fixtures:
+        fixtures.extend(sorted(glob.glob(
+            os.path.join(FIXTURE_DIR, "rc*_*.py"))))
+    if fixtures:
+        rc = graft_sync.main(
+            [arg for p in fixtures for arg in ("--fixture", p)])
+        if rc != 0:
+            print("sync gate: FIXTURE FAILED TO TRIP ITS RULE — the "
+                  "analyzer lost a detection", file=sys.stderr)
+        return rc
+
+    cli = [] if args.refresh else ["--check"]
+    rc = graft_sync.main(cli)
+    if rc != 0:
+        print("sync gate: FAILED (a lock-discipline rule is violated "
+              "or the manifest drifted — rerun `python -m "
+              "arrow_matrix_tpu.analysis sync` and review the diff)",
+              file=sys.stderr)
+        return rc
+    print("sync gate: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
