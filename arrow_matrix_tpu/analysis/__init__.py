@@ -1,7 +1,8 @@
-"""graft-lint + graft-prove + graft-sync: static analysis for the
-JAX/TPU hot paths and the serving stack's concurrency discipline.
+"""graft-lint + graft-prove + graft-sync + graft-kcert: static
+analysis for the JAX/TPU hot paths, the serving stack's concurrency
+discipline, and the Pallas kernel layer.
 
-Four complementary engines guard the invariants the benches depend on
+Five complementary engines guard the invariants the benches depend on
 (PERFORMANCE.md measurement discipline):
 
 * **AST pass** (`core` + `rules`): a visitor-based linter over the
@@ -35,19 +36,35 @@ Four complementary engines guard the invariants the benches depend on
   ``bench_cache/sync_manifest.json`` (the hlo_manifest drift
   discipline), and the same contracts arm the runtime lock-order
   witness under ``AMT_LOCK_WITNESS=1``.
+* **Pallas kernel certifier** (`kernels`, graft-kcert): proves five
+  rules (KC1-KC5) over every kernel builder's declared
+  ``KernelContract`` and its concretized call metas at representative
+  (row_block, ring, k) points — KC1 every SMEM/VMEM/HBM index in
+  bounds, KC2 VMEM blocks + scratch and SMEM prefetch inside their
+  budgets, KC3 DMA ring discipline (waited before slot reuse, no
+  in-flight aliasing, replayed in a ring simulator), KC4 the
+  accumulator >= f32 regardless of carriage dtype (H4' at the kernel
+  level), KC5 the output index map covers every output block exactly
+  once.  Verdicts land in the checked-in
+  ``bench_cache/kernel_manifest.json``, tune/space prunes
+  uncertifiable candidates through ``certify_candidate_opts``, and
+  generated programs (ROADMAP item 3) enter via
+  ``ops/kernel_contract.register_kernel``.
 
-Together R1-R9 (lint), H1-H7 (prove), and RC1-RC5 (sync) are one
-rule family: every id is unique, every verdict is drift-gated, and
-every engine exits non-zero on an unwaived finding.
+Together R1-R9 (lint), H1-H7 (prove), RC1-RC5 (sync), and KC1-KC5
+(kcert) are one rule family: every id is unique, every verdict is
+drift-gated, and every engine exits non-zero on an unwaived finding.
 
 Run ``python -m arrow_matrix_tpu.analysis <paths>`` to lint,
 ``python -m arrow_matrix_tpu.analysis audit`` for the trace audit,
-``python -m arrow_matrix_tpu.analysis prove`` for the HLO proof, and
-``python -m arrow_matrix_tpu.analysis sync`` for the lock proof;
-``graft_lint`` / ``graft_prove`` / ``graft_sync`` are the installed
-console scripts (tools/lint_gate.py, tools/proof_gate.py, and
-tools/sync_gate.py are the CI wrappers).  Findings are suppressed
-inline with ``# graft-lint: disable=R1`` (core.WAIVER_RE) and
+``python -m arrow_matrix_tpu.analysis prove`` for the HLO proof,
+``python -m arrow_matrix_tpu.analysis sync`` for the lock proof, and
+``python -m arrow_matrix_tpu.analysis kernels`` for the kernel
+certification; ``graft_lint`` / ``graft_prove`` / ``graft_sync`` /
+``graft_kcert`` are the installed console scripts (tools/lint_gate.py,
+tools/proof_gate.py, tools/sync_gate.py, and tools/kernel_gate.py are
+the CI wrappers).  Findings are suppressed inline with
+``# graft-lint: disable=R1`` (core.WAIVER_RE) and
 ``# graft-sync: disable=RC1`` (sync waivers).
 """
 

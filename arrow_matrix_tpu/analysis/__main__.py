@@ -6,6 +6,7 @@ Usage:
   python -m arrow_matrix_tpu.analysis audit           trace-time audit
   python -m arrow_matrix_tpu.analysis prove           HLO contract proof
   python -m arrow_matrix_tpu.analysis sync            lock-discipline proof
+  python -m arrow_matrix_tpu.analysis kernels         Pallas kernel certifier
   python -m arrow_matrix_tpu.analysis --list-rules    rule table
 
 Exit status: 0 when no (unwaived) findings, 1 otherwise — the CI gate
@@ -65,6 +66,10 @@ def main(argv=None) -> int:
         from arrow_matrix_tpu.analysis.sync import main as sync_main
 
         return sync_main(argv[1:])
+    if argv and argv[0] == "kernels":
+        from arrow_matrix_tpu.analysis.kernels import main as kcert_main
+
+        return kcert_main(argv[1:])
     if argv and argv[0] == "lint":
         argv = argv[1:]
 

@@ -16,11 +16,13 @@ docstring.  Rules are registered with ``core.register`` and receive a
 ``ModuleContext``; they yield ``(line, message)`` pairs.  Suppress a
 deliberate violation inline with ``# graft-lint: disable=Rn``.
 
-The R rules are one third of the package's static-rule family: H1-H7
-(analysis/prove.py) prove HLO collective contracts, and RC1-RC5
+The R rules are one quarter of the package's static-rule family:
+H1-H7 (analysis/prove.py) prove HLO collective contracts, RC1-RC5
 (analysis/sync.py, graft-sync) prove the serving stack's lock
-discipline.  Ids are unique across all three engines so one finding
-line always names one rule.
+discipline, and KC1-KC5 (analysis/kernels.py, graft-kcert) certify
+the Pallas kernel layer's bounds, budgets, DMA ring discipline,
+accumulator widths, and output coverage.  Ids are unique across all
+four engines so one finding line always names one rule.
 """
 
 from __future__ import annotations

@@ -223,6 +223,55 @@ def probe_sync() -> tuple[bool, str]:
                   f"({acq} order-checked acquisitions, 0 violations)")
 
 
+def probe_kcert() -> tuple[bool, str]:
+    """graft-kcert health: the KC1-KC5 certifier must trip on its
+    broken selftest twins (in-process, host-only — no jax import);
+    then ONE certified kernel runs a full interpret-mode round trip
+    in a bounded subprocess (certify_entry replays the DMA-ring
+    schedule, enumerates the grid, and executes the numeric witness:
+    stream == vectorized bit-identity vs the f32 golden).  The full
+    two-kernel manifest check is kernel_gate/--kernels, not a doctor
+    probe."""
+    try:
+        from arrow_matrix_tpu.analysis import kernels as graft_kcert
+
+        ok, lines = graft_kcert.selftest()
+        if not ok:
+            bad = [ln for ln in lines if "fail" in ln.lower()]
+            return False, ("selftest failed: "
+                           + (bad[0] if bad else lines[-1]))[:140]
+    except Exception as e:  # the doctor must never crash on a probe
+        return False, f"{type(e).__name__}: {str(e)[:100]}"
+    code = ("import sys; sys.argv=[]; "
+            "from arrow_matrix_tpu.utils.platform import "
+            "force_cpu_devices; force_cpu_devices(1); "
+            "from arrow_matrix_tpu.ops.kernel_contract import "
+            "builtin_kernels; "
+            "from arrow_matrix_tpu.analysis.kernels import "
+            "certify_entry; "
+            "e = [x for x in builtin_kernels() "
+            "if x.name == 'sell_tier_spmm_packed'][0]; "
+            "rec = certify_entry(e); "
+            "print('KCERT ok ' + str(rec['points']) if rec['ok'] "
+            "else 'KCERT FAIL: ' + '; '.join(rec['findings'])[:200])")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("KCERT")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if not lines[-1].startswith("KCERT ok"):
+        return False, lines[-1][:120]
+    pts = lines[-1].rsplit(" ", 1)[-1]
+    return True, (f"twins trip, certified interpret round trip "
+                  f"({pts} grid/BlockSpec points, witness passed)")
+
+
 def probe_obs() -> tuple[bool, str]:
     """graft-scope round-trip: the obs layer imports and a minimal
     smoke trace (one algorithm, 2 devices) produces a valid run
@@ -683,6 +732,10 @@ def main(argv=None) -> int:
     sync_ok, detail = probe_sync()
     ok &= _check("graft-sync (lock discipline RC1-RC5 + witness)",
                  sync_ok, detail)
+
+    kcert_ok, detail = probe_kcert()
+    ok &= _check("graft-kcert (Pallas kernel certifier KC1-KC5)",
+                 kcert_ok, detail)
 
     obs_ok, detail = probe_obs()
     ok &= _check("graft-scope (obs smoke trace)", obs_ok, detail)

@@ -21,7 +21,11 @@ each of which makes the CLI exit nonzero:
   meaningful threshold: any f32 error above the floor is a
   bit-identity break);
 * **schema drift** — records failing ``store.schema_problems`` or a
-  store failing chain validation.
+  store failing chain validation;
+* **kcert regression** — a ``kind="kcert"`` rule-count record (the
+  kernel certifier's passing KC-rule tally, graft-kcert) falling
+  below the baseline median: certified rules may only be added,
+  never silently lost.
 
 Keys absent from the baseline are reported as NEW, never as failures —
 a new structure/metric must not block the ledger that is trying to
@@ -204,6 +208,26 @@ def check_records(records: List[Dict[str, Any]],
                 failures.append(
                     f"accuracy regression: {key} curve shortened "
                     f"({len(fresh)} < baseline {len(ref)} points)")
+            continue
+        if rec["kind"] == "kcert":
+            # Kernel-certifier verdict counts (graft-kcert): the
+            # number of passing KC rules must never shrink — fewer
+            # rules passing than the baseline median means a kernel
+            # or the certifier itself regressed.  Counts have no
+            # host-load band; the comparison is direct.
+            entry = metrics.get(key)
+            if entry is None:
+                notes.append(f"new metric key (no baseline): {key}")
+                continue
+            value = rec.get("value")
+            if value is None:
+                notes.append(f"no numeric value: {key}")
+                continue
+            if float(value) < float(entry["median"]):
+                failures.append(
+                    f"kcert regression: {key}: {float(value):.0f} "
+                    f"passing rules < baseline median "
+                    f"{entry['median']:.0f}")
             continue
         if is_degraded(rec):
             notes.append(f"degraded measurement (unbanded): {key}")

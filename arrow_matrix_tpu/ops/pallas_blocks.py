@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from arrow_matrix_tpu.ops.kernel_contract import KernelContract
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -74,6 +76,81 @@ def feasible(w: int, k: int, banded: bool) -> bool:
             + stacks * 8 * w * 4 * 2) <= VMEM_BUDGET
 
 
+def column_call_meta(nb: int, w: int, k: int, t: int,
+                     banded: bool) -> dict:
+    """Literal description of one concretized column-SpMM
+    ``pallas_call`` in the graft-kcert meta schema;
+    :func:`column_spmm_pallas` derives its grid and block shapes FROM
+    this dict (single source of truth for the KC1-KC5 certifier)."""
+    if t < 1 or w % t:
+        raise ValueError(f"row tile must divide w ({w}), got {t}")
+    if nb < 1 or k < 1:
+        raise ValueError(f"meta needs nb, k >= 1, got nb={nb} k={k}")
+
+    def mat(name):
+        return {"name": name, "shape": [nb, w, w], "block": [1, t, w],
+                "index": ["b", "r", 0], "space": "vmem", "itemsize": 4}
+
+    def vec(name):
+        return {"name": name, "shape": [nb, w, k], "block": [1, w, k],
+                "index": ["b", 0, 0], "space": "vmem", "itemsize": 4}
+
+    ins = [mat("diag"), mat("col")]
+    if banded:
+        ins += [mat("lo"), mat("hi")]
+    ins.append(vec("x"))
+    ins.append({"name": "x0", "shape": [w, k], "block": [w, k],
+                "index": [0, 0], "space": "vmem", "itemsize": 4})
+    if banded:
+        ins += [vec("x_lo"), vec("x_hi")]
+    return {
+        "kernel": "column_spmm_pallas",
+        "kind": "dense_blocks",
+        "grid": [["b", nb], ["r", w // t]],
+        "out": {"shape": [nb, w, k], "block": [1, t, k],
+                "index": ["b", "r", 0], "itemsize": 4},
+        "ins": ins,
+        "smem": None,
+        "scratch": [],
+        "sems": None,
+        "vmem_budget": VMEM_BUDGET,
+        "accum_dtype": "f32",
+        "carriage_dtype": "f32",
+        "revisit_axes": [],
+    }
+
+
+def head_call_meta(nb: int, w: int, k: int, t: int) -> dict:
+    """Meta of one concretized head-row reduction ``pallas_call``.
+    The inner grid axis ``b`` revisits the SAME output tile on purpose
+    (matmul k-innermost accumulation) — declared via ``revisit_axes``
+    so KC5 exempts exactly this axis and nothing else."""
+    if t < 1 or w % t:
+        raise ValueError(f"row tile must divide w ({w}), got {t}")
+    if nb < 1 or k < 1:
+        raise ValueError(f"meta needs nb, k >= 1, got nb={nb} k={k}")
+    return {
+        "kernel": "head_spmm_pallas",
+        "kind": "dense_blocks",
+        "grid": [["r", w // t], ["b", nb]],
+        "out": {"shape": [w, k], "block": [t, k], "index": ["r", 0],
+                "itemsize": 4},
+        "ins": [
+            {"name": "head", "shape": [nb, w, w], "block": [1, t, w],
+             "index": ["b", "r", 0], "space": "vmem", "itemsize": 4},
+            {"name": "x", "shape": [nb, w, k], "block": [1, w, k],
+             "index": ["b", 0, 0], "space": "vmem", "itemsize": 4},
+        ],
+        "smem": None,
+        "scratch": [],
+        "sems": None,
+        "vmem_budget": VMEM_BUDGET,
+        "accum_dtype": "f32",
+        "carriage_dtype": "f32",
+        "revisit_axes": ["b"],
+    }
+
+
 def _column_kernel(diag_ref, col_ref, x_ref, x0_ref, out_ref):
     """One (block b, row-tile r) program of the fused column SpMM."""
     acc = jnp.dot(diag_ref[0], x_ref[0], preferred_element_type=jnp.float32)
@@ -111,21 +188,26 @@ def column_spmm_pallas(diag: jax.Array, col: jax.Array, x: jax.Array,
     banded_in = lo is not None
     t = tile or _row_tile(w, stacks=4 if banded_in else 2, k=k,
                           n_vec=4 if banded_in else 2)
-    grid = (nb, w // t)
+    meta = column_call_meta(nb, w, k, t, banded_in)
+    grid = tuple(size for _axis, size in meta["grid"])
 
     # Row-tiled operand specs: program (b, r) sees row tile r of block b
-    # and the full contraction dimension.
+    # and the full contraction dimension.  Block shapes come FROM the
+    # certified meta (graft-kcert single source of truth).
     def mat_spec():
-        return pl.BlockSpec((1, t, w), lambda b, r: (b, r, 0),
+        return pl.BlockSpec(tuple(meta["ins"][0]["block"]),
+                            lambda b, r: (b, r, 0),
                             memory_space=pltpu.VMEM)
 
     def vec_spec():
         return pl.BlockSpec((1, w, k), lambda b, r: (b, 0, 0),
                             memory_space=pltpu.VMEM)
 
-    out_spec = pl.BlockSpec((1, t, k), lambda b, r: (b, r, 0),
+    out_spec = pl.BlockSpec(tuple(meta["out"]["block"]),
+                            lambda b, r: (b, r, 0),
                             memory_space=pltpu.VMEM)
-    out_shape = jax.ShapeDtypeStruct((nb, w, k), x.dtype)
+    out_shape = jax.ShapeDtypeStruct(tuple(meta["out"]["shape"]),
+                                     x.dtype)
 
     banded = lo is not None
     flops = 2 * nb * w * w * k * (4 if banded else 2)
@@ -186,16 +268,21 @@ def head_spmm_pallas(head: jax.Array, x: jax.Array) -> jax.Array:
     """
     nb, w, k = x.shape
     t = _row_tile(w, stacks=1, k=k, n_vec=1)
+    meta = head_call_meta(nb, w, k, t)
     return pl.pallas_call(
         _head_kernel,
-        grid=(w // t, nb),
-        in_specs=[pl.BlockSpec((1, t, w), lambda r, b: (b, r, 0),
+        grid=tuple(size for _axis, size in meta["grid"]),
+        in_specs=[pl.BlockSpec(tuple(meta["ins"][0]["block"]),
+                               lambda r, b: (b, r, 0),
                                memory_space=pltpu.VMEM),
-                  pl.BlockSpec((1, w, k), lambda r, b: (b, 0, 0),
+                  pl.BlockSpec(tuple(meta["ins"][1]["block"]),
+                               lambda r, b: (b, 0, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((t, k), lambda r, b: (r, 0),
+        out_specs=pl.BlockSpec(tuple(meta["out"]["block"]),
+                               lambda r, b: (r, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((w, k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(tuple(meta["out"]["shape"]),
+                                       jnp.float32),
         cost_estimate=pl.CostEstimate(
             flops=2 * nb * w * w * k,
             bytes_accessed=nb * w * w * 4 + nb * w * k * 4 + w * k * 4,
@@ -229,3 +316,85 @@ def arrow_spmm_pallas(blocks, x: jax.Array) -> jax.Array:
     else:
         c = column_spmm_pallas(blocks.diag_data, blocks.col_data, x, x[0])
     return c.at[0].set(c0)
+
+
+# --------------------------------------------------------------------
+# graft-kcert: the declared contract + concretized metas + witness the
+# KC1-KC5 certifier (analysis/kernels.py) reads.
+# --------------------------------------------------------------------
+
+KERNEL_CONTRACT = KernelContract(
+    name="arrow_spmm_pallas",
+    module="arrow_matrix_tpu.ops.pallas_blocks",
+    kind="dense_blocks",
+    granule=1,
+    stream_k_multiple=1,     # dense MXU path carries any k
+    row_blocks=(),           # row tiles are derived (``_row_tile``)
+    rings=(),
+    waves=(),
+    ks=(16, 128),
+    carriage_dtypes=("f32",),
+    accum_dtype="f32",
+    smem_cols_budget=0,
+    vmem_budget_bytes=VMEM_BUDGET,
+    revisit_axes=("b",),     # head_spmm's accumulation axis
+)
+
+
+def kcert_metas():
+    """Concretized call metas at representative (nb, w, k) points:
+    both kernel bodies, banded and plain column stacks, both protocol
+    feature widths, with the row tile ``_row_tile`` would pick."""
+    points_col = [
+        # (nb, w, k, banded)
+        (8, 256, 16, False),
+        (8, 512, 128, True),   # the VMEM-tightest committed shape
+        (4, 128, 128, False),
+    ]
+    metas = []
+    for nb, w, k, banded in points_col:
+        t = _row_tile(w, stacks=4 if banded else 2, k=k,
+                      n_vec=4 if banded else 2)
+        metas.append(column_call_meta(nb, w, k, t, banded))
+    for nb, w, k in [(8, 256, 16), (4, 512, 128)]:
+        metas.append(head_call_meta(nb, w, k,
+                                    _row_tile(w, stacks=1, k=k,
+                                              n_vec=1)))
+    return metas
+
+
+def kcert_witness():
+    """Interpret-mode round trip -> (ok, detail): tiny banded arrow
+    against the einsum golden, exercising both kernel bodies and the
+    revisiting head accumulation."""
+    import numpy as np
+
+    nb, w, k = 3, 16, 4
+    rng = np.random.default_rng(7)
+    mats = {name: jnp.asarray(rng.standard_normal((nb, w, w)),
+                              dtype=jnp.float32)
+            for name in ("head", "diag", "col", "lo", "hi")}
+    x = jnp.asarray(rng.standard_normal((nb, w, k)), dtype=jnp.float32)
+    try:
+        c0 = head_spmm_pallas(mats["head"], x)
+        want0 = jnp.einsum("bij,bjk->ik", mats["head"], x)
+        zeros = jnp.zeros((1, w, k), dtype=x.dtype)
+        x_lo = jnp.concatenate([zeros, x[:-1]], axis=0)
+        x_hi = jnp.concatenate([x[1:], zeros], axis=0)
+        c = column_spmm_pallas(mats["diag"], mats["col"], x, x[0],
+                               mats["lo"], mats["hi"], x_lo, x_hi)
+        want = (jnp.einsum("bij,bjk->bik", mats["diag"], x)
+                + jnp.einsum("bij,jk->bik", mats["col"], x[0])
+                + jnp.einsum("bij,bjk->bik", mats["lo"], x_lo)
+                + jnp.einsum("bij,bjk->bik", mats["hi"], x_hi))
+        # 3x16x4 witness arrays: provably tiny host fetches.
+        if not np.allclose(np.asarray(c0), np.asarray(want0),  # graft-lint: disable=R6
+                           rtol=1e-5, atol=1e-5):
+            return False, "head reduction off the einsum golden"
+        if not np.allclose(np.asarray(c), np.asarray(want),  # graft-lint: disable=R6
+                           rtol=1e-5, atol=1e-5):
+            return False, "banded column SpMM off the einsum golden"
+    except Exception as exc:
+        return False, f"interpret round trip raised: {exc!r}"
+    return True, ("banded column + revisiting head interpret round "
+                  "trip match the einsum golden")

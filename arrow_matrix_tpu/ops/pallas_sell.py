@@ -54,7 +54,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from arrow_matrix_tpu.ops.ell import align_up
-from arrow_matrix_tpu.ops.pallas_blocks import _interpret
+from arrow_matrix_tpu.ops.kernel_contract import (
+    CARRIAGE_ITEMSIZE,
+    KernelContract,
+)
+from arrow_matrix_tpu.ops.pallas_blocks import VMEM_BUDGET, _interpret
 from arrow_matrix_tpu.ops.sell import SellMatrix
 
 GRANULE = 8          # rows per packed feature line (C): 8*k floats each
@@ -63,13 +67,22 @@ GRANULE = 8          # rows per packed feature line (C): 8*k floats each
 # Mosaic vector unit wants the minor dimension in whole 128-lane tiles.
 STREAM_K_MULTIPLE = 16   # C * 16 = 128
 
+#: The contract-declared scalar-prefetch budget (the certified value —
+#: ``KERNEL_CONTRACT`` and the committed kernel_manifest pin THIS one,
+#: independent of the env override below).
+DEFAULT_SMEM_COLS_BUDGET = 1 << 20
+
 #: Scalar-prefetch (SMEM) budget for one slab's column array.  Tiers
 #: whose cols exceed it are streamed through the kernel in row slabs.
 #: ``AMT_PALLAS_SELL_SMEM`` is the *default only*, read once at import
 #: (R9: no per-call env reads); callers — and graft-tune plans — pass
 #: ``smem_cols_budget=`` explicitly to override.
 SMEM_COLS_BUDGET = int(os.environ.get("AMT_PALLAS_SELL_SMEM",
-                                      str(1 << 20)))
+                                      str(DEFAULT_SMEM_COLS_BUDGET)))
+
+#: Carriage dtypes the fused kernel serves (graft-kcert KC4 contract:
+#: the carriage may narrow, the accumulator stays f32).
+CARRIAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
 DEFAULT_ROW_BLOCK = 256  # rows per grid program (multiple of GRANULE)
 DEFAULT_WAVE = 16        # async copies per DMA wave (streaming path)
@@ -114,18 +127,138 @@ def _select_accumulate(lines, cols_j, w_j, r, k):
     return picked * w_j.reshape(r // c, c, 1)
 
 
+def resolve_carriage_dtype(feature_dtype, default=jnp.float32):
+    """Normalize a carriage-dtype request to ``(key, jnp dtype)``.
+
+    ``feature_dtype`` may be a contract key ("f32"/"bf16"), a dtype
+    name, or a dtype object; ``None`` means "carry whatever the input
+    already is" (``default``), falling back to f32 for dtypes the
+    contract does not serve — an *explicit* unsupported request raises
+    instead of silently widening."""
+    if feature_dtype is None:
+        dt = jnp.dtype(default)
+        for key, val in CARRIAGE_DTYPES.items():
+            if dt == jnp.dtype(val):
+                return key, val
+        return "f32", jnp.float32
+    try:
+        if isinstance(feature_dtype, str):
+            alias = {"f32": "float32", "bf16": "bfloat16"}.get(
+                feature_dtype, feature_dtype)
+            dt = jnp.dtype(alias)
+        else:
+            dt = jnp.dtype(feature_dtype)
+    except TypeError:
+        raise ValueError(
+            f"unsupported pallas_sell carriage dtype "
+            f"{feature_dtype!r}; the kernel contract serves "
+            f"{tuple(CARRIAGE_DTYPES)}") from None
+    for key, val in CARRIAGE_DTYPES.items():
+        if dt == jnp.dtype(val):
+            return key, val
+    raise ValueError(
+        f"unsupported pallas_sell carriage dtype {feature_dtype!r}; "
+        f"the kernel contract serves {tuple(CARRIAGE_DTYPES)}")
+
+
+def slab_call_meta(m_t: int, slab: int, k: int, row_block: int,
+                   binary: bool, stream: bool, wave: int, ring: int,
+                   n_lines: Optional[int] = None,
+                   carriage: str = "f32",
+                   smem_cols_budget: Optional[int] = None) -> dict:
+    """The literal description of one concretized slab ``pallas_call``
+    — grid, BlockSpecs, scratch, budgets — in the graft-kcert meta
+    schema.  :func:`_make_slab_call` derives its real grid/block/
+    scratch numbers FROM this dict, so the certified description and
+    the executed call cannot drift apart."""
+    c = GRANULE
+    if ring < 1:
+        raise ValueError(f"ring depth must be >= 1, got {ring}")
+    if m_t < 1:
+        raise ValueError(f"meta needs m_t >= 1, got {m_t}")
+    if k < 1:
+        raise ValueError(f"meta needs k >= 1, got {k}")
+    if row_block < c or row_block % c:
+        raise ValueError(
+            f"row_block must be a positive GRANULE ({c}) multiple, "
+            f"got {row_block}")
+    if wave < 1 or row_block % wave:
+        raise ValueError(
+            f"wave must divide row_block ({row_block}), got {wave}")
+    if slab < row_block or slab % row_block:
+        raise ValueError(
+            f"slab must be a positive row_block ({row_block}) "
+            f"multiple, got {slab}")
+    if carriage not in CARRIAGE_ITEMSIZE:
+        raise ValueError(
+            f"unknown carriage dtype key {carriage!r}; contract "
+            f"serves {tuple(CARRIAGE_ITEMSIZE)}")
+    lanes = c * k
+    n_lines = (max(1, (1 << 12) // c) if n_lines is None
+               # host-side meta builder: the argument is a static
+               # shape, never a traced value
+               else int(n_lines))  # graft-lint: disable=R1
+    budget = (SMEM_COLS_BUDGET if smem_cols_budget is None
+              else smem_cols_budget)
+    item = CARRIAGE_ITEMSIZE[carriage]
+    w_rows = 1 if binary else m_t
+    meta = {
+        "kernel": "sell_tier_spmm_packed",
+        "kind": "sell_stream" if stream else "sell_vectorized",
+        "grid": [["i", slab // row_block]],
+        "out": {"shape": [slab // c, lanes],
+                "block": [row_block // c, lanes],
+                "index": ["i", 0], "itemsize": 4},
+        "ins": [
+            {"name": "cols_vmem", "shape": [m_t, slab],
+             "block": [m_t, row_block], "index": [0, "i"],
+             "space": "vmem", "itemsize": 4},
+            {"name": "weights", "shape": [w_rows, slab],
+             "block": [w_rows, row_block], "index": [0, "i"],
+             "space": "vmem", "itemsize": 4},
+            {"name": "x_packed", "shape": [n_lines, lanes],
+             "block": None, "index": None, "space": "any",
+             "itemsize": item},
+        ],
+        "smem": {"name": "cols_prefetch", "bytes": m_t * 4 * slab,
+                 "budget": budget, "single_block": slab == row_block},
+        "scratch": ([{"name": "dma_scratch",
+                      "shape": [row_block, lanes], "itemsize": item}]
+                    if stream else []),
+        "sems": ({"shape": [ring, wave]} if stream else None),
+        "vmem_budget": VMEM_BUDGET,
+        "accum_dtype": "f32",
+        "carriage_dtype": carriage,
+        "revisit_axes": [],
+    }
+    if stream:
+        meta["stream"] = {
+            "ring": ring, "wave": wave, "n_waves": row_block // wave,
+            "row_block": row_block, "granule": c, "slab": slab,
+            "m_t": m_t, "lines": n_lines, "table_rows": n_lines * c,
+        }
+    return meta
+
+
 def _make_slab_call(m_t: int, slab: int, k: int, row_block: int,
                     binary: bool, stream: bool, wave: int,
-                    interpret: bool, ring: int = DEFAULT_RING):
+                    interpret: bool, ring: int = DEFAULT_RING,
+                    n_lines: Optional[int] = None,
+                    carriage: str = "f32"):
     """One ``pallas_call`` over a (m_t, slab) column slab -> packed
-    (slab // C, C*k) f32 partial output."""
+    (slab // C, C*k) f32 partial output (accumulation is f32 whatever
+    the carriage dtype of ``x_packed`` — KC4)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    meta = slab_call_meta(m_t, slab, k, row_block, binary, stream,
+                          wave, ring, n_lines=n_lines,
+                          carriage=carriage)
     c = GRANULE
     lanes = c * k
-    grid = (slab // row_block,)
-    n_waves = row_block // wave
+    grid = tuple(size for _axis, size in meta["grid"])
+    n_waves = meta["stream"]["n_waves"] if stream else row_block // wave
+    carriage_dt = CARRIAGE_DTYPES[carriage]
 
     def _weight(w_all, cols_all, j, r):
         if binary:
@@ -209,22 +342,28 @@ def _make_slab_call(m_t: int, slab: int, k: int, row_block: int,
         acc = jax.lax.fori_loop(0, m_t, slot_body, acc0)
         out_ref[...] = acc.reshape(row_block // c, lanes)
 
-    w_block = ((1, row_block) if binary else (m_t, row_block))
+    cols_block = tuple(meta["ins"][0]["block"])
+    w_block = tuple(meta["ins"][1]["block"])
+    out_block = tuple(meta["out"]["block"])
     gs = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,            # cols -> SMEM, whole slab
         grid=grid,
         in_specs=[
-            pl.BlockSpec((m_t, row_block), lambda i, sc: (0, i),
+            pl.BlockSpec(cols_block, lambda i, sc: (0, i),
                          memory_space=pltpu.VMEM),   # cols, vector math
             pl.BlockSpec(w_block, lambda i, sc: (0, i),
                          memory_space=pltpu.VMEM),   # data / deg
             pl.BlockSpec(memory_space=pl.ANY),       # packed x: HBM
         ],
-        out_specs=pl.BlockSpec((row_block // c, lanes),
-                               lambda i, sc: (i, 0),
+        out_specs=pl.BlockSpec(out_block, lambda i, sc: (i, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=([pltpu.VMEM((row_block, lanes), jnp.float32),
-                         pltpu.SemaphoreType.DMA((ring, wave))]
+        # DMA scratch carries the FEATURE dtype (a bf16 line must land
+        # in a bf16 slab: async copies cannot convert); the accumulator
+        # in the kernel body stays f32.
+        scratch_shapes=([pltpu.VMEM(tuple(meta["scratch"][0]["shape"]),
+                                    carriage_dt),
+                         pltpu.SemaphoreType.DMA(
+                             tuple(meta["sems"]["shape"]))]
                         if stream else []),
     )
     kernel = kernel_stream if stream else kernel_vectorized
@@ -255,7 +394,8 @@ def sell_tier_spmm_packed(cols: jax.Array, x_packed: jax.Array,
                           stream: Optional[bool] = None,
                           interpret: Optional[bool] = None,
                           smem_cols_budget: Optional[int] = None,
-                          ring: int = DEFAULT_RING) -> jax.Array:
+                          ring: int = DEFAULT_RING,
+                          feature_dtype=None) -> jax.Array:
     """One tier's fused SpMM against granule-packed features.
 
     cols: (m_t, n_t) slot-major int32; x_packed: (n_gran, C*k) from
@@ -265,7 +405,11 @@ def sell_tier_spmm_packed(cols: jax.Array, x_packed: jax.Array,
 
     ``smem_cols_budget`` bounds one slab's scalar-prefetch bytes
     (default: module-level :data:`SMEM_COLS_BUDGET`); ``ring`` is the
-    DMA ring depth of the streaming path (waves in flight).
+    DMA ring depth of the streaming path (waves in flight);
+    ``feature_dtype`` picks the carriage dtype ("f32"/"bf16") the
+    gathered features travel in — accumulation stays f32 either way
+    (the certified KC4 contract), so bf16 carriage halves DMA bytes
+    without narrowing the reduction.
     """
     if interpret is None:
         interpret = _interpret()
@@ -275,6 +419,10 @@ def sell_tier_spmm_packed(cols: jax.Array, x_packed: jax.Array,
         raise ValueError(f"ring depth must be >= 1, got {ring}")
     m_t, n_t = cols.shape
     k = x_packed.shape[1] // GRANULE
+    carriage, carriage_dt = resolve_carriage_dtype(
+        feature_dtype, default=x_packed.dtype)
+    if x_packed.dtype != jnp.dtype(carriage_dt):
+        x_packed = x_packed.astype(carriage_dt)
     if data is None and deg is None and m_t > 0:
         raise ValueError("binary SELL tier (data=None) requires deg")
     if m_t == 0 or n_t == 0:
@@ -314,7 +462,9 @@ def sell_tier_spmm_packed(cols: jax.Array, x_packed: jax.Array,
     for lo in range(0, rows_pad, slab):
         hi = min(lo + slab, rows_pad)
         call = _make_slab_call(m_t, hi - lo, k, rb, binary, stream, w,
-                               interpret, ring=ring)
+                               interpret, ring=ring,
+                               n_lines=x_packed.shape[0],
+                               carriage=carriage)
         outs.append(call(
             jax.lax.slice_in_dim(cols, lo, hi, axis=1),
             jax.lax.slice_in_dim(weights, lo, hi, axis=1),
@@ -329,7 +479,8 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
                        stream: Optional[bool] = None,
                        interpret: Optional[bool] = None,
                        smem_cols_budget: Optional[int] = None,
-                       ring: int = DEFAULT_RING) -> jax.Array:
+                       ring: int = DEFAULT_RING,
+                       feature_dtype=None) -> jax.Array:
     """Drop-in fused twin of ``ops.sell.sell_spmm_t``: (k, n_rows)
     feature-major output, one kernel launch stream per tier, outputs
     concatenated along the sorted row axis (tiers are contiguous runs
@@ -338,6 +489,8 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
     The ``gather_budget``/``chunk`` tiling knobs of the XLA kernel have
     no counterpart here: the fused kernel's footprint is its
     ``row_block`` VMEM tile, not a materialized gather intermediate.
+    ``feature_dtype="bf16"`` narrows the packed-feature carriage only;
+    accumulation stays f32 and the output dtype follows ``x_t``.
     """
     k = x_t.shape[0]
     x_packed = pack_features_t(x_t)
@@ -349,7 +502,7 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
             deg=None if m.deg is None else m.deg[t],
             row_block=row_block, wave=wave, stream=stream,
             interpret=interpret, smem_cols_budget=smem_cols_budget,
-            ring=ring)
+            ring=ring, feature_dtype=feature_dtype)
         outs.append(out_t.T.astype(x_t.dtype))               # (k, n_t)
     if not outs:
         return jnp.zeros((k, 0), dtype=x_t.dtype)
@@ -359,21 +512,117 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
 def supported_feature_width(k: int) -> bool:
     """Whether the streaming (compiled-TPU) path can carry width ``k``
     — callers racing formats use this to fall back to the XLA fold
-    kernel instead of tripping the lane-alignment ValueError."""
-    return k % STREAM_K_MULTIPLE == 0
+    kernel instead of tripping the lane-alignment ValueError.
+
+    Delegates to :meth:`KernelContract.supports_k` — the SAME predicate
+    ``tune/space.py`` prunes with, so kernel validation and tuner
+    feasibility can never disagree (graft-kcert satellite contract).
+    """
+    return KERNEL_CONTRACT.supports_k(k)
 
 
 @functools.partial(jax.jit, static_argnames=("row_block", "wave",
                                              "stream", "interpret",
-                                             "smem_cols_budget", "ring"))
+                                             "smem_cols_budget", "ring",
+                                             "feature_dtype"))
 def sell_spmm_t_pallas_jit(m: SellMatrix, x_t: jax.Array,
                            row_block: int = DEFAULT_ROW_BLOCK,
                            wave: int = DEFAULT_WAVE,
                            stream: Optional[bool] = None,
                            interpret: Optional[bool] = None,
                            smem_cols_budget: Optional[int] = None,
-                           ring: int = DEFAULT_RING) -> jax.Array:
+                           ring: int = DEFAULT_RING,
+                           feature_dtype: Optional[str] = None
+                           ) -> jax.Array:
     return sell_spmm_t_pallas(m, x_t, row_block=row_block, wave=wave,
                               stream=stream, interpret=interpret,
                               smem_cols_budget=smem_cols_budget,
-                              ring=ring)
+                              ring=ring, feature_dtype=feature_dtype)
+
+
+# --------------------------------------------------------------------
+# graft-kcert: the declared contract + concretized metas + witness the
+# KC1-KC5 certifier (analysis/kernels.py) reads.
+# --------------------------------------------------------------------
+
+KERNEL_CONTRACT = KernelContract(
+    name="sell_tier_spmm_packed",
+    module="arrow_matrix_tpu.ops.pallas_sell",
+    kind="sell_stream",
+    granule=GRANULE,
+    stream_k_multiple=STREAM_K_MULTIPLE,
+    row_blocks=(64, 128, 256),
+    rings=(1, 2, 3, 4),
+    waves=(8, 16),
+    ks=(16, 128),
+    carriage_dtypes=("f32", "bf16"),
+    accum_dtype="f32",
+    smem_cols_budget=DEFAULT_SMEM_COLS_BUDGET,
+    vmem_budget_bytes=VMEM_BUDGET,
+)
+
+
+def kcert_metas():
+    """Concretized slab-call metas at the contract's representative
+    parameter points: every ring depth, all row-block tiers, both
+    protocol feature widths, both carriage dtypes, plus the
+    interpret-only vectorized twin.  Hermetic: budgets come from the
+    CONTRACT, not the env-overridable module default, so the committed
+    manifest cannot drift with ``AMT_PALLAS_SELL_SMEM``."""
+    budget = KERNEL_CONTRACT.smem_cols_budget
+    lines = (1 << 12) // GRANULE
+    points = [
+        # (row_block, ring, wave, k, m_t, binary, carriage)
+        (256, 2, 16, 16, 16, True, "f32"),    # the defaults
+        (256, 2, 16, 128, 8, False, "f32"),   # wide k, weighted
+        (64, 1, 8, 16, 5, True, "f32"),       # serial ring, small tier
+        (128, 3, 8, 128, 3, True, "bf16"),    # deep ring, bf16 carriage
+        (256, 4, 16, 16, 16, False, "bf16"),  # deepest ring, weighted
+    ]
+    metas = []
+    for rb, ring, wave, k, m_t, binary, carriage in points:
+        metas.append(slab_call_meta(
+            m_t, slab_rows(m_t, rb, budget), k, rb, binary, True,
+            wave, ring, n_lines=lines, carriage=carriage,
+            smem_cols_budget=budget))
+    # The interpret-only vectorized twin (tier-1 correctness path).
+    metas.append(slab_call_meta(
+        8, 256, 16, 256, True, False, 16, 1, n_lines=lines,
+        smem_cols_budget=budget))
+    return metas
+
+
+def kcert_witness():
+    """KC1 boundary witness -> (ok, detail): a tiny interpret-mode
+    round trip in which EVERY slot points at the last feature row (the
+    upper index bound), both carriage dtypes, streamed and vectorized
+    bodies bit-identical and finite."""
+    rows, m_t, k, n_table = 32, 3, 16, 64
+    cols = jnp.full((m_t, rows), n_table - 1, dtype=jnp.int32)
+    deg = jnp.full((rows,), m_t, dtype=jnp.int32)
+    x_t = jnp.asarray(
+        np.linspace(-1.0, 1.0, k * n_table, dtype=np.float32)
+        .reshape(k, n_table))
+    x_packed = pack_features_t(x_t)
+    try:
+        for fd in ("f32", "bf16"):
+            vec = sell_tier_spmm_packed(
+                cols, x_packed, deg=deg, stream=False, interpret=True,
+                row_block=32, wave=8, feature_dtype=fd)
+            st = sell_tier_spmm_packed(
+                cols, x_packed, deg=deg, stream=True, interpret=True,
+                row_block=32, wave=8, ring=2, feature_dtype=fd)
+            vec, st = np.asarray(vec), np.asarray(st)
+            if not np.array_equal(vec, st):
+                return False, (f"stream/vectorized mismatch at the "
+                               f"boundary column ({fd})")
+            if not np.isfinite(st).all():
+                return False, f"non-finite boundary output ({fd})"
+            # 32-element witness vector: provably tiny host fetch.
+            want = m_t * np.asarray(x_t[:, -1], dtype=np.float32)  # graft-lint: disable=R6
+            if fd == "f32" and not np.allclose(st[0], want, rtol=1e-6):
+                return False, "boundary row value off the golden"
+    except Exception as exc:  # a raise IS the out-of-bounds evidence
+        return False, f"boundary interpret run raised: {exc!r}"
+    return True, ("boundary-column interpret round trip ok "
+                  "(f32+bf16, stream==vectorized, finite)")

@@ -719,7 +719,18 @@ class MultiLevelArrow:
                     sell_spmm_t_pallas,
                 )
 
-                return sell_spmm_t_pallas(blocks[0], xt, **kopts)
+                # The carriage dtype is declared explicitly (KC4: the
+                # kernel accumulates f32 regardless), and follows the
+                # features as delivered — set_features retargeting
+                # keeps working because xt.dtype is a trace-time
+                # static, not a build-time capture.  int8 was widened
+                # above, so it always lands on the f32 carriage.
+                fd = kopts.get("feature_dtype") or (
+                    "bf16" if xt.dtype == jnp.bfloat16 else "f32")
+                opts = {kk: vv for kk, vv in kopts.items()
+                        if kk != "feature_dtype"}
+                return sell_spmm_t_pallas(blocks[0], xt,
+                                          feature_dtype=fd, **opts)
             if chunk == "auto":
                 return sell_spmm_t(blocks[0], xt,
                                    gather_budget=gather_budget)

@@ -271,6 +271,7 @@ def search(source: dict, k: int, *, iters: int = 3,
            run_dir: Optional[str] = None,
            ledger_dir: Optional[str] = None,
            traffic_class: str = "exact",
+           extra: Optional[List[Candidate]] = None,
            quiet: bool = False) -> Tuple[Optional[TunePlan], dict]:
     """Search (or cache-hit) the tuned plan for one (structure, k).
 
@@ -287,6 +288,11 @@ def search(source: dict, k: int, *, iters: int = 3,
     (``load_plan`` keys on k within one structure file — approx
     searches should use a distinct ``plan_dir`` or consume the plan
     object directly, as ``serve/scheduler.ArrowServer`` does).
+
+    ``extra`` forwards caller-supplied candidates (generated
+    programs) to ``enumerate_candidates``; pallas extras must pass
+    graft-kcert certification there or they are pruned with zero
+    children spawned.
     """
     from arrow_matrix_tpu.classes import tolerance_for
     from arrow_matrix_tpu.utils.platform import host_load
@@ -330,7 +336,7 @@ def search(source: dict, k: int, *, iters: int = 3,
 
     cands, pruned = enumerate_candidates(
         fp, k, platform=platform, allow_int8=allow_int8,
-        restrict=restrict, traffic_class=traffic_class)
+        restrict=restrict, traffic_class=traffic_class, extra=extra)
     for name, why in pruned.items():
         _say(f"pruned {name}: {why}")
 

@@ -22,8 +22,19 @@ already trusts:
   configuration's model (3x slack — the models rank, the bench race
   decides);
 * evaluator capability: the streaming pallas path needs
-  ``k % 16 == 0`` on a real chip; DMA-ring variants are stream-only
-  so they are pruned on the interpret (CPU) evaluator.
+  ``k % 16 == 0`` on a real chip — read from the ONE predicate the
+  kernel itself validates with
+  (``ops/pallas_sell.supported_feature_width`` ->
+  ``KernelContract.supports_k``, graft-kcert) so the tuner and the
+  kernel can never disagree; DMA-ring variants are stream-only so
+  they are pruned on the interpret (CPU) evaluator;
+* kernel certification (graft-kcert): every pallas candidate's
+  concretized call meta is proven under KC1-KC5
+  (``analysis/kernels.certify_candidate_opts``) BEFORE any child
+  spawns — an uncertifiable grid/ring/budget combination is pruned
+  with a ``"kcert: ..."`` reason and zero children.  Generated
+  programs (ROADMAP item 3) ride the same screen through the
+  ``extra`` candidate hook.
 
 Carriage-dtype eligibility is per traffic class (graft-classes): for
 ``traffic_class="exact"`` (the default, today's contract) bf16/int8
@@ -73,7 +84,8 @@ def enumerate_candidates(fp: dict, k: int, *,
                          allow_int8: bool = False,
                          budget_bytes: Optional[int] = None,
                          restrict: Optional[List[str]] = None,
-                         traffic_class: str = "exact"
+                         traffic_class: str = "exact",
+                         extra: Optional[List[Candidate]] = None
                          ) -> Tuple[List[Candidate], Dict[str, str]]:
     """The candidate list for one (fingerprint, k), already pruned.
 
@@ -87,6 +99,11 @@ def enumerate_candidates(fp: dict, k: int, *,
     ``traffic_class="approx"`` flips the carriage-dtype candidates to
     ``eligible=True`` (tolerance-gated winners, see module docstring);
     int8 still needs the explicit ``allow_int8`` opt-in even there.
+
+    ``extra`` appends caller-supplied candidates (the generated-
+    program hook): they ride the same screens, including graft-kcert
+    certification for pallas kernels — an uncertifiable candidate is
+    pruned here, before any child spawns.
     """
     from arrow_matrix_tpu.classes import TRAFFIC_CLASSES
 
@@ -136,6 +153,15 @@ def enumerate_candidates(fp: dict, k: int, *,
                   build={"kernel": "pallas_sell"},
                   kernel_opts={"ring": 4},
                   note="deeper VMEM ring"),
+        Candidate("pallas_sell_bf16",
+                  build={"kernel": "pallas_sell",
+                         "feature_dtype": "bf16"},
+                  eligible=approx,
+                  note=("fused kernel, bf16 carriage / f32 "
+                        "accumulate (KC1-KC5 certified); "
+                        "tolerance-gated winner" if approx else
+                        "fused kernel, bf16 carriage diagnostic "
+                        "(never f32 bit-identical; cannot win)")),
         Candidate("overlap2",
                   build={"overlap_slabs": 2},
                   note="S=2 chunked overlap schedule"),
@@ -155,6 +181,8 @@ def enumerate_candidates(fp: dict, k: int, *,
             note=("opt-in int8 (q, scale) carriage: approx-class "
                   "candidate" if approx else
                   "opt-in int8-carriage experiment (diagnostic only)")))
+    if extra:
+        raw.extend(extra)
 
     budget = hbm_budget_bytes(budget_bytes)
     base_bytes = predicted_operator_bytes(fp, k)
@@ -191,7 +219,11 @@ def enumerate_candidates(fp: dict, k: int, *,
                               f"(k={k}, c={repl})")
             continue
         if c.build.get("kernel") == "pallas_sell":
-            if not interpret and k % 16:
+            # The ONE streaming-gate predicate: the kernel's own
+            # contract (supported_feature_width -> supports_k).
+            from arrow_matrix_tpu.ops.pallas_sell import (
+                supported_feature_width)
+            if not interpret and not supported_feature_width(k):
                 pruned[c.name] = ("streaming pallas_sell needs "
                                   f"k % 16 == 0 on chip (k={k})")
                 continue
@@ -199,6 +231,14 @@ def enumerate_candidates(fp: dict, k: int, *,
                 pruned[c.name] = ("DMA ring depth is a stream-only "
                                   "knob; interpret evaluator runs the "
                                   "vectorized body")
+                continue
+            from arrow_matrix_tpu.analysis.kernels import (
+                certify_candidate_opts)
+            reason = certify_candidate_opts(
+                c.kernel_opts, k, interpret=interpret,
+                feature_dtype=c.build.get("feature_dtype"))
+            if reason is not None:
+                pruned[c.name] = reason
                 continue
         out.append(c)
     return out, pruned

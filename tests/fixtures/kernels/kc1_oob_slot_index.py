@@ -1,0 +1,33 @@
+"""Planted KC1 violation: the cols VMEM operand is indexed past its
+extent.  The grid walks 3 row blocks of 128 (slab = 384) but the
+column array only holds 256 rows — the third program's slot indices
+read out of bounds.  Everything else (output tiling, budgets, ring
+discipline, coverage) is consistent, so exactly KC1 fires.
+"""
+
+META = {
+    "kernel": "kc1_oob_slot_index", "kind": "sell_stream",
+    "grid": [["i", 3]],
+    "out": {"shape": [48, 128], "block": [16, 128],
+            "index": ["i", 0], "itemsize": 4},
+    "ins": [
+        {"name": "cols_vmem", "shape": [8, 256], "block": [8, 128],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "weights", "shape": [1, 384], "block": [1, 128],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "x_packed", "shape": [512, 128], "block": None,
+         "index": None, "space": "any", "itemsize": 4},
+    ],
+    "smem": {"name": "cols_prefetch", "bytes": 12288,
+             "budget": 1048576, "single_block": False},
+    "scratch": [{"name": "dma_scratch", "shape": [128, 128],
+                 "itemsize": 4}],
+    "sems": {"shape": [2, 16]},
+    "vmem_budget": 8388608,
+    "accum_dtype": "f32",
+    "carriage_dtype": "f32",
+    "revisit_axes": [],
+    "stream": {"ring": 2, "wave": 16, "n_waves": 8,
+               "row_block": 128, "granule": 8, "slab": 384,
+               "m_t": 8, "lines": 512, "table_rows": 4096},
+}

@@ -1,0 +1,31 @@
+"""Planted KC2 violation: the DMA scratch slab alone (128 x 8192 f32
+= 4 MiB) exceeds the kernel's declared 2 MiB VMEM budget.  Indexing,
+ring discipline, and coverage all hold, so exactly KC2 fires.
+"""
+
+META = {
+    "kernel": "kc2_overbudget_scratch", "kind": "sell_stream",
+    "grid": [["i", 2]],
+    "out": {"shape": [32, 8192], "block": [16, 8192],
+            "index": ["i", 0], "itemsize": 4},
+    "ins": [
+        {"name": "cols_vmem", "shape": [8, 256], "block": [8, 128],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "weights", "shape": [1, 256], "block": [1, 128],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "x_packed", "shape": [512, 8192], "block": None,
+         "index": None, "space": "any", "itemsize": 4},
+    ],
+    "smem": {"name": "cols_prefetch", "bytes": 8192,
+             "budget": 1048576, "single_block": False},
+    "scratch": [{"name": "dma_scratch", "shape": [128, 8192],
+                 "itemsize": 4}],
+    "sems": {"shape": [2, 16]},
+    "vmem_budget": 2097152,
+    "accum_dtype": "f32",
+    "carriage_dtype": "f32",
+    "revisit_axes": [],
+    "stream": {"ring": 2, "wave": 16, "n_waves": 8,
+               "row_block": 128, "granule": 8, "slab": 256,
+               "m_t": 8, "lines": 512, "table_rows": 4096},
+}

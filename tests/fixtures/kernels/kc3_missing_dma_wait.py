@@ -1,0 +1,36 @@
+"""Planted KC3 violation: the streaming kernel issues async copies
+(.start()) but never waits on any of them — the accumulate reads the
+scratch slab while the DMA engine may still be writing it.  Exactly
+KC3 fires (the accumulator stays f32, budgets and indices are not
+declared here).
+"""
+
+
+def kernel_stream_broken(cols_smem, x_any, out_ref, scratch, sems,
+                         pltpu, jax, jnp, pl, wave, ring, n_waves):
+    def copy(j, w, r):
+        rr = w * wave + r
+        g = cols_smem[j, rr]
+        return pltpu.make_async_copy(
+            x_any.at[g], scratch.at[rr], sems.at[w % ring, r])
+
+    def issue(j, w):
+        jax.lax.fori_loop(
+            0, wave, lambda r, _: (copy(j, w, r).start(), 0)[1], 0)
+
+    def slot_body(j, acc):
+        for p in range(min(ring - 1, n_waves)):
+            issue(j, p)
+
+        def wave_body(w, carry):
+            @pl.when(w + ring - 1 < n_waves)
+            def _():
+                issue(j, w + ring - 1)
+            # BROKEN: no copy(...).wait() anywhere — the scratch read
+            # below races the in-flight DMA.
+            return carry
+
+        jax.lax.fori_loop(0, n_waves, wave_body, 0)
+        return acc + scratch[...].astype(jnp.float32).sum()
+
+    out_ref[...] = slot_body(0, 0)

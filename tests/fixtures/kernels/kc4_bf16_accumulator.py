@@ -1,0 +1,38 @@
+"""Planted KC4 violation: the kernel's accumulator is initialized at
+bf16, so every partial sum rounds to 8 mantissa bits — the carriage
+may narrow, the accumulator may not (H4' at the kernel level).
+Exactly KC4 fires: both the declared META accum dtype and the
+in-source ``jnp.zeros(dtype=jnp.bfloat16)`` are narrow.
+"""
+
+META = {
+    "kernel": "kc4_bf16_accumulator", "kind": "sell_vectorized",
+    "grid": [["i", 2]],
+    "out": {"shape": [32, 128], "block": [16, 128],
+            "index": ["i", 0], "itemsize": 4},
+    "ins": [
+        {"name": "cols_vmem", "shape": [8, 256], "block": [8, 128],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "weights", "shape": [1, 256], "block": [1, 128],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "x_packed", "shape": [512, 128], "block": None,
+         "index": None, "space": "any", "itemsize": 4},
+    ],
+    "smem": {"name": "cols_prefetch", "bytes": 8192,
+             "budget": 1048576, "single_block": False},
+    "scratch": [],
+    "sems": None,
+    "vmem_budget": 8388608,
+    "accum_dtype": "bf16",
+    "carriage_dtype": "bf16",
+    "revisit_axes": [],
+}
+
+
+def kernel_vectorized_broken(cols_vmem, x_any, out_ref, jnp, m_t):
+    # BROKEN: bf16 accumulator — every slot's contribution is rounded
+    # before the next one lands.
+    acc = jnp.zeros((16, 128), dtype=jnp.bfloat16)
+    for j in range(m_t):
+        acc = acc + x_any[j].astype(jnp.bfloat16)
+    out_ref[...] = acc

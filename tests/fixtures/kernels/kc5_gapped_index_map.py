@@ -1,0 +1,32 @@
+"""Planted KC5 violation: the output holds 4 row blocks of 32 packed
+rows but the grid only walks 3 — output block (3, 0) is never
+written and serves stale memory.  All indices stay in bounds (3 x 32
+<= 128) and budgets hold, so exactly KC5 fires.
+"""
+
+META = {
+    "kernel": "kc5_gapped_index_map", "kind": "sell_stream",
+    "grid": [["i", 3]],
+    "out": {"shape": [128, 128], "block": [32, 128],
+            "index": ["i", 0], "itemsize": 4},
+    "ins": [
+        {"name": "cols_vmem", "shape": [8, 1024], "block": [8, 256],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "weights", "shape": [1, 1024], "block": [1, 256],
+         "index": [0, "i"], "space": "vmem", "itemsize": 4},
+        {"name": "x_packed", "shape": [512, 128], "block": None,
+         "index": None, "space": "any", "itemsize": 4},
+    ],
+    "smem": {"name": "cols_prefetch", "bytes": 24576,
+             "budget": 1048576, "single_block": False},
+    "scratch": [{"name": "dma_scratch", "shape": [256, 128],
+                 "itemsize": 4}],
+    "sems": {"shape": [2, 16]},
+    "vmem_budget": 8388608,
+    "accum_dtype": "f32",
+    "carriage_dtype": "f32",
+    "revisit_axes": [],
+    "stream": {"ring": 2, "wave": 16, "n_waves": 16,
+               "row_block": 256, "granule": 8, "slab": 768,
+               "m_t": 8, "lines": 512, "table_rows": 4096},
+}

@@ -333,7 +333,8 @@ def test_chaos_gate_fast_scenarios(tmp_path):
     gate = _load_gate()
     problems, scenarios = gate.run_gate(str(tmp_path), fast=True)
     assert problems == []
-    assert scenarios == ["nan", "hang", "corrupt", "sync", "serve_hang",
+    assert scenarios == ["nan", "hang", "corrupt", "sync", "kcert",
+                         "serve_hang",
                          "serve_corrupt", "serve_overflow", "serve_hbm",
                          "slo_burn_degrade", "serve_classes",
                          "reshard_h7"]

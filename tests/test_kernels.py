@@ -1,0 +1,249 @@
+"""graft-kcert tests: the static Pallas kernel certifier (KC1-KC5).
+
+Covers the certifier's own selftest twins, the planted-broken-kernel
+fixtures (each fires EXACTLY its rule), the shipped two-kernel
+manifest (clean + drift-free against the checked-in
+bench_cache/kernel_manifest.json), the ONE streaming-gate predicate
+shared by the kernel and the tuner (they can never disagree), tune
+pruning of uncertifiable candidates BEFORE any child spawns, the
+generated-program registration hook, and the kind="kcert" ledger
+record the drift gate bands on rule counts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from arrow_matrix_tpu.analysis import kernels as kcert
+from arrow_matrix_tpu.ledger import gate as ledger_gate
+from arrow_matrix_tpu.ledger.store import Ledger
+from arrow_matrix_tpu.ops.kernel_contract import (
+    KernelContract,
+    KernelEntry,
+    builtin_kernels,
+    register_kernel,
+    registered_kernels,
+    unregister_kernel,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "kernels")
+MANIFEST = os.path.join(REPO, "bench_cache", "kernel_manifest.json")
+FIXTURES = sorted(
+    os.path.join(FIXTURE_DIR, f) for f in os.listdir(FIXTURE_DIR)
+    if f.startswith("kc") and f.endswith(".py"))
+
+
+# ---------------------------------------------------------------------------
+# Selftest + fixtures (host-only: no jax)
+# ---------------------------------------------------------------------------
+
+def test_selftest_green():
+    ok, lines = kcert.selftest()
+    assert ok, "\n".join(lines)
+
+
+def test_fixtures_exist_one_per_rule():
+    got = sorted(kcert.fixture_contract(p) for p in FIXTURES)
+    assert got == sorted(kcert.RULE_IDS)
+
+
+@pytest.mark.parametrize("path", FIXTURES,
+                         ids=[os.path.basename(p) for p in FIXTURES])
+def test_fixture_fires_exactly_its_rule(path):
+    ok, detail = kcert.verify_fixture(path)
+    assert ok, detail
+    # Exclusivity: the planted violation trips its own rule and ONLY
+    # its own rule — collateral findings would mean the fixture (or a
+    # checker) is sloppier than it claims.
+    expected = kcert.fixture_contract(path)
+    fired = {f.rule for f in kcert.certify_paths([path])}
+    assert fired == {expected}, (expected, sorted(fired))
+
+
+def test_kernel_gate_paths_nonzero_on_fixture():
+    # The CI wrapper treats a planted fixture as a real kernel file:
+    # certification must FAIL loudly (nonzero exit).
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kernel_gate.py"),
+         "--paths", FIXTURES[0]],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Shipped kernels: clean + drift-free manifest
+# ---------------------------------------------------------------------------
+
+def test_shipped_kernels_certify_clean_and_drift_free():
+    records = kcert.certify_all()
+    fresh = kcert.build_manifest(records)
+    assert fresh["ok"], [r["findings"] for r in records]
+    assert fresh["counts"]["kernels"] == 2
+    with open(MANIFEST, encoding="utf-8") as fh:
+        committed = json.load(fh)
+    problems = kcert.manifest_drift(committed, fresh)
+    assert not problems, problems
+
+
+def test_manifest_volatile_keys_do_not_drift():
+    records = kcert.certify_all()
+    a = kcert.build_manifest(records)
+    b = dict(kcert.build_manifest(records))
+    b["timestamp"] = "1970-01-01T00:00:00"
+    b["platform"] = "somewhere-else"
+    assert not kcert.manifest_drift(a, b)
+
+
+# ---------------------------------------------------------------------------
+# The ONE streaming-gate predicate (kernel == tuner, never disagree)
+# ---------------------------------------------------------------------------
+
+def test_streaming_gate_predicate_is_shared():
+    from arrow_matrix_tpu.ops.pallas_sell import (
+        KERNEL_CONTRACT,
+        supported_feature_width,
+    )
+
+    for k in range(1, 257):
+        assert supported_feature_width(k) == KERNEL_CONTRACT.supports_k(k)
+
+
+@pytest.mark.parametrize("k", [16, 17, 32, 48, 100, 128])
+def test_tune_pruning_agrees_with_kernel_predicate(k):
+    from arrow_matrix_tpu.ops.pallas_sell import supported_feature_width
+    from arrow_matrix_tpu.tune.space import enumerate_candidates
+
+    fp = {"ladder": {"slots": [1024], "rows": [128]},
+          "total_rows": 128, "binary": False, "n": 128}
+    cands, pruned = enumerate_candidates(fp, k, platform="tpu")
+    kept = {c.name for c in cands}
+    if supported_feature_width(k):
+        assert "pallas_sell" in kept
+    else:
+        assert "pallas_sell" not in kept
+        assert "k % 16 == 0" in pruned["pallas_sell"]
+
+
+# ---------------------------------------------------------------------------
+# kcert pruning: uncertifiable candidates die before any child spawns
+# ---------------------------------------------------------------------------
+
+def test_uncertifiable_candidate_pruned_with_kcert_reason():
+    from arrow_matrix_tpu.tune.space import Candidate, enumerate_candidates
+
+    fp = {"ladder": {"slots": [1024], "rows": [128]},
+          "total_rows": 128, "binary": False, "n": 128}
+    bad = Candidate("pallas_bad_ring",
+                    build={"kernel": "pallas_sell"},
+                    kernel_opts={"ring": 0})
+    cands, pruned = enumerate_candidates(fp, 16, platform="tpu",
+                                         extra=[bad])
+    # Pruned at enumeration time — the search loop only spawns child
+    # processes for surviving candidates, so this is the zero-children
+    # guarantee.
+    assert "pallas_bad_ring" not in {c.name for c in cands}
+    assert pruned["pallas_bad_ring"].startswith("kcert:")
+
+
+def test_certify_candidate_opts_reasons():
+    assert kcert.certify_candidate_opts({}, 16) is None
+    assert kcert.certify_candidate_opts({}, 16,
+                                        feature_dtype="bf16") is None
+    reason = kcert.certify_candidate_opts({"ring": 0}, 16)
+    assert reason is not None and reason.startswith("kcert:")
+    reason = kcert.certify_candidate_opts({}, 17)
+    assert reason is not None and "k % 16" in reason
+    # Interpret evaluators run the vectorized body: k is not gated.
+    assert kcert.certify_candidate_opts({}, 17, interpret=True) is None
+    reason = kcert.certify_candidate_opts({}, 16, feature_dtype="f64")
+    assert reason is not None and reason.startswith("kcert:")
+
+
+def test_bf16_pallas_candidate_is_approx_class_only():
+    from arrow_matrix_tpu.tune.space import enumerate_candidates
+
+    fp = {"ladder": {"slots": [1024], "rows": [128]},
+          "total_rows": 128, "binary": False, "n": 128}
+    for traffic_class, eligible in (("exact", False), ("approx", True)):
+        cands, _ = enumerate_candidates(fp, 16, platform="tpu",
+                                        traffic_class=traffic_class)
+        by_name = {c.name: c for c in cands}
+        assert "pallas_sell_bf16" in by_name
+        assert by_name["pallas_sell_bf16"].eligible is eligible
+
+
+# ---------------------------------------------------------------------------
+# Generated-program hook
+# ---------------------------------------------------------------------------
+
+def test_registered_kernel_rides_certification():
+    broken = kcert._broken_meta(grid=[["i", 5]])
+    broken = dict(broken, kernel="generated_oob")
+    contract = KernelContract(name="generated_oob", module="<gen>",
+                              kind="sell_stream",
+                              smem_cols_budget=1 << 20,
+                              vmem_budget_bytes=8 << 20)
+    entry = KernelEntry(contract=contract, metas=lambda: [broken],
+                        source_path=None)
+    register_kernel(entry)
+    try:
+        names = [e.name for e in registered_kernels()]
+        assert "generated_oob" in names
+        rec = kcert.certify_entry(entry)
+        assert not rec["ok"]
+        assert rec["rules"]["KC1"]["status"] == "fail"
+    finally:
+        unregister_kernel("generated_oob")
+    assert all(e.name != "generated_oob" for e in registered_kernels())
+    assert len(builtin_kernels()) == 2
+
+
+# ---------------------------------------------------------------------------
+# Ledger: kind="kcert" rule-count drift gate
+# ---------------------------------------------------------------------------
+
+def test_kcert_ledger_record_and_count_regression_gate(tmp_path):
+    lg = Ledger(str(tmp_path))
+    rec = lg.record("kcert", "rules_pass", 10.0, unit="count",
+                    host_load=None,
+                    knobs={"kernels": 2, "points": 11},
+                    payload={"findings": 0, "ok": True})
+    baseline = ledger_gate.build_baseline([rec])
+    same = dict(rec, value=10.0)
+    failures, _ = ledger_gate.check_records([same], baseline)
+    assert not failures, failures
+    worse = lg.record("kcert", "rules_pass", 9.0, unit="count",
+                      host_load=None,
+                      knobs={"kernels": 2, "points": 11},
+                      payload={"findings": 1, "ok": False})
+    failures, _ = ledger_gate.check_records([worse], baseline)
+    assert failures and "kcert regression" in failures[0]
+
+
+def test_run_kernels_records_rule_count(tmp_path, monkeypatch):
+    monkeypatch.setenv("AMT_LEDGER", "1")
+    out = str(tmp_path / "manifest.json")
+    manifest = kcert.run_kernels(out_path=out, write=True,
+                                 ledger_dir=str(tmp_path), record=True)
+    assert os.path.exists(out)
+    recs = Ledger(str(tmp_path)).read_all()
+    assert len(recs) == 1 and recs[0]["kind"] == "kcert"
+    assert recs[0]["value"] == float(manifest["counts"]["rules_pass"])
+
+
+# ---------------------------------------------------------------------------
+# CLI round trip
+# ---------------------------------------------------------------------------
+
+def test_cli_check_mode_green():
+    proc = subprocess.run(
+        [sys.executable, "-m", "arrow_matrix_tpu.analysis", "kernels",
+         "--check"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "kernel certification passed" in proc.stdout

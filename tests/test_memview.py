@@ -19,7 +19,6 @@ from arrow_matrix_tpu.obs.__main__ import main as trace_main
 from arrow_matrix_tpu.obs.imbalance import summarize_units
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-FIXTURE_BASE = os.path.join(REPO, "ba_256_3")
 
 
 # ---------------------------------------------------------------------------
@@ -83,7 +82,7 @@ def test_tree_device_bytes_counts_array_leaves_only():
 
 
 @pytest.fixture(scope="module")
-def fixture_multi():
+def fixture_multi(ba_256_3_base):
     import jax
 
     from arrow_matrix_tpu.io import load_decomposition
@@ -92,7 +91,7 @@ def fixture_multi():
     from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
 
     levels = as_levels(
-        load_decomposition(FIXTURE_BASE, 32, block_diagonal=True), 32)
+        load_decomposition(ba_256_3_base, 32, block_diagonal=True), 32)
     mesh = make_mesh((4,), ("blocks",), devices=jax.devices()[:4])
     return MultiLevelArrow(levels, 32, mesh=mesh), levels
 

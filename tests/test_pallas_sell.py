@@ -205,3 +205,95 @@ def test_jit_wrapper_no_retrace():
     assert fn._cache_size() == n0
     np.testing.assert_allclose(np.asarray(out2), 2 * np.asarray(out1),
                                rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# graft-kcert certified parity matrix: every (ring, row_block, k,
+# carriage) cell of the contract's representative space, interpret
+# stream vs the ops/sell.py golden.
+# ---------------------------------------------------------------------------
+
+def _parity_problem(k, seed):
+    rng = np.random.default_rng(seed)
+    rows, m_t, n_table = 256, 4, 256
+    cols = rng.integers(0, n_table, size=(m_t, rows)).astype(np.int32)
+    deg = rng.integers(0, m_t + 1, size=rows).astype(np.int32)
+    x_t = jnp.asarray(rng.standard_normal((k, n_table)),
+                      dtype=jnp.float32)
+    m = SellMatrix(cols=(jnp.asarray(cols),), data=None,
+                   deg=(jnp.asarray(deg),), n_rows=rows,
+                   row_starts=(0,))
+    return m, x_t
+
+
+@pytest.mark.parametrize("feature_dtype", ["f32", "bf16"])
+@pytest.mark.parametrize("k", [16, 128])
+@pytest.mark.parametrize("row_block", [64, 128])
+@pytest.mark.parametrize("ring", [1, 2, 3, 4])
+def test_certified_parity_matrix(ring, row_block, k, feature_dtype):
+    from arrow_matrix_tpu.analysis.kernels import certify_candidate_opts
+    from arrow_matrix_tpu.classes import BF16_TOLERANCE
+
+    # Every cell raced here is a cell the certifier admits: the tuner
+    # prunes with the same call, so a red cell could never ship.
+    assert certify_candidate_opts(
+        {"ring": ring, "row_block": row_block}, k,
+        feature_dtype=feature_dtype) is None
+
+    m, x_t = _parity_problem(k, seed=ring * 1000 + row_block + k)
+    x_packed = pack_features_t(x_t)
+    cols, deg = m.cols[0], m.deg[0]
+    got = np.asarray(sell_tier_spmm_packed(
+        cols, x_packed, deg=deg, stream=True, interpret=True,
+        row_block=row_block, wave=4, ring=ring,
+        feature_dtype=feature_dtype))
+    if feature_dtype == "f32":
+        # f32 carriage: the golden is the unfused gather kernel; only
+        # accumulation order differs.
+        want = np.asarray(sell_spmm_t(m, x_t,
+                                      gather_budget=1 << 24)).T
+        assert relative_error(got, want) <= relative_tolerance(4)
+    else:
+        # bf16 carriage: the emulated-bf16 golden quantizes the
+        # features exactly like the kernel's carriage cast, then
+        # accumulates in f32 (KC4) — agreement must land within the
+        # committed approx-class certificate tolerance.
+        xq = x_t.astype(jnp.bfloat16).astype(jnp.float32)
+        want = np.asarray(sell_spmm_t(m, xq,
+                                      gather_budget=1 << 24)).T
+        assert relative_error(got, want) <= BF16_TOLERANCE
+
+
+@pytest.mark.parametrize("k", [16, 128])
+def test_bf16_stream_bitwise_matches_vectorized(k):
+    # Same accumulation order on both interpret bodies -> the bf16
+    # carriage answers bit-identically regardless of the DMA path.
+    m, x_t = _parity_problem(k, seed=31 + k)
+    x_packed = pack_features_t(x_t)
+    cols, deg = m.cols[0], m.deg[0]
+    vec = sell_tier_spmm_packed(cols, x_packed, deg=deg, stream=False,
+                                interpret=True, feature_dtype="bf16")
+    st = sell_tier_spmm_packed(cols, x_packed, deg=deg, stream=True,
+                               interpret=True, wave=4, ring=2,
+                               feature_dtype="bf16")
+    np.testing.assert_array_equal(np.asarray(st), np.asarray(vec))
+    assert st.dtype == jnp.float32  # f32 accumulator surfaces f32
+
+
+def test_bf16_full_matrix_and_jit_static_dtype():
+    # The SellMatrix entry point + jit wrapper thread feature_dtype as
+    # a static arg: retargeting the carriage recompiles exactly once
+    # and lands within the approx-class tolerance of the f32 answer.
+    from arrow_matrix_tpu.classes import BF16_TOLERANCE
+
+    m, x_t = _synthetic_binary(512, 128, 4, 16, seed=21)
+    fn = pallas_sell.sell_spmm_t_pallas_jit
+    f32 = fn(m, x_t)
+    n0 = fn._cache_size()
+    bf = fn(m, x_t, feature_dtype="bf16")
+    assert fn._cache_size() == n0 + 1
+    bf2 = fn(m, x_t, feature_dtype="bf16")
+    assert fn._cache_size() == n0 + 1
+    np.testing.assert_array_equal(np.asarray(bf), np.asarray(bf2))
+    assert relative_error(np.asarray(bf),
+                          np.asarray(f32)) <= BF16_TOLERANCE

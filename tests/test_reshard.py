@@ -21,7 +21,6 @@ from arrow_matrix_tpu.parallel.reshard import (
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-FIXTURE_BASE = os.path.join(REPO, "ba_256_3")
 
 
 def _expected(table, x):
@@ -210,9 +209,10 @@ def test_take_dispatches_staged_routes():
 
 
 @pytest.fixture(scope="module")
-def a2a_pair():
-    """(one-shot, staged) a2a executors over the checked-in ba_256_3
-    decomposition on a 4-device sub-mesh."""
+def a2a_pair(ba_256_3_base):
+    """(one-shot, staged) a2a executors over the ba_256_3 decomposition
+    artifact (regenerated on demand by conftest) on a 4-device
+    sub-mesh."""
     import jax
 
     from arrow_matrix_tpu.io import load_decomposition
@@ -221,7 +221,7 @@ def a2a_pair():
     from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
 
     levels = as_levels(
-        load_decomposition(FIXTURE_BASE, 32, block_diagonal=True), 32)
+        load_decomposition(ba_256_3_base, 32, block_diagonal=True), 32)
     mesh = make_mesh((4,), ("blocks",), devices=jax.devices()[:4])
     one = MultiLevelArrow(levels, 32, mesh=mesh, routing="a2a")
     budget = max(one.exchange_scratch_bytes(4) // 2, 4 * 2 * 4 * 4)

@@ -28,6 +28,11 @@ checkpoint resume), and that the recovered run's final X is
              checkpoint must be the canonical merged carriage (the
              Supervisor ``canonicalize`` hook), so the resumed run is
              still bit-identical to the never-killed replicated run.
+  sync     — graft-sync selftest twins trip + the static RC1-RC5
+             lock-discipline proof holds over the shipped package.
+  kcert    — graft-kcert selftest twins trip + both shipped Pallas
+             kernels certify under KC1-KC5 (including the
+             interpret-mode numeric witness).
 
 Plus the graft-serve chaos-under-load matrix (tools/serve_gate.py):
 serve_hang / serve_corrupt / serve_overflow / serve_hbm in-process
@@ -337,6 +342,24 @@ def scenario_sync():
     return problems
 
 
+def scenario_kcert():
+    """graft-kcert: the KC1-KC5 certifier's broken twins must still
+    trip (host-only selftest) and both shipped Pallas kernels must
+    certify — grid/BlockSpec/budget proof plus the interpret-mode
+    numeric witness (no drift check here — tools/kernel_gate.py owns
+    that)."""
+    from arrow_matrix_tpu.analysis import kernels as graft_kcert
+
+    problems = []
+    ok, lines = graft_kcert.selftest()
+    if not ok:
+        problems += [f"kcert: {ln}" for ln in lines]
+    for rec in graft_kcert.certify_all():
+        for f in rec["findings"]:
+            problems.append(f"kcert: {f}")
+    return problems
+
+
 def run_gate(workdir, fast=False):
     """Run the matrix; returns (problems, scenarios_run)."""
     from arrow_matrix_tpu import faults
@@ -367,6 +390,11 @@ def run_gate(workdir, fast=False):
         # AMT_LOCK_WITNESS=1 is exported around this gate.
         scenarios.append("sync")
         problems += scenario_sync()
+        # graft-kcert rides the fast list too: the certifier is
+        # host-side meta/AST work and the witness is a small
+        # interpret-mode round trip per kernel.
+        scenarios.append("kcert")
+        problems += scenario_kcert()
         # The serving matrix rides the same gate (tools/serve_gate.py):
         # chaos under multi-tenant load with the same detected/
         # recovered/bit-identical contract.

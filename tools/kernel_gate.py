@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Tier-1 kernel gate: re-run graft-kcert and fail on any KC1-KC5
+violation OR on drift against the checked-in
+bench_cache/kernel_manifest.json.
+
+This is the CI wrapper around ``python -m arrow_matrix_tpu.analysis
+kernels --check`` (the pytest suite runs the same invariant in
+tests/test_kernels.py): every Pallas kernel builder's declared
+KernelContract and concretized call metas are proven against the five
+kernel rules — indices in bounds at every grid point, VMEM/SMEM
+budgets respected, DMA ring discipline replayed in a semaphore-slot
+simulator, the accumulator >= f32 regardless of carriage dtype, and
+the output index map gap- and overlap-free — so a kernel regression
+fails the push before any TPU runs.
+
+Usage:
+  python tools/kernel_gate.py                 certify + drift check (CI)
+  python tools/kernel_gate.py --refresh       certify + rewrite manifest
+  python tools/kernel_gate.py --fixture F     verify a planted-broken-
+                                              kernel fixture (tests/
+                                              fixtures/kernels/
+                                              kcN_*.py) fires its
+                                              expected rule; exits
+                                              nonzero when it does NOT
+  python tools/kernel_gate.py --fixtures      run every shipped fixture
+  python tools/kernel_gate.py --paths F...    certify arbitrary kernel
+                                              files and exit nonzero on
+                                              ANY finding (feeding a
+                                              planted fixture here
+                                              fails the gate, per rule)
+  python tools/kernel_gate.py --selftest      verify the certifier
+                                              itself trips on its
+                                              broken twins (host-only)
+"""
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FIXTURE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "kernels")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--refresh", action="store_true",
+                    help="rewrite bench_cache/kernel_manifest.json "
+                         "instead of drift-checking against it")
+    ap.add_argument("--fixture", action="append", default=[],
+                    help="verify this planted-broken-kernel fixture "
+                         "fires its expected rule (repeatable)")
+    ap.add_argument("--fixtures", action="store_true",
+                    help="verify every tests/fixtures/kernels/"
+                         "kc*_*.py")
+    ap.add_argument("--paths", nargs="+", default=None,
+                    help="certify these files and exit nonzero on any "
+                         "finding (a planted fixture fails the gate)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the certifier trips on its broken "
+                         "twins (host-only, no jax)")
+    args = ap.parse_args(argv)
+
+    from arrow_matrix_tpu.analysis import kernels as graft_kcert
+
+    if args.selftest:
+        return graft_kcert.main(["--selftest"])
+
+    if args.paths:
+        findings = graft_kcert.certify_paths(args.paths)
+        for f in findings:
+            print(f.format())
+        if findings:
+            print(f"kernel gate: {len(findings)} finding(s) in "
+                  f"{len(args.paths)} file(s)", file=sys.stderr)
+            return 1
+        print("kernel gate: paths certify clean", file=sys.stderr)
+        return 0
+
+    fixtures = list(args.fixture)
+    if args.fixtures:
+        fixtures.extend(sorted(glob.glob(
+            os.path.join(FIXTURE_DIR, "kc*_*.py"))))
+    if fixtures:
+        rc = graft_kcert.main(
+            [arg for p in fixtures for arg in ("--fixture", p)])
+        if rc != 0:
+            print("kernel gate: FIXTURE FAILED TO TRIP ITS RULE — "
+                  "the certifier lost a detection", file=sys.stderr)
+        return rc
+
+    cli = [] if args.refresh else ["--check"]
+    rc = graft_kcert.main(cli)
+    if rc != 0:
+        print("kernel gate: FAILED (a KC rule is violated or the "
+              "manifest drifted — rerun `python -m arrow_matrix_tpu."
+              "analysis kernels` and review the diff)",
+              file=sys.stderr)
+        return rc
+    print("kernel gate: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

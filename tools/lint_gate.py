@@ -16,11 +16,14 @@ mode against the committed store + baseline (tools/ledger_gate.py
 standalone); ``--sync`` additionally runs the graft-sync
 lock-discipline proof in check mode (fails on any RC1-RC5 violation
 or drift against the checked-in bench_cache/sync_manifest.json —
-tools/sync_gate.py standalone).
+tools/sync_gate.py standalone); ``--kernels`` additionally runs the
+graft-kcert Pallas kernel certifier in check mode (fails on any
+KC1-KC5 violation or drift against the checked-in
+bench_cache/kernel_manifest.json — tools/kernel_gate.py standalone).
 
 Usage:
   python tools/lint_gate.py [--audit] [--prove] [--ledger] [--sync]
-                            [paths...]
+                            [--kernels] [paths...]
 """
 
 import os
@@ -45,6 +48,9 @@ def main(argv=None) -> int:
     run_sync = "--sync" in argv
     if run_sync:
         argv.remove("--sync")
+    run_kernels = "--kernels" in argv
+    if run_kernels:
+        argv.remove("--kernels")
     rc = graft_lint_main(argv)
     if rc != 0:
         print("lint gate: FAILED (fix the findings or waive them with "
@@ -74,6 +80,12 @@ def main(argv=None) -> int:
         rc = graft_lint_main(["sync", "--check"])
         if rc != 0:
             print("lint gate: lock-discipline proof FAILED",
+                  file=sys.stderr)
+            return rc
+    if run_kernels:
+        rc = graft_lint_main(["kernels", "--check"])
+        if rc != 0:
+            print("lint gate: kernel certification FAILED",
                   file=sys.stderr)
             return rc
     print("lint gate: ok", file=sys.stderr)
