@@ -669,6 +669,90 @@ def probe_reshard() -> tuple[bool, str]:
                   "tools/reshard_gate.py runs the armed version")
 
 
+def probe_xray() -> tuple[bool, str]:
+    """graft-xray round-trip: spawn a 2-worker process fleet, route
+    one request to each worker, merge the run dir into ONE fleet
+    trace, and require closed span trees (each request id on the
+    router track AND a worker track), a measured clock offset per
+    worker that is sane for one host, and zero truncated tracks —
+    the tracing loop in miniature (the SIGKILL-recovery half is
+    tools/chaos_gate.py:scenario_xray_kill).  Bounded subprocess, as
+    for the other probes."""
+    code = (
+        "import sys, tempfile; sys.argv=[]; "
+        "from arrow_matrix_tpu.utils.platform import "
+        "force_cpu_devices; force_cpu_devices(1); "
+        "import numpy as np; "
+        "from arrow_matrix_tpu.fleet.router import FleetRouter; "
+        "from arrow_matrix_tpu.obs import xray; "
+        "from arrow_matrix_tpu.serve.request import Request; "
+        "d = tempfile.mkdtemp(prefix='xray_probe_'); "
+        "r = FleetRouter(spawn=2, vertices=64, width=16, seed=3, "
+        "run_dir=d); p = []; "
+        "\n"
+        "try:\n"
+        "    x = np.ones((r.n_rows, 2), dtype=np.float32)\n"
+        "    wids = sorted(r.workers)\n"
+        "    ten = {}\n"
+        "    i = 0\n"
+        "    while len(ten) < 2 and i < 256:\n"
+        "        ten.setdefault(r.ring.lookup(f't{i}'), f't{i}')\n"
+        "        i += 1\n"
+        "    ts = [r.submit(Request(f'p{j}', ten[w], x, 1))\n"
+        "          for j, w in enumerate(wids)]\n"
+        "    r.drain(timeout_s=120)\n"
+        "    if not all(t.status == 'completed' for t in ts):\n"
+        "        p.append('fleet warmup failed: '\n"
+        "                 + repr([t.status for t in ts]))\n"
+        "    report = r.fleet_summary()\n"
+        "    xray.save_router_trace(r.tracer, d)\n"
+        "finally:\n"
+        "    r.shutdown()\n"
+        "doc = xray.merge_run_dir(d, report=report)\n"
+        "info = doc['xray']\n"
+        "if len(info['processes']) != 3:\n"
+        "    p.append('expected 3 tracks, got '\n"
+        "             + repr([q['process'] for q in "
+        "info['processes']]))\n"
+        "if info['truncated']:\n"
+        "    p.append('graceful run left truncated tracks: '\n"
+        "             + repr(info['truncated']))\n"
+        "offs = report.get('clock_offsets_ns') or {}\n"
+        "for w in wids:\n"
+        "    rec = offs.get(w)\n"
+        "    if not isinstance(rec, dict):\n"
+        "        p.append('no clock offset for ' + w)\n"
+        "    elif abs(rec.get('offset_ns', 0)) > 1e9:\n"
+        "        p.append('implausible same-host offset: ' + repr(rec))\n"
+        "pid_of = {q['process']: q['pid'] for q in info['processes']}\n"
+        "evs = [e for e in doc['traceEvents'] if e.get('ph') == 'X']\n"
+        "for t in ts:\n"
+        "    rid = t.request.request_id\n"
+        "    pids = {e['pid'] for e in evs if rid in\n"
+        "            str(e['args'].get('request_id', '')).split('+')}\n"
+        "    if pid_of['router'] not in pids or len(pids) < 2:\n"
+        "        p.append(rid + ' span tree not closed across the '\n"
+        "                 'wire (pids=' + repr(sorted(pids)) + ')')\n"
+        "        break\n"
+        "print('XRAY ok' if not p else 'XRAY FAIL: ' + str(p[0]))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("XRAY")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "XRAY ok":
+        return False, lines[-1][:120]
+    return True, ("2-worker fleet merged into one closed-span trace "
+                  "with sane clock offsets — run `graft_xray report` "
+                  "on any fleet run dir")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -767,6 +851,10 @@ def main(argv=None) -> int:
     reshard_ok, detail = probe_reshard()
     ok &= _check("graft-reshard (grow-migration round trip)",
                  reshard_ok, detail)
+
+    xray_ok, detail = probe_xray()
+    ok &= _check("graft-xray (merged fleet trace + clock offsets)",
+                 xray_ok, detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

@@ -69,8 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run_fleet(args) -> dict:
     from arrow_matrix_tpu.fleet.router import FleetRouter
+    from arrow_matrix_tpu.ledger import store as ledger_store
     from arrow_matrix_tpu.ledger.store import _default_host_load
     from arrow_matrix_tpu.obs import pulse as pulse_mod
+    from arrow_matrix_tpu.obs import xray as xray_mod
     from arrow_matrix_tpu.serve.loadgen import synthetic_trace
     from arrow_matrix_tpu.utils.artifacts import atomic_write_json
 
@@ -101,6 +103,11 @@ def run_fleet(args) -> dict:
         tickets = [router.submit(r) for r in trace]
         router.drain(timeout_s=args.submit_timeout_s)
         report = router.fleet_summary()
+        # The router's own trace doc goes to disk while the router is
+        # still alive; workers write theirs on graceful close (during
+        # shutdown), and a SIGKILLed worker leaves its flight ring —
+        # merge_run_dir below stitches whichever survived.
+        xray_mod.save_router_trace(router.tracer, args.run_dir)
     finally:
         router.shutdown()
     report["host_load"] = _default_host_load()
@@ -109,10 +116,50 @@ def run_fleet(args) -> dict:
          "tenant": t.request.tenant, "status": t.status,
          "reason": t.reason,
          "worker_id": getattr(t, "worker_id", None),
-         "requeues": getattr(t, "requeues", 0)}
+         "requeues": getattr(t, "requeues", 0),
+         "served_class": getattr(t, "served_class", None),
+         "trace_id": (t.trace or {}).get("trace_id")}
         for t in tickets]
     folded = router.fold_ledgers()
     report["ledger_records_folded"] = folded
+
+    # graft-xray: ONE merged fleet trace (router + every worker track,
+    # clock-offset aligned, dead workers recovered truncated), the
+    # per-class critical-path report over it, and the wire cost totals
+    # as banded first-class ledger metrics.
+    trace_doc = xray_mod.merge_run_dir(args.run_dir, report=report)
+    trace_path = xray_mod.save_fleet_trace(trace_doc, args.run_dir)
+    classes = {t["request_id"]: t["served_class"]
+               for t in report["tickets"] if t["served_class"]}
+    cp = xray_mod.critical_path(trace_doc, classes=classes)
+    atomic_write_json(os.path.join(args.run_dir, "xray_report.json"),
+                      cp, indent=2, sort_keys=True)
+    report["xray"] = {
+        "trace": trace_path,
+        "processes": trace_doc["xray"]["processes"],
+        "truncated": trace_doc["xray"]["truncated"],
+        "per_class": {cls: {"count": agg["count"],
+                            "mean_ms": agg.get("mean_ms"),
+                            "segments_mean_ms":
+                                agg.get("segments_mean_ms")}
+                      for cls, agg in cp["per_class"].items()},
+    }
+    tot = report.get("wire", {}).get("totals") or {}
+    shape_tag = (f"fleet_w{args.workers}_n{args.vertices}"
+                 f"_r{args.requests}_k{args.k}")
+    for metric, value, unit in (
+            ("wire_bytes",
+             tot.get("bytes_out", 0) + tot.get("bytes_in", 0), "B"),
+            ("wire_ms", tot.get("wire_ms"), "ms"),
+            ("serialize_ms", tot.get("serialize_ms"), "ms")):
+        ledger_store.record(
+            "fleet", metric, value,
+            directory=os.path.join(args.run_dir, "ledger"),
+            unit=unit, structure_hash=shape_tag,
+            knobs={"fleet": report["fleet"],
+                   "workers": args.workers,
+                   "requests": args.requests,
+                   "frames": tot.get("frames")})
 
     ring_docs = []
     for wid in sorted(router.workers):

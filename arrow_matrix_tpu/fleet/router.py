@@ -57,7 +57,9 @@ from arrow_matrix_tpu.fleet.placement import (
 )
 from arrow_matrix_tpu.ledger import store as ledger_store
 from arrow_matrix_tpu.obs import flight
+from arrow_matrix_tpu.obs import xray as xray_mod
 from arrow_matrix_tpu.obs.metrics import Histogram
+from arrow_matrix_tpu.obs.tracer import Tracer
 from arrow_matrix_tpu.sync import guarded_by, witnessed
 from arrow_matrix_tpu.serve import request as rq
 
@@ -94,9 +96,10 @@ class WorkerHandle:
     obs_dir: Optional[str] = None
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
-    def call(self, obj: Any, *, timeout_s: float = 30.0) -> Any:
+    def call(self, obj: Any, *, timeout_s: float = 30.0,
+             stats: Optional[Dict[str, Any]] = None) -> Any:
         return wire.request_call(self.host, self.port, obj,
-                                 timeout_s=timeout_s)
+                                 timeout_s=timeout_s, stats=stats)
 
     @property
     def pid(self) -> Optional[int]:
@@ -213,7 +216,8 @@ def _append_log(log_path: str, line: str) -> None:
 @guarded_by("_lock", node="fleet_router",
             attrs=("_dead", "_deaths", "_tickets", "_threads",
                    "_pack_assignment", "_pack_unplaced", "_pins",
-                   "_counts", "requeues", "migrations"))
+                   "_counts", "requeues", "migrations",
+                   "_wire_totals", "_wire_frames", "_clock_offsets"))
 class FleetRouter:
     """Places, dispatches, watches, requeues, reports (see the module
     docstring).  Construct with ``spawn=`` worker count to spawn local
@@ -268,6 +272,16 @@ class FleetRouter:
         self._counts: Dict[str, int] = {}
         self.requeues = 0
         self.migrations = 0
+        # graft-xray: the router's own trace (dispatch/rpc spans), its
+        # wire cost ledger (per-round-trip frames + running totals —
+        # the byte-conservation invariant obs_gate checks), and the
+        # per-worker clock offsets from the xray_ping handshake.
+        self.tracer = Tracer(name="router")
+        self._wire_totals: Dict[str, float] = {
+            "frames": 0, "bytes_out": 0, "bytes_in": 0,
+            "serialize_ms": 0.0, "wire_ms": 0.0}
+        self._wire_frames: List[dict] = []
+        self._clock_offsets: Dict[str, dict] = {}
         self.started_s = time.perf_counter()
 
         self.workers: Dict[str, WorkerHandle] = {}
@@ -297,15 +311,70 @@ class FleetRouter:
             n_rows = h.meta.get("n_rows")
             if n_rows is None:
                 try:
-                    hello = h.call({"op": "hello"}, timeout_s=30.0)
+                    hello = self._call(h, {"op": "hello"},
+                                       timeout_s=30.0)
                     h.meta.update(hello)
                     n_rows = hello.get("n_rows")
                 except (OSError, wire.WireError):
                     continue
             self.n_rows = int(n_rows)
+        self.measure_clock_offsets()
         flight.record("fleet", "router_up", fleet=self.name,
                       workers=sorted(self.workers),
                       placement=self.placement)
+
+    # -- wire accounting + clock alignment (graft-xray) --------------------
+
+    def _call(self, handle: WorkerHandle, obj: Any, *,
+              timeout_s: float = 30.0) -> Any:
+        """A worker call with wire accounting: every successful round
+        trip's measured bytes/serialize/wire cost lands in the
+        router's per-frame list and running totals."""
+        st: Dict[str, Any] = {}
+        reply = handle.call(obj, timeout_s=timeout_s, stats=st)
+        if st:
+            st["worker"] = handle.worker_id
+            with self._lock:
+                self._wire_frames.append(st)
+                tot = self._wire_totals
+                tot["frames"] += 2       # request + response frames
+                tot["bytes_out"] += st["bytes_out"]
+                tot["bytes_in"] += st["bytes_in"]
+                tot["serialize_ms"] += st["serialize_ms"]
+                tot["wire_ms"] += st["wire_ms"]
+        return reply
+
+    def measure_clock_offsets(self, pings: int = 5) -> Dict[str, dict]:
+        """Estimate each worker's wall-clock offset vs the router via
+        ``pings`` ``xray_ping`` round trips, keeping the minimum-RTT
+        sample (offset = worker_clock − router_midpoint — the classic
+        NTP-style bound; same-host it is ~0, which the doctor probe
+        asserts).  Measured once at startup so a worker that later
+        dies still has its offset for trace merging."""
+        offsets: Dict[str, dict] = {}
+        for wid in sorted(self.workers):
+            handle = self.workers[wid]
+            best: Optional[dict] = None
+            for _ in range(max(int(pings), 1)):
+                t0 = time.time_ns()
+                try:
+                    reply = self._call(handle, {"op": "xray_ping"},
+                                       timeout_s=10.0)
+                except (OSError, wire.WireError):
+                    break
+                t1 = time.time_ns()
+                if not (isinstance(reply, dict) and reply.get("ok")
+                        and reply.get("t_ns") is not None):
+                    break
+                rtt = t1 - t0
+                off = int(reply["t_ns"]) - (t0 + t1) // 2
+                if best is None or rtt < best["rtt_ns"]:
+                    best = {"offset_ns": off, "rtt_ns": rtt}
+            if best is not None:
+                offsets[wid] = best
+        with self._lock:
+            self._clock_offsets.update(offsets)
+        return offsets
 
     # -- placement ---------------------------------------------------------
 
@@ -320,13 +389,13 @@ class FleetRouter:
             raise RuntimeError("no live worker to price tenants")
         tenant_bytes = {}
         for tenant, k in sorted(tenant_ks.items()):
-            reply = pricer.call({"op": "price", "k": int(k)})
+            reply = self._call(pricer, {"op": "price", "k": int(k)})
             tenant_bytes[tenant] = int(reply.get("bytes", 0))
         capacities = {}
         for wid, h in self.workers.items():
             if wid in self._dead:
                 continue
-            reply = h.call({"op": "hello"})
+            reply = self._call(h, {"op": "hello"})
             capacities[wid] = int(reply.get("headroom_bytes", 0))
         assignment, unplaced = pack_tenants(tenant_bytes, capacities)
         with self._lock:
@@ -397,6 +466,20 @@ class FleetRouter:
 
     def _dispatch(self, ticket: rq.Ticket) -> None:
         req = ticket.request
+        # Mint the fleet-level trace id here — the root of this
+        # request's distributed trace.  Every frame to a worker is
+        # stamped with it, every router span inherits it through the
+        # request context, and the ticket keeps it for the report.
+        trace_id = xray_mod.new_trace_id()
+        ticket.trace = {"trace_id": trace_id}
+        with flight.request_context(req.request_id, req.tenant,
+                                    trace_id=trace_id), \
+                self.tracer.span("dispatch"):
+            self._dispatch_attempts(ticket, trace_id)
+
+    def _dispatch_attempts(self, ticket: rq.Ticket,
+                           trace_id: str) -> None:
+        req = ticket.request
         max_attempts = (3 * len(self.workers) + 1)
         attempt = 0
         while True:
@@ -422,13 +505,34 @@ class FleetRouter:
             handle = self.workers[wid]
             ticket.worker_id = wid
             try:
-                reply = handle.call(
-                    {"op": "submit",
-                     "request": {"request_id": req.request_id,
-                                 "tenant": req.tenant, "x": req.x,
-                                 "iterations": req.iterations,
-                                 "deadline_s": req.deadline_s}},
-                    timeout_s=self.submit_timeout_s)
+                with self.tracer.span("rpc", worker=wid,
+                                      attempt=attempt) as span_args:
+                    st: Dict[str, Any] = {}
+                    reply = handle.call(
+                        {"op": "submit",
+                         "xray": {"trace_id": trace_id,
+                                  "parent_span": "dispatch",
+                                  "send_ns": time.time_ns()},
+                         "request": {"request_id": req.request_id,
+                                     "tenant": req.tenant, "x": req.x,
+                                     "iterations": req.iterations,
+                                     "deadline_s": req.deadline_s}},
+                        timeout_s=self.submit_timeout_s, stats=st)
+                    if st:
+                        span_args.update(
+                            serialize_ms=st["serialize_ms"],
+                            wire_ms=st["wire_ms"],
+                            bytes_out=st["bytes_out"],
+                            bytes_in=st["bytes_in"])
+                        st["worker"] = wid
+                        with self._lock:
+                            self._wire_frames.append(st)
+                            tot = self._wire_totals
+                            tot["frames"] += 2
+                            tot["bytes_out"] += st["bytes_out"]
+                            tot["bytes_in"] += st["bytes_in"]
+                            tot["serialize_ms"] += st["serialize_ms"]
+                            tot["wire_ms"] += st["wire_ms"]
             except (OSError, wire.WireError) as e:
                 self._on_worker_failure(wid, f"{type(e).__name__}: "
                                              f"{e}")
@@ -451,6 +555,8 @@ class FleetRouter:
             ticket.recoveries = int(reply.get("recoveries") or 0)
             ticket.resumed_step = reply.get("resumed_step")
             ticket.worker_latency_s = reply.get("latency_s")
+            if reply.get("served_class"):
+                ticket.served_class = reply["served_class"]
             if status == rq.COMPLETED:
                 ticket.result = reply.get("result")
                 ticket._finish(rq.COMPLETED)
@@ -668,8 +774,8 @@ class FleetRouter:
                     "health": health.get(wid)}
                 continue
             try:
-                reply = handle.call({"op": "summary"},
-                                    timeout_s=30.0)
+                reply = self._call(handle, {"op": "summary"},
+                                   timeout_s=30.0)
             except (OSError, wire.WireError) as e:
                 worker_reports[wid] = {"alive": False,
                                        "error": f"{type(e).__name__}"
@@ -695,6 +801,10 @@ class FleetRouter:
             migrations = self.migrations
             pins = dict(self._pins)
             dead_workers = sorted(self._dead)
+            wire_totals = dict(self._wire_totals)
+            wire_frames = [dict(f) for f in self._wire_frames]
+            clock_offsets = {k: dict(v)
+                             for k, v in self._clock_offsets.items()}
         wall = time.perf_counter() - self.started_s
         completed = counts.get("completed", 0)
         shed_reasons: Dict[str, int] = {}
@@ -728,6 +838,11 @@ class FleetRouter:
             # Exact pooled quantiles over every worker's raw samples.
             "latency_ms": pooled.summary(),
             "router_latency_ms": router_lat.summary(),
+            # graft-xray wire cost ledger: per-round-trip frames plus
+            # running totals (summing the frames MUST reproduce the
+            # totals — obs_gate's byte-conservation check).
+            "wire": {"totals": wire_totals, "frames": wire_frames},
+            "clock_offsets_ns": clock_offsets,
             "health": self.health.snapshot(),
             "workers": worker_reports,
         }
@@ -783,8 +898,8 @@ class FleetRouter:
                 dead = wid in self._dead
             if not dead:
                 try:
-                    handle.call({"op": "shutdown"},
-                                timeout_s=timeout_s)
+                    self._call(handle, {"op": "shutdown"},
+                               timeout_s=timeout_s)
                 except (OSError, wire.WireError):
                     pass
             handle.reap(timeout_s=timeout_s)

@@ -17,6 +17,19 @@ the seam where a real network partition or a dying peer shows up.  A
 torn or oversized frame raises :class:`WireError`, never a silent
 truncation; the router treats any wire failure as a worker-health
 question, not an answer.
+
+graft-xray instrumentation: every frame is measured from inside the
+wire (numba-mpi's argument — measure comm in the runtime, not around
+it).  ``serialize_ms`` (encode/decode + JSON), ``frame_bytes``, and
+``wire_ms`` (socket time; on recv split into header wait vs payload
+transfer, so a server's think time does not masquerade as transfer
+cost) are recorded per message kind into the process-global
+``MetricsRegistry``, and returned to callers that want per-call
+accounting (``request_call(..., stats=...)`` — the router's wire
+ledger).  A frame within :data:`NEAR_LIMIT_FRACTION` of
+``MAX_FRAME_BYTES`` is delivered but complains LOUDLY
+(:class:`WireNearLimitWarning` + a flight event + a counter): the
+warn-before-wedge rung below the hard refusal.
 """
 
 from __future__ import annotations
@@ -25,7 +38,9 @@ import base64
 import json
 import socket
 import struct
-from typing import Any, Optional
+import time
+import warnings
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -38,10 +53,46 @@ _HEADER = struct.Struct(">Q")
 #: for exabytes and wedge the reader in recv).
 MAX_FRAME_BYTES = 1 << 30
 
+#: Fraction of ``MAX_FRAME_BYTES`` at which a frame is still delivered
+#: but warns loudly — the operator hears about a wedge-in-waiting
+#: before the hard limit turns it into a failed request.
+NEAR_LIMIT_FRACTION = 0.99
+
 
 class WireError(RuntimeError):
     """A framing-level failure: torn frame, oversized length, closed
     peer mid-frame, or undecodable payload."""
+
+
+class WireNearLimitWarning(RuntimeWarning):
+    """A frame came within ``NEAR_LIMIT_FRACTION`` of
+    ``MAX_FRAME_BYTES``: the next growth step wedges the wire."""
+
+
+def _frame_kind(obj: Any) -> str:
+    """The message kind a frame is accounted under (its ``op``)."""
+    if isinstance(obj, dict) and obj.get("op") is not None:
+        return str(obj.get("op"))
+    return "?"
+
+
+def _account(stats: Dict[str, Any], role: Optional[str]) -> None:
+    """Record one frame's measurements into the process-global metrics
+    registry.  Telemetry must never take down the wire it observes, so
+    any failure here is swallowed."""
+    try:
+        from arrow_matrix_tpu.obs import metrics as metrics_mod
+
+        reg = metrics_mod.get_registry()
+        labels = {"op": stats["op"], "dir": stats["dir"]}
+        if role is not None:
+            labels["role"] = role
+        reg.record("wire_frame_bytes", float(stats["frame_bytes"]),
+                   **labels)
+        reg.record("wire_serialize_ms", stats["serialize_ms"], **labels)
+        reg.record("wire_ms", stats["wire_ms"], **labels)
+    except Exception:  # graft-lint: disable=R8 — telemetry
+        pass
 
 
 def encode_payload(obj: Any) -> Any:
@@ -86,40 +137,117 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def send_msg(sock: socket.socket, obj: Any) -> None:
-    """Send one framed message (arrays encoded automatically)."""
+def send_msg(sock: socket.socket, obj: Any, *,
+             role: Optional[str] = None) -> Dict[str, Any]:
+    """Send one framed message (arrays encoded automatically).
+
+    Returns the frame's measurement record: ``{"op", "dir": "send",
+    "frame_bytes", "serialize_ms", "wire_ms"}`` (also observed into the
+    process-global metrics registry, labeled with ``role`` when one is
+    given).  Within 1% of the frame limit the message still goes out
+    but warns loudly; beyond the limit it raises :class:`WireError`.
+    """
     faults.inject("fleet.wire.send",
                   target=str(obj.get("op")) if isinstance(obj, dict)
                   else None)
+    kind = _frame_kind(obj)
+    t0 = time.perf_counter()
     blob = json.dumps(encode_payload(obj)).encode("utf-8")
-    if len(blob) > MAX_FRAME_BYTES:
-        raise WireError(f"frame of {len(blob)} B exceeds the "
+    serialize_ms = (time.perf_counter() - t0) * 1e3
+    nbytes = len(blob)
+    if nbytes > MAX_FRAME_BYTES:
+        raise WireError(f"frame of {nbytes} B exceeds the "
                         f"{MAX_FRAME_BYTES} B wire limit")
-    sock.sendall(_HEADER.pack(len(blob)) + blob)
+    if nbytes >= NEAR_LIMIT_FRACTION * MAX_FRAME_BYTES:
+        warnings.warn(
+            f"wire frame of {nbytes} B (op={kind!r}) is within "
+            f"{100 * (1 - NEAR_LIMIT_FRACTION):.0f}% of the "
+            f"{MAX_FRAME_BYTES} B limit — the next growth step wedges "
+            f"the wire", WireNearLimitWarning, stacklevel=2)
+        try:
+            from arrow_matrix_tpu.obs import flight, metrics as metrics_mod
+
+            flight.record("wire", "near_frame_limit", op=kind,
+                          frame_bytes=nbytes, limit=MAX_FRAME_BYTES)
+            metrics_mod.get_registry().counter(
+                "wire_near_limit_total", op=kind).inc()
+        except Exception:  # graft-lint: disable=R8 — telemetry
+            pass
+    t1 = time.perf_counter()
+    sock.sendall(_HEADER.pack(nbytes) + blob)
+    wire_ms = (time.perf_counter() - t1) * 1e3
+    stats = {"op": kind, "dir": "send", "frame_bytes": nbytes,
+             "serialize_ms": serialize_ms, "wire_ms": wire_ms}
+    _account(stats, role)
+    return stats
 
 
-def recv_msg(sock: socket.socket) -> Any:
-    """Receive one framed message (arrays decoded automatically)."""
+def recv_msg_stats(sock: socket.socket, *, role: Optional[str] = None
+                   ) -> Tuple[Any, Dict[str, Any]]:
+    """Receive one framed message, returning ``(msg, stats)``.
+
+    ``stats["wire_ms"]`` is the payload transfer time AFTER the header
+    arrived; the wait for the first header byte is reported separately
+    as ``wait_ms`` (on a client it is dominated by the server's think
+    time, which must not be booked as transfer cost).
+    ``serialize_ms`` is the JSON decode + ndarray rebuild time.
+    """
     faults.inject("fleet.wire.recv")
+    t0 = time.perf_counter()
     header = _recv_exact(sock, _HEADER.size)
+    t1 = time.perf_counter()
     (length,) = _HEADER.unpack(header)
     if length > MAX_FRAME_BYTES:
         raise WireError(f"frame header asks for {length} B (> "
                         f"{MAX_FRAME_BYTES} B) — corrupted stream")
     blob = _recv_exact(sock, int(length))
+    t2 = time.perf_counter()
     try:
-        return decode_payload(json.loads(blob.decode("utf-8")))
+        msg = decode_payload(json.loads(blob.decode("utf-8")))
     except (ValueError, UnicodeDecodeError) as e:
         raise WireError(f"undecodable frame payload: {e}") from e
+    stats = {"op": _frame_kind(msg), "dir": "recv",
+             "frame_bytes": int(length),
+             "wait_ms": (t1 - t0) * 1e3,
+             "wire_ms": (t2 - t1) * 1e3,
+             "serialize_ms": (time.perf_counter() - t2) * 1e3}
+    _account(stats, role)
+    return msg, stats
+
+
+def recv_msg(sock: socket.socket, *, role: Optional[str] = None) -> Any:
+    """Receive one framed message (arrays decoded automatically)."""
+    msg, _ = recv_msg_stats(sock, role=role)
+    return msg
 
 
 def request_call(host: str, port: int, obj: Any, *,
-                 timeout_s: Optional[float] = 30.0) -> Any:
+                 timeout_s: Optional[float] = 30.0,
+                 stats: Optional[Dict[str, Any]] = None) -> Any:
     """One request/response round trip on a fresh connection (the
     router's unit of interaction: connection state never outlives an
     operation, so a dead worker surfaces as a connect/recv error on
-    the NEXT op, not as a half-open socket wedge)."""
+    the NEXT op, not as a half-open socket wedge).
+
+    When a ``stats`` dict is passed it is filled (on success) with the
+    round trip's wire accounting: ``op``, ``bytes_out``/``bytes_in``/
+    ``frame_bytes`` (request, response, sum), combined ``serialize_ms``
+    and ``wire_ms`` (send + payload transfer — the response's
+    header-wait, i.e. the server's think time, is reported apart as
+    ``wait_ms``).
+    """
     with socket.create_connection((host, int(port)),
                                   timeout=timeout_s) as sock:
-        send_msg(sock, obj)
-        return recv_msg(sock)
+        out = send_msg(sock, obj, role="client")
+        reply, back = recv_msg_stats(sock, role="client")
+    if stats is not None:
+        stats.update({
+            "op": out["op"],
+            "bytes_out": out["frame_bytes"],
+            "bytes_in": back["frame_bytes"],
+            "frame_bytes": out["frame_bytes"] + back["frame_bytes"],
+            "serialize_ms": out["serialize_ms"] + back["serialize_ms"],
+            "wire_ms": out["wire_ms"] + back["wire_ms"],
+            "wait_ms": back["wait_ms"],
+        })
+    return reply

@@ -51,6 +51,9 @@ from arrow_matrix_tpu import faults
 from arrow_matrix_tpu.faults.policy import RetryPolicy
 from arrow_matrix_tpu.fleet import wire
 from arrow_matrix_tpu.ledger import store as ledger_store
+from arrow_matrix_tpu.obs import flight
+from arrow_matrix_tpu.obs import xray as xray_mod
+from arrow_matrix_tpu.obs.tracer import Tracer
 from arrow_matrix_tpu.serve import request as rq
 from arrow_matrix_tpu.serve.loadgen import ba_executor_factory
 from arrow_matrix_tpu.serve.scheduler import ArrowServer, ExecConfig
@@ -97,6 +100,10 @@ class FleetWorker:
         self.verbose = verbose
         self.obs_dir = obs_dir
         self.monitor = None
+        # graft-xray: one tracer per worker process; the scheduler and
+        # Supervisor emit their spans into it, each stamped with the
+        # fleet-level trace context entered at the wire (op_submit).
+        self.tracer = Tracer(name=worker_id)
         factory, self.n_rows = ba_executor_factory(vertices, width,
                                                    seed, fmt=fmt)
         policy = RetryPolicy(jitter=0.5).for_worker(worker_id)
@@ -108,6 +115,7 @@ class FleetWorker:
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             max_batch_k=max_batch_k,
+            tracer=self.tracer,
             name=worker_id, verbose=verbose)
         if obs_dir:
             from arrow_matrix_tpu.obs import pulse as pulse_mod
@@ -161,11 +169,20 @@ class FleetWorker:
         if not isinstance(x, np.ndarray):
             return {"ok": False,
                     "error": "submit carries no feature array"}
-        ticket = self.server.submit(rq.Request(
-            request_id=str(req.get("request_id")), tenant=tenant,
-            x=x, iterations=int(req.get("iterations", 1)),
-            deadline_s=req.get("deadline_s")))
-        ticket.wait()
+        # Enter the fleet-level trace context stamped on the frame by
+        # the router: every span / flight event / Supervisor attempt
+        # this request triggers carries its trace_id from here on.
+        xr = msg.get("xray") or {}
+        with flight.request_context(str(req.get("request_id")), tenant,
+                                    trace_id=xr.get("trace_id"),
+                                    parent_span=xr.get("parent_span")), \
+                self.tracer.span("worker_submit",
+                                 send_ns=xr.get("send_ns")):
+            ticket = self.server.submit(rq.Request(
+                request_id=str(req.get("request_id")), tenant=tenant,
+                x=x, iterations=int(req.get("iterations", 1)),
+                deadline_s=req.get("deadline_s")))
+            ticket.wait()
         reply = {"ok": True, "worker_id": self.worker_id,
                  "request_id": ticket.request.request_id,
                  "tenant": tenant, "status": ticket.status,
@@ -173,10 +190,18 @@ class FleetWorker:
                  "latency_s": ticket.latency_s,
                  "faults_seen": ticket.faults_seen,
                  "recoveries": ticket.recoveries,
-                 "resumed_step": ticket.resumed_step}
+                 "resumed_step": ticket.resumed_step,
+                 "served_class": getattr(ticket, "served_class", None)}
         if ticket.status == rq.COMPLETED:
             reply["result"] = ticket.result
         return reply
+
+    def op_xray_ping(self, msg: dict) -> dict:
+        """Clock-offset handshake: answer with this process's wall
+        clock in ns.  The router brackets the call with its own clock
+        and estimates the offset from the minimum-RTT ping."""
+        return {"ok": True, "worker_id": self.worker_id,
+                "t_ns": time.time_ns(), "pid": os.getpid()}
 
     def op_summary(self, msg: dict) -> dict:
         return {"ok": True, "worker_id": self.worker_id,
@@ -220,6 +245,10 @@ class FleetWorker:
         if self.monitor is not None:
             self.monitor.close()
         if self.obs_dir:
+            xray_mod.save_process_trace(
+                self.tracer,
+                os.path.join(self.obs_dir, "xray_trace.json"),
+                self.worker_id)
             atomic_write_json(
                 os.path.join(self.obs_dir, "worker_summary.json"),
                 census, indent=2, sort_keys=True)
@@ -246,20 +275,20 @@ def serve_worker(worker: FleetWorker, *, host: str = "127.0.0.1",
     class Handler(socketserver.BaseRequestHandler):
         def handle(self):
             try:
-                msg = wire.recv_msg(self.request)
+                msg = wire.recv_msg(self.request, role="server")
             except (OSError, wire.WireError):
                 return
             if isinstance(msg, dict) and msg.get("op") == "shutdown":
                 reply = {"ok": True, "worker_id": worker.worker_id}
                 try:
-                    wire.send_msg(self.request, reply)
+                    wire.send_msg(self.request, reply, role="server")
                 except (OSError, wire.WireError):
                     pass
                 done.set()
                 return
             reply = worker.handle(msg)
             try:
-                wire.send_msg(self.request, reply)
+                wire.send_msg(self.request, reply, role="server")
             except (OSError, wire.WireError):
                 pass
 
@@ -311,6 +340,13 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     maybe_init_distributed(verbose=args.verbose)
+    if args.obs_dir:
+        # The flight ring flushes eagerly per event, so when this
+        # process dies by SIGKILL mid-batch its completed spans are
+        # already on disk — graft-xray recovers the partial trace from
+        # exactly this artifact.
+        os.makedirs(args.obs_dir, exist_ok=True)
+        flight.install(os.path.join(args.obs_dir, "flight.json"))
     budget = (int(args.hbm_budget_mb * 2**20)
               if args.hbm_budget_mb > 0 else None)
     worker = FleetWorker(

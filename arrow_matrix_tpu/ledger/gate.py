@@ -67,7 +67,10 @@ CURVE_FLOOR = 1e-6
 #: Units where larger means worse.  Everything else (errors included —
 #: error curves have their own pointwise check) is compared the same
 #: way on ``value``; unit-less counts are skipped for banding.
-_LOWER_IS_BETTER_UNITS = {"ms", "s"}
+#: "B" (bytes) bands the graft-xray wire metrics: replacing the
+#: base64 wire must show up as a gated byte DROP, and a frame-size
+#: regression fails like a latency regression does.
+_LOWER_IS_BETTER_UNITS = {"ms", "s", "B"}
 
 
 def baseline_key(rec: Dict[str, Any]) -> str:

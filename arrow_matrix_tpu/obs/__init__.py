@@ -28,6 +28,12 @@ the paper's headline claim (communication volume) per run:
     graft-serve event stream, a crash-readable on-disk ring, a stdlib
     Prometheus-style scrape endpoint, and the SLO-burn watchdog that
     feeds measured pressure into the degradation ladder;
+  * :mod:`~arrow_matrix_tpu.obs.xray` — graft-xray, fleet-wide
+    distributed tracing: router-minted trace context on every wire
+    frame, per-process trace docs merged into ONE clock-offset-aligned
+    Perfetto timeline (SIGKILLed workers recovered from their flight
+    rings with explicit ``truncated`` markers), and the per-class
+    critical-path decomposition (``graft_xray`` CLI);
   * :mod:`~arrow_matrix_tpu.obs.smoke` — a reduced-scale CPU-mesh run
     of all five parallel algorithms producing one inspectable run
     directory (traces + metrics.jsonl + summary.json).
@@ -80,6 +86,14 @@ from arrow_matrix_tpu.obs.tracer import (
     iteration_time_ms,
     timed,
 )
+from arrow_matrix_tpu.obs.xray import (
+    critical_path,
+    merge_process_traces,
+    merge_run_dir,
+    new_trace_id,
+    process_trace,
+    recover_from_flight,
+)
 
 __all__ = [
     "BurnRule",
@@ -96,6 +110,7 @@ __all__ = [
     "account_memory",
     "auto_repl",
     "chained_iteration_ms",
+    "critical_path",
     "format_imbalance_report",
     "format_memory_report",
     "get_registry",
@@ -104,7 +119,12 @@ __all__ = [
     "init_registry",
     "iteration_time_ms",
     "memory_report",
+    "merge_process_traces",
+    "merge_run_dir",
+    "new_trace_id",
     "predicted_bytes_for",
+    "process_trace",
+    "recover_from_flight",
     "reduce_bytes_for",
     "set_registry",
     "shard_report_for",

@@ -64,13 +64,28 @@ def current_request() -> Optional[Dict[str, str]]:
 
 @contextlib.contextmanager
 def request_context(request_id: str,
-                    tenant: Optional[str] = None) -> Iterator[None]:
+                    tenant: Optional[str] = None,
+                    **extra: Optional[str]) -> Iterator[None]:
     """Scope every flight event / tracer span / pulse observation made
     inside the body to one request (or one batch of requests — a
-    batched key like ``"r0001+r0002"`` names every member)."""
-    ctx: Dict[str, str] = {"request_id": str(request_id)}
+    batched key like ``"r0001+r0002"`` names every member).
+
+    Nested scopes MERGE-INHERIT: keys of the enclosing context that the
+    inner scope does not override stay visible, so a fleet-level
+    ``trace_id`` stamped at the worker's wire entry survives the
+    scheduler re-entering the context for the same request (graft-xray
+    rides on exactly this).  Extra keyword correlation keys (e.g.
+    ``trace_id``, ``parent_span``) are stamped as strings; None values
+    are skipped, never stored.
+    """
+    base = current_request()
+    ctx: Dict[str, str] = dict(base) if base else {}
+    ctx["request_id"] = str(request_id)
     if tenant is not None:
         ctx["tenant"] = str(tenant)
+    for key, value in extra.items():
+        if value is not None:
+            ctx[key] = str(value)
     token = _REQUEST_CTX.set(ctx)
     try:
         yield

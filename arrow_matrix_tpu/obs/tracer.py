@@ -77,6 +77,10 @@ class Tracer:
         self.registry = registry
         self.spans: List[Span] = []
         self._epoch = time.perf_counter()
+        # Wall-clock anchor for the monotonic span epoch: a span's
+        # absolute time is ``epoch_unix + ts_us/1e6``.  graft-xray uses
+        # this to merge per-process traces onto one fleet timeline.
+        self.epoch_unix = time.time()
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):

@@ -86,6 +86,10 @@ class Ticket:
         self.served_class: str = request.traffic_class
         self.class_fallback: Optional[str] = None
         self.certified_bound: Optional[float] = None
+        # graft-xray: the correlation context captured at submit time
+        # ({"trace_id": ...} and friends) — the handoff that carries
+        # the fleet-level trace onto the batch worker thread.
+        self.trace: Optional[dict] = None
         self._done = threading.Event()
 
     @property

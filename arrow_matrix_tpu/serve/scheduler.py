@@ -440,6 +440,12 @@ class ArrowServer:
 
         ticket = rq.Ticket(request)
         ticket.submitted_s = time.monotonic()
+        # Keep the submit-time correlation context (fleet trace_id and
+        # friends) on the ticket: _process_batch runs on the worker
+        # thread, where the submitting thread's contextvars are out of
+        # reach — the ticket is the handoff.
+        ctx = flight.current_request()
+        ticket.trace = dict(ctx) if ctx else None
         self._count("submitted", request.tenant)
         if request.traffic_class not in TRAFFIC_CLASSES:
             ticket._finish(
@@ -707,10 +713,18 @@ class ArrowServer:
         key = "+".join(t.request.request_id for t in batch)
         tenants = sorted({t.request.tenant for t in batch})
         tenant = "+".join(tenants)
-        with flight.request_context(key, tenant), \
+        # Rejoin the members' fleet trace ids on this worker thread
+        # (class-pure batches of one make the join a single id).
+        trace_ids = sorted({(t.trace or {}).get("trace_id")
+                            for t in batch
+                            if (t.trace or {}).get("trace_id")})
+        with flight.request_context(
+                key, tenant,
+                trace_id="+".join(trace_ids) if trace_ids else None), \
                 self._span("batch", requests=len(batch),
                            k_total=sum(t.request.k for t in batch),
                            iterations=batch[0].request.iterations,
+                           traffic_class=batch[0].served_class,
                            config=dataclasses.asdict(cfg)):
             self._run_batch(batch, cfg, key)
 

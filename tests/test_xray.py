@@ -1,0 +1,417 @@
+"""graft-xray tests: wire accounting (measured stats, registry
+labels, byte conservation across a socketpair), the near-limit
+warning and the hard frame refusal, merge-inheriting request
+contexts, per-process trace docs and the clock-offset-aligned fleet
+merge, flight-ring recovery with explicit ``truncated`` markers, the
+per-class critical-path decomposition (segment math pinned on a
+synthetic trace), report diffing, the per-class ledger bands (a
+planted byte-cheap/time-slow approx record must trip the drift gate,
+and ``wire_bytes`` bands as lower-is-better), and one in-process
+two-worker fleet end to end: worker spans carry the router-minted
+trace_id, the merged trace has one track per process, and the
+router's per-frame wire ledger sums exactly to its totals."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu.fleet import wire
+from arrow_matrix_tpu.ledger import Ledger, gate
+from arrow_matrix_tpu.obs import flight
+from arrow_matrix_tpu.obs import metrics as metrics_mod
+from arrow_matrix_tpu.obs import xray
+from arrow_matrix_tpu.obs.tracer import Tracer
+
+
+@pytest.fixture
+def fresh_registry():
+    old = metrics_mod.get_registry()
+    reg = metrics_mod.MetricsRegistry()
+    metrics_mod.set_registry(reg)
+    yield reg
+    metrics_mod.set_registry(old)
+
+
+# ---------------------------------------------------------------------------
+# Wire accounting
+# ---------------------------------------------------------------------------
+
+def test_wire_stats_measured_and_conserved(fresh_registry):
+    a, b = socket.socketpair()
+    try:
+        out = wire.send_msg(
+            a, {"op": "submit", "x": np.arange(6, dtype=np.float32)},
+            role="client")
+        msg, back = wire.recv_msg_stats(b, role="server")
+    finally:
+        a.close()
+        b.close()
+    assert msg["op"] == "submit"
+    assert out["dir"] == "send" and back["dir"] == "recv"
+    assert out["op"] == back["op"] == "submit"
+    assert out["frame_bytes"] == back["frame_bytes"] > 0
+    assert out["serialize_ms"] >= 0.0 and out["wire_ms"] >= 0.0
+    # recv splits header wait (server think time) from payload
+    # transfer — both present, neither negative.
+    assert back["wait_ms"] >= 0.0 and back["wire_ms"] >= 0.0
+    hists = {(h["labels"].get("role"), h["labels"].get("dir")):
+             h["summary"]
+             for h in fresh_registry.snapshot()["histograms"]
+             if h["name"] == "wire_frame_bytes"}
+    assert hists[("client", "send")]["count"] == 1
+    assert hists[("server", "recv")]["count"] == 1
+    # byte conservation, measured independently on both sides
+    assert (hists[("client", "send")]["mean"]
+            == hists[("server", "recv")]["mean"])
+
+
+def test_near_limit_warns_and_oversize_refuses(fresh_registry,
+                                               monkeypatch):
+    msg = {"op": "pad", "pad": "x" * 1000}
+    blob = len(json.dumps(wire.encode_payload(msg)).encode("utf-8"))
+    a, b = socket.socketpair()
+    try:
+        # Exactly at the limit: delivered, but LOUD.
+        monkeypatch.setattr(wire, "MAX_FRAME_BYTES", blob)
+        with pytest.warns(wire.WireNearLimitWarning):
+            wire.send_msg(a, msg)
+        assert wire.recv_msg(b)["pad"] == msg["pad"]
+        # One byte over: refused before any bytes hit the socket.
+        monkeypatch.setattr(wire, "MAX_FRAME_BYTES", blob - 1)
+        with pytest.raises(wire.WireError, match="exceeds"):
+            wire.send_msg(a, msg)
+    finally:
+        a.close()
+        b.close()
+    counters = {c["labels"].get("op"): c["value"]
+                for c in fresh_registry.snapshot()["counters"]
+                if c["name"] == "wire_near_limit_total"}
+    assert counters.get("pad") == 1
+
+
+# ---------------------------------------------------------------------------
+# Trace context + per-process docs
+# ---------------------------------------------------------------------------
+
+def test_request_context_merge_inherits():
+    with flight.request_context("rq1", "tenantA", trace_id="abc"):
+        # The scheduler re-enters the context for a batch; the fleet
+        # keys entered at the wire must survive the nesting.
+        with flight.request_context("b1+b2", "tenantA"):
+            ctx = flight.current_request()
+            assert ctx["request_id"] == "b1+b2"
+            assert ctx["trace_id"] == "abc"
+        assert flight.current_request()["request_id"] == "rq1"
+    assert flight.current_request() is None
+
+
+def test_process_trace_doc_carries_context_and_epoch():
+    tr = Tracer(name="t")
+    assert tr.epoch_unix == pytest.approx(time.time(), abs=60.0)
+    with flight.request_context("rq9", "t0", trace_id="deadbeef"):
+        with tr.span("work"):
+            pass
+    doc = xray.process_trace(tr, "w9")
+    assert doc["process"] == "w9" and doc["truncated"] is False
+    (s,) = doc["spans"]
+    assert s["args"]["request_id"] == "rq9"
+    assert s["args"]["trace_id"] == "deadbeef"
+    assert s["dur_us"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Merge + flight-ring recovery
+# ---------------------------------------------------------------------------
+
+def _doc(process, epoch, spans, truncated=False):
+    return {"schema": 1, "process": process, "pid": 1,
+            "epoch_unix": epoch, "truncated": truncated,
+            "spans": [{"name": n, "ts_us": ts, "dur_us": d, "tid": 0,
+                       "args": dict(a)} for (n, ts, d, a) in spans]}
+
+
+def test_merge_aligns_clocks_and_orders_router_first():
+    router = _doc("router", 1000.0,
+                  [("dispatch", 0.0, 100.0, {"request_id": "r1"})])
+    # The worker's clock reads 0.5 s AHEAD of the router's; the ping
+    # handshake measured exactly that, so the tracks must align.
+    worker = _doc("w0", 1000.5,
+                  [("batch", 20.0, 50.0, {"request_id": "r1"})])
+    merged = xray.merge_process_traces(
+        [worker, router], offsets_ns={"w0": {"offset_ns": 500_000_000}})
+    evs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    by = {e["name"]: e for e in evs}
+    assert by["dispatch"]["pid"] == 0          # router track is pid 0
+    assert by["batch"]["pid"] == 1
+    assert min(e["ts"] for e in evs) == 0.0    # rebased
+    assert (by["batch"]["ts"] - by["dispatch"]["ts"]
+            == pytest.approx(20.0, abs=1e-3))
+    names = {m["args"]["name"] for m in merged["traceEvents"]
+             if m["ph"] == "M"}
+    assert names == {"router", "w0"}
+    assert merged["xray"]["truncated"] == []
+
+
+def test_merge_marks_truncated_tracks():
+    merged = xray.merge_process_traces([
+        _doc("router", 0.0, [("dispatch", 0.0, 1.0, {})]),
+        _doc("w1", 0.0, [("batch", 0.0, 1.0, {"truncated": True})],
+             truncated=True)])
+    assert merged["xray"]["truncated"] == ["w1"]
+    meta = {m["pid"]: m["args"]["name"]
+            for m in merged["traceEvents"] if m["ph"] == "M"}
+    assert meta[1] == "w1 (truncated)"
+
+
+def test_recover_from_flight_marks_every_span_truncated(tmp_path):
+    path = str(tmp_path / "flight.json")
+    rec = flight.FlightRecorder(path)
+    flight.set_recorder(rec)
+    try:
+        with flight.request_context("rq7", "tz", trace_id="feed"):
+            flight.record("span", "batch", ms=12.5)
+        flight.record("fleet", "router_up")    # non-span: ignored
+    finally:
+        flight.set_recorder(None)
+    doc = xray.recover_from_flight(path, "worker-1")
+    assert doc["truncated"] is True and doc["process"] == "worker-1"
+    (s,) = doc["spans"]
+    assert s["name"] == "batch"
+    assert s["args"]["truncated"] is True
+    assert s["args"]["recovered_from"] == "flight_ring"
+    assert s["args"]["request_id"] == "rq7"
+    assert s["args"]["trace_id"] == "feed"
+    assert s["dur_us"] == pytest.approx(12_500.0)
+    # missing artifact or no spans -> None, never a fabricated track
+    assert xray.recover_from_flight(str(tmp_path / "no.json"),
+                                    "x") is None
+
+
+# ---------------------------------------------------------------------------
+# Critical path
+# ---------------------------------------------------------------------------
+
+def _ev(name, ts_us, dur_us, pid, args):
+    return {"name": name, "ph": "X", "ts": float(ts_us),
+            "dur": float(dur_us), "pid": pid, "tid": 0, "args": args}
+
+
+def test_critical_path_segment_math_is_pinned():
+    rid = "rq1"
+    events = [
+        _ev("dispatch", 0, 100_000, 0, {"request_id": rid}),
+        _ev("rpc", 10_000, 80_000, 0,
+            {"request_id": rid, "serialize_ms": 2.0, "wire_ms": 3.0}),
+        _ev("worker_submit", 12_000, 70_000, 1, {"request_id": rid}),
+        _ev("admission", 12_000, 1_000, 1, {"request_id": rid}),
+        _ev("batch", 20_000, 40_000, 1,
+            {"request_id": rid, "traffic_class": "approx"}),
+        _ev("checkpoint", 30_000, 5_000, 1, {"request_id": rid}),
+        _ev("finalize", 61_000, 2_000, 1, {"request_id": rid}),
+    ]
+    cp = xray.critical_path({"traceEvents": events})
+    r = cp["requests"][rid]
+    seg = r["segments"]
+    assert r["class"] == "approx"              # from the batch span
+    assert r["total_ms"] == pytest.approx(100.0)
+    assert seg["queue"] == pytest.approx(10.0)
+    assert seg["admission"] == pytest.approx(1.0)
+    assert seg["serialize"] == pytest.approx(2.0)
+    assert seg["wire"] == pytest.approx(3.0)
+    assert seg["worker_queue"] == pytest.approx(7.0)   # 20 - (12+1)
+    assert seg["checkpoint"] == pytest.approx(5.0)
+    assert seg["compute"] == pytest.approx(35.0)       # batch - ckpt
+    assert seg["response"] == pytest.approx(12.0)      # 2 + tail 10
+    agg = cp["per_class"]["approx"]
+    assert agg["count"] == 1
+    assert agg["segments_mean_ms"]["compute"] == pytest.approx(35.0)
+    # an explicit class map (the fleet report's served_class) wins
+    cp2 = xray.critical_path({"traceEvents": events},
+                             classes={rid: "exact"})
+    assert cp2["requests"][rid]["class"] == "exact"
+
+
+def test_critical_path_splits_batch_shared_spans_evenly():
+    events = [
+        _ev("dispatch", 0, 50_000, 0, {"request_id": "a"}),
+        _ev("rpc", 0, 50_000, 0, {"request_id": "a"}),
+        _ev("dispatch", 0, 50_000, 0, {"request_id": "b"}),
+        _ev("rpc", 0, 50_000, 0, {"request_id": "b"}),
+        _ev("batch", 10_000, 20_000, 1, {"request_id": "a+b"}),
+    ]
+    cp = xray.critical_path({"traceEvents": events})
+    assert cp["requests"]["a"]["segments"]["compute"] \
+        == pytest.approx(10.0)
+    assert cp["requests"]["b"]["segments"]["compute"] \
+        == pytest.approx(10.0)
+
+
+def test_diff_reports_flags_grown_segment_only():
+    base = {"per_class": {"exact": {"segments_mean_ms":
+                                    {"wire": 10.0, "compute": 50.0}}}}
+    worse = {"per_class": {"exact": {"segments_mean_ms":
+                                     {"wire": 20.0, "compute": 50.0}}}}
+    d = xray.diff_reports(base, worse)
+    assert len(d["regressions"]) == 1
+    assert "exact/wire" in d["regressions"][0]
+    assert xray.diff_reports(base, base)["regressions"] == []
+    # a shrink is not a regression
+    assert xray.diff_reports(worse, base)["regressions"] == []
+
+
+# ---------------------------------------------------------------------------
+# Per-class ledger bands (graft-xray satellite on class_bench)
+# ---------------------------------------------------------------------------
+
+def _cls_rec(lg, value, *, ts, carriage=1 << 20, degraded=False):
+    """One per-class bench record shaped like tools/class_bench.py's
+    class-suffixed rows."""
+    return lg.record(
+        "bench", "spmm_iter_ms_n4096_w64_bf16", value, unit="ms",
+        platform="cpu", device_kind="host", host_load=0.2,
+        git_rev=None, ts_unix=ts,
+        knobs={"traffic_class": "bf16"},
+        payload={"parsed": {"metric": "spmm_iter_ms_bf16",
+                            "class": "bf16",
+                            "carriage_bytes": carriage,
+                            "degraded": degraded}})
+
+
+def test_planted_byte_cheap_time_slow_class_trips_gate(tmp_path):
+    lg = Ledger(str(tmp_path / "lg"))
+    for i, v in enumerate([100.0, 100.5, 99.5, 100.2]):
+        _cls_rec(lg, v, ts=1000.0 + i)
+    baseline = gate.build_baseline(lg.read_all())
+    # Half the carriage bytes but 30% slower: the class-suffixed band
+    # must fail it — byte-cheap may not hide time-slow behind the f32
+    # headline metric.
+    slow = _cls_rec(lg, 130.0, ts=2000.0, carriage=1 << 19)
+    failures, _ = gate.check_records([slow], baseline)
+    assert any("perf regression" in f for f in failures)
+    rc, lines = gate.run_gate(
+        ledger_dir=lg.directory,
+        baseline_file=gate.save_baseline(
+            gate.baseline_path(lg.directory), baseline))
+    assert rc == 1 and any("FAIL" in ln for ln in lines)
+    # a degraded (host-fallback) class round is a note, never a fail
+    soft = _cls_rec(lg, 130.0, ts=2001.0, degraded=True)
+    failures, notes = gate.check_records([soft], baseline)
+    assert failures == []
+    assert any("degraded" in n for n in notes)
+
+
+def test_wire_bytes_band_is_lower_is_better(tmp_path):
+    lg = Ledger(str(tmp_path / "lg"))
+    for i, v in enumerate([21000.0, 21100.0, 20900.0, 21050.0]):
+        lg.record("fleet", "wire_bytes", v, unit="B",
+                  structure_hash="fleet_w3", platform="cpu",
+                  host_load=0.2, git_rev=None, ts_unix=1000.0 + i)
+    baseline = gate.build_baseline(lg.read_all())
+    bloat = lg.record("fleet", "wire_bytes", 42000.0, unit="B",
+                      structure_hash="fleet_w3", platform="cpu",
+                      host_load=0.2, git_rev=None, ts_unix=2000.0)
+    failures, _ = gate.check_records([bloat], baseline)
+    assert any("perf regression" in f for f in failures)
+    fine = lg.record("fleet", "wire_bytes", 21010.0, unit="B",
+                     structure_hash="fleet_w3", platform="cpu",
+                     host_load=0.2, git_rev=None, ts_unix=2001.0)
+    failures, _ = gate.check_records([fine], baseline)
+    assert failures == []
+
+
+# ---------------------------------------------------------------------------
+# In-process fleet end to end
+# ---------------------------------------------------------------------------
+
+def _start_worker(worker_id, obs_dir):
+    from arrow_matrix_tpu.fleet.worker import FleetWorker, serve_worker
+
+    worker = FleetWorker(worker_id, vertices=64, width=16, seed=5,
+                         obs_dir=obs_dir)
+    ready = threading.Event()
+    box = {}
+
+    def announce(port):
+        box["port"] = port
+        ready.set()
+
+    threading.Thread(target=serve_worker, args=(worker,),
+                     kwargs={"port": 0, "announce": announce},
+                     daemon=True).start()
+    assert ready.wait(120), f"{worker_id} never bound"
+    return worker, box["port"]
+
+
+def test_fleet_trace_merges_with_shared_trace_ids(tmp_path,
+                                                  fresh_registry):
+    from arrow_matrix_tpu.fleet.health import HealthMonitor
+    from arrow_matrix_tpu.fleet.router import FleetRouter, WorkerHandle
+    from arrow_matrix_tpu.serve.loadgen import synthetic_trace
+
+    run_dir = str(tmp_path)
+    workers, handles = [], []
+    for wid in ("w0", "w1"):
+        w, port = _start_worker(wid, str(tmp_path / wid))
+        workers.append(w)
+        handles.append(WorkerHandle(wid, "127.0.0.1", port))
+    router = FleetRouter(
+        handles=handles,
+        health=HealthMonitor(timeout_s=5.0, max_failures=3))
+    try:
+        trace = synthetic_trace(router.n_rows, tenants=2, requests=3,
+                                k=2, iterations=1, seed=9)
+        tickets = [router.submit(r) for r in trace]
+        router.drain(timeout_s=180)
+        assert [t.status for t in tickets] == ["completed"] * 3
+        report = router.fleet_summary()
+        xray.save_router_trace(router.tracer, run_dir)
+    finally:
+        router.shutdown()
+        for w in workers:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    # Router-side wire ledger: per-frame records sum EXACTLY to the
+    # totals (byte conservation at the accounting layer).
+    totals = report["wire"]["totals"]
+    frames = report["wire"]["frames"]
+    assert totals["frames"] == 2 * len(frames) > 0
+    assert sum(f["bytes_out"] for f in frames) == totals["bytes_out"]
+    assert sum(f["bytes_in"] for f in frames) == totals["bytes_in"]
+    # A ping-measured clock offset per worker, sane for one host.
+    offs = report["clock_offsets_ns"]
+    assert set(offs) == {"w0", "w1"}
+    assert all(abs(o["offset_ns"]) < 1e9 for o in offs.values())
+
+    merged = xray.merge_run_dir(run_dir, report=report)
+    procs = {p["process"] for p in merged["xray"]["processes"]}
+    assert procs == {"router", "w0", "w1"}
+    assert merged["xray"]["truncated"] == []
+    events = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    pid_of = {p["process"]: p["pid"]
+              for p in merged["xray"]["processes"]}
+    for t in tickets:
+        rid = t.request.request_id
+        trace_id = (t.trace or {}).get("trace_id")
+        assert trace_id
+        mine = [e for e in events if rid in
+                str(e["args"].get("request_id", "")).split("+")]
+        pids = {e["pid"] for e in mine}
+        # the span tree closes across the wire: router AND one worker
+        assert pid_of["router"] in pids and len(pids) >= 2
+        remote = [e for e in mine if e["pid"] != pid_of["router"]]
+        assert any(trace_id in
+                   str(e["args"].get("trace_id", "")).split("+")
+                   for e in remote)
+    # and the decomposition covers every request with nonzero compute
+    cp = xray.critical_path(merged)
+    assert set(cp["requests"]) == {t.request.request_id
+                                   for t in tickets}
+    for rec in cp["requests"].values():
+        assert rec["segments"]["compute"] > 0.0

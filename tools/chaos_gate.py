@@ -46,7 +46,10 @@ fleet_baseline + fleet_kill — SIGKILL one worker process of N=3
 mid-batch; the router must bury exactly the victim, requeue its
 accepted-but-unfinished requests onto survivors (checkpoint-resumed,
 not recomputed), lose zero accepted requests, and report EXACT pooled
-fleet quantiles.
+fleet quantiles.  xray_kill then inspects the merged graft-xray trace
+that run left behind: the victim's partial spans must be recovered
+from its eagerly-flushed flight ring with explicit ``truncated``
+markers, still correlated to the router track by shared request ids.
 
 Exits 0 when every scenario passes, 1 otherwise.  Determinism is the
 whole contract: recovery re-runs the same compiled step from the same
@@ -360,6 +363,61 @@ def scenario_kcert():
     return problems
 
 
+def scenario_xray_kill(workdir):
+    """graft-xray under SIGKILL: the fleet_kill scenario's merged
+    trace must still carry the victim's track — rebuilt from the
+    flight ring the dead worker flushed eagerly per event — with an
+    EXPLICIT ``truncated`` marker on the track and on every recovered
+    span, and at least one request id shared with the router track
+    (the kill must not sever the fleet-level correlation)."""
+    path = os.path.join(workdir, "fleet_kill", "fleet_xray.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            trace = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [f"xray_kill: merged fleet trace unreadable: {e}"]
+    problems = []
+    xr = trace.get("xray") or {}
+    procs = {p["process"]: p for p in xr.get("processes", [])}
+    victim = procs.get("worker-1")
+    if victim is None:
+        return ["xray_kill: the SIGKILLed worker-1 has no track in "
+                "the merged trace (flight-ring recovery failed)"]
+    if ("worker-1" not in (xr.get("truncated") or [])
+            or not victim.get("truncated")):
+        problems.append("xray_kill: worker-1's recovered track is "
+                        "not marked truncated")
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X"]
+    vic = [e for e in events if e.get("pid") == victim.get("pid")]
+    if not vic:
+        return problems + ["xray_kill: no spans recovered from "
+                           "worker-1's flight ring"]
+    untagged = sorted({e["name"] for e in vic
+                       if not (e.get("args") or {}).get("truncated")})
+    if untagged:
+        problems.append(f"xray_kill: recovered spans lack the "
+                        f"explicit truncated marker: {untagged}")
+
+    def _rids(evs):
+        return {m for e in evs
+                for m in str((e.get("args") or {})
+                             .get("request_id", "")).split("+") if m}
+
+    vic_rids = _rids(vic)
+    if not vic_rids:
+        problems.append("xray_kill: no recovered victim span carries "
+                        "a request id")
+    router_pid = procs.get("router", {}).get("pid")
+    shared = vic_rids & _rids(
+        [e for e in events if e.get("pid") == router_pid])
+    if vic_rids and not shared:
+        problems.append("xray_kill: no request id shared between the "
+                        "router track and the victim's recovered "
+                        "track")
+    return problems
+
+
 def run_gate(workdir, fast=False):
     """Run the matrix; returns (problems, scenarios_run)."""
     from arrow_matrix_tpu import faults
@@ -414,6 +472,12 @@ def run_gate(workdir, fast=False):
             workdir, fast=fast)
         problems += fleet_problems
         scenarios += fleet_scenarios
+        # graft-xray piggybacks on the fleet_kill run: the SIGKILLed
+        # worker's partial trace must be recovered (truncated, loudly)
+        # in the merged fleet_xray.json that run left behind.
+        if "fleet_kill" in fleet_scenarios:
+            scenarios.append("xray_kill")
+            problems += scenario_xray_kill(workdir)
         # And the reshard matrix (tools/reshard_gate.py): H7 bounded-
         # scratch staging plus SIGKILL mid staged-migration with zero
         # accepted-request loss and bit-identical resumed results.

@@ -261,6 +261,34 @@ def main(argv=None) -> int:
         if rec is not None:
             print(f"[class_bench] ledger {rec['record_id']}",
                   flush=True)
+        # graft-xray satellite: one banded record PER CARRIAGE CLASS
+        # (metric carries the class suffix, e.g.
+        # ``spmm_iter_ms_n65536_w2048_bf16``), so the drift gate bands
+        # each class's iter_ms separately — a class that gets
+        # byte-cheaper but time-slower fails loudly instead of hiding
+        # behind the f32 headline number.
+        base_metric = store.bench_metric(parsed["metric"],
+                                         parsed["config"])
+        for cls_name in sorted(classes):
+            cls_rec = classes[cls_name]
+            crec = store.record(
+                "bench", f"{base_metric}_{cls_name}",
+                cls_rec["iter_ms"], directory=args.ledger_dir,
+                unit="ms", platform=parsed["platform"],
+                device_kind=parsed["device_kind"],
+                knobs={"traffic_class": cls_name,
+                       "config": parsed["config"]},
+                payload={"parsed": {
+                    "metric": f"{parsed['metric']}_{cls_name}",
+                    "class": cls_name,
+                    "carriage_bytes": cls_rec["carriage_bytes"],
+                    "rel_frobenius_vs_f32":
+                        cls_rec["rel_frobenius_vs_f32"],
+                    "degraded": parsed["degraded"],
+                }})
+            if crec is not None:
+                print(f"[class_bench] ledger {crec['record_id']} "
+                      f"({cls_name})", flush=True)
 
     print(json.dumps(parsed, sort_keys=True))
     return 0

@@ -161,6 +161,19 @@ def _check_exact_pooled_p99(tag, run_dir):
     return problems
 
 
+def _check_xray_artifacts(tag, run_dir):
+    """graft-xray: every fleet run must leave ONE merged Perfetto
+    trace (``fleet_xray.json``) and the per-class critical-path report
+    next to its fleet report — the trace is a first-class run
+    artifact, not a debug extra.  The kill scenario's truncated-track
+    content checks live in tools/chaos_gate.py:scenario_xray_kill."""
+    problems = []
+    for name in ("fleet_xray.json", "xray_report.json"):
+        if not os.path.isfile(os.path.join(run_dir, name)):
+            problems.append(f"{tag}: {name} artifact missing")
+    return problems
+
+
 def scenario_fleet_baseline(workdir, ref):
     """No-fault fleet run: complete, bit-identical, exact quantiles,
     clean merged pulse."""
@@ -181,6 +194,7 @@ def scenario_fleet_baseline(workdir, ref):
                         f"{verdict['pulse_problems']}")
     problems += _check_bit_identity("fleet_baseline", npz, ref)
     problems += _check_exact_pooled_p99("fleet_baseline", run_dir)
+    problems += _check_xray_artifacts("fleet_baseline", run_dir)
     return problems
 
 
@@ -247,6 +261,7 @@ def scenario_fleet_kill(workdir, ref):
     problems += _check_bit_identity("fleet_kill", npz, ref,
                                     expect_ids=completed_ids)
     problems += _check_exact_pooled_p99("fleet_kill", run_dir)
+    problems += _check_xray_artifacts("fleet_kill", run_dir)
     return problems
 
 

@@ -233,6 +233,184 @@ def ledger_problems(smoke_summary: dict, serve_summary: dict) -> list:
     return problems
 
 
+def xray_problems(trace_doc: dict, tickets: list, wire=None,
+                  registry=None) -> list:
+    """Gate problems from a merged graft-xray fleet trace.
+
+    Two invariants, both correctness properties of the tracer rather
+    than style checks:
+
+    * **Closed span trees.**  Every COMPLETED request must appear as a
+      router-track ``dispatch`` span AND at least one worker-track
+      span carrying the same request id and the router-minted
+      ``trace_id`` — and the worker spans must land inside the
+      dispatch interval (0.25 s slack for clock-offset residue).  A
+      request the fleet says it served but the trace cannot follow
+      across the wire is a broken context propagation, the exact bug
+      this gate exists to catch.
+
+    * **Byte conservation.**  The router's per-frame wire ledger must
+      sum EXACTLY to its totals (a dropped frame record is silent
+      undercounting), and — when a fresh process-local registry is
+      passed — the bytes the client side sent must equal the bytes the
+      server side received, and vice versa: the wire may not create or
+      destroy bytes between the two measurement points.
+    """
+    problems = []
+    xr = trace_doc.get("xray") or {}
+    procs = {p["process"]: p["pid"] for p in xr.get("processes", [])}
+    if "router" not in procs:
+        problems.append("xray: merged trace lacks a router track")
+    if len(procs) < 2:
+        problems.append("xray: merged trace has no worker tracks")
+    if problems:
+        return problems
+    router_pid = procs["router"]
+    events = [e for e in trace_doc.get("traceEvents", [])
+              if e.get("ph") == "X"]
+    slack_us = 0.25e6
+    for t in tickets:
+        if t.get("status") != "completed":
+            continue
+        rid = t["request_id"]
+        mine = [e for e in events if rid in
+                str(e.get("args", {}).get("request_id", "")).split("+")]
+        disp = [e for e in mine
+                if e["pid"] == router_pid and e["name"] == "dispatch"]
+        remote = [e for e in mine if e["pid"] != router_pid]
+        if not disp:
+            problems.append(f"xray: {rid}: no router dispatch span")
+            continue
+        if not remote:
+            problems.append(f"xray: {rid}: no worker-side spans — "
+                            f"span tree not closed across the wire")
+            continue
+        want = t.get("trace_id")
+        if want and not any(
+                want in str(e["args"].get("trace_id", "")).split("+")
+                for e in remote):
+            problems.append(f"xray: {rid}: worker spans lack the "
+                            f"router-minted trace_id {want}")
+        d0 = min(e["ts"] for e in disp)
+        d1 = max(e["ts"] + e["dur"] for e in disp)
+        stray = [e["name"] for e in remote
+                 if e["ts"] < d0 - slack_us
+                 or e["ts"] + e["dur"] > d1 + slack_us]
+        if stray:
+            problems.append(f"xray: {rid}: worker spans {stray} fall "
+                            f"outside the dispatch interval")
+    if wire:
+        totals = wire.get("totals") or {}
+        frames = wire.get("frames") or []
+        out_sum = sum(int(f.get("bytes_out") or 0) for f in frames)
+        in_sum = sum(int(f.get("bytes_in") or 0) for f in frames)
+        if (out_sum != totals.get("bytes_out")
+                or in_sum != totals.get("bytes_in")):
+            problems.append(
+                f"xray: wire ledger does not conserve bytes: frame "
+                f"sums {out_sum}/{in_sum} (out/in) vs totals "
+                f"{totals.get('bytes_out')}/{totals.get('bytes_in')}")
+        if totals.get("frames") != 2 * len(frames):
+            problems.append(
+                f"xray: wire ledger frame count "
+                f"{totals.get('frames')} != 2 x {len(frames)} "
+                f"round trips")
+    if registry is not None:
+        sums: dict = {}
+        for rec in registry.snapshot()["histograms"]:
+            if rec["name"] != "wire_frame_bytes":
+                continue
+            lab = rec.get("labels") or {}
+            s = rec.get("summary") or {}
+            key = (lab.get("role"), lab.get("dir"))
+            sums[key] = sums.get(key, 0) + int(round(
+                s.get("mean", 0.0) * s.get("count", 0)))
+        for a, b in ((("client", "send"), ("server", "recv")),
+                     (("server", "send"), ("client", "recv"))):
+            if sums.get(a, 0) != sums.get(b, 0):
+                problems.append(
+                    f"xray: bytes not conserved across the socket: "
+                    f"{'/'.join(a)}={sums.get(a, 0)} != "
+                    f"{'/'.join(b)}={sums.get(b, 0)}")
+    return problems
+
+
+def run_xray_fleet(out: str) -> list:
+    """In-process 2-worker fleet exercising the full graft-xray loop
+    (trace context over the wire, per-process docs, clock-offset
+    handshake, merge, conservation) and returning its gate problems."""
+    import threading
+
+    from arrow_matrix_tpu.fleet.health import HealthMonitor
+    from arrow_matrix_tpu.fleet.router import FleetRouter, WorkerHandle
+    from arrow_matrix_tpu.fleet.worker import FleetWorker, serve_worker
+    from arrow_matrix_tpu.obs import metrics as metrics_mod
+    from arrow_matrix_tpu.obs import xray
+    from arrow_matrix_tpu.serve.loadgen import synthetic_trace
+
+    # Fresh registry: the byte-symmetry check must see exactly this
+    # fleet's frames, not the smoke runs' leftovers.
+    metrics_mod.set_registry(metrics_mod.MetricsRegistry())
+    xray_dir = os.path.join(out, "xray")
+    workers, handles = [], []
+    for wid in ("w0", "w1"):
+        worker = FleetWorker(wid, vertices=96, width=16, seed=5,
+                             obs_dir=os.path.join(xray_dir, wid))
+        ready = threading.Event()
+        box: dict = {}
+
+        def announce(port, box=box, ready=ready):
+            box["port"] = port
+            ready.set()
+
+        threading.Thread(target=serve_worker, args=(worker,),
+                         kwargs={"port": 0, "announce": announce},
+                         daemon=True).start()
+        if not ready.wait(120):
+            return [f"xray: worker {wid} never bound"]
+        workers.append(worker)
+        handles.append(WorkerHandle(wid, "127.0.0.1", box["port"]))
+    router = FleetRouter(
+        handles=handles,
+        health=HealthMonitor(timeout_s=5.0, max_failures=3))
+    try:
+        trace = synthetic_trace(router.n_rows, tenants=2, requests=4,
+                                k=2, iterations=2, seed=11)
+        tickets = [router.submit(r) for r in trace]
+        router.drain(timeout_s=180)
+        report = router.fleet_summary()
+        xray.save_router_trace(router.tracer, xray_dir)
+    finally:
+        router.shutdown()
+        for w in workers:
+            try:
+                w.close()
+            except Exception:
+                pass
+    bad = [t.request.request_id for t in tickets
+           if t.status != "completed"]
+    if bad:
+        return [f"xray: fleet requests not completed: {bad}"]
+    trace_doc = xray.merge_run_dir(xray_dir, report=report)
+    xray.save_fleet_trace(trace_doc, xray_dir)
+    tick = [{"request_id": t.request.request_id, "status": t.status,
+             "trace_id": (t.trace or {}).get("trace_id")}
+            for t in tickets]
+    problems = xray_problems(trace_doc, tick,
+                             wire=report.get("wire"),
+                             registry=metrics_mod.get_registry())
+    offs = report.get("clock_offsets_ns") or {}
+    for wid in ("w0", "w1"):
+        rec = offs.get(wid)
+        if not isinstance(rec, dict):
+            problems.append(f"xray: no clock offset measured for "
+                            f"{wid}")
+        elif abs(rec.get("offset_ns", 0)) > 1e9:
+            problems.append(f"xray: implausible same-host clock "
+                            f"offset for {wid}: {rec}")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
@@ -256,6 +434,7 @@ def main(argv=None) -> int:
     problems += serve_problems(s)
     problems += pulse_problems(s)
     problems += ledger_problems(summary, s)
+    problems += run_xray_fleet(out)
     if problems:
         for p in problems:
             print(f"obs gate: {p}", file=sys.stderr)

@@ -1,0 +1,299 @@
+"""graft-xray measurement bench: the numbers behind PERFORMANCE.md.
+
+Three measured sections, one JSON line of output:
+
+1. ``critical_path`` — the r07 scale point (n=2^20, width=2048)
+   served through a real :class:`ArrowServer` holding the committed
+   bf16 certificate (BENCH_r07.json's probed error curve — never
+   hand-declared), one warmup request per class to absorb XLA
+   compilation, then paired exact/approx requests decomposed into the
+   graft-xray segments per served class.  The f32-vs-bf16 iter gap
+   must land in a *named* segment (compute), not vanish into a
+   blended mean — that is the whole point of the per-class report.
+
+2. ``wire_per_mb`` — serialize and socket-transfer cost of MB-scale
+   ndarray frames over a local socketpair, measured by the same
+   ``send_msg`` / ``recv_msg_stats`` accounting the fleet uses
+   (median of repeats, per-MB normalized).
+
+3. ``tracing_overhead`` — the same synthetic trace served twice at a
+   smaller scale point, tracer+registry attached vs detached,
+   interleaved A/B repeats; plus the microbenchmarked cost of one
+   span.  The ISSUE's acceptance bar is overhead <= 5%.
+
+The big section decomposes a 2^20-row operator on the host backend
+(~2.5 min), so the full run takes a few minutes; ``--n`` scales it
+down for smoke runs (the certificate is then probed live instead of
+read from BENCH_r07.json, since certificates bind to one structure).
+
+Usage: python tools/xray_bench.py [--n 1048576] [--width 2048] ...
+Prints ONE JSON line (the measured payload) as its last stdout line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from arrow_matrix_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+#: The committed error-curve source for the r07 structure; used only
+#: when the bench runs at exactly the r07 (n, width, seed) point.
+BENCH_R07 = os.path.join(REPO, "BENCH_r07.json")
+
+
+def _median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2]
+
+
+# ---------------------------------------------------------------------------
+# Section 1: per-segment critical path, f32 vs bf16
+# ---------------------------------------------------------------------------
+
+def _bf16_certificate(n: int, width: int, seed: int):
+    """The serving certificate for the bench structure.  At the r07
+    point the committed BENCH_r07.json curve is the source (probed
+    once, exported, reused); at any other point the curve is probed
+    live — a certificate is only ever a measured artifact."""
+    from arrow_matrix_tpu.classes import certificate_from_record
+
+    if n == 1048576 and width == 2048 and seed == 7 \
+            and os.path.exists(BENCH_R07):
+        parsed = json.load(open(BENCH_R07))["parsed"]
+        for cur in parsed.get("error_curves", []):
+            if cur.get("dtype") == "bf16" and cur.get("rel_frobenius"):
+                rec = {"kind": "error_curve",
+                       "structure_hash": cur["structure_hash"],
+                       "record_id": cur.get("record_id", "r07"),
+                       "knobs": {"dtype": "bf16",
+                                 "emulated": cur.get("emulated", False),
+                                 "seed": seed},
+                       "payload": {"rel_frobenius":
+                                   list(cur["rel_frobenius"])}}
+                cert = certificate_from_record(rec)
+                if cert is not None:
+                    return cert, "BENCH_r07.json"
+    from arrow_matrix_tpu.ledger.probe import error_curves_for_source
+
+    source = {"kind": "ba", "n": n, "m": 3, "width": width,
+              "seed": seed}
+    curves = error_curves_for_source(source)
+    rec = next(r for r in curves if r["knobs"]["dtype"] == "bf16")
+    cert = certificate_from_record(rec)
+    assert cert is not None
+    return cert, "probed"
+
+
+def bench_critical_path(n: int, width: int, seed: int, *, k: int,
+                        per_class: int, iterations: int) -> dict:
+    """Serve paired exact/approx requests over one resident operator
+    and decompose each served class into the graft-xray segments."""
+    from arrow_matrix_tpu.obs import xray
+    from arrow_matrix_tpu.obs.tracer import Tracer
+    from arrow_matrix_tpu.serve import request as rq
+    from arrow_matrix_tpu.serve.loadgen import (
+        ba_executor_factory,
+        run_trace,
+        synthetic_trace,
+    )
+    from arrow_matrix_tpu.serve.scheduler import ArrowServer, ExecConfig
+
+    t0 = time.perf_counter()
+    factory, n_rows = ba_executor_factory(n, width, seed, fmt="fold")
+    decompose_s = time.perf_counter() - t0
+    cert, cert_source = _bf16_certificate(n, width, seed)
+    assert cert.covers(iterations), \
+        "bench iterations exceed the certified curve"
+
+    tracer = Tracer("xray_bench")
+    server = ArrowServer(factory, ExecConfig(), certificates=[cert],
+                         tracer=tracer, name="xray_bench")
+
+    def _paired(requests: int, trace_seed: int):
+        trace = synthetic_trace(n_rows, tenants=1, requests=requests,
+                                k=k, iterations=iterations,
+                                seed=trace_seed)
+        return [dataclasses.replace(
+                    r, traffic_class=("exact" if i % 2 == 0
+                                      else "approx"))
+                for i, r in enumerate(trace)]
+
+    # One warmup request per class absorbs XLA compilation so the
+    # measured segments are steady-state (the honest per-iter cost).
+    warm = run_trace(server, _paired(2, trace_seed=seed + 1))
+    assert all(t.status == rq.COMPLETED for t in warm)
+    tracer.spans.clear()
+
+    tickets = run_trace(server, _paired(2 * per_class,
+                                        trace_seed=seed))
+    assert all(t.status == rq.COMPLETED for t in tickets)
+    served = {t.request.request_id: t.served_class for t in tickets}
+    approx = [t for t in tickets
+              if t.request.traffic_class == "approx"]
+    assert approx and all(t.served_class == "approx" for t in approx), \
+        "approx requests fell back to exact — certificate not honored"
+
+    doc = xray.merge_process_traces(
+        [xray.process_trace(tracer, "serve")])
+    cp = xray.critical_path(doc, classes=served)
+    return {"config": {"n": n, "width": width, "seed": seed, "k": k,
+                       "iterations": iterations,
+                       "requests_per_class": per_class,
+                       "decompose_s": round(decompose_s, 2),
+                       "certificate": cert_source},
+            "per_class": cp["per_class"],
+            "requests": cp["requests"]}
+
+
+# ---------------------------------------------------------------------------
+# Section 2: wire serialize/transfer cost per MB
+# ---------------------------------------------------------------------------
+
+def bench_wire_per_mb(sizes_mb=(1, 4, 16), repeats: int = 5) -> dict:
+    """Measured cost of MB-scale ndarray frames over a socketpair,
+    using the fleet's own ``send_msg``/``recv_msg_stats`` accounting."""
+    import numpy as np
+
+    from arrow_matrix_tpu.fleet import wire
+
+    out = {}
+    for mb in sizes_mb:
+        x = np.random.default_rng(mb).standard_normal(
+            (mb << 20) // 4).astype(np.float32)
+        sends, decodes, wires = [], [], []
+        for _ in range(repeats):
+            a, b = socket.socketpair()
+            got = {}
+
+            def _server(sock=b, sink=got):
+                msg, stats = wire.recv_msg_stats(sock, role="server")
+                sink.update(stats)
+                wire.send_msg(sock, {"op": "ack"}, role="server")
+
+            th = threading.Thread(target=_server, daemon=True)
+            th.start()
+            st = wire.send_msg(a, {"op": "bench", "x": x},
+                               role="client")
+            wire.recv_msg(a, role="client")
+            th.join()
+            a.close(); b.close()
+            sends.append(st["serialize_ms"])
+            wires.append(st["wire_ms"] + got["wire_ms"])
+            decodes.append(got["serialize_ms"])
+        frame_mb = st["frame_bytes"] / float(1 << 20)
+        out[f"{mb}MiB"] = {
+            "frame_bytes": st["frame_bytes"],
+            "encode_ms_per_mb": round(_median(sends) / frame_mb, 3),
+            "decode_ms_per_mb": round(_median(decodes) / frame_mb, 3),
+            "wire_ms_per_mb": round(_median(wires) / frame_mb, 3)}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Section 3: tracing overhead on/off
+# ---------------------------------------------------------------------------
+
+def bench_tracing_overhead(n: int = 262144, width: int = 512, *,
+                           requests: int = 6, iterations: int = 2,
+                           k: int = 4, seed: int = 3,
+                           repeats: int = 5) -> dict:
+    """The same synthetic trace served with tracing+metrics attached
+    vs detached, interleaved A/B so drift hits both variants equally."""
+    from arrow_matrix_tpu.obs.metrics import MetricsRegistry
+    from arrow_matrix_tpu.obs.tracer import Tracer
+    from arrow_matrix_tpu.serve import request as rq
+    from arrow_matrix_tpu.serve.loadgen import (
+        ba_executor_factory,
+        run_trace,
+        synthetic_trace,
+    )
+    from arrow_matrix_tpu.serve.scheduler import ArrowServer, ExecConfig
+
+    factory, n_rows = ba_executor_factory(n, width, seed, fmt="fold")
+    tracer = Tracer("overhead")
+    servers = {
+        "on": ArrowServer(factory, ExecConfig(), tracer=tracer,
+                          registry=MetricsRegistry(), name="on"),
+        "off": ArrowServer(factory, ExecConfig(), name="off"),
+    }
+
+    def _run(server) -> float:
+        trace = synthetic_trace(n_rows, tenants=2, requests=requests,
+                                k=k, iterations=iterations, seed=seed)
+        t0 = time.perf_counter()
+        tickets = run_trace(server, trace)
+        wall = time.perf_counter() - t0
+        assert all(t.status == rq.COMPLETED for t in tickets)
+        return wall
+
+    for server in servers.values():   # compile both variants first
+        _run(server)
+    walls = {"on": [], "off": []}
+    for _ in range(repeats):
+        for name, server in servers.items():
+            tracer.spans.clear()
+            walls[name].append(_run(server))
+    on, off = _median(walls["on"]), _median(walls["off"])
+
+    t0 = time.perf_counter()
+    probe = Tracer("span_cost")
+    for _ in range(20000):
+        with probe.span("noop"):
+            pass
+    span_us = (time.perf_counter() - t0) / 20000 * 1e6
+    return {"config": {"n": n, "width": width, "requests": requests,
+                       "iterations": iterations, "repeats": repeats},
+            "wall_on_s": round(on, 4), "wall_off_s": round(off, 4),
+            "overhead_pct": round((on - off) / off * 100.0, 2),
+            "span_cost_us": round(span_us, 2)}
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=1048576)
+    ap.add_argument("--width", type=int, default=2048)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--per-class", type=int, default=2)
+    ap.add_argument("--iterations", type=int, default=2)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--sections", default="critical,wire,overhead",
+                    help="comma list of critical/wire/overhead — the "
+                         "overhead A/B is best run in its own fresh "
+                         "process, unpolluted by the big section's "
+                         "heap")
+    args = ap.parse_args(argv)
+    force_cpu_devices(1)
+
+    sections = set(args.sections.split(","))
+    payload = {}
+    if "critical" in sections:
+        payload["critical_path"] = bench_critical_path(
+            args.n, args.width, args.seed, k=args.k,
+            per_class=args.per_class, iterations=args.iterations)
+    if "wire" in sections:
+        payload["wire_per_mb"] = bench_wire_per_mb()
+    if "overhead" in sections:
+        payload["tracing_overhead"] = bench_tracing_overhead()
+    if args.out:
+        from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+
+        atomic_write_json(args.out, payload, indent=2, sort_keys=True)
+    print(json.dumps(payload, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
