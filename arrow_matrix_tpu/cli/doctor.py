@@ -753,6 +753,75 @@ def probe_xray() -> tuple[bool, str]:
                   "on any fleet run dir")
 
 
+def probe_lens() -> tuple[bool, str]:
+    """graft-lens round trip: profile a small BA fold level-by-level
+    with the prefix-difference harness, fit the structure-conditioned
+    cost model from the static counters, and predict the iteration
+    back — the calibration loop in miniature.  At this smoke scale
+    the tight bands the tier-1 gate enforces on the committed
+    ba_256_3 point do not hold (tier times are microseconds), so the
+    probe checks the round trip is structurally sound and the
+    prediction lands in a loose sanity band.  Bounded subprocess, as
+    for the other probes."""
+    code = (
+        "import sys; sys.argv=[]; "
+        "from arrow_matrix_tpu.utils.platform import "
+        "force_cpu_devices; force_cpu_devices(1); "
+        "from arrow_matrix_tpu.obs import lens; "
+        "from arrow_matrix_tpu.obs.costmodel import CostModel; "
+        "from arrow_matrix_tpu.tune.search import "
+        "load_levels_from_source; "
+        "p = []; "
+        "\n"
+        "levels, width = load_levels_from_source(\n"
+        "    {'kind': 'ba', 'n': 96, 'm': 3, 'width': 16,\n"
+        "     'seed': 5, 'max_levels': 6})\n"
+        "prof = lens.profile_fold(levels, width, 8, kernel='xla',\n"
+        "                         feature_dtypes=('f32',), iters=20)\n"
+        "ent = prof['dtypes'].get('f32') or {}\n"
+        "if not ent.get('full_ms', 0.0) > 0.0:\n"
+        "    p.append('no positive full-step time measured')\n"
+        "tiers = ent.get('tiers') or []\n"
+        "if not tiers:\n"
+        "    p.append('profile attributed no tiers')\n"
+        "for t in tiers:\n"
+        "    for key in ('family', 'nnz', 'rows', 'streamed_bytes'):\n"
+        "        if key not in t:\n"
+        "            p.append('tier missing counter ' + key)\n"
+        "            break\n"
+        "model = lens.fit_from_profile(prof)\n"
+        "if not p and not model.coeffs:\n"
+        "    p.append('fit produced no per-family coefficients')\n"
+        "if not p:\n"
+        "    pred = lens.predict_profile_iter_ms(prof, model, 'f32')\n"
+        "    full = ent['full_ms']\n"
+        "    if not pred > 0.0:\n"
+        "        p.append('non-positive prediction ' + repr(pred))\n"
+        "    elif not 0.02 <= pred / full <= 50.0:\n"
+        "        p.append('prediction insane: ' + repr(pred)\n"
+        "                 + ' ms vs measured ' + repr(full) + ' ms')\n"
+        "    m2 = CostModel.from_dict(model.to_dict())\n"
+        "    if m2.to_dict() != model.to_dict():\n"
+        "        p.append('cost model dict round trip not lossless')\n"
+        "print('LENS ok' if not p else 'LENS FAIL: ' + str(p[0]))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("LENS")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "LENS ok":
+        return False, lines[-1][:120]
+    return True, ("per-level profile -> cost-model fit -> prediction "
+                  "round trip is sane — tools/lens_gate.py checks "
+                  "the committed calibration")
+
+
 def probe_native() -> tuple[bool | None, str]:
     try:
         from arrow_matrix_tpu.decomposition import native
@@ -855,6 +924,10 @@ def main(argv=None) -> int:
     xray_ok, detail = probe_xray()
     ok &= _check("graft-xray (merged fleet trace + clock offsets)",
                  xray_ok, detail)
+
+    lens_ok, detail = probe_lens()
+    ok &= _check("graft-lens (profile -> fit -> predict round trip)",
+                 lens_ok, detail)
 
     cache = "bench_cache"
     if os.path.isdir(cache):

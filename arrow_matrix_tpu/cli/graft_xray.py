@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--ledger-dir", default=None,
                    help="append per-class segment means as "
                         "kind='xray' ledger records")
+    r.add_argument("--lens", default=None, metavar="PROFILE",
+                   help="graft-lens profile JSON (graft_lens profile "
+                        "--out): subdivide each class's compute "
+                        "segment by per-level attribution (exact "
+                        "class uses the f32 fractions, approx the "
+                        "bf16 ones when profiled)")
     r.add_argument("--json", action="store_true",
                    help="skip the table, JSON line only")
 
@@ -114,6 +120,22 @@ def cmd_report(args) -> int:
 
     trace = _load_trace(args.run_dir)
     cp = xray.critical_path(trace, classes=_load_classes(args.run_dir))
+    if getattr(args, "lens", None):
+        from arrow_matrix_tpu.obs import lens as lens_mod
+        with open(args.lens, encoding="utf-8") as fh:
+            profile = json.load(fh)
+        dtypes = profile.get("dtypes", {})
+        fractions = {}
+        if "f32" in dtypes:
+            fractions["exact"] = lens_mod.attribution_fractions(
+                profile, "f32")
+        # Approx traffic rides the bf16 carriage when it was profiled;
+        # otherwise the f32 attribution is the best available shape.
+        approx_fd = "bf16" if "bf16" in dtypes else "f32"
+        if approx_fd in dtypes:
+            fractions["approx"] = lens_mod.attribution_fractions(
+                profile, approx_fd)
+        cp = xray.subdivide_compute(cp, fractions)
     if not args.json:
         for line in xray.format_report(cp):
             print(line)

@@ -25,7 +25,17 @@ each of which makes the CLI exit nonzero:
 * **kcert regression** — a ``kind="kcert"`` rule-count record (the
   kernel certifier's passing KC-rule tally, graft-kcert) falling
   below the baseline median: certified rules may only be added,
-  never silently lost.
+  never silently lost;
+* **lens miscalibration** — a ``kind="lens"`` ratio record (the
+  compute cost model's measured/predicted ratio, graft-lens) outside
+  the absolute calibration band ``[0.5, 2.0]``, or drifted more than
+  ``LENS_DRIFT_FACTOR×`` from the baseline median ratio: a model that
+  stops predicting within 2× of reality (or quietly walks away from
+  its committed calibration) must not keep pruning tune candidates.
+  Ratios are load-invariant (both sides of the division ran under the
+  same load), so the comparison is on the raw value, never
+  host-load-normalized.  Lens ``ms`` records band like any other
+  timing metric.
 
 Keys absent from the baseline are reported as NEW, never as failures —
 a new structure/metric must not block the ledger that is trying to
@@ -71,6 +81,18 @@ CURVE_FLOOR = 1e-6
 #: base64 wire must show up as a gated byte DROP, and a frame-size
 #: regression fails like a latency regression does.
 _LOWER_IS_BETTER_UNITS = {"ms", "s", "B"}
+
+#: Absolute calibration band for graft-lens measured/predicted ratio
+#: records — mirrors ``obs/lens.py``'s LENS_RATIO_MIN/MAX (ISSUE 18
+#: acceptance band; the two constants are pinned equal by
+#: tests/test_lens.py).
+LENS_RATIO_MIN = 0.5
+LENS_RATIO_MAX = 2.0
+
+#: A fresh lens ratio may drift at most this factor from the baseline
+#: median ratio (in either direction) before the model is declared
+#: miscalibrated relative to its committed calibration.
+LENS_DRIFT_FACTOR = 1.5
 
 
 def baseline_key(rec: Dict[str, Any]) -> str:
@@ -231,6 +253,35 @@ def check_records(records: List[Dict[str, Any]],
                     f"kcert regression: {key}: {float(value):.0f} "
                     f"passing rules < baseline median "
                     f"{entry['median']:.0f}")
+            continue
+        if rec["kind"] == "lens" and rec.get("unit") == "ratio":
+            # Compute-model calibration (graft-lens): the
+            # measured/predicted ratio must sit inside the absolute
+            # band regardless of any baseline, and — once a baseline
+            # exists — must not drift far from its committed median.
+            # Raw value on purpose: a ratio is load-invariant.
+            value = rec.get("value")
+            if value is None:
+                notes.append(f"no numeric value: {key}")
+                continue
+            v = float(value)
+            if not (LENS_RATIO_MIN <= v <= LENS_RATIO_MAX):
+                failures.append(
+                    f"lens miscalibration: {key}: measured/predicted "
+                    f"ratio {v:.3f} outside "
+                    f"[{LENS_RATIO_MIN}, {LENS_RATIO_MAX}]")
+                continue
+            entry = metrics.get(key)
+            if entry is None:
+                notes.append(f"new metric key (no baseline): {key}")
+                continue
+            med = float(entry["median"])
+            if med > 0 and not (med / LENS_DRIFT_FACTOR <= v
+                                <= med * LENS_DRIFT_FACTOR):
+                failures.append(
+                    f"lens miscalibration: {key}: ratio {v:.3f} "
+                    f"drifted > {LENS_DRIFT_FACTOR}x from baseline "
+                    f"median {med:.3f}")
             continue
         if is_degraded(rec):
             notes.append(f"degraded measurement (unbanded): {key}")

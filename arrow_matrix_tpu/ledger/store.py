@@ -53,7 +53,7 @@ SCHEMA_VERSION = 1
 #: The emitter families.  A record's ``kind`` names which subsystem
 #: measured it — the coarse query axis (`graft_ledger report --kind`).
 KINDS = ("bench", "tune", "serve", "pulse", "ladder", "smoke",
-         "error_curve", "probe", "fleet", "kcert", "xray")
+         "error_curve", "probe", "fleet", "kcert", "xray", "lens")
 
 DEFAULT_LEDGER_DIR = os.path.join("bench_results", "ledger")
 LEDGER_BASENAME = "ledger.jsonl"

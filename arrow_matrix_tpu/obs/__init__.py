@@ -36,7 +36,15 @@ the paper's headline claim (communication volume) per run:
     critical-path decomposition (``graft_xray`` CLI);
   * :mod:`~arrow_matrix_tpu.obs.smoke` — a reduced-scale CPU-mesh run
     of all five parallel algorithms producing one inspectable run
-    directory (traces + metrics.jsonl + summary.json).
+    directory (traces + metrics.jsonl + summary.json);
+  * :mod:`~arrow_matrix_tpu.obs.lens` /
+    :mod:`~arrow_matrix_tpu.obs.costmodel` — graft-lens, the compute
+    twin of the comm cost model: per-degree-ladder-level profiling of
+    the folded operator, static stream-byte / padded-slot / wave
+    counters derived from the kcert call metas, and a fitted
+    per-level-family model ``t ≈ α·nnz + β·rows + γ·streamed_bytes``
+    whose measured/predicted ratio is a first-class ledger metric
+    (``graft_lens`` CLI).
 
 CLI: ``python -m arrow_matrix_tpu.obs`` (``graft_trace``) summarizes a
 run directory, diffs two runs with regression flagging, exports merged
@@ -56,6 +64,13 @@ from arrow_matrix_tpu.obs.flight import (
     current_request,
     request_context,
 )
+from arrow_matrix_tpu.obs.costmodel import (
+    CostModel,
+    fit_cost_model,
+    predict_candidate_ms,
+    predict_iter_ms,
+    tier_counters,
+)
 from arrow_matrix_tpu.obs.imbalance import (
     account_imbalance,
     format_imbalance_report,
@@ -67,6 +82,14 @@ from arrow_matrix_tpu.obs.memview import (
     memory_report,
     predicted_bytes_for,
     tree_device_bytes,
+)
+from arrow_matrix_tpu.obs.lens import (
+    attribution_fractions,
+    explain_gap,
+    fit_from_profile,
+    profile_fold,
+    ratio_points,
+    record_profile,
 )
 from arrow_matrix_tpu.obs.metrics import (
     MetricsRegistry,
@@ -82,6 +105,7 @@ from arrow_matrix_tpu.obs.pulse import (
 )
 from arrow_matrix_tpu.obs.tracer import (
     Tracer,
+    call_time_ms,
     chained_iteration_ms,
     iteration_time_ms,
     timed,
@@ -93,10 +117,12 @@ from arrow_matrix_tpu.obs.xray import (
     new_trace_id,
     process_trace,
     recover_from_flight,
+    subdivide_compute,
 )
 
 __all__ = [
     "BurnRule",
+    "CostModel",
     "FlightRecorder",
     "MetricsRegistry",
     "PulseEndpoint",
@@ -104,13 +130,18 @@ __all__ = [
     "SloWatchdog",
     "Tracer",
     "account_collectives",
+    "attribution_fractions",
     "current_request",
     "request_context",
     "account_imbalance",
     "account_memory",
     "auto_repl",
+    "call_time_ms",
     "chained_iteration_ms",
     "critical_path",
+    "explain_gap",
+    "fit_cost_model",
+    "fit_from_profile",
     "format_imbalance_report",
     "format_memory_report",
     "get_registry",
@@ -122,12 +153,19 @@ __all__ = [
     "merge_process_traces",
     "merge_run_dir",
     "new_trace_id",
+    "predict_candidate_ms",
+    "predict_iter_ms",
     "predicted_bytes_for",
     "process_trace",
+    "profile_fold",
+    "ratio_points",
+    "record_profile",
     "recover_from_flight",
     "reduce_bytes_for",
     "set_registry",
     "shard_report_for",
+    "subdivide_compute",
+    "tier_counters",
     "timed",
     "tree_device_bytes",
 ]

@@ -1,0 +1,356 @@
+"""graft-lens: structure-conditioned compute cost model (static half).
+
+The paper's cost model prices *communication*; this module prices the
+compute the repo actually launches, from two static sources that
+already exist:
+
+  * the graft-kcert call metas (``ops/pallas_sell.slab_call_meta`` /
+    ``ops/pallas_blocks.column_call_meta``) — the literal description
+    of each concretized ``pallas_call``, from which the per-call
+    stream-byte / wave-count / grid-work counters here are pure
+    functions (no Pallas execution, no jax import);
+  * the graft-tune structure fingerprint
+    (``tune/fingerprint.structure_fingerprint``) — whose degree ladder
+    (per-tier rows / nnz / slots / slot width) is the k-free structure
+    axis every prediction is conditioned on.
+
+On top of the counters sits a per-level-family linear model
+
+    t_tier ≈ α·nnz + β·rows + γ·streamed_bytes
+
+fitted from one measured ``obs/lens.py`` profile and keyed by the
+fingerprint hash: tiers are grouped into families by kernel and slot
+width (a 3-wide tail tier and a 200-wide head tier price differently),
+coefficients are clamped nonnegative, and the fit is rescaled so the
+predicted total matches the measured total — the model RANKS
+candidates (the tune compute screen's 3× margin) and flags drift (the
+ledger's measured/predicted ratio band); the bench race still decides.
+
+Everything here is host-side numpy — importable from tooling
+processes that never load jax (the same constraint the kcert
+certifier's analysis half lives under).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+#: Mirrors ``ops/pallas_sell.GRANULE`` (rows per packed feature line).
+#: Duplicated so this module stays jax-free; pinned equal by
+#: tests/test_lens.py.
+GRANULE = 8
+
+#: Carriage itemsize per contract dtype key (mirrors
+#: ``ops/kernel_contract.CARRIAGE_ITEMSIZE`` plus the opt-in int8).
+ITEMSIZE = {None: 4, "f32": 4, "bf16": 2, "int8": 1}
+
+#: Slot-width family boundaries: a tier's per-row slot count decides
+#: which coefficient set prices it (JITSPMM's structure-conditioned
+#: per-row-block costs, at tier granularity).
+_FAMILY_BOUNDS = ((0, "zero"), (GRANULE, "tail"), (64, "mid"))
+
+
+def tier_family(slot_width: int) -> str:
+    """Width family of one ladder tier: zero / tail / mid / head."""
+    for bound, name in _FAMILY_BOUNDS:
+        if slot_width <= bound:
+            return name
+    return "head"
+
+
+# ---------------------------------------------------------------------------
+# Static counters over kcert call metas (pure functions of the dict)
+# ---------------------------------------------------------------------------
+
+def meta_grid_programs(meta: Dict[str, Any]) -> int:
+    """Grid programs one concretized call launches: the product of the
+    declared grid axis sizes."""
+    out = 1
+    for _axis, size in meta["grid"]:
+        out *= int(size)
+    return out
+
+
+def _x_itemsize(meta: Dict[str, Any]) -> int:
+    for entry in meta["ins"]:
+        if entry["name"] == "x_packed":
+            return int(entry["itemsize"])
+    return 4
+
+
+def meta_stream_bytes(meta: Dict[str, Any]) -> int:
+    """Feature bytes one call moves for its gather.
+
+    * ``sell_stream`` / ``sell_vectorized`` metas: every slot of every
+      row fetches ONE granule line of ``lanes · itemsize`` bytes, so
+      the volume is ``m_t · slab · lanes · itemsize`` — for the
+      streaming body that is exactly the async-copy DMA traffic; the
+      interpret-only vectorized twin models the same logical gather.
+    * ``dense_blocks`` metas: every grid program loads its declared
+      VMEM input blocks and writes its output block (no gather — the
+      operands ARE the traffic).
+    """
+    kind = meta.get("kind")
+    if kind in ("sell_stream", "sell_vectorized"):
+        m_t, slab = (int(v) for v in meta["ins"][0]["shape"])
+        lanes = int(meta["out"]["shape"][1])
+        return m_t * slab * lanes * _x_itemsize(meta)
+    programs = meta_grid_programs(meta)
+    total = 0
+    for entry in meta["ins"]:
+        block = entry.get("block")
+        if block is None:
+            continue
+        total += int(np.prod(block)) * int(entry["itemsize"]) * programs
+    out = meta["out"]
+    total += int(np.prod(out["block"])) * int(out["itemsize"]) * programs
+    return total
+
+
+def meta_wave_count(meta: Dict[str, Any]) -> int:
+    """DMA waves one streaming call issues: ``m_t`` slots × ``n_waves``
+    per slot per program × grid programs.  Zero for non-streaming
+    bodies (their gather has no wave schedule)."""
+    stream = meta.get("stream")
+    if not stream:
+        return 0
+    return (int(stream["m_t"]) * int(stream["n_waves"])
+            * meta_grid_programs(meta))
+
+
+def meta_dma_copies(meta: Dict[str, Any]) -> int:
+    """Individual async granule-line copies a streaming call issues:
+    one per (slot, row) — ``wave_count · wave`` by construction."""
+    stream = meta.get("stream")
+    if not stream:
+        return 0
+    return int(stream["m_t"]) * int(stream["slab"])
+
+
+def meta_smem_bytes(meta: Dict[str, Any]) -> int:
+    """Scalar-prefetch (SMEM) bytes of one call (0 when the meta
+    declares no SMEM operand)."""
+    smem = meta.get("smem")
+    return int(smem["bytes"]) if smem else 0
+
+
+def meta_padded_rows(meta: Dict[str, Any]) -> int:
+    """Rows the call processes (the slab), including padding up to the
+    row-block multiple — grid programs × rows per program."""
+    kind = meta.get("kind")
+    if kind in ("sell_stream", "sell_vectorized"):
+        return int(meta["ins"][0]["shape"][1])
+    return int(meta["out"]["shape"][0]) * int(meta["out"]["shape"][1])
+
+
+# ---------------------------------------------------------------------------
+# Ladder counters (fingerprint side)
+# ---------------------------------------------------------------------------
+
+def ladder_padded_slots(fp: Dict[str, Any]) -> List[int]:
+    """Per-tier padding (slots − nnz) of the fingerprint's ladder —
+    the realized padded-slot waste the imbalance report also carries."""
+    ladder = fp["ladder"]
+    return [int(s) - int(n)
+            for s, n in zip(ladder["slots"], ladder["nnz"])]
+
+
+def tier_stream_bytes(slot_width: int, rows: int, k: int, *,
+                      itemsize: int = 4, granule: int = 1) -> int:
+    """Modeled gather bytes of one ladder tier at feature width ``k``.
+
+    ``granule > 1`` models the fused pallas kernel (every slot fetches
+    a whole ``granule``-row line, rows padded up to a granule
+    multiple); ``granule == 1`` models the XLA fold kernel's per-slot
+    feature-row gather.
+    """
+    if slot_width <= 0 or rows <= 0:
+        return 0
+    rows_pad = -(-rows // granule) * granule if granule > 1 else rows
+    return slot_width * rows_pad * granule * k * itemsize
+
+
+def tier_counters(fp: Dict[str, Any], k: int, *,
+                  kernel: str = "xla",
+                  feature_dtype: Optional[str] = None
+                  ) -> List[Dict[str, Any]]:
+    """Static per-tier counter set for one (fingerprint, k, kernel,
+    carriage) point — the regressor rows the cost model is fit on and
+    predicts from.  ``kernel`` is "xla" or "pallas"."""
+    itemsize = ITEMSIZE.get(feature_dtype, 4)
+    granule = GRANULE if kernel == "pallas" else 1
+    ladder = fp["ladder"]
+    out = []
+    for t, (rows, nnz, slots, w) in enumerate(zip(
+            ladder["rows"], ladder["nnz"], ladder["slots"],
+            ladder["slot_width"])):
+        out.append({
+            "tier": t,
+            "family": f"{kernel}:{tier_family(int(w))}",
+            "rows": int(rows),
+            "nnz": int(nnz),
+            "slots": int(slots),
+            "slot_width": int(w),
+            "padded_slots": int(slots) - int(nnz),
+            "streamed_bytes": tier_stream_bytes(
+                int(w), int(rows), k, itemsize=itemsize,
+                granule=granule),
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The fitted model
+# ---------------------------------------------------------------------------
+
+COSTMODEL_VERSION = 1
+
+#: Regressor order of one family's coefficient vector.
+_REGRESSORS = ("nnz", "rows", "streamed_bytes")
+
+
+@dataclass
+class CostModel:
+    """Per-level-family linear compute model for ONE structure.
+
+    ``coeffs[family]`` maps each regressor to its ms-per-unit
+    coefficient (α·nnz + β·rows + γ·streamed_bytes, all ≥ 0);
+    ``dma_wait_ms[family]`` is the measured serial-ring DMA wait of
+    one tier of that family (the ring-1 minus deep-ring split a
+    profile's ring sweep produced) — added back for candidates that
+    run ``ring=1``.
+    """
+
+    structure_hash: str
+    platform: str
+    coeffs: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    dma_wait_ms: Dict[str, float] = field(default_factory=dict)
+    version: int = COSTMODEL_VERSION
+
+    def predict_point(self, family: str, nnz: int, rows: int,
+                      streamed_bytes: int) -> float:
+        """Predicted ms of one tier; an unseen family falls back to
+        the same-kernel families' mean coefficients (never raises —
+        the screen must price every candidate it sees)."""
+        c = self.coeffs.get(family)
+        if c is None:
+            prefix = family.split(":", 1)[0] + ":"
+            pool = [v for f, v in self.coeffs.items()
+                    if f.startswith(prefix)] or list(self.coeffs.values())
+            if not pool:
+                return 0.0
+            c = {r: float(np.mean([v.get(r, 0.0) for v in pool]))
+                 for r in _REGRESSORS}
+        ms = (c.get("nnz", 0.0) * nnz + c.get("rows", 0.0) * rows
+              + c.get("streamed_bytes", 0.0) * streamed_bytes)
+        return max(float(ms), 0.0)
+
+    def predict_tiers(self, tiers: List[Dict[str, Any]]) -> float:
+        return sum(self.predict_point(t["family"], t["nnz"], t["rows"],
+                                      t["streamed_bytes"])
+                   for t in tiers)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "kind": "lens_cost_model",
+            "structure_hash": self.structure_hash,
+            "platform": self.platform,
+            "coeffs": {f: dict(c) for f, c in self.coeffs.items()},
+            "dma_wait_ms": dict(self.dma_wait_ms),
+        }
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "CostModel":
+        if doc.get("version") != COSTMODEL_VERSION:
+            raise ValueError(
+                f"cost model version {doc.get('version')} != runtime "
+                f"{COSTMODEL_VERSION}")
+        return cls(structure_hash=str(doc.get("structure_hash") or ""),
+                   platform=str(doc.get("platform") or ""),
+                   coeffs={f: {r: float(v) for r, v in c.items()}
+                           for f, c in (doc.get("coeffs") or {}).items()},
+                   dma_wait_ms={f: float(v) for f, v in
+                                (doc.get("dma_wait_ms") or {}).items()})
+
+
+def fit_cost_model(points: List[Dict[str, Any]], *,
+                   structure_hash: str = "", platform: str = "",
+                   dma_wait_ms: Optional[Dict[str, float]] = None
+                   ) -> CostModel:
+    """Fit per-family coefficients from measured tier points.
+
+    Each point carries ``family``, the :data:`_REGRESSORS`, and
+    ``measured_ms``.  Per family: least squares through the origin,
+    negative coefficients clamped to zero (a negative ms-per-nonzero
+    is noise, not physics), then one global rescale so the predicted
+    family total equals the measured family total — the fit is exact
+    in aggregate and the per-point measured/predicted ratio becomes
+    the calibration metric the ledger bands.
+    """
+    by_family: Dict[str, List[Dict[str, Any]]] = {}
+    for p in points:
+        if float(p.get("measured_ms", 0.0)) <= 0.0:
+            continue
+        by_family.setdefault(str(p["family"]), []).append(p)
+    coeffs: Dict[str, Dict[str, float]] = {}
+    for family, pts in sorted(by_family.items()):
+        a = np.array([[float(p.get(r, 0.0)) for r in _REGRESSORS]
+                      for p in pts], dtype=np.float64)
+        y = np.array([float(p["measured_ms"]) for p in pts],
+                     dtype=np.float64)
+        # Column scaling keeps lstsq honest when bytes are ~1e6x nnz.
+        scale = np.maximum(np.abs(a).max(axis=0), 1e-12)
+        sol, *_ = np.linalg.lstsq(a / scale, y, rcond=None)
+        c = np.maximum(sol / scale, 0.0)
+        pred = float((a @ c).sum())
+        meas = float(y.sum())
+        if pred > 0.0 and meas > 0.0:
+            c = c * (meas / pred)
+        elif meas > 0.0:
+            # Degenerate regressors (all-zero rows): price by nnz so
+            # the family still predicts something positive.
+            nnz_total = max(sum(float(p.get("nnz", 0.0)) for p in pts),
+                            1.0)
+            c = np.zeros(len(_REGRESSORS))
+            c[0] = meas / nnz_total
+        coeffs[family] = {r: float(v) for r, v in zip(_REGRESSORS, c)}
+    return CostModel(structure_hash=structure_hash, platform=platform,
+                     coeffs=coeffs,
+                     dma_wait_ms=dict(dma_wait_ms or {}))
+
+
+def predict_iter_ms(fp: Dict[str, Any], k: int, model: CostModel, *,
+                    kernel: str = "xla",
+                    feature_dtype: Optional[str] = None,
+                    ring: Optional[int] = None) -> float:
+    """Predicted fold-iteration ms for one (structure, k) candidate
+    point: the sum of per-tier family predictions over the static
+    counters, plus the measured per-family DMA wait for a serial-ring
+    (``ring=1``) schedule — ring 1 forfeits exactly the overlap the
+    deep ring buys."""
+    tiers = tier_counters(fp, k, kernel=kernel,
+                          feature_dtype=feature_dtype)
+    total = model.predict_tiers(tiers)
+    if kernel == "pallas" and ring == 1:
+        for t in tiers:
+            if t["slot_width"] > 0:
+                total += float(model.dma_wait_ms.get(t["family"], 0.0))
+    return total
+
+
+def predict_candidate_ms(model: CostModel, fp: Dict[str, Any], k: int,
+                         build: Dict[str, Any],
+                         kernel_opts: Optional[Dict[str, Any]] = None
+                         ) -> float:
+    """Price one graft-tune candidate from its build/kernel_opts dicts
+    (the ``tune/space.py`` compute screen's entry point)."""
+    kernel = ("pallas" if build.get("kernel") == "pallas_sell"
+              else "xla")
+    fd = build.get("feature_dtype")
+    ring = (kernel_opts or {}).get("ring")
+    return predict_iter_ms(fp, k, model, kernel=kernel,
+                           feature_dtype=fd, ring=ring)

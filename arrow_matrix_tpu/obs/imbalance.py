@@ -55,12 +55,19 @@ def summarize_units(rows, nnz, slots, units: str = "shard"
     slots = [int(v) for v in np.asarray(slots, dtype=np.int64).ravel()]
     slots_total = sum(slots)
     nnz_total = sum(nnz)
+    padded = [s - z for s, z in zip(slots, nnz)]
     return {
         "units": units,
         "n_units": len(nnz),
         "rows": rows,
         "nnz": nnz,
         "slots": slots,
+        # Realized per-unit padding — graft-lens prices these slots
+        # (every padded slot still streams a full granule line), so the
+        # shard report names WHICH tier/shard pays the waste.
+        "padded_slots": padded,
+        "padded_slot_waste_per_unit": [
+            (p / s if s else None) for p, s in zip(padded, slots)],
         "rows_total": sum(rows),
         "nnz_total": nnz_total,
         "slots_total": slots_total,

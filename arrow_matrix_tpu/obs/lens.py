@@ -1,0 +1,594 @@
+"""graft-lens: per-level compute profiling for the folded operator.
+
+``tools/profile_tpu.py`` proved the philosophy — break the opaque
+iteration into its constituent device programs — as a loose script.
+This module promotes it into the obs stack as a library: profile one
+structure's fold step per degree-ladder tier, per carriage dtype, pair
+every measurement with the STATIC counters of ``obs/costmodel.py``
+(nnz / rows / streamed bytes straight off the realized SELL tiers),
+optionally split DMA-stream wait from accumulate time via a ring-depth
+sweep (``ring=1`` serializes the copies the deep ring overlaps), and
+fit/score the per-level-family cost model.
+
+The resulting profile document is the contract everything downstream
+consumes: ``fit_from_profile`` → a :class:`~.costmodel.CostModel` for
+the tune compute screen, ``ratio_points`` → the measured/predicted
+calibration records the ledger bands (``kind="lens"``),
+``attribution_fractions`` → graft-xray's per-class compute
+subdivision, ``explain_gap`` → the per-level answer to "where did the
+bf16 regression land".
+
+All timing goes through the shared ``obs/tracer.py`` helpers — one
+honest way to time async-dispatch work (graft-lint R7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from arrow_matrix_tpu.obs.costmodel import (
+    CostModel,
+    GRANULE,
+    ITEMSIZE,
+    fit_cost_model,
+    tier_family,
+    tier_stream_bytes,
+)
+LENS_PROFILE_SCHEMA = 1
+
+#: Acceptance tolerance: per-level attribution must cover the measured
+#: full iteration within this relative gap (ISSUE 18 criterion).
+LENS_COVERAGE_TOL = 0.10
+
+#: Calibration band for measured/predicted ratios — the ledger gate
+#: re-declares the same band on its side (``ledger/gate.py``).
+LENS_RATIO_MIN = 0.5
+LENS_RATIO_MAX = 2.0
+
+#: A level whose marginal (prefix-difference) time is under this
+#: fraction of the full iteration is below the harness's differencing
+#: resolution: it cannot meaningfully move the iteration time and its
+#: measured/predicted ratio is noise, so it is tagged
+#: ``below_resolution`` and excluded from the fit and the calibration
+#: ratios (its ms still counts toward attribution/coverage).
+LENS_RESOLUTION_FRAC = 0.05
+
+
+def _resolve_kernel(kernel: str, k: int, platform: str) -> str:
+    if kernel != "auto":
+        return kernel
+    from arrow_matrix_tpu.ops.pallas_sell import supported_feature_width
+    return "pallas" if (platform == "tpu"
+                        and supported_feature_width(k)) else "xla"
+
+
+def _tier_static(sell, t: int, k: int, *, kernel: str,
+                 feature_dtype: Optional[str]) -> Dict[str, Any]:
+    """Static counter row for one realized SELL tier — same fields
+    :func:`~.costmodel.tier_counters` derives from the fingerprint, but
+    read off the concrete operator the profile actually ran."""
+    cols = sell.cols[t]
+    m_t, n_t = int(cols.shape[0]), int(cols.shape[1])
+    if sell.deg is not None:
+        nnz = int(np.asarray(sell.deg[t]).sum())
+    elif sell.data is not None:
+        nnz = int(np.count_nonzero(np.asarray(sell.data[t])))
+    else:
+        nnz = m_t * n_t
+    itemsize = ITEMSIZE.get(feature_dtype, 4)
+    granule = GRANULE if kernel == "pallas" else 1
+    return {
+        "tier": t,
+        "family": f"{kernel}:{tier_family(m_t)}",
+        "rows": n_t,
+        "nnz": nnz,
+        "slots": m_t * n_t,
+        "slot_width": m_t,
+        "padded_slots": m_t * n_t - nnz,
+        "streamed_bytes": tier_stream_bytes(m_t, n_t, k,
+                                            itemsize=itemsize,
+                                            granule=granule),
+    }
+
+
+def _tier_launches(multi, sell, x, k: int, *, kernel: str,
+                   feature_dtype: Optional[str],
+                   kernel_opts: Dict[str, Any]):
+    """Yield ``(tier, fn, prefix, single)`` per non-empty tier, where
+    ``fn`` is the EXACT production kernel entry point the fold step
+    dispatches (``sell_spmm_t`` / ``sell_spmm_t_pallas``), ``prefix``
+    the sub-SellMatrix holding tiers ``0..tier`` and ``single`` the
+    one-tier sub.  Attribution times the PREFIX programs and takes
+    successive differences: every prefix pays the same fixed
+    per-program cost (chain bump, shared feature decode, loop
+    overhead), so the difference isolates the tier's marginal compute
+    and the tier sum telescopes to the full multi-tier program
+    instead of over-counting the fixed cost once per level."""
+    from arrow_matrix_tpu.ops.sell import SellMatrix
+
+    def sub_upto(j: int) -> SellMatrix:
+        # row_starts holds starts only (tier t ends at the next start,
+        # the last at n_rows), so the prefix through tier j ends at
+        # row_starts[j + 1] when one exists.
+        end = (int(sell.row_starts[j + 1])
+               if j + 1 < len(sell.row_starts) else int(sell.n_rows))
+        return SellMatrix(
+            cols=tuple(sell.cols[:j + 1]),
+            data=(tuple(sell.data[:j + 1])
+                  if sell.data is not None else None),
+            deg=(tuple(sell.deg[:j + 1])
+                 if sell.deg is not None else None),
+            n_rows=end,
+            row_starts=tuple(int(r) for r in sell.row_starts[:j + 1]))
+
+    for t, cols in enumerate(sell.cols):
+        m_t, n_t = int(cols.shape[0]), int(cols.shape[1])
+        if m_t == 0:
+            continue
+        single = SellMatrix(
+            cols=(cols,),
+            data=(sell.data[t],) if sell.data is not None else None,
+            deg=(sell.deg[t],) if sell.deg is not None else None,
+            n_rows=n_t, row_starts=(0,))
+        if kernel == "pallas":
+            from arrow_matrix_tpu.ops.pallas_sell import (
+                sell_spmm_t_pallas,
+            )
+            opts = {kk: v for kk, v in kernel_opts.items()
+                    if kk != "feature_dtype"}
+            fn = jax_jit(functools.partial(
+                sell_spmm_t_pallas, feature_dtype=feature_dtype,
+                **opts))
+        else:
+            from arrow_matrix_tpu.ops.sell import sell_spmm_t
+            from arrow_matrix_tpu.parallel.multi_level import (
+                gather_budget_for,
+            )
+            gb = gather_budget_for(multi.dense_budget)
+            fn = jax_jit(functools.partial(sell_spmm_t,
+                                           gather_budget=gb))
+        yield t, fn, sub_upto(t), single
+
+
+def jax_jit(fn):
+    import jax
+    return jax.jit(fn)
+
+
+def _chain_sampler(raw_fn, x, iters: int):
+    """Compile-and-warm one chained measurement of ``raw_fn(x)`` —
+    ``iters`` iterations inside ONE ``lax.scan`` program
+    (``tracer.chained_sampler`` underneath, so the dispatch+fetch
+    round-trip is subtracted) — and return its zero-arg sampler.
+
+    A same-shape program (the full fold step) feeds its output back
+    as the next carry; a shape-changing one (a tier-prefix launch)
+    threads a runtime-valued, numerically negligible bump of its
+    output back into the carry instead — either way every iteration
+    depends on the previous one, so the compiler can neither hoist
+    the call out of the scan nor dead-code it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from arrow_matrix_tpu.obs.tracer import chained_sampler
+
+    def body(carry, _):
+        out = raw_fn(carry)
+        if out.shape == carry.shape and out.dtype == carry.dtype:
+            return out, None
+        bump = (out.astype(jnp.float32).sum()
+                * jnp.float32(1e-30)).astype(carry.dtype)
+        return carry + bump, None
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def run(x0, n):
+        return jax.lax.scan(body, x0, None, length=n)[0]
+
+    return chained_sampler(lambda x0, n: run(x0, n=n), x, iters)
+
+
+def _sweep_min(samplers: Dict[str, Any], repeats: int = 5
+               ) -> Dict[str, float]:
+    """Minimum ms per program over ``repeats`` interleaved sampling
+    sweeps.  At the µs/iteration scale of a single tier prefix, host
+    load drift is the dominant error; sweeping every program once per
+    round puts the drift on whole rounds, and the per-program minimum
+    — the classic noise-robust timing estimator — discards it."""
+    best: Dict[str, float] = {}
+    for _ in range(max(repeats, 1)):
+        for name, sample in samplers.items():
+            ms = sample()
+            if name not in best or ms < best[name]:
+                best[name] = ms
+    return best
+
+
+def profile_fold(levels, width: int, k: int, *,
+                 kernel: str = "auto",
+                 feature_dtypes: Sequence[str] = ("f32",),
+                 iters: int = 20,
+                 ring_sweep: bool = False,
+                 kernel_opts: Optional[Dict[str, Any]] = None,
+                 growth: float = 1.2,
+                 fold_align: Optional[int] = None,
+                 registry=None) -> Dict[str, Any]:
+    """Profile one structure's folded step per tier and carriage dtype.
+
+    Builds the fold executor once per dtype, times the full jitted
+    step, then attributes each tier as the DIFFERENCE between the
+    production kernel run on tiers ``0..t`` and on tiers ``0..t-1``
+    (the fold step is a linear sum of per-tier programs, so the
+    telescoped per-level times should cover the full step —
+    ``coverage`` records how well they do; differencing cancels the
+    fixed per-program cost that a naive one-launch-per-tier
+    measurement over-counts once per level).  With ``ring_sweep`` and the pallas kernel, each tier is
+    re-timed at ``ring=1``: the excess over the deep-ring time is the
+    DMA wait the ring was hiding, stored per level family.
+
+    Every number is a CHAINED on-device measurement (``iters``
+    iterations inside one ``lax.scan`` program, dispatch round-trip
+    subtracted — the ``obs.tracer.chained_iteration_ms`` discipline):
+    the full step is ONE dispatch while per-tier attribution would pay
+    one dispatch per level, so per-call walls would double-count
+    launch overhead once per tier — fatal at small-structure scale
+    where dispatch rivals compute.  Chaining amortizes it on both
+    sides instead of modeling it.
+
+    Returns the lens profile document (schema 1) that every other
+    graft-lens entry point consumes.
+    """
+    import jax
+
+    from arrow_matrix_tpu.parallel.multi_level import MultiLevelArrow
+    from arrow_matrix_tpu.tune.fingerprint import (
+        fingerprint_hash,
+        structure_fingerprint,
+    )
+    from arrow_matrix_tpu.utils.graphs import random_dense
+
+    platform = jax.default_backend()
+    kernel = _resolve_kernel(kernel, k, platform)
+    kopts = dict(kernel_opts or {})
+    fp = structure_fingerprint(levels, width, np.float32,
+                               growth=growth, slot_align=fold_align)
+    doc: Dict[str, Any] = {
+        "schema": LENS_PROFILE_SCHEMA,
+        "kind": "lens_profile",
+        "structure_hash": fingerprint_hash(fp),
+        "platform": platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        "width": int(width),
+        "k": int(k),
+        "kernel": kernel,
+        "iters": int(iters),
+        "kernel_opts": kopts,
+        "dtypes": {},
+    }
+    for fd in feature_dtypes:
+        feature_dtype = None if fd == "f32" else fd
+        multi = MultiLevelArrow(
+            levels, width, mesh=None, fmt="fold",
+            kernel="pallas_sell" if kernel == "pallas" else "xla",
+            kernel_opts=kopts or None, feature_dtype=feature_dtype,
+            fold_growth=growth, fold_align=fold_align)
+        doc["n"] = int(multi.n)
+        sell = multi.blocks[0]
+        x = multi.set_features(random_dense(multi.n, k, seed=3))
+
+        # Compile/warm every chain program first, then sample them in
+        # interleaved sweeps (_sweep_min) so host load drift cannot
+        # bias one program against another.  "floor" is the
+        # shape-changing chain's own per-iteration cost (scan step +
+        # carry bump, no kernel) — the base of the prefix telescoping,
+        # so the chain's own cost never lands on a level.
+        samplers = {
+            "full": _chain_sampler(lambda c: multi._step(
+                c, multi.fwd, multi.bwd, multi.blocks), x, iters),
+            "floor": _chain_sampler(lambda c: c[:1, :1], x, iters),
+        }
+        launches = list(_tier_launches(
+            multi, sell, x, k, kernel=kernel,
+            feature_dtype=feature_dtype, kernel_opts=kopts))
+        for t, fn, prefix, single in launches:
+            samplers[f"prefix{t}"] = _chain_sampler(
+                functools.partial(fn, prefix), x, iters)
+            if ring_sweep and kernel == "pallas":
+                from arrow_matrix_tpu.ops.pallas_sell import (
+                    sell_spmm_t_pallas,
+                )
+                samplers[f"deep{t}"] = _chain_sampler(
+                    functools.partial(fn, single), x, iters)
+                opts1 = {kk: v for kk, v in kopts.items()
+                         if kk not in ("feature_dtype", "ring")}
+                samplers[f"ring1_{t}"] = _chain_sampler(
+                    functools.partial(
+                        sell_spmm_t_pallas, single, ring=1,
+                        feature_dtype=feature_dtype, **opts1),
+                    x, iters)
+        best = _sweep_min(samplers)
+        full_ms = best["full"]
+        floor_ms = max(best["floor"], 0.0)
+        if registry is not None:
+            registry.record("call_time_ms", full_ms,
+                            call=f"lens_full_{fd}", dtype=fd)
+        tiers: List[Dict[str, Any]] = []
+        for t, cols in enumerate(sell.cols):
+            tiers.append(_tier_static(sell, t, k, kernel=kernel,
+                                      feature_dtype=feature_dtype))
+        dma_wait: Dict[str, List[float]] = {}
+        prev_ms = floor_ms
+        for t, fn, prefix, single in launches:
+            cur = best[f"prefix{t}"]
+            ms = max(cur - prev_ms, 0.0)
+            prev_ms = max(cur, prev_ms)
+            tiers[t]["measured_ms"] = float(ms)
+            if registry is not None:
+                registry.record("call_time_ms", ms,
+                                call=f"lens_tier{t}_{fd}", dtype=fd)
+            if ring_sweep and kernel == "pallas":
+                ms1 = best[f"ring1_{t}"]
+                tiers[t]["ring1_ms"] = float(ms1)
+                wait = max(float(ms1) - float(best[f"deep{t}"]), 0.0)
+                tiers[t]["dma_wait_ms"] = wait
+                dma_wait.setdefault(tiers[t]["family"], []).append(wait)
+        attributed = sum(t.get("measured_ms", 0.0) for t in tiers)
+        resolution_ms = max(float(floor_ms),
+                            LENS_RESOLUTION_FRAC * float(full_ms))
+        for tr in tiers:
+            if (tr.get("measured_ms") is not None
+                    and tr["measured_ms"] < resolution_ms):
+                tr["below_resolution"] = True
+        entry = {
+            "full_ms": float(full_ms),
+            "chain_floor_ms": float(floor_ms),
+            "resolution_ms": float(resolution_ms),
+            "attributed_ms": float(attributed),
+            "coverage": float(attributed / full_ms) if full_ms else 0.0,
+            "tiers": tiers,
+            "dma_wait_ms": {f: float(np.mean(v))
+                            for f, v in sorted(dma_wait.items())},
+        }
+        doc["dtypes"][fd] = entry
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Model fit / score over a profile
+# ---------------------------------------------------------------------------
+
+def fit_from_profile(profile: Dict[str, Any],
+                     dtypes: Optional[Sequence[str]] = None
+                     ) -> CostModel:
+    """Fit the per-level-family model from one profile's measured
+    tiers.  By default ALL carriage dtypes feed one joint fit — the
+    f32/bf16 pair varies ``streamed_bytes`` at fixed nnz/rows, which
+    is exactly the leverage that separates the byte coefficient from
+    the accumulate coefficients."""
+    points: List[Dict[str, Any]] = []
+    waits: Dict[str, List[float]] = {}
+    for fd, entry in profile["dtypes"].items():
+        if dtypes is not None and fd not in dtypes:
+            continue
+        for t in entry["tiers"]:
+            if t.get("measured_ms") and not t.get("below_resolution"):
+                points.append(t)
+        for fam, w in entry.get("dma_wait_ms", {}).items():
+            waits.setdefault(fam, []).append(float(w))
+    return fit_cost_model(
+        points,
+        structure_hash=str(profile.get("structure_hash", "")),
+        platform=str(profile.get("platform", "")),
+        dma_wait_ms={f: float(np.mean(v)) for f, v in waits.items()})
+
+
+def ratio_points(profile: Dict[str, Any], model: CostModel
+                 ) -> List[Dict[str, Any]]:
+    """Measured/predicted ratio per measured tier point (plus one
+    full-iteration point per dtype) — the first-class calibration
+    metric the ledger records and the gate bands."""
+    out: List[Dict[str, Any]] = []
+    for fd, entry in profile["dtypes"].items():
+        total_pred = 0.0
+        for t in entry["tiers"]:
+            measured = float(t.get("measured_ms") or 0.0)
+            if measured <= 0.0 or t.get("below_resolution"):
+                continue
+            pred = model.predict_point(t["family"], t["nnz"],
+                                       t["rows"], t["streamed_bytes"])
+            total_pred += pred
+            out.append({
+                "dtype": fd, "tier": t["tier"], "family": t["family"],
+                "measured_ms": measured, "predicted_ms": pred,
+                "ratio": measured / pred if pred > 0 else float("inf"),
+            })
+        full = float(entry["full_ms"])
+        if total_pred > 0 and full > 0:
+            out.append({
+                "dtype": fd, "tier": None, "family": "full",
+                "measured_ms": full, "predicted_ms": total_pred,
+                "ratio": full / total_pred,
+            })
+    return out
+
+
+def attribution_fractions(profile: Dict[str, Any], dtype: str
+                          ) -> Dict[str, float]:
+    """Per-level fractions of the measured full iteration for one
+    carriage dtype, normalized to sum to 1 (the remainder the tier
+    sum does not cover lands in ``other``) — graft-xray's compute
+    segment subdivides by these."""
+    entry = profile["dtypes"][dtype]
+    full = float(entry["full_ms"])
+    if full <= 0.0:
+        return {}
+    out: Dict[str, float] = {}
+    for t in entry["tiers"]:
+        ms = float(t.get("measured_ms") or 0.0)
+        if ms > 0.0:
+            out[f"L{t['tier']}:{t['family'].split(':')[1]}"] = ms / full
+    covered = sum(out.values())
+    if covered > 1.0:  # timing noise: renormalize over the tier sum
+        out = {lbl: v / covered for lbl, v in out.items()}
+    else:
+        out["other"] = 1.0 - covered
+    return out
+
+
+def explain_gap(profile: Dict[str, Any], *, base: str = "f32",
+                other: str = "bf16",
+                model: Optional[CostModel] = None) -> Dict[str, Any]:
+    """Attribute the ``other``−``base`` full-iteration gap per level.
+
+    Names the dominant per-level delta, and — when a model is given —
+    classifies it into a segment: the gather/stream term (γ·Δbytes:
+    the byte volume CHANGES between carriages) versus the
+    decode/accumulate residual (the cast + unpack work the byte model
+    cannot see), versus DMA wait (the ring-sweep split).
+    """
+    eb = profile["dtypes"][base]
+    eo = profile["dtypes"][other]
+    gap = float(eo["full_ms"]) - float(eb["full_ms"])
+    deltas: Dict[str, float] = {}
+    gather_delta: Dict[str, float] = {}
+    for tb, to in zip(eb["tiers"], eo["tiers"]):
+        label = f"L{tb['tier']}:{tb['family'].split(':')[1]}"
+        d = (float(to.get("measured_ms") or 0.0)
+             - float(tb.get("measured_ms") or 0.0))
+        if to.get("measured_ms") or tb.get("measured_ms"):
+            deltas[label] = d
+        if model is not None:
+            gamma = model.coeffs.get(to["family"], {}).get(
+                "streamed_bytes", 0.0)
+            gather_delta[label] = gamma * (
+                float(to["streamed_bytes"]) - float(tb["streamed_bytes"]))
+    wait_b = sum(eb.get("dma_wait_ms", {}).values())
+    wait_o = sum(eo.get("dma_wait_ms", {}).values())
+    if wait_b or wait_o:
+        deltas["dma_wait"] = wait_o - wait_b
+    if not deltas:
+        return {"gap_ms": gap, "per_level": {}, "dominant": None,
+                "dominant_segment": None, "note": "no measured tiers"}
+    dominant = max(deltas, key=lambda lbl: abs(deltas[lbl]))
+    if dominant == "dma_wait":
+        segment = "dma-wait"
+        note = (f"{other} vs {base}: dominant delta is DMA wait "
+                f"({deltas[dominant]:+.3f} ms)")
+    else:
+        segment = "decode/accumulate"
+        g = gather_delta.get(dominant)
+        if g is not None and abs(g) >= 0.5 * abs(deltas[dominant]) > 0:
+            segment = "gather-bytes"
+        note = (f"{other} vs {base}: dominant delta at {dominant} "
+                f"({deltas[dominant]:+.3f} ms of {gap:+.3f} ms gap), "
+                f"segment: {segment}")
+    return {"gap_ms": gap, "per_level": deltas,
+            "gather_delta_ms": gather_delta or None,
+            "dominant": dominant, "dominant_segment": segment,
+            "note": note}
+
+
+def predict_profile_iter_ms(profile: Dict[str, Any], model: CostModel,
+                            dtype: str = "f32") -> float:
+    """Model-predicted full-iteration ms for one profile point — the
+    sum over its static tier counters (convenience for check/doctor)."""
+    entry = profile["dtypes"][dtype]
+    return model.predict_tiers(
+        [t for t in entry["tiers"] if t["slot_width"] > 0])
+
+
+# ---------------------------------------------------------------------------
+# Ledger emission
+# ---------------------------------------------------------------------------
+
+def record_profile(profile: Dict[str, Any],
+                   model: Optional[CostModel] = None,
+                   directory: Optional[str] = None) -> List[str]:
+    """Sink one profile (and, with a model, its calibration ratios) as
+    ``kind="lens"`` ledger records.
+
+    Millisecond metrics record with the default host-load stamp like
+    every other timing emitter; ratio metrics record with
+    ``host_load=None`` — a measured/predicted ratio is load-invariant
+    (both sides ran under the same load), and normalizing it would
+    skew the baseline median the drift band is taken over.
+    """
+    from arrow_matrix_tpu.ledger import store as ledger_store
+
+    sh = str(profile.get("structure_hash", ""))
+    kern = profile.get("kernel", "?")
+    k = int(profile.get("k", 0))
+    ids: List[str] = []
+
+    def _rec(metric, value, unit, **extra):
+        rid = ledger_store.record(
+            "lens", metric, round(float(value), 6),
+            directory=directory, unit=unit, structure_hash=sh,
+            knobs={"kernel": kern, "k": k,
+                   "width": int(profile.get("width", 0)), **extra},
+            **({"host_load": None} if unit == "ratio" else {}))
+        if rid:
+            ids.append(rid)
+
+    for fd, entry in profile["dtypes"].items():
+        _rec(f"lens_full_ms_{kern}_{fd}_k{k}", entry["full_ms"], "ms",
+             feature_dtype=fd)
+        for t in entry["tiers"]:
+            if t.get("measured_ms"):
+                _rec(f"lens_tier{t['tier']}_ms_{kern}_{fd}_k{k}",
+                     t["measured_ms"], "ms", feature_dtype=fd,
+                     tier=t["tier"], family=t["family"])
+        _rec(f"lens_coverage_{kern}_{fd}_k{k}", entry["coverage"],
+             "ratio", feature_dtype=fd)
+    if model is not None:
+        for p in ratio_points(profile, model):
+            tier = "full" if p["tier"] is None else f"t{p['tier']}"
+            _rec(f"lens_ratio_{kern}_{p['dtype']}_k{k}_{tier}",
+                 p["ratio"], "ratio", feature_dtype=p["dtype"],
+                 family=p["family"])
+    return ids
+
+
+def check_profile(profile: Dict[str, Any],
+                  model: Optional[CostModel] = None,
+                  coverage_tol: float = LENS_COVERAGE_TOL
+                  ) -> List[str]:
+    """Problem strings for one profile (+model): schema drift,
+    attribution that fails to cover the measured iteration, ratios
+    outside the calibration band.  Empty list == healthy."""
+    problems: List[str] = []
+    if profile.get("schema") != LENS_PROFILE_SCHEMA:
+        problems.append(
+            f"lens profile schema {profile.get('schema')} != "
+            f"{LENS_PROFILE_SCHEMA}")
+        return problems
+    if not profile.get("dtypes"):
+        problems.append("lens profile has no dtype entries")
+    for fd, entry in profile.get("dtypes", {}).items():
+        full = float(entry.get("full_ms") or 0.0)
+        if not np.isfinite(full) or full <= 0.0:
+            problems.append(f"{fd}: non-positive full_ms {full}")
+            continue
+        cov = float(entry.get("coverage") or 0.0)
+        if abs(cov - 1.0) > coverage_tol:
+            problems.append(
+                f"{fd}: per-level attribution covers {cov:.3f} of the "
+                f"measured iteration (|1-cov| > {coverage_tol})")
+        measured = [t for t in entry.get("tiers", ())
+                    if t.get("measured_ms")]
+        if not measured:
+            problems.append(f"{fd}: no measured tiers")
+    if model is not None:
+        for p in ratio_points(profile, model):
+            r = p["ratio"]
+            if not (LENS_RATIO_MIN <= r <= LENS_RATIO_MAX):
+                where = ("full" if p["tier"] is None
+                         else f"tier {p['tier']}")
+                problems.append(
+                    f"{p['dtype']} {where}: measured/predicted ratio "
+                    f"{r:.3f} outside [{LENS_RATIO_MIN}, "
+                    f"{LENS_RATIO_MAX}]")
+    return problems

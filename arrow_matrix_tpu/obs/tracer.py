@@ -167,6 +167,29 @@ def timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def call_time_ms(fn, *args, iters: int = 5, warmup: int = 1,
+                 registry=None, name: str = "call", **labels) -> float:
+    """Mean ms per call of ``fn(*args)`` with fixed arguments —
+    ``tools/profile_tpu.py``'s former private ``timeit``, promoted to
+    the shared harness so every profiler times one way.
+
+    Unlike :func:`iteration_time_ms` the output is NOT fed back (the
+    per-level launches a profile times take operands of differing
+    shapes); every call is individually blocked until ready, so a
+    slow first wave cannot hide behind async dispatch.  Records each
+    sample into ``registry`` as ``call_time_ms`` when one is given.
+    """
+    for _ in range(max(warmup, 0)):
+        block_until_ready(fn(*args))
+    samples: List[float] = []
+    for _ in range(max(iters, 1)):
+        ms = timed(lambda: fn(*args)) * 1e3
+        samples.append(ms)
+        if registry is not None:
+            registry.record("call_time_ms", ms, call=name, **labels)
+    return sum(samples) / len(samples)
+
+
 def iteration_time_ms(step_fn, x, iters: int, warmup: int = 1,
                       registry=None, name: str = "step",
                       **labels) -> List[float]:
@@ -189,11 +212,15 @@ def iteration_time_ms(step_fn, x, iters: int, warmup: int = 1,
     return out
 
 
-def chained_iteration_ms(run_fn, x, iters: int) -> float:
-    """ms/iter via chained on-device iteration (`lax.scan`) ending in a
-    scalar host fetch, with the dispatch+fetch round-trip subtracted —
-    block_until_ready alone can return early over remote/tunneled
-    devices, a host fetch cannot."""
+def chained_sampler(run_fn, x, iters: int):
+    """Compile-and-warm a chained measurement, return a zero-arg
+    callable producing one ms/iter sample per call.
+
+    Splitting compile/warmup from sampling lets a caller timing MANY
+    programs (graft-lens's per-level prefixes) interleave sampling
+    sweeps across all of them and take per-program minima: slow host
+    load drift then lands on whole sweeps instead of whole programs,
+    and the minimum discards it."""
     def chain(n: int) -> float:
         t0 = time.perf_counter()
         xd = run_fn(x, n) if n else x
@@ -202,4 +229,16 @@ def chained_iteration_ms(run_fn, x, iters: int) -> float:
 
     chain(iters)  # compile + warmup at the benchmark length
     rtt = min(chain(0) for _ in range(3))
-    return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
+
+    def sample() -> float:
+        return max((chain(iters) - rtt) / iters, 1e-9) * 1e3
+
+    return sample
+
+
+def chained_iteration_ms(run_fn, x, iters: int) -> float:
+    """ms/iter via chained on-device iteration (`lax.scan`) ending in a
+    scalar host fetch, with the dispatch+fetch round-trip subtracted —
+    block_until_ready alone can return early over remote/tunneled
+    devices, a host fetch cannot."""
+    return chained_sampler(run_fn, x, iters)()

@@ -374,8 +374,36 @@ def critical_path(trace_doc: Dict[str, Any],
             "requests": requests, "per_class": per_class}
 
 
+def subdivide_compute(cp: Dict[str, Any],
+                      fractions: Dict[str, Dict[str, float]]
+                      ) -> Dict[str, Any]:
+    """Split each class's mean ``compute`` segment by graft-lens
+    per-level attribution fractions.
+
+    ``fractions`` maps traffic class → {level label → fraction of the
+    compute segment} (``obs.lens.attribution_fractions`` output; the
+    labels are ``"L<tier>:<family>"`` plus ``"other"``).  Returns a
+    copy of the critical-path doc with ``compute_breakdown_ms`` added
+    to each matched class aggregate — the xray ``compute`` span stops
+    being opaque without re-deriving anything from the trace.
+    """
+    out = dict(cp, per_class={cls: dict(agg) for cls, agg in
+                              cp.get("per_class", {}).items()})
+    for cls, agg in out["per_class"].items():
+        frac = fractions.get(cls)
+        if not frac:
+            continue
+        compute = float(agg.get("segments_mean_ms", {})
+                        .get("compute", 0.0))
+        agg["compute_breakdown_ms"] = {
+            label: round(compute * float(f), 6)
+            for label, f in frac.items()}
+    return out
+
+
 def format_report(cp: Dict[str, Any]) -> List[str]:
-    """Human-readable per-class segment table for the CLI."""
+    """Human-readable per-class segment table for the CLI (plus the
+    per-level compute breakdown when :func:`subdivide_compute` ran)."""
     lines: List[str] = []
     names = list(cp.get("segments", SEGMENTS))
     header = (f"{'class':<8} {'n':>4} {'mean_ms':>9} "
@@ -388,6 +416,11 @@ def format_report(cp: Dict[str, Any]) -> List[str]:
         lines.append(
             f"{cls:<8} {agg['count']:>4} {agg.get('mean_ms', 0.0):>9.2f} "
             + " ".join(f"{segs.get(n, 0.0):>9.2f}" for n in names))
+        breakdown = agg.get("compute_breakdown_ms")
+        if breakdown:
+            for label, ms in breakdown.items():
+                lines.append(f"{'':<8}   compute/{label:<12} "
+                             f"{float(ms):>9.3f}")
     return lines
 
 

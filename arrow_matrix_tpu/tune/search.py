@@ -272,6 +272,7 @@ def search(source: dict, k: int, *, iters: int = 3,
            ledger_dir: Optional[str] = None,
            traffic_class: str = "exact",
            extra: Optional[List[Candidate]] = None,
+           lens_model=None,
            quiet: bool = False) -> Tuple[Optional[TunePlan], dict]:
     """Search (or cache-hit) the tuned plan for one (structure, k).
 
@@ -293,6 +294,11 @@ def search(source: dict, k: int, *, iters: int = 3,
     programs) to ``enumerate_candidates``; pallas extras must pass
     graft-kcert certification there or they are pruned with zero
     children spawned.
+
+    ``lens_model`` (an ``obs.costmodel.CostModel``, or a path to its
+    JSON artifact) arms the graft-lens compute screen in
+    ``enumerate_candidates``: compute-hopeless candidates are pruned
+    with ``"lens: …"`` reasons before their child spawns.
     """
     from arrow_matrix_tpu.classes import tolerance_for
     from arrow_matrix_tpu.utils.platform import host_load
@@ -334,9 +340,16 @@ def search(source: dict, k: int, *, iters: int = 3,
         pass
     evaluator = "cpu-interpret" if platform == "cpu" else platform
 
+    if isinstance(lens_model, (str, os.PathLike)):
+        import json as _json
+
+        from arrow_matrix_tpu.obs.costmodel import CostModel
+        with open(lens_model, "r", encoding="utf-8") as fh:
+            lens_model = CostModel.from_dict(_json.load(fh))
     cands, pruned = enumerate_candidates(
         fp, k, platform=platform, allow_int8=allow_int8,
-        restrict=restrict, traffic_class=traffic_class, extra=extra)
+        restrict=restrict, traffic_class=traffic_class, extra=extra,
+        lens_model=lens_model)
     for name, why in pruned.items():
         _say(f"pruned {name}: {why}")
 

@@ -85,7 +85,8 @@ def enumerate_candidates(fp: dict, k: int, *,
                          budget_bytes: Optional[int] = None,
                          restrict: Optional[List[str]] = None,
                          traffic_class: str = "exact",
-                         extra: Optional[List[Candidate]] = None
+                         extra: Optional[List[Candidate]] = None,
+                         lens_model=None
                          ) -> Tuple[List[Candidate], Dict[str, str]]:
     """The candidate list for one (fingerprint, k), already pruned.
 
@@ -104,6 +105,15 @@ def enumerate_candidates(fp: dict, k: int, *,
     program hook): they ride the same screens, including graft-kcert
     certification for pallas kernels — an uncertifiable candidate is
     pruned here, before any child spawns.
+
+    ``lens_model`` (a fitted ``obs.costmodel.CostModel`` for THIS
+    structure) arms the compute-side screen — the comm-only T(c)
+    screen's twin: a candidate whose lens-predicted iteration time
+    exceeds 3x the default candidate's prediction is pruned before
+    any child spawns, with a ``"lens: …"`` reason.  The margin is
+    deliberately conservative (the model ranks, the bench decides)
+    and the screen never touches eligibility — f32 bit-identity and
+    winner rules are unchanged.
     """
     from arrow_matrix_tpu.classes import TRAFFIC_CLASSES
 
@@ -190,6 +200,11 @@ def enumerate_candidates(fp: dict, k: int, *,
     # link rate — only used as the 3x cost-model screen's yardstick.
     default_ms = repl_predict_ms(1, 0, compute_ms=0.0)
 
+    lens_base = 0.0
+    if lens_model is not None:
+        from arrow_matrix_tpu.obs.costmodel import predict_candidate_ms
+        lens_base = predict_candidate_ms(lens_model, fp, k, {}, {})
+
     out, pruned = [], {}
     for c in raw:
         if restrict is not None and c.name not in restrict:
@@ -239,6 +254,15 @@ def enumerate_candidates(fp: dict, k: int, *,
                 feature_dtype=c.build.get("feature_dtype"))
             if reason is not None:
                 pruned[c.name] = reason
+                continue
+        if lens_model is not None and lens_base > 0.0 \
+                and c.name != "default":
+            predicted = predict_candidate_ms(lens_model, fp, k,
+                                             c.build, c.kernel_opts)
+            if predicted > 3.0 * lens_base:
+                pruned[c.name] = (
+                    f"lens: predicted compute {predicted:.3f} ms > "
+                    f"3x default {lens_base:.3f} ms")
                 continue
         out.append(c)
     return out, pruned

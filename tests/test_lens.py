@@ -1,0 +1,443 @@
+"""graft-lens tests (arrow_matrix_tpu/obs/lens.py + obs/costmodel.py):
+static counter invariants over the kcert metas and the fingerprint
+ladder, cost-model fit/round-trip/versioning, ratio + coverage
+bookkeeping (below-resolution exclusion), the ledger gate's lens
+calibration band, the tune-space compute screen, the xray compute
+subdivision, and the tools/lens_gate.py fixture discipline."""
+
+import copy
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu.decomposition import arrow_decomposition
+from arrow_matrix_tpu.obs import lens
+from arrow_matrix_tpu.obs.costmodel import (
+    GRANULE,
+    CostModel,
+    fit_cost_model,
+    ladder_padded_slots,
+    meta_dma_copies,
+    meta_grid_programs,
+    meta_padded_rows,
+    meta_smem_bytes,
+    meta_stream_bytes,
+    meta_wave_count,
+    predict_candidate_ms,
+    tier_counters,
+    tier_family,
+    tier_stream_bytes,
+)
+from arrow_matrix_tpu.tune import (
+    enumerate_candidates,
+    structure_fingerprint,
+)
+from arrow_matrix_tpu.utils import barabasi_albert
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _levels(n=120, width=16, seed=3, m=3, max_levels=4):
+    a = barabasi_albert(n, m, seed=seed)
+    return arrow_decomposition(a, width, max_levels=max_levels,
+                               block_diagonal=True, seed=seed)
+
+
+def _profile(tiers, *, full_ms=0.1, attributed=None, coverage=None,
+             dtype="f32", kernel="xla"):
+    """Minimal schema-valid profile around one tier list."""
+    att = (sum(t.get("measured_ms") or 0.0 for t in tiers)
+           if attributed is None else attributed)
+    return {
+        "schema": lens.LENS_PROFILE_SCHEMA, "kind": "lens_profile",
+        "structure_hash": "testhash", "platform": "cpu",
+        "device_kind": "cpu", "width": 16, "k": 8, "kernel": kernel,
+        "iters": 10, "kernel_opts": {}, "n": 100,
+        "dtypes": {dtype: {
+            "full_ms": full_ms, "chain_floor_ms": 0.001,
+            "resolution_ms": 0.005, "attributed_ms": att,
+            "coverage": (att / full_ms if coverage is None
+                         else coverage),
+            "tiers": tiers, "dma_wait_ms": {}}},
+    }
+
+
+def _tier(t, family, *, rows=100, nnz=500, slots=800, width=8,
+          ms=0.05, **extra):
+    return {"tier": t, "family": family, "rows": rows, "nnz": nnz,
+            "slots": slots, "slot_width": width,
+            "padded_slots": slots - nnz, "streamed_bytes": 4096,
+            "measured_ms": ms, **extra}
+
+
+# ---------------------------------------------------------------------------
+# Static counters (satellite: pure functions over kcert metas)
+# ---------------------------------------------------------------------------
+
+def test_granule_pinned_to_kernel():
+    # The cost model prices the fused kernel's granule-line streaming;
+    # a GRANULE drift would silently misprice every pallas tier.
+    from arrow_matrix_tpu.ops import pallas_sell
+    assert GRANULE == pallas_sell.GRANULE
+
+
+def test_tier_family_bounds():
+    assert tier_family(0) == "zero"
+    assert tier_family(1) == "tail"
+    assert tier_family(GRANULE) == "tail"
+    assert tier_family(GRANULE + 1) == "mid"
+    assert tier_family(64) == "mid"
+    assert tier_family(65) == "head"
+
+
+def test_counters_over_sell_kcert_metas():
+    from arrow_matrix_tpu.ops import pallas_sell
+    metas = pallas_sell.kcert_metas()
+    assert isinstance(metas, list) and metas
+    for meta in metas:
+        assert meta_grid_programs(meta) >= 1
+        bytes_ = meta_stream_bytes(meta)
+        assert bytes_ > 0
+        if meta.get("kind") in ("sell_stream", "sell_vectorized"):
+            # Every slot of every slab row fetches one granule line.
+            m_t, slab = (int(v) for v in meta["ins"][0]["shape"])
+            assert bytes_ % (m_t * slab) == 0
+            assert meta_padded_rows(meta) == slab
+        if meta.get("stream"):
+            assert meta_wave_count(meta) >= int(meta["stream"]["m_t"])
+            assert meta_dma_copies(meta) == (
+                int(meta["stream"]["m_t"]) * int(meta["stream"]["slab"]))
+        else:
+            assert meta_wave_count(meta) == 0
+        assert meta_smem_bytes(meta) >= 0
+
+
+def test_counters_over_dense_kcert_metas():
+    # dense_blocks metas have no gather: the declared operand blocks
+    # ARE the traffic, scaled by the grid.
+    from arrow_matrix_tpu.ops import pallas_blocks
+    for meta in pallas_blocks.kcert_metas():
+        assert meta_stream_bytes(meta) > 0
+        assert meta_wave_count(meta) == 0
+        assert meta_grid_programs(meta) >= 1
+
+
+def test_tier_counters_from_fingerprint():
+    fp = structure_fingerprint(_levels(), 16)
+    ladder = fp["ladder"]
+    for kernel in ("xla", "pallas"):
+        counters = tier_counters(fp, 8, kernel=kernel)
+        assert len(counters) == len(ladder["rows"])
+        for t, c in enumerate(counters):
+            assert c["family"].startswith(f"{kernel}:")
+            assert c["padded_slots"] == c["slots"] - c["nnz"]
+            assert c["family"].split(":")[1] == tier_family(
+                c["slot_width"])
+        assert ([c["padded_slots"] for c in counters]
+                == ladder_padded_slots(fp))
+    xla = tier_counters(fp, 8, kernel="xla")
+    pallas = tier_counters(fp, 8, kernel="pallas")
+    for cx, cp in zip(xla, pallas):
+        # Granule-line streaming never moves fewer bytes than the
+        # per-row XLA gather (padding up to granule multiples).
+        assert cp["streamed_bytes"] >= cx["streamed_bytes"]
+        assert cx["streamed_bytes"] == tier_stream_bytes(
+            cx["slot_width"], cx["rows"], 8)
+    bf16 = tier_counters(fp, 8, kernel="xla", feature_dtype="bf16")
+    for cx, cb in zip(xla, bf16):
+        assert cb["streamed_bytes"] * 2 == cx["streamed_bytes"]
+
+
+def test_imbalance_report_carries_padded_slots():
+    from arrow_matrix_tpu.obs.imbalance import summarize_units
+    rep = summarize_units([10, 20], [30, 50], [40, 80], units="tier")
+    assert rep["padded_slots"] == [10, 30]
+    assert rep["padded_slot_waste"] == pytest.approx(40 / 120)
+    assert rep["padded_slot_waste_per_unit"][0] == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# Cost model fit / round trip / versioning
+# ---------------------------------------------------------------------------
+
+def test_costmodel_fit_roundtrip_and_version_skew():
+    pts = [_tier(0, "xla:tail", nnz=900, rows=200, ms=0.06),
+           _tier(1, "xla:mid", nnz=1200, rows=100, width=16, ms=0.04)]
+    model = fit_cost_model(pts, structure_hash="h", platform="cpu")
+    assert set(model.coeffs) == {"xla:tail", "xla:mid"}
+    # The fit is exact in aggregate per family (global rescale).
+    for p in pts:
+        pred = model.predict_point(p["family"], p["nnz"], p["rows"],
+                                   p["streamed_bytes"])
+        assert pred == pytest.approx(p["measured_ms"], rel=1e-6)
+    doc = model.to_dict()
+    assert CostModel.from_dict(doc).to_dict() == doc
+    bad = dict(doc, version=doc["version"] + 1)
+    with pytest.raises(ValueError, match="version"):
+        CostModel.from_dict(bad)
+
+
+def test_unseen_family_falls_back_to_kernel_pool():
+    model = fit_cost_model([_tier(0, "xla:tail", ms=0.05)])
+    # Same-kernel fallback prices what it has never seen — the tune
+    # screen must never raise on a candidate.
+    assert model.predict_point("xla:head", 500, 100, 4096) > 0.0
+    assert model.predict_point("pallas:mid", 500, 100, 4096) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Profile bookkeeping: fit exclusion, ratios, attribution, explain
+# ---------------------------------------------------------------------------
+
+def test_below_resolution_excluded_from_fit_and_ratios():
+    tiers = [_tier(0, "xla:tail", ms=0.06),
+             _tier(1, "xla:mid", width=16, ms=0.04),
+             _tier(2, "xla:tail", ms=0.002, below_resolution=True)]
+    profile = _profile(tiers, full_ms=0.102)
+    model = lens.fit_from_profile(profile)
+    pts = lens.ratio_points(profile, model)
+    assert all(p["tier"] != 2 for p in pts)
+    # One full-iteration point per dtype rides along.
+    full = [p for p in pts if p["tier"] is None]
+    assert len(full) == 1 and full[0]["family"] == "full"
+    # The sub-resolution tier still counts toward attribution.
+    frac = lens.attribution_fractions(profile, "f32")
+    assert "L2:tail" in frac
+    assert sum(frac.values()) == pytest.approx(1.0)
+    assert not lens.check_profile(profile, model)
+
+
+def test_attribution_fractions_other_and_renormalize():
+    profile = _profile([_tier(0, "xla:tail", ms=0.06)], full_ms=0.1)
+    frac = lens.attribution_fractions(profile, "f32")
+    assert frac["other"] == pytest.approx(0.4)
+    over = _profile([_tier(0, "xla:tail", ms=0.08),
+                     _tier(1, "xla:mid", width=16, ms=0.06)],
+                    full_ms=0.1)
+    frac = lens.attribution_fractions(over, "f32")
+    assert "other" not in frac
+    assert sum(frac.values()) == pytest.approx(1.0)
+
+
+def _gap_profile(f32_bytes=1000, bf16_bytes=500, f32_ms=0.1,
+                 bf16_ms=0.3):
+    prof = _profile([_tier(0, "xla:tail", ms=f32_ms)], full_ms=f32_ms)
+    prof["dtypes"]["f32"]["tiers"][0]["streamed_bytes"] = f32_bytes
+    b = copy.deepcopy(prof["dtypes"]["f32"])
+    b["full_ms"] = bf16_ms
+    b["tiers"][0]["measured_ms"] = bf16_ms
+    b["tiers"][0]["streamed_bytes"] = bf16_bytes
+    prof["dtypes"]["bf16"] = b
+    return prof
+
+
+def test_explain_gap_segments():
+    prof = _gap_profile()
+    out = lens.explain_gap(prof)
+    assert out["dominant"] == "L0:tail"
+    assert out["gap_ms"] == pytest.approx(0.2)
+    # Without a model the residual is decode/accumulate by default.
+    assert out["dominant_segment"] == "decode/accumulate"
+    # A byte coefficient large enough to explain >= half the delta
+    # reclassifies it as the gather/stream term.
+    gather = CostModel(structure_hash="h", platform="cpu",
+                       coeffs={"xla:tail": {"streamed_bytes": 4e-4}})
+    out = lens.explain_gap(prof, model=gather)
+    assert out["dominant_segment"] == "gather-bytes"
+    tiny = CostModel(structure_hash="h", platform="cpu",
+                     coeffs={"xla:tail": {"streamed_bytes": 1e-9}})
+    out = lens.explain_gap(prof, model=tiny)
+    assert out["dominant_segment"] == "decode/accumulate"
+
+
+def test_explain_gap_dma_wait_dominates():
+    prof = _gap_profile(bf16_ms=0.11)
+    prof["dtypes"]["bf16"]["dma_wait_ms"] = {"pallas:tail": 1.0}
+    out = lens.explain_gap(prof)
+    assert out["dominant"] == "dma_wait"
+    assert out["dominant_segment"] == "dma-wait"
+
+
+# ---------------------------------------------------------------------------
+# Ledger: record validity + the gate's lens calibration band
+# ---------------------------------------------------------------------------
+
+def test_lens_constants_pinned_to_ledger_gate():
+    from arrow_matrix_tpu.ledger import gate
+    assert gate.LENS_RATIO_MIN == lens.LENS_RATIO_MIN
+    assert gate.LENS_RATIO_MAX == lens.LENS_RATIO_MAX
+
+
+def test_record_profile_validates_and_pins_ratio_host_load(tmp_path):
+    tiers = [_tier(0, "xla:tail", ms=0.06),
+             _tier(1, "xla:mid", width=16, ms=0.04)]
+    profile = _profile(tiers, full_ms=0.1)
+    model = lens.fit_from_profile(profile)
+    d = str(tmp_path / "ledger")
+    ids = lens.record_profile(profile, model, directory=d)
+    assert ids
+    from arrow_matrix_tpu.ledger.store import Ledger
+    led = Ledger(d)
+    assert led.validate() == []
+    recs = led.read_all()
+    assert {r["kind"] for r in recs} == {"lens"}
+    for r in recs:
+        # Ratios are load-invariant and recorded unpinned to any
+        # loadavg; millisecond metrics keep the live stamp.
+        if r["unit"] == "ratio":
+            assert r["host_load"] is None
+        else:
+            assert r["host_load"] is not None
+
+
+def _lens_rec(tmp_path, value, metric="lens_ratio_t0"):
+    from arrow_matrix_tpu.ledger.store import Ledger
+    led = Ledger(str(tmp_path / "l"))
+    return led.record("lens", metric, value, unit="ratio",
+                      structure_hash="h", platform="cpu",
+                      host_load=None)
+
+
+def test_gate_lens_absolute_band(tmp_path):
+    from arrow_matrix_tpu.ledger.gate import baseline_key, check_records
+    bad = _lens_rec(tmp_path, 3.0)
+    failures, _ = check_records([bad], {"metrics": {}})
+    assert any("lens miscalibration" in f for f in failures)
+    ok = _lens_rec(tmp_path, 1.0, metric="lens_ratio_t1")
+    failures, notes = check_records([ok], {"metrics": {}})
+    assert failures == []
+    assert any("no baseline" in n for n in notes)
+    assert baseline_key(ok) == "lens|lens_ratio_t1|h|cpu"
+
+
+def test_gate_lens_drift_band(tmp_path):
+    from arrow_matrix_tpu.ledger.gate import baseline_key, check_records
+    rec = _lens_rec(tmp_path, 1.8)
+    base = {"metrics": {baseline_key(rec): {"median": 1.0,
+                                            "unit": "ratio"}}}
+    failures, _ = check_records([rec], base)
+    # 1.8 is inside the absolute band but > 1.5x the baseline median.
+    assert any("drifted" in f for f in failures)
+    ok = _lens_rec(tmp_path, 1.2, metric="lens_ratio_t1")
+    base = {"metrics": {baseline_key(ok): {"median": 1.0,
+                                           "unit": "ratio"}}}
+    assert check_records([ok], base)[0] == []
+
+
+# ---------------------------------------------------------------------------
+# Consumers: tune compute screen, xray compute subdivision
+# ---------------------------------------------------------------------------
+
+def test_tune_screen_prunes_on_lens_prediction():
+    fp = structure_fingerprint(_levels(), 16)
+    cheap = {r: 1e-9 for r in ("nnz", "rows", "streamed_bytes")}
+    model = CostModel(
+        structure_hash=fp.get("structure_hash", ""), platform="cpu",
+        coeffs={**{f"xla:{f}": dict(cheap)
+                   for f in ("zero", "tail", "mid", "head")},
+                **{f"pallas:{f}": {"nnz": 1.0, "rows": 1.0,
+                                   "streamed_bytes": 0.0}
+                   for f in ("zero", "tail", "mid", "head")}})
+    plain, plain_pruned = enumerate_candidates(fp, 16, platform="cpu")
+    cands, pruned = enumerate_candidates(fp, 16, platform="cpu",
+                                         lens_model=model)
+    lens_pruned = {n: r for n, r in pruned.items()
+                   if r.startswith("lens: ")}
+    # The screen prunes before any child spawn, with a "lens: " reason.
+    assert lens_pruned
+    assert all("predicted compute" in r for r in lens_pruned.values())
+    names = [c.name for c in cands]
+    assert "default" in names
+    # The screen only prunes; it never touches eligibility — the f32
+    # bit-identity contract is unchanged for surviving candidates.
+    plain_elig = {c.name: c.eligible for c in plain}
+    for c in cands:
+        assert c.eligible == plain_elig[c.name]
+    # Predicting a lens-pruned candidate confirms the 3x margin.
+    name = next(iter(lens_pruned))
+    cand = {c.name: c for c in plain}[name]
+    base = predict_candidate_ms(model, fp, 16, {}, {})
+    assert predict_candidate_ms(model, fp, 16, cand.build,
+                                cand.kernel_opts) > 3.0 * base
+
+
+def test_xray_subdivide_compute():
+    from arrow_matrix_tpu.obs.xray import subdivide_compute
+    cp = {"per_class": {"exact": {"segments_mean_ms":
+                                  {"compute": 10.0, "wire": 1.0}}}}
+    out = subdivide_compute(cp, {"exact": {"L0:tail": 0.6,
+                                           "other": 0.4}})
+    bd = out["per_class"]["exact"]["compute_breakdown_ms"]
+    assert bd == {"L0:tail": 6.0, "other": 4.0}
+    # Unmatched classes pass through untouched.
+    assert "compute_breakdown_ms" not in subdivide_compute(
+        cp, {})["per_class"]["exact"]
+
+
+# ---------------------------------------------------------------------------
+# tools/: the lens gate, its fixtures, the obs-gate validator
+# ---------------------------------------------------------------------------
+
+def test_lens_gate_selftest_and_committed_artifacts():
+    gate = _load_tool("lens_gate")
+    assert gate.selftest() == 0
+    # The committed ba_256_3 calibration must pass its own gate.
+    assert gate.main([]) == 0
+
+
+def test_planted_miscalibration_fixture_trips_the_gate():
+    gate = _load_tool("lens_gate")
+    path = os.path.join(REPO, "tests", "fixtures", "lens",
+                        "miscalibrated.json")
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    problems = gate.check_pair(doc["profile"], doc["model"])
+    assert problems, "planted miscalibration passed clean"
+    assert any("ratio" in p for p in problems)
+    assert any("cover" in p for p in problems)
+    # --fixture treats it as real data: nonzero exit.
+    assert gate.main(["--fixture", path]) == 1
+    # --fixtures is the detection-loss direction over the shipped set.
+    assert gate.main(["--fixtures"]) == 0
+
+
+def test_committed_profile_model_hashes_agree():
+    gate = _load_tool("lens_gate")
+    with open(gate.PROFILE_PATH, encoding="utf-8") as fh:
+        profile = json.load(fh)
+    with open(gate.MODEL_PATH, encoding="utf-8") as fh:
+        model = json.load(fh)
+    assert profile["structure_hash"] == model["structure_hash"]
+    # Both carriage dtypes are committed — the attribution table in
+    # PERFORMANCE.md reads straight off this artifact.
+    assert set(profile["dtypes"]) == {"f32", "bf16"}
+    # A hash-mismatched model is the silent miscalibration the gate
+    # names explicitly.
+    problems = gate.check_pair(dict(profile, structure_hash="other"),
+                               model)
+    assert any("structure hash mismatch" in p for p in problems)
+
+
+def test_obs_gate_lens_problems_validator():
+    og = _load_tool("obs_gate")
+    tiers = [_tier(0, "xla:tail", ms=0.06)]
+    profile = _profile(tiers, full_ms=0.1)
+    assert og.lens_problems(profile) == []
+    wrong_kernel = copy.deepcopy(profile)
+    wrong_kernel["dtypes"]["f32"]["tiers"][0]["family"] = "pallas:tail"
+    assert og.lens_problems(wrong_kernel)
+    missing = copy.deepcopy(profile)
+    del missing["dtypes"]["f32"]["tiers"][0]["nnz"]
+    assert og.lens_problems(missing)

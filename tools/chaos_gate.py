@@ -33,6 +33,10 @@ checkpoint resume), and that the recovered run's final X is
   kcert    — graft-kcert selftest twins trip + both shipped Pallas
              kernels certify under KC1-KC5 (including the
              interpret-mode numeric witness).
+  lens     — graft-lens cost model fit/predict/serialize round trip
+             is exact on synthetic points, and a planted out-of-band
+             calibration ratio record trips the ledger gate's lens
+             band.
 
 Plus the graft-serve chaos-under-load matrix (tools/serve_gate.py):
 serve_hang / serve_corrupt / serve_overflow / serve_hbm in-process
@@ -363,6 +367,54 @@ def scenario_kcert():
     return problems
 
 
+def scenario_lens(workdir):
+    """graft-lens: the compute cost model must survive a host-side
+    round trip — a fit over synthetic per-family points reproduces
+    them, the model serializes and deserializes losslessly — and a
+    planted out-of-band calibration record MUST trip the ledger
+    gate's lens band (the detection the drift gate grew in PR 18)."""
+    from arrow_matrix_tpu.ledger import gate as ledger_gate
+    from arrow_matrix_tpu.ledger.store import Ledger
+    from arrow_matrix_tpu.obs.costmodel import (
+        CostModel,
+        fit_cost_model,
+    )
+
+    problems = []
+    pts = [
+        {"family": "xla:tail", "nnz": 1000, "rows": 200,
+         "streamed_bytes": 400000, "measured_ms": 0.05},
+        {"family": "xla:tail", "nnz": 2000, "rows": 400,
+         "streamed_bytes": 800000, "measured_ms": 0.10},
+        {"family": "xla:mid", "nnz": 1500, "rows": 100,
+         "streamed_bytes": 600000, "measured_ms": 0.06},
+        {"family": "xla:mid", "nnz": 3000, "rows": 200,
+         "streamed_bytes": 1200000, "measured_ms": 0.12},
+    ]
+    model = fit_cost_model(pts, structure_hash="chaos",
+                           platform="cpu")
+    for p in pts:
+        pred = model.predict_point(p["family"], p["nnz"], p["rows"],
+                                   p["streamed_bytes"])
+        if pred <= 0 or not 0.5 <= p["measured_ms"] / pred <= 2.0:
+            problems.append(
+                f"lens: fit does not reproduce its own points: "
+                f"{p['family']} measured {p['measured_ms']} vs "
+                f"predicted {pred}")
+    rt = CostModel.from_dict(model.to_dict())
+    if rt.to_dict() != model.to_dict():
+        problems.append("lens: CostModel round trip is lossy")
+    lg = Ledger(os.path.join(workdir, "lens_ledger"))
+    rec = lg.record("lens", "lens_ratio_chaos", 3.0, unit="ratio",
+                    structure_hash="chaos", host_load=None)
+    failures, _ = ledger_gate.check_records([rec], {"metrics": {}})
+    if not any("lens miscalibration" in f for f in failures):
+        problems.append(
+            "lens: planted out-of-band ratio record (3.0) did NOT "
+            "trip the ledger gate's lens band")
+    return problems
+
+
 def scenario_xray_kill(workdir):
     """graft-xray under SIGKILL: the fleet_kill scenario's merged
     trace must still carry the victim's track — rebuilt from the
@@ -453,6 +505,11 @@ def run_gate(workdir, fast=False):
         # interpret-mode round trip per kernel.
         scenarios.append("kcert")
         problems += scenario_kcert()
+        # graft-lens rides the fast list: the cost-model round trip
+        # is pure numpy and the planted-record check is a host-side
+        # ledger-gate call.
+        scenarios.append("lens")
+        problems += scenario_lens(workdir)
         # The serving matrix rides the same gate (tools/serve_gate.py):
         # chaos under multi-tenant load with the same detected/
         # recovered/bit-identical contract.

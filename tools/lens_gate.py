@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Tier-1 lens gate: validate the committed graft-lens calibration.
+
+Default mode is pure document validation (no kernels run): load the
+committed ba_256_3 profile + fitted cost model from
+``bench_results/lens/`` and re-run ``obs/lens.py:check_profile`` —
+schema drift, per-level attribution failing to cover the measured
+iteration (|1-cov| > 0.10), or any measured/predicted ratio outside
+[0.5, 2.0] fails the push.  The profile/model pair must also agree on
+the structure hash: a model fitted against a different structure is
+exactly the silent miscalibration this gate exists to catch.
+
+Unlike tools/kernel_gate.py's ``--fixture`` (which verifies a planted
+fixture TRIPS its rule and exits nonzero when it does NOT), this
+gate's ``--fixture`` treats the fixture as real calibration data: a
+planted miscalibration therefore EXITS NONZERO.  ``--fixtures`` is
+the detection-loss check — it runs every shipped fixture and fails
+if any of them passes clean.
+
+Usage:
+  python tools/lens_gate.py                 check the committed
+                                            profile + model
+  python tools/lens_gate.py --refresh       re-profile ba_256_3
+                                            (k=64, f32+bf16), rewrite
+                                            the committed artifacts,
+                                            append kind='lens' ledger
+                                            records, rebaseline
+  python tools/lens_gate.py --fixture F     check a fixture JSON
+                                            ({"profile": .., "model":
+                                            ..}) as real data; a
+                                            planted miscalibration
+                                            exits nonzero
+  python tools/lens_gate.py --fixtures      verify every shipped
+                                            tests/fixtures/lens/
+                                            fixture trips the check
+  python tools/lens_gate.py --selftest      synthetic profile/model
+                                            round trip: clean passes,
+                                            perturbed trips (host
+                                            only, no jax execution)
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LENS_DIR = os.path.join(REPO, "bench_results", "lens")
+PROFILE_PATH = os.path.join(LENS_DIR, "ba_256_3_profile.json")
+MODEL_PATH = os.path.join(LENS_DIR, "ba_256_3_model.json")
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "lens")
+
+#: The committed calibration point: the same deterministic BA 256/3
+#: seed-0 width-32 decomposition tests/conftest.py regenerates.
+BA_256_3_SOURCE = {"kind": "ba", "n": 256, "m": 3, "width": 32,
+                   "seed": 0, "max_levels": 10}
+REFRESH_K = 64
+REFRESH_ATTEMPTS = 3
+
+
+def _load(path):
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def check_pair(profile: dict, model_doc: dict) -> list:
+    """Problems for one profile+model pair: the lens check itself plus
+    the cross-document structure-hash agreement."""
+    from arrow_matrix_tpu.obs import lens
+    from arrow_matrix_tpu.obs.costmodel import CostModel
+
+    try:
+        model = CostModel.from_dict(model_doc)
+    except (ValueError, KeyError, TypeError) as e:
+        return [f"cost model unreadable: {e}"]
+    problems = lens.check_profile(profile, model)
+    ph = str(profile.get("structure_hash", ""))
+    if ph and model.structure_hash and ph != model.structure_hash:
+        problems.append(
+            f"structure hash mismatch: profile {ph} vs model "
+            f"{model.structure_hash}")
+    return problems
+
+
+def run_fixture(path: str) -> int:
+    doc = _load(path)
+    problems = check_pair(doc["profile"], doc["model"])
+    for p in problems:
+        print(f"lens gate: {os.path.basename(path)}: {p}",
+              file=sys.stderr)
+    return 1 if problems else 0
+
+
+def run_fixtures() -> int:
+    paths = sorted(glob.glob(os.path.join(FIXTURE_DIR, "*.json")))
+    if not paths:
+        print("lens gate: no fixtures found", file=sys.stderr)
+        return 1
+    rc = 0
+    for path in paths:
+        if run_fixture(path) == 0:
+            print(f"lens gate: FIXTURE {os.path.basename(path)} "
+                  f"PASSED CLEAN — the lens check lost a detection",
+                  file=sys.stderr)
+            rc = 1
+    if rc == 0:
+        print(f"lens gate: {len(paths)} fixture(s) trip the check",
+              file=sys.stderr)
+    return rc
+
+
+def selftest() -> int:
+    """Host-only round trip: a self-consistent synthetic profile fits
+    and checks clean; scaling one tier's measured time 5x trips the
+    ratio band; shrinking the tier sum trips coverage."""
+    import copy
+
+    from arrow_matrix_tpu.obs import lens
+
+    tiers = [
+        {"tier": 0, "family": "xla:tail", "rows": 200, "nnz": 900,
+         "slots": 1600, "slot_width": 8, "padded_slots": 700,
+         "streamed_bytes": 409600, "measured_ms": 0.06},
+        {"tier": 1, "family": "xla:mid", "rows": 100, "nnz": 1200,
+         "slots": 1600, "slot_width": 16, "padded_slots": 400,
+         "streamed_bytes": 409600, "measured_ms": 0.04},
+    ]
+    profile = {
+        "schema": lens.LENS_PROFILE_SCHEMA, "kind": "lens_profile",
+        "structure_hash": "selftest", "platform": "cpu",
+        "device_kind": "cpu", "width": 32, "k": 64, "kernel": "xla",
+        "iters": 100, "kernel_opts": {}, "n": 300,
+        "dtypes": {"f32": {
+            "full_ms": 0.1, "chain_floor_ms": 0.001,
+            "resolution_ms": 0.005, "attributed_ms": 0.1,
+            "coverage": 1.0, "tiers": tiers, "dma_wait_ms": {}}},
+    }
+    model = lens.fit_from_profile(profile)
+    clean = lens.check_profile(profile, model)
+    if clean:
+        print(f"lens gate selftest: clean profile reported problems: "
+              f"{clean}", file=sys.stderr)
+        return 1
+    bad_ratio = copy.deepcopy(profile)
+    bad_ratio["dtypes"]["f32"]["tiers"][0]["measured_ms"] *= 5.0
+    if not any("ratio" in p
+               for p in lens.check_profile(bad_ratio, model)):
+        print("lens gate selftest: 5x tier did not trip the ratio "
+              "band", file=sys.stderr)
+        return 1
+    bad_cov = copy.deepcopy(profile)
+    bad_cov["dtypes"]["f32"]["attributed_ms"] = 0.05
+    bad_cov["dtypes"]["f32"]["coverage"] = 0.5
+    if not any("cover" in p for p in lens.check_profile(bad_cov)):
+        print("lens gate selftest: half coverage did not trip",
+              file=sys.stderr)
+        return 1
+    print("lens gate: selftest ok", file=sys.stderr)
+    return 0
+
+
+def refresh(ledger_dir=None) -> int:
+    """Re-profile the committed calibration point and rewrite the
+    artifacts + ledger records + baseline.  Retries the measurement a
+    few times and only commits a profile that passes its own check —
+    a noisy host must not be able to commit a miscalibrated model."""
+    from arrow_matrix_tpu.obs import lens
+    from arrow_matrix_tpu.tune.search import load_levels_from_source
+    from arrow_matrix_tpu.utils.artifacts import atomic_write_json
+
+    levels, width = load_levels_from_source(BA_256_3_SOURCE)
+    profile = model = problems = None
+    for attempt in range(REFRESH_ATTEMPTS):
+        profile = lens.profile_fold(
+            levels, width, REFRESH_K, kernel="auto",
+            feature_dtypes=("f32", "bf16"), iters=100)
+        model = lens.fit_from_profile(profile)
+        problems = lens.check_profile(profile, model)
+        if not problems:
+            break
+        print(f"lens gate: refresh attempt {attempt + 1} unclean: "
+              f"{problems}", file=sys.stderr)
+    if problems:
+        print("lens gate: refresh could not produce a clean profile",
+              file=sys.stderr)
+        return 1
+    os.makedirs(LENS_DIR, exist_ok=True)
+    atomic_write_json(PROFILE_PATH, profile, indent=2, sort_keys=True)
+    atomic_write_json(MODEL_PATH, model.to_dict(), indent=2,
+                      sort_keys=True)
+    ids = lens.record_profile(profile, model, directory=ledger_dir)
+    from arrow_matrix_tpu.ledger.gate import main as ledger_main
+    rc = ledger_main(["--rebaseline"]
+                     + (["--ledger-dir", ledger_dir]
+                        if ledger_dir else []))
+    if rc != 0:
+        print("lens gate: ledger rebaseline failed", file=sys.stderr)
+        return rc
+    print(f"lens gate: refreshed {PROFILE_PATH} + model, "
+          f"{len(ids)} ledger record(s)", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--refresh", action="store_true",
+                    help="re-profile ba_256_3 and rewrite the "
+                         "committed artifacts + ledger + baseline")
+    ap.add_argument("--ledger-dir", default=None,
+                    help="with --refresh: sink records here instead "
+                         "of the committed store")
+    ap.add_argument("--fixture", action="append", default=[],
+                    help="check this profile+model fixture as real "
+                         "data (a planted miscalibration exits "
+                         "nonzero; repeatable)")
+    ap.add_argument("--fixtures", action="store_true",
+                    help="verify every shipped lens fixture trips "
+                         "the check")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic round trip, no jax execution")
+    ap.add_argument("--profile", default=PROFILE_PATH,
+                    help="profile JSON to check (default: committed)")
+    ap.add_argument("--model", default=MODEL_PATH,
+                    help="model JSON to check (default: committed)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if args.fixtures:
+        return run_fixtures()
+    if args.fixture:
+        rc = 0
+        for path in args.fixture:
+            rc |= run_fixture(path)
+        return rc
+    if args.refresh:
+        return refresh(ledger_dir=args.ledger_dir)
+
+    for path in (args.profile, args.model):
+        if not os.path.isfile(path):
+            print(f"lens gate: missing committed artifact {path} — "
+                  f"run `python tools/lens_gate.py --refresh`",
+                  file=sys.stderr)
+            return 1
+    problems = check_pair(_load(args.profile), _load(args.model))
+    if problems:
+        for p in problems:
+            print(f"lens gate: {p}", file=sys.stderr)
+        print("lens gate: FAILED", file=sys.stderr)
+        return 1
+    print("lens gate: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,11 +19,15 @@ or drift against the checked-in bench_cache/sync_manifest.json —
 tools/sync_gate.py standalone); ``--kernels`` additionally runs the
 graft-kcert Pallas kernel certifier in check mode (fails on any
 KC1-KC5 violation or drift against the checked-in
-bench_cache/kernel_manifest.json — tools/kernel_gate.py standalone).
+bench_cache/kernel_manifest.json — tools/kernel_gate.py standalone);
+``--lens`` additionally runs the graft-lens calibration gate in check
+mode against the committed bench_results/lens profile + cost model
+(attribution coverage and measured/predicted ratio bands —
+tools/lens_gate.py standalone).
 
 Usage:
   python tools/lint_gate.py [--audit] [--prove] [--ledger] [--sync]
-                            [--kernels] [paths...]
+                            [--kernels] [--lens] [paths...]
 """
 
 import os
@@ -51,6 +55,9 @@ def main(argv=None) -> int:
     run_kernels = "--kernels" in argv
     if run_kernels:
         argv.remove("--kernels")
+    run_lens = "--lens" in argv
+    if run_lens:
+        argv.remove("--lens")
     rc = graft_lint_main(argv)
     if rc != 0:
         print("lint gate: FAILED (fix the findings or waive them with "
@@ -86,6 +93,15 @@ def main(argv=None) -> int:
         rc = graft_lint_main(["kernels", "--check"])
         if rc != 0:
             print("lint gate: kernel certification FAILED",
+                  file=sys.stderr)
+            return rc
+    if run_lens:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from lens_gate import main as lens_main
+
+        rc = lens_main([])
+        if rc != 0:
+            print("lint gate: lens calibration gate FAILED",
                   file=sys.stderr)
             return rc
     print("lint gate: ok", file=sys.stderr)

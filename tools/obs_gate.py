@@ -15,7 +15,10 @@ predicts is the OOM-in-waiting memview exists to catch; the smoke
 ratios sit in 1.0-2.6x).  Also runs one graft-serve smoke
 (serve/loadgen.py:smoke_serve) and requires the serving SLO report to
 carry p50/p99 latency, shed/rejected counts, HBM occupancy, and the
-per-tenant breakdown — plus the graft-pulse surfaces the smoke run
+per-tenant breakdown — plus a bounded graft-lens per-level profile
+validated structurally (every measured tier paired with its static
+counters; the calibration bands live in tools/lens_gate.py) and the
+graft-pulse surfaces the smoke run
 writes: a schema-valid crash-readable pulse ring
 (``pulse_ring.json``), parseable Prometheus exposition text
 (``pulse_metrics.prom``), the embedded window series using the shared
@@ -335,6 +338,78 @@ def xray_problems(trace_doc: dict, tickets: list, wire=None,
     return problems
 
 
+def lens_problems(profile: dict) -> list:
+    """Gate problems from a graft-lens profile document: structural
+    validation of the per-level attribution contract.  Every measured
+    tier must ride with its full static counter row (nnz / rows /
+    streamed bytes — the pairing IS the point of graft-lens), the
+    family label must match the profiled kernel, and the coverage
+    bookkeeping must be finite and self-consistent.  The calibration
+    BANDS (coverage tolerance, ratio range) are enforced against the
+    committed artifact by tools/lens_gate.py — at this gate's reduced
+    smoke scale per-tier times sit at the measurement floor, so only
+    the structure is load-bearing here."""
+    from arrow_matrix_tpu.obs import lens
+
+    problems = []
+    if profile.get("schema") != lens.LENS_PROFILE_SCHEMA:
+        return [f"lens: profile schema {profile.get('schema')} != "
+                f"{lens.LENS_PROFILE_SCHEMA}"]
+    kernel = profile.get("kernel")
+    if not profile.get("structure_hash"):
+        problems.append("lens: profile lacks structure_hash")
+    if not profile.get("dtypes"):
+        problems.append("lens: profile has no dtype entries")
+    for fd, entry in (profile.get("dtypes") or {}).items():
+        full = entry.get("full_ms")
+        if not isinstance(full, (int, float)) or not full > 0:
+            problems.append(f"lens: {fd}: non-positive full_ms "
+                            f"{full}")
+        measured = 0
+        for t in entry.get("tiers", ()):
+            if not t.get("measured_ms"):
+                continue
+            measured += 1
+            for field in ("nnz", "rows", "streamed_bytes", "slots",
+                          "slot_width"):
+                v = t.get(field)
+                if not isinstance(v, (int, float)) or v < 0:
+                    problems.append(
+                        f"lens: {fd} tier {t.get('tier')}: measured "
+                        f"tier lacks static counter {field}")
+            fam = str(t.get("family", ""))
+            if kernel and not fam.startswith(f"{kernel}:"):
+                problems.append(
+                    f"lens: {fd} tier {t.get('tier')}: family {fam!r}"
+                    f" does not match profiled kernel {kernel!r}")
+        if not measured:
+            problems.append(f"lens: {fd}: no measured tiers")
+        att = entry.get("attributed_ms")
+        cov = entry.get("coverage")
+        if (isinstance(att, (int, float))
+                and isinstance(cov, (int, float))
+                and isinstance(full, (int, float)) and full > 0
+                and abs(att / full - cov) > 1e-6):
+            problems.append(f"lens: {fd}: coverage {cov} inconsistent "
+                            f"with attributed/full {att / full}")
+    return problems
+
+
+def run_lens_profile() -> list:
+    """Bounded in-process graft-lens profile (small BA structure, XLA
+    kernel) validated structurally — the obs-smoke form of the lens
+    contract."""
+    from arrow_matrix_tpu.obs import lens
+    from arrow_matrix_tpu.tune.search import load_levels_from_source
+
+    levels, width = load_levels_from_source(
+        {"kind": "ba", "n": 96, "m": 3, "width": 16, "seed": 5,
+         "max_levels": 10})
+    profile = lens.profile_fold(levels, width, 8, kernel="xla",
+                                feature_dtypes=("f32",), iters=20)
+    return lens_problems(profile)
+
+
 def run_xray_fleet(out: str) -> list:
     """In-process 2-worker fleet exercising the full graft-xray loop
     (trace context over the wire, per-process docs, clock-offset
@@ -435,6 +510,7 @@ def main(argv=None) -> int:
     problems += pulse_problems(s)
     problems += ledger_problems(summary, s)
     problems += run_xray_fleet(out)
+    problems += run_lens_profile()
     if problems:
         for p in problems:
             print(f"obs gate: {p}", file=sys.stderr)
