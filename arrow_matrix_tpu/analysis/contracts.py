@@ -89,6 +89,41 @@ class CollectiveContract:
         schedule (the statically-visible form of the ÷c law)."""
         return k // self.repl // self.overlap_slabs
 
+    def inter_host_bytes(self, num_hosts: int, num_devices: int,
+                         pattern: str = "ring") -> int:
+        """The slice of ``step_bytes`` that crosses a host boundary
+        when the global device axis is split into ``num_hosts``
+        contiguous blocks (graft-host fault domains).
+
+        Arrow-matrix exchange is neighbor traffic: under ``"ring"``,
+        each device sends one hop along the axis, so exactly the
+        ``num_hosts`` block-edge hops (of ``num_devices`` total)
+        leave their host — the inter-host fraction is ``hosts /
+        devices``.  Under ``"alltoall"`` every device pairs with all
+        ``num_devices - 1`` others, of which ``num_devices /
+        num_hosts - 1`` share its host, so the fraction is
+        ``1 - (d/h - 1)/(d - 1)``.  This is a METHOD, not a field:
+        host topology is a deployment property, and keeping it out of
+        the dataclass keeps ``to_json`` / the checked-in HLO manifest
+        byte-stable across host counts."""
+        if num_hosts < 1 or num_devices < 1:
+            raise ValueError("num_hosts and num_devices must be >= 1")
+        if num_devices % num_hosts != 0:
+            raise ValueError(
+                f"num_devices ({num_devices}) must split evenly over "
+                f"num_hosts ({num_hosts})")
+        if num_hosts == 1 or num_devices == 1:
+            return 0
+        if pattern == "ring":
+            frac = num_hosts / num_devices
+        elif pattern == "alltoall":
+            per_host = num_devices // num_hosts
+            frac = 1.0 - (per_host - 1) / (num_devices - 1)
+        else:
+            raise ValueError(f"unknown pattern {pattern!r} "
+                             f"(want 'ring' or 'alltoall')")
+        return int(round(self.step_bytes * frac))
+
     def to_json(self) -> dict:
         rec = dataclasses.asdict(self)
         rec["lowered_kinds"] = sorted(self.lowered_kinds)

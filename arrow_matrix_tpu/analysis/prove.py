@@ -245,6 +245,39 @@ def check_h2(measured_bytes: int, source: str,
                 f"[{lo}, {hi}]")
 
 
+def check_host_bytes(contract: CollectiveContract, num_hosts: int,
+                     num_devices: int, measured_bytes: int,
+                     pattern: str = "ring",
+                     band: Optional[Tuple[float, float]] = None) -> dict:
+    """graft-host extension of H2: the measured bytes that cross a
+    host fault-domain boundary match the contract's inter-host slice
+    (``CollectiveContract.inter_host_bytes``) within the band.
+
+    Deliberately NOT in :data:`RULE_IDS` — H1–H7 are topology-free
+    promises checked against the checked-in manifest at one fixed
+    scale, while the inter-host slice depends on the deployment's
+    host split; this check runs from the fleet/host gates, which know
+    the split they rehearsed.  Defaults to the contract's own H2
+    ``ratio_band``."""
+    ideal = contract.inter_host_bytes(num_hosts, num_devices,
+                                      pattern=pattern)
+    if ideal == 0:
+        if measured_bytes == 0:
+            return _res("pass",
+                        f"hosts={num_hosts}: no inter-host slice "
+                        f"promised, none measured")
+        return _res("fail",
+                    f"hosts={num_hosts} promises zero inter-host "
+                    f"bytes but {measured_bytes} B crossed a domain "
+                    f"boundary")
+    lo, hi = band if band is not None else contract.ratio_band
+    ratio = measured_bytes / ideal
+    detail = (f"{measured_bytes} B inter-host / ideal {ideal} B "
+              f"({pattern}, hosts={num_hosts}, devices={num_devices})"
+              f" = {ratio:.3f} vs [{lo}, {hi}]")
+    return _res("pass" if lo <= ratio <= hi else "fail", detail)
+
+
 def check_h3(lowered: CollectiveSummary, contract: CollectiveContract,
              k: int, merge_bytes: Optional[int] = None) -> dict:
     """The ÷c law: repl=c exchanges carry k/(c·S) slabs, and the

@@ -598,6 +598,90 @@ def probe_fleet() -> tuple[bool, str]:
                   "matrix")
 
 
+def probe_host() -> tuple[bool, str]:
+    """graft-host round-trip: spawn a 2-worker fleet split into two
+    host fault domains, aim a checkpointing request at the host-1
+    domain, wait for its first COMPLETE checkpoint, SIGKILL the whole
+    domain, and require the host-0 survivor to requeue AND resume the
+    request from the shared checkpoint rather than recompute — the
+    kill-a-host contract in miniature (tools/fleet_gate.py runs the
+    full 2x2 mid-batch version with bit-identity and wire-ledger
+    checks).  Bounded subprocess, as for the other probes."""
+    code = (
+        "import os, sys, tempfile, time; sys.argv=[]; "
+        "from arrow_matrix_tpu.utils.platform import "
+        "force_cpu_devices; force_cpu_devices(1); "
+        "import numpy as np; "
+        "from arrow_matrix_tpu.fleet.router import FleetRouter; "
+        "from arrow_matrix_tpu.serve.request import Request; "
+        "d = tempfile.mkdtemp(prefix='host_probe_'); "
+        "ck = os.path.join(d, 'ck'); "
+        "r = FleetRouter(spawn=2, hosts=2, vertices=64, width=16, "
+        "seed=3, run_dir=d, checkpoint_dir=ck, checkpoint_every=1); "
+        "p = []; "
+        "\n"
+        "try:\n"
+        "    hm = r.host_map()\n"
+        "    if sorted(hm) != ['host-0', 'host-1']:\n"
+        "        p.append('bad host map: ' + repr(hm))\n"
+        "    doomed = set(hm.get('host-1') or ())\n"
+        "    x = np.ones((r.n_rows, 2), dtype=np.float32)\n"
+        "    ten = None\n"
+        "    i = 0\n"
+        "    while ten is None and i < 256:\n"
+        "        if r.ring.lookup('t%d' % i) in doomed:\n"
+        "            ten = 't%d' % i\n"
+        "        i += 1\n"
+        "    t = r.submit(Request('h0', ten, x, 32))\n"
+        "    deadline = time.monotonic() + 60\n"
+        "    while time.monotonic() < deadline:\n"
+        "        if os.path.exists(os.path.join(ck, 'ck_h0')):\n"
+        "            break\n"
+        "        time.sleep(0.005)\n"
+        "    else:\n"
+        "        p.append('no checkpoint appeared before the kill')\n"
+        "    r.kill_host('host-1')\n"
+        "    r.drain(timeout_s=120)\n"
+        "    if t.status != 'completed':\n"
+        "        p.append('request lost with the host: '\n"
+        "                 + repr((t.status, t.reason, t.error)))\n"
+        "    elif getattr(t, 'requeues', 0) < 1:\n"
+        "        p.append('dead-domain request was not requeued')\n"
+        "    elif getattr(t, 'worker_id', None) in doomed:\n"
+        "        p.append('request credited to the dead domain')\n"
+        "    logs = ''\n"
+        "    for h in r.workers.values():\n"
+        "        if h.worker_id in doomed:\n"
+        "            continue\n"
+        "        try:\n"
+        "            logs += open(h.log_path).read()\n"
+        "        except OSError:\n"
+        "            pass\n"
+        "    if not p and 'resumed request' not in logs:\n"
+        "        p.append('survivor recomputed instead of resuming')\n"
+        "    if not p and r.live_hosts() != ['host-0']:\n"
+        "        p.append('dead domain not buried: '\n"
+        "                 + repr(r.live_hosts()))\n"
+        "finally:\n"
+        "    r.shutdown()\n"
+        "print('HOST ok' if not p else 'HOST FAIL: ' + str(p[0]))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "no response in 240s"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("HOST")]
+    if proc.returncode != 0 or not lines:
+        return False, (proc.stderr.strip()[-120:]
+                       or f"rc={proc.returncode}, no probe output")
+    if lines[-1] != "HOST ok":
+        return False, lines[-1][:120]
+    return True, ("kill-a-host domain survived with resume — run "
+                  "tools/fleet_gate.py for quorum + bit-identity")
+
+
 def probe_reshard() -> tuple[bool, str]:
     """graft-reshard round-trip: seed one mid-flight checkpoint on a
     2-device layout, grow the server onto 4 devices (the checkpoint
@@ -916,6 +1000,10 @@ def main(argv=None) -> int:
     fleet_ok, detail = probe_fleet()
     ok &= _check("graft-fleet (kill one of 2 workers + requeue)",
                  fleet_ok, detail)
+
+    host_ok, detail = probe_host()
+    ok &= _check("graft-host (kill a host domain + resume)",
+                 host_ok, detail)
 
     reshard_ok, detail = probe_reshard()
     ok &= _check("graft-reshard (grow-migration round trip)",

@@ -22,6 +22,18 @@ on ``*.step`` — the worker SIGKILLs itself mid-batch
 deterministically), which is how tools/fleet_gate.py runs the
 kill-one-worker-of-N survival scenario.  The last stdout line is the
 JSON verdict (the gate/doctor handshake).
+
+graft-host: ``--hosts H`` groups the workers into H host fault
+domains (contiguous blocks, spawn env ``AMT_HOST_ID``); the router
+resolves the wire per domain (same host -> shm descriptors, cross
+host -> raw framing; ``--transport`` overrides).  ``--fault_host``
+arms EVERY worker of one domain with the same fault plan — the
+kill-a-host rung: a whole domain SIGKILLs mid-batch and the
+survivors must absorb its work with zero accepted-request loss.
+``--measure_wire`` additionally benchmarks all three transports over
+a local socketpair and records ``serialize_ms_per_mb_<transport>``
+in the run ledger, the banded evidence that the shm path stays
+cheaper than base64.
 """
 
 from __future__ import annotations
@@ -30,6 +42,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from arrow_matrix_tpu.serve import request as rq
 
@@ -57,12 +70,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--results_npz", default=None,
                    help="also save completed results (request id -> "
                         "array) for bit-identity comparisons")
+    p.add_argument("--hosts", type=int, default=1,
+                   help="host fault domains to split the workers "
+                        "into (graft-host; contiguous blocks)")
+    p.add_argument("--transport", default="auto",
+                   choices=("auto", "json", "raw", "shm"),
+                   help="wire transport override (auto: same-host "
+                        "shm, cross-host raw)")
     p.add_argument("--fault_worker", default=None,
                    help="worker id whose environment gets "
                         "--fault_plan (chaos scenarios)")
+    p.add_argument("--fault_host", default=None,
+                   help="host domain id (e.g. host-1) whose EVERY "
+                        "worker gets --fault_plan — the kill-a-host "
+                        "rung")
     p.add_argument("--fault_plan", default=None,
                    help="AMT_FAULT_PLAN JSON (or a path to it) for "
-                        "--fault_worker only")
+                        "--fault_worker / --fault_host only")
+    p.add_argument("--kill_host", default=None,
+                   help="router-side kill-a-host rung: once the batch "
+                        "is mid-flight, SIGKILL every worker of this "
+                        "domain AT ONCE and heartbeat-probe the "
+                        "victims to a dead verdict")
+    p.add_argument("--measure_wire", action="store_true",
+                   help="benchmark json/raw/shm over a socketpair "
+                        "and record serialize_ms_per_mb_<transport> "
+                        "in the run ledger")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -77,20 +110,40 @@ def run_fleet(args) -> dict:
     from arrow_matrix_tpu.utils.artifacts import atomic_write_json
 
     os.makedirs(args.run_dir, exist_ok=True)
+    if args.fault_worker and getattr(args, "fault_host", None):
+        raise SystemExit("pass --fault_worker or --fault_host, "
+                         "not both")
     worker_env = None
-    if args.fault_worker:
+    if args.fault_worker or getattr(args, "fault_host", None):
         plan = args.fault_plan or ""
         if os.path.exists(plan):
             with open(plan, encoding="utf-8") as fh:
                 plan = fh.read()
-        worker_env = {args.fault_worker: {"AMT_FAULT_PLAN": plan}}
+        if args.fault_worker:
+            worker_env = {args.fault_worker: {"AMT_FAULT_PLAN": plan}}
+        else:
+            # Arm the WHOLE domain: worker i of n lives in
+            # host-{i*hosts//n} (the router's contiguous-block split).
+            n, hosts = args.workers, max(1, int(args.hosts))
+            victims = [f"worker-{i}" for i in range(n)
+                       if f"host-{i * min(hosts, n) // n}"
+                       == args.fault_host]
+            if not victims:
+                raise SystemExit(f"--fault_host {args.fault_host!r} "
+                                 f"matches no worker (workers={n}, "
+                                 f"hosts={hosts})")
+            worker_env = {wid: {"AMT_FAULT_PLAN": plan}
+                          for wid in victims}
     router = FleetRouter(
         spawn=args.workers, vertices=args.vertices, width=args.width,
         seed=args.seed, fmt=args.fmt, queue_capacity=args.queue,
         hbm_budget_mb=args.hbm_budget_mb,
         checkpoint_dir=os.path.join(args.run_dir, "checkpoints"),
         run_dir=args.run_dir, window_s=args.window_s,
-        placement=args.placement, worker_env=worker_env,
+        placement=args.placement,
+        hosts=getattr(args, "hosts", 1),
+        transport=getattr(args, "transport", "auto"),
+        worker_env=worker_env,
         submit_timeout_s=args.submit_timeout_s,
         verbose=args.verbose)
     try:
@@ -101,6 +154,49 @@ def run_fleet(args) -> dict:
         if args.placement == "pack":
             router.plan_packing({r.tenant: r.k for r in trace})
         tickets = [router.submit(r) for r in trace]
+        killed_hosts = []
+        if getattr(args, "kill_host", None):
+            # Mid-batch on purpose, and timed so the survivors can
+            # RESUME rather than recompute: wait until some request
+            # dispatched to the doomed domain is still in flight AND
+            # has a checkpoint on the shared dir (per-request
+            # ``ck_<request_id>`` keys), then take the whole domain
+            # down in one sweep.  The deaths are then probed to a
+            # verdict through the REAL heartbeat ladder — the same
+            # wire discovery a dispatch failure triggers — so the
+            # burial is deterministic for the gate without
+            # short-circuiting health.
+            domain = set(router.host_map().get(args.kill_host) or [])
+            ck_dir = os.path.join(args.run_dir, "checkpoints")
+
+            def _resumable_in_flight():
+                for t in tickets:
+                    if t.status in rq.TERMINAL:
+                        continue
+                    if getattr(t, "worker_id", None) not in domain:
+                        continue
+                    # The FINAL checkpoint path only (orbax writes a
+                    # *-tmp-* then renames atomically): its existence
+                    # means a COMPLETE save a survivor can resume;
+                    # matching the tmp file would fire the kill
+                    # mid-write, before anything is resumable.
+                    if os.path.exists(os.path.join(
+                            ck_dir, f"ck_{t.request.request_id}")):
+                        return True
+                return False
+
+            deadline = time.monotonic() + args.submit_timeout_s
+            while time.monotonic() < deadline:
+                if _resumable_in_flight():
+                    break
+                if all(t.status in rq.TERMINAL for t in tickets):
+                    break   # batch outran the kill; fire anyway
+                time.sleep(0.005)
+            victims = router.kill_host(args.kill_host)
+            killed_hosts.append(args.kill_host)
+            for wid in victims:
+                router._on_worker_failure(
+                    wid, f"host domain {args.kill_host} killed")
         router.drain(timeout_s=args.submit_timeout_s)
         report = router.fleet_summary()
         # The router's own trace doc goes to disk while the router is
@@ -111,6 +207,7 @@ def run_fleet(args) -> dict:
     finally:
         router.shutdown()
     report["host_load"] = _default_host_load()
+    report["killed_hosts"] = killed_hosts
     report["tickets"] = [
         {"request_id": t.request.request_id,
          "tenant": t.request.tenant, "status": t.status,
@@ -159,7 +256,27 @@ def run_fleet(args) -> dict:
             knobs={"fleet": report["fleet"],
                    "workers": args.workers,
                    "requests": args.requests,
-                   "frames": tot.get("frames")})
+                   "frames": tot.get("frames"),
+                   "hosts": getattr(args, "hosts", 1),
+                   "payload_bytes": tot.get("payload_bytes"),
+                   "shm_bytes": tot.get("shm_bytes")})
+    if getattr(args, "measure_wire", False):
+        # Same payload, three wires, one socketpair: the banded proof
+        # that shm descriptor passing stays cheaper than the base64
+        # envelope (and how close it gets to raw framing).
+        from arrow_matrix_tpu.fleet import wire as wire_mod
+        measured = wire_mod.measure_transports()
+        report["wire_measured"] = measured
+        for transport in ("base64", "raw", "shm"):
+            ledger_store.record(
+                "fleet", f"serialize_ms_per_mb_{transport}",
+                round(float(
+                    measured[transport]["serialize_ms_per_mb"]), 4),
+                directory=os.path.join(args.run_dir, "ledger"),
+                unit="ms", structure_hash="wire_1mb",
+                knobs={"transport": transport,
+                       "frame_bytes":
+                           measured[transport]["frame_bytes"]})
 
     ring_docs = []
     for wid in sorted(router.workers):
@@ -342,6 +459,16 @@ def main(argv=None) -> int:
         "fleet": report["fleet"],
         "workers": report["num_workers"],
         "dead_workers": report["dead_workers"],
+        "hosts": report.get("hosts"),
+        "live_hosts": report.get("live_hosts"),
+        "killed_hosts": report.get("killed_hosts"),
+        "transports": report.get("transports"),
+        "wire_shm_bytes": (report.get("wire", {}).get("totals")
+                           or {}).get("shm_bytes"),
+        "wire_measured": {
+            t: {"serialize_ms_per_mb":
+                    round(float(m["serialize_ms_per_mb"]), 4)}
+            for t, m in (report.get("wire_measured") or {}).items()},
         "requests": report["requests"],
         "completed": report["completed"],
         "failed": report["failed"],

@@ -41,6 +41,8 @@ class WorkerHealth:
     last_ok_s: Optional[float] = None
     last_error: Optional[str] = None
     declared_dead_s: Optional[float] = None
+    readmissions: int = 0
+    readmitted_s: Optional[float] = None
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
@@ -144,6 +146,35 @@ class HealthMonitor:
                 break
             if attempt < self.max_failures:
                 self.sleep(policy.delay_s(attempt, salt="heartbeat"))
+        return h
+
+    def readmit(self, worker_id: str) -> WorkerHealth:
+        """The ONE way back from a dead verdict.  Death is sticky on
+        purpose — a passing heartbeat from a half-recovered process
+        must never quietly resurrect it (``record_ok`` checks
+        ``h.alive`` first) — so rejoining the fleet is an explicit
+        operator/host decision: a new host restarted the worker and
+        vouches for it.  Resets the verdict and the failure streak and
+        counts the readmission, so the fleet report shows a worker
+        that died and came back as exactly that, not as one that never
+        died."""
+        now = float(self.clock())
+        with self._lock:
+            h = self._health_locked(worker_id)
+            h.alive = True
+            h.consecutive_failures = 0
+            h.last_error = None
+            h.declared_dead_s = None
+            h.readmissions += 1
+            h.readmitted_s = now
+        try:
+            from arrow_matrix_tpu.obs import flight
+
+            flight.record("fleet", "worker_readmitted",
+                          worker=worker_id,
+                          readmissions=h.readmissions)
+        except Exception:  # graft-lint: disable=R8 — telemetry
+            pass
         return h
 
     def record_noop(self, worker_id: str) -> WorkerHealth:

@@ -49,6 +49,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from arrow_matrix_tpu import faults
+from arrow_matrix_tpu.fleet import shm as shm_mod
 from arrow_matrix_tpu.fleet import wire
 from arrow_matrix_tpu.fleet.health import HealthMonitor
 from arrow_matrix_tpu.fleet.placement import (
@@ -86,7 +87,10 @@ def _repo_pythonpath(env: Dict[str, str]) -> str:
 @dataclasses.dataclass
 class WorkerHandle:
     """One fleet worker as the router sees it: an address, optionally
-    the spawned process, and the spawn handshake metadata."""
+    the spawned process, the spawn handshake metadata, its host fault
+    domain (``host_id``, from the spawn env / READY announce), and the
+    wire transport the router resolved for it (same host → ``shm``,
+    cross host → ``raw``, unknown/attached → ``json``)."""
 
     worker_id: str
     host: str
@@ -95,11 +99,22 @@ class WorkerHandle:
     log_path: Optional[str] = None
     obs_dir: Optional[str] = None
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    transport: str = "json"
+
+    @property
+    def host_id(self) -> Optional[str]:
+        return self.meta.get("host_id")
 
     def call(self, obj: Any, *, timeout_s: float = 30.0,
-             stats: Optional[Dict[str, Any]] = None) -> Any:
+             stats: Optional[Dict[str, Any]] = None,
+             shm_pool: Optional[shm_mod.SegmentPool] = None) -> Any:
+        transport = self.transport if (self.transport != "shm"
+                                       or shm_pool is not None) \
+            else "json"
         return wire.request_call(self.host, self.port, obj,
-                                 timeout_s=timeout_s, stats=stats)
+                                 timeout_s=timeout_s, stats=stats,
+                                 transport=transport,
+                                 shm_pool=shm_pool)
 
     @property
     def pid(self) -> Optional[int]:
@@ -131,6 +146,7 @@ def spawn_worker(worker_id: str, *, vertices: int, width: int,
                  checkpoint_every: int = 2,
                  obs_dir: Optional[str] = None,
                  window_s: float = 0.25,
+                 host_id: Optional[str] = None,
                  extra_env: Optional[Dict[str, str]] = None,
                  ready_timeout_s: float = 120.0) -> WorkerHandle:
     """Spawn one worker process and complete the stdout handshake.
@@ -138,9 +154,12 @@ def spawn_worker(worker_id: str, *, vertices: int, width: int,
     The worker announces ``FLEET_WORKER_READY {json}`` once its server
     is up and its TCP port is bound; everything it prints (including
     the scheduler's ``resumed request`` lines the gates grep) is
-    copied to ``<obs_dir>/worker.log``.  ``extra_env`` lands ON TOP of
-    the inherited environment — the fleet gate arms exactly one victim
-    worker with an ``AMT_FAULT_PLAN`` kill plan this way.
+    copied to ``<obs_dir>/worker.log``.  ``host_id`` assigns the
+    worker's host fault domain via the spawn env (``AMT_HOST_ID``) —
+    the worker echoes it back in the READY announce, so the router's
+    domain map is what the workers actually believe.  ``extra_env``
+    lands ON TOP of the inherited environment — the fleet gate arms
+    victim workers with an ``AMT_FAULT_PLAN`` kill plan this way.
     """
     cmd = [sys.executable, "-m", "arrow_matrix_tpu.fleet.worker",
            "--worker_id", worker_id,
@@ -161,6 +180,8 @@ def spawn_worker(worker_id: str, *, vertices: int, width: int,
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = _repo_pythonpath(env)
     env["PYTHONUNBUFFERED"] = "1"
+    if host_id is not None:
+        env["AMT_HOST_ID"] = str(host_id)
     env.update(extra_env or {})
 
     log_path = (os.path.join(obs_dir, "worker.log")
@@ -242,6 +263,8 @@ class FleetRouter:
                  run_dir: Optional[str] = None,
                  window_s: float = 0.25,
                  placement: str = "ring",
+                 hosts: int = 1,
+                 transport: str = "auto",
                  health: Optional[HealthMonitor] = None,
                  worker_env: Optional[Dict[str, Dict[str, str]]] = None,
                  submit_timeout_s: float = 300.0,
@@ -253,12 +276,23 @@ class FleetRouter:
                              f"got {placement!r}")
         if spawn and handles:
             raise ValueError("pass spawn= or handles=, not both")
+        if transport not in ("auto", "json") + wire.TRANSPORTS:
+            raise ValueError(f"transport must be 'auto' or one of "
+                             f"{wire.TRANSPORTS}, got {transport!r}")
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
         self.name = name
         self.verbose = verbose
         self.run_dir = run_dir
         self.placement = placement
         self.checkpoint_dir = checkpoint_dir
         self.submit_timeout_s = float(submit_timeout_s)
+        # The router's own host fault domain: it rides with domain 0
+        # unless the spawn env says otherwise (a quorum peer on
+        # another "host" sees every domain-0 worker as cross-host).
+        self.host_id = os.environ.get("AMT_HOST_ID", "host-0")
+        self.transport_mode = transport
+        self.shm: Optional[shm_mod.SegmentPool] = None
         self.health = health or HealthMonitor(timeout_s=5.0,
                                               max_failures=3)
         self._lock = witnessed("fleet_router", threading.RLock())
@@ -279,6 +313,7 @@ class FleetRouter:
         self.tracer = Tracer(name="router")
         self._wire_totals: Dict[str, float] = {
             "frames": 0, "bytes_out": 0, "bytes_in": 0,
+            "payload_bytes": 0, "shm_bytes": 0,
             "serialize_ms": 0.0, "wire_ms": 0.0}
         self._wire_frames: List[dict] = []
         self._clock_offsets: Dict[str, dict] = {}
@@ -290,11 +325,15 @@ class FleetRouter:
                 self.workers[h.worker_id] = h
         else:
             n = max(int(spawn), 1)
+            hosts = min(int(hosts), n)
             env_map = worker_env or {}
             for i in range(n):
                 wid = f"worker-{i}"
                 obs_dir = (os.path.join(run_dir, wid)
                            if run_dir else None)
+                extra = dict(env_map.get(wid) or {})
+                if self.transport_mode in ("auto", "shm"):
+                    extra.setdefault("AMT_SHM", "1")
                 self.workers[wid] = spawn_worker(
                     wid, vertices=vertices, width=width, seed=seed,
                     fmt=fmt, queue_capacity=queue_capacity,
@@ -302,9 +341,14 @@ class FleetRouter:
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_every=checkpoint_every,
                     obs_dir=obs_dir, window_s=window_s,
-                    extra_env=env_map.get(wid))
+                    # Contiguous blocks: workers 0..n/H-1 are host-0
+                    # and so on — the slicing a real per-host mesh
+                    # would use (fleet/host.py mirrors it).
+                    host_id=f"host-{i * hosts // n}",
+                    extra_env=extra)
         if not self.workers:
             raise ValueError("a fleet needs at least one worker")
+        self._resolve_transports()
         self.ring = ConsistentHashRing(self.workers)
         self.n_rows = None
         for h in self.workers.values():
@@ -323,7 +367,105 @@ class FleetRouter:
                       workers=sorted(self.workers),
                       placement=self.placement)
 
+    # -- host fault domains + transport resolution (graft-host) ------------
+
+    def _resolve_transports(self) -> None:
+        """Pick each worker's wire transport from host-domain
+        topology: same domain as the router → shm descriptors, other
+        domain → raw framing, no domain metadata (attached handles,
+        older workers) → the original json wire.  A fixed
+        ``transport=`` overrides for every worker.  One shared
+        SegmentPool is created iff some worker rides shm."""
+        want_shm = False
+        for h in self.workers.values():
+            if self.transport_mode == "auto":
+                if h.host_id is None:
+                    h.transport = "json"
+                elif h.host_id == self.host_id \
+                        and h.meta.get("shm"):
+                    h.transport = "shm"
+                else:
+                    h.transport = "raw"
+            else:
+                h.transport = self.transport_mode
+            want_shm = want_shm or h.transport == "shm"
+        if want_shm and self.shm is None:
+            self.shm = shm_mod.SegmentPool(
+                slots=max(16, 4 * len(self.workers)),
+                name=f"amtr_{os.getpid()}")
+
+    def host_map(self) -> Dict[str, List[str]]:
+        """host_id -> sorted worker ids (workers without a domain
+        group under ``host-?``)."""
+        domains: Dict[str, List[str]] = {}
+        for wid in sorted(self.workers):
+            hid = self.workers[wid].host_id or "host-?"
+            domains.setdefault(hid, []).append(wid)
+        return domains
+
+    def kill_host(self, host_id: str) -> List[str]:
+        """SIGKILL every worker in one host fault domain AT ONCE —
+        the kill-a-host chaos rung.  Like :meth:`kill_worker`, the
+        deaths are DISCOVERED through the wire + heartbeat ladder,
+        never short-circuited here.  Returns the victim worker ids."""
+        victims = self.host_map().get(host_id, [])
+        if not victims:
+            raise ValueError(f"unknown host domain {host_id!r} "
+                             f"(have {sorted(self.host_map())})")
+        for wid in victims:
+            self.workers[wid].kill()
+        flight.record("fleet", "host_killed", host=host_id,
+                      workers=victims)
+        return victims
+
+    def live_hosts(self) -> List[str]:
+        with self._lock:
+            dead = set(self._dead)
+        return sorted({h.host_id or "host-?"
+                       for wid, h in self.workers.items()
+                       if wid not in dead})
+
+    def readmit(self, worker_id: str,
+                handle: Optional[WorkerHandle] = None) -> WorkerHandle:
+        """Rejoin a buried worker WITHOUT rebuilding the router: a new
+        host restarted it (same id, possibly a new port/process) and
+        vouches for it.  Replaces the handle when a new one is given,
+        clears the dead mark (the ring still carries the id — dead
+        workers are excluded at lookup, not removed), resolves the
+        new handle's transport, and flips health through its explicit
+        :meth:`~arrow_matrix_tpu.fleet.health.HealthMonitor.readmit`
+        path — the only way back from a sticky dead verdict."""
+        if worker_id not in self.workers:
+            raise ValueError(f"unknown worker {worker_id!r}")
+        if handle is not None:
+            if handle.worker_id != worker_id:
+                raise ValueError(
+                    f"handle is for {handle.worker_id!r}, not "
+                    f"{worker_id!r}")
+            self.workers[worker_id] = handle
+        self._resolve_transports()
+        self.health.readmit(worker_id)
+        with self._lock:
+            self._dead.discard(worker_id)
+        flight.record("fleet", "worker_rejoined", worker=worker_id,
+                      host=self.workers[worker_id].host_id)
+        if self.verbose:
+            print(f"[graft-fleet {self.name}] worker {worker_id} "
+                  f"readmitted", flush=True)
+        return self.workers[worker_id]
+
     # -- wire accounting + clock alignment (graft-xray) --------------------
+
+    def _fold_wire_stats_locked(self, st: Dict[str, Any]) -> None:
+        self._wire_frames.append(st)
+        tot = self._wire_totals
+        tot["frames"] += 2       # request + response frames
+        tot["bytes_out"] += st["bytes_out"]
+        tot["bytes_in"] += st["bytes_in"]
+        tot["payload_bytes"] += st.get("payload_bytes", 0)
+        tot["shm_bytes"] += st.get("shm_bytes", 0)
+        tot["serialize_ms"] += st["serialize_ms"]
+        tot["wire_ms"] += st["wire_ms"]
 
     def _call(self, handle: WorkerHandle, obj: Any, *,
               timeout_s: float = 30.0) -> Any:
@@ -331,17 +473,12 @@ class FleetRouter:
         trip's measured bytes/serialize/wire cost lands in the
         router's per-frame list and running totals."""
         st: Dict[str, Any] = {}
-        reply = handle.call(obj, timeout_s=timeout_s, stats=st)
+        reply = handle.call(obj, timeout_s=timeout_s, stats=st,
+                            shm_pool=self.shm)
         if st:
             st["worker"] = handle.worker_id
             with self._lock:
-                self._wire_frames.append(st)
-                tot = self._wire_totals
-                tot["frames"] += 2       # request + response frames
-                tot["bytes_out"] += st["bytes_out"]
-                tot["bytes_in"] += st["bytes_in"]
-                tot["serialize_ms"] += st["serialize_ms"]
-                tot["wire_ms"] += st["wire_ms"]
+                self._fold_wire_stats_locked(st)
         return reply
 
     def measure_clock_offsets(self, pings: int = 5) -> Dict[str, dict]:
@@ -510,6 +647,7 @@ class FleetRouter:
                     st: Dict[str, Any] = {}
                     reply = handle.call(
                         {"op": "submit",
+                         "reply_transport": handle.transport,
                          "xray": {"trace_id": trace_id,
                                   "parent_span": "dispatch",
                                   "send_ns": time.time_ns()},
@@ -517,7 +655,8 @@ class FleetRouter:
                                      "tenant": req.tenant, "x": req.x,
                                      "iterations": req.iterations,
                                      "deadline_s": req.deadline_s}},
-                        timeout_s=self.submit_timeout_s, stats=st)
+                        timeout_s=self.submit_timeout_s, stats=st,
+                        shm_pool=self.shm)
                     if st:
                         span_args.update(
                             serialize_ms=st["serialize_ms"],
@@ -526,14 +665,8 @@ class FleetRouter:
                             bytes_in=st["bytes_in"])
                         st["worker"] = wid
                         with self._lock:
-                            self._wire_frames.append(st)
-                            tot = self._wire_totals
-                            tot["frames"] += 2
-                            tot["bytes_out"] += st["bytes_out"]
-                            tot["bytes_in"] += st["bytes_in"]
-                            tot["serialize_ms"] += st["serialize_ms"]
-                            tot["wire_ms"] += st["wire_ms"]
-            except (OSError, wire.WireError) as e:
+                            self._fold_wire_stats_locked(st)
+            except (OSError, wire.WireError, shm_mod.ShmError) as e:
                 self._on_worker_failure(wid, f"{type(e).__name__}: "
                                              f"{e}")
                 with self._lock:
@@ -588,13 +721,14 @@ class FleetRouter:
                 return
             self._dead.add(worker_id)
             death = {"worker_id": worker_id,
+                     "host_id": handle.host_id,
                      "error": error,
                      "health": h.snapshot(),
                      "exit_code": (handle.proc.poll()
                                    if handle.proc else None)}
             self._deaths.append(death)
         flight.record("fleet", "worker_dead", worker=worker_id,
-                      error=error)
+                      host=handle.host_id, error=error)
         if self.verbose:
             print(f"[graft-fleet {self.name}] worker {worker_id} "
                   f"declared dead ({error}); requeueing its work "
@@ -819,6 +953,13 @@ class FleetRouter:
         return {
             "fleet": self.name,
             "placement": self.placement,
+            "router_host": self.host_id,
+            "hosts": self.host_map(),
+            "live_hosts": self.live_hosts(),
+            "transports": {wid: h.transport
+                           for wid, h in sorted(self.workers.items())},
+            "shm_pool": (self.shm.stats() if self.shm is not None
+                         else None),
             "num_workers": len(self.workers),
             "live_workers": self.live_workers(),
             "dead_workers": dead_workers,
@@ -903,5 +1044,14 @@ class FleetRouter:
                 except (OSError, wire.WireError):
                     pass
             handle.reap(timeout_s=timeout_s)
+        if self.shm is not None:
+            # Leak/tear detection stays LOUD in the report (flight
+            # event + stderr) but must not mask the shutdown itself:
+            # a request that died mid-flight legitimately strands its
+            # pin, and close() reclaims the segments either way.
+            problems = self.shm.close(strict=False)
+            for p in problems:
+                print(f"[graft-fleet {self.name}] shm: {p}",
+                      file=sys.stderr, flush=True)
         flight.record("fleet", "router_down", fleet=self.name,
                       dead=sorted(self._dead))

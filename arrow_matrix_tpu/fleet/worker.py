@@ -49,6 +49,7 @@ import numpy as np
 
 from arrow_matrix_tpu import faults
 from arrow_matrix_tpu.faults.policy import RetryPolicy
+from arrow_matrix_tpu.fleet import shm
 from arrow_matrix_tpu.fleet import wire
 from arrow_matrix_tpu.ledger import store as ledger_store
 from arrow_matrix_tpu.obs import flight
@@ -95,11 +96,23 @@ class FleetWorker:
                  checkpoint_every: int = 2,
                  obs_dir: Optional[str] = None,
                  window_s: float = 0.25,
+                 host_id: Optional[str] = None,
+                 shm_slots: int = 0,
                  verbose: bool = False):
         self.worker_id = worker_id
         self.verbose = verbose
         self.obs_dir = obs_dir
         self.monitor = None
+        # graft-host: the worker's host fault domain (spawn env
+        # AMT_HOST_ID) and, when enabled, its reply-side segment pool
+        # — replies ride shm descriptors back to a same-host router.
+        # Reply publishes are unpinned: the worker cannot know when
+        # the remote reader is done, so slots recycle on demand and
+        # the generation stamp is the (loud) safety net.
+        self.host_id = host_id
+        self.shm = (shm.SegmentPool(slots=int(shm_slots),
+                                    name=f"amtw{os.getpid()}")
+                    if shm_slots > 0 else None)
         # graft-xray: one tracer per worker process; the scheduler and
         # Supervisor emit their spans into it, each stamped with the
         # fleet-level trace context entered at the wire (op_submit).
@@ -135,6 +148,8 @@ class FleetWorker:
         acct = self.server.accountant
         return {"ok": True, "worker_id": self.worker_id,
                 "pid": os.getpid(), "n_rows": self.n_rows,
+                "host_id": self.host_id,
+                "shm": self.shm is not None,
                 "budget_bytes": int(acct.budget_bytes),
                 "headroom_bytes": int(acct.headroom_bytes())}
 
@@ -244,6 +259,12 @@ class FleetWorker:
                       self.server.latency_samples_ms()}
         if self.monitor is not None:
             self.monitor.close()
+        if self.shm is not None:
+            # Reply segments are unpinned by design, so a clean close
+            # reports no leaks; anything it DOES report is real.
+            for p in self.shm.close(strict=False):
+                print(f"[graft-fleet {self.worker_id}] shm: {p}",
+                      file=sys.stderr, flush=True)
         if self.obs_dir:
             xray_mod.save_process_trace(
                 self.tracer,
@@ -287,9 +308,23 @@ def serve_worker(worker: FleetWorker, *, host: str = "127.0.0.1",
                 done.set()
                 return
             reply = worker.handle(msg)
+            # Mirror the transport the router asked for: shm replies
+            # ride this worker's own (unpinned) segment pool, raw
+            # replies the scatter-gather framing; anything else is
+            # the original json wire.
+            want = (msg.get("reply_transport")
+                    if isinstance(msg, dict) else None)
+            transport = "json"
+            pool = None
+            if want == "shm" and worker.shm is not None:
+                transport, pool = "shm", worker.shm
+            elif want == "raw":
+                transport = "raw"
             try:
-                wire.send_msg(self.request, reply, role="server")
-            except (OSError, wire.WireError):
+                wire.send_msg(self.request, reply, role="server",
+                              transport=transport, shm_pool=pool,
+                              pin=False)
+            except (OSError, wire.WireError, shm.ShmError):
                 pass
 
     class Server(socketserver.ThreadingTCPServer):
@@ -333,6 +368,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint_every", type=int, default=2)
     p.add_argument("--obs_dir", default=None)
     p.add_argument("--window_s", type=float, default=0.25)
+    p.add_argument("--shm_slots", type=int, default=16,
+                   help="reply-side segment pool size (armed only "
+                        "when the spawn env sets AMT_SHM=1)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -349,6 +387,12 @@ def main(argv=None) -> int:
         flight.install(os.path.join(args.obs_dir, "flight.json"))
     budget = (int(args.hbm_budget_mb * 2**20)
               if args.hbm_budget_mb > 0 else None)
+    # graft-host spawn env: the host fault domain this process belongs
+    # to, and whether to stand up the reply-side shm pool (the router
+    # only uses it for same-domain workers, but arming is cheap).
+    host_id = os.environ.get("AMT_HOST_ID")
+    shm_slots = (args.shm_slots
+                 if os.environ.get("AMT_SHM") == "1" else 0)
     worker = FleetWorker(
         args.worker_id, vertices=args.vertices, width=args.width,
         seed=args.seed, fmt=args.fmt, queue_capacity=args.queue,
@@ -356,12 +400,14 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         obs_dir=args.obs_dir, window_s=args.window_s,
+        host_id=host_id, shm_slots=shm_slots,
         verbose=args.verbose)
 
     def announce(port: int) -> None:
         print("FLEET_WORKER_READY " + json.dumps(
             {"worker_id": args.worker_id, "port": port,
-             "pid": os.getpid()}), flush=True)
+             "pid": os.getpid(), "host_id": host_id,
+             "shm": worker.shm is not None}), flush=True)
 
     try:
         serve_worker(worker, host=args.host, port=args.port,

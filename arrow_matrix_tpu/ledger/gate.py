@@ -94,6 +94,14 @@ LENS_RATIO_MAX = 2.0
 #: miscalibrated relative to its committed calibration.
 LENS_DRIFT_FACTOR = 1.5
 
+#: graft-host satellite: a non-exact traffic class (graft-xray
+#: ``iter_ms_<cls>`` records) must keep its latency within this
+#: factor of the exact class measured on the same structure/platform.
+#: Reduced-precision carriage that is byte-cheaper but TIME-slower is
+#: a regression the per-key band cannot see (each class drifts inside
+#: its own band); this cross-class check fails it loudly.
+XRAY_CLASS_FACTOR = 1.5
+
 
 def baseline_key(rec: Dict[str, Any]) -> str:
     return "|".join(str(rec.get(f)) for f in
@@ -304,6 +312,64 @@ def check_records(records: List[Dict[str, Any]],
                 f"perf regression: {key}: normalized {nv:.4g} {unit} "
                 f"> band {upper:.4g} (median {entry['median']:.4g}, "
                 f"MAD {entry['mad']:.4g}, n={entry['count']})")
+    f3, n3 = xray_class_problems(records, baseline)
+    failures += f3
+    notes += n3
+    return failures, notes
+
+
+def xray_class_problems(records: List[Dict[str, Any]],
+                        baseline: Dict[str, Any],
+                        factor: float = XRAY_CLASS_FACTOR
+                        ) -> Tuple[List[str], List[str]]:
+    """Cross-class latency check over graft-xray ``iter_ms_<cls>``
+    records (see :data:`XRAY_CLASS_FACTOR`).  Classes are compared on
+    the same ``(structure_hash, platform)`` cell; the exact reference
+    is the fresh exact measurement when this batch carries one, else
+    the committed baseline median for the exact key.  Same-batch
+    comparison on purpose: both numbers then share the host load, so
+    no load band is needed."""
+    failures: List[str] = []
+    notes: List[str] = []
+    fresh: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for rec in records:
+        metric = str(rec.get("metric") or "")
+        if rec.get("kind") != "xray" \
+                or not metric.startswith("iter_ms_") \
+                or is_degraded(rec):
+            continue
+        value = rec.get("value")
+        if value is None:
+            continue
+        cell = (str(rec.get("structure_hash")),
+                str(rec.get("platform")))
+        # Last write wins inside one batch — matches read_all order.
+        fresh.setdefault(cell, {})[metric[len("iter_ms_"):]] = \
+            float(value)
+    metrics = baseline.get("metrics", {})
+    for (shash, platform), by_cls in sorted(fresh.items()):
+        exact = by_cls.get("exact")
+        if exact is None:
+            key = "|".join(("xray", "iter_ms_exact", shash, platform))
+            entry = metrics.get(key)
+            if entry is not None:
+                exact = float(entry["median"])
+        for cls in sorted(by_cls):
+            if cls == "exact":
+                continue
+            if exact is None or exact <= 0:
+                notes.append(
+                    f"xray class {cls!r} has no exact reference "
+                    f"(structure {shash}, {platform}) — class band "
+                    f"skipped")
+                continue
+            v = by_cls[cls]
+            if v > factor * exact:
+                failures.append(
+                    f"class regression: iter_ms_{cls} = {v:.4g} ms > "
+                    f"{factor} x exact {exact:.4g} ms (structure "
+                    f"{shash}, {platform}) — byte-cheaper but "
+                    f"time-slower")
     return failures, notes
 
 

@@ -95,6 +95,15 @@ DECLARED_ORDER: Tuple[Tuple[str, str], ...] = (
     ("fleet_router", "health_monitor"),
     ("fleet_router", "flight_recorder"),
     ("fleet_router", "metrics_registry"),
+    # graft-host: the shm segment pool is a LEAF below the router —
+    # the data plane may be entered with routing state held, but pool
+    # methods never call back into the router (the reverse order is a
+    # witness violation by construction).
+    ("fleet_router", "shm_pool"),
+    # A router quorum coordinates member routers (submit fan-out,
+    # failover resubmission) while holding its own lock; each member
+    # then takes its fleet_router lock underneath.
+    ("router_quorum", "fleet_router"),
     # PulseMonitor.snapshot() reads the watchdog's burning set while
     # holding the pulse lock (one consistent ring document); the
     # watchdog never takes the pulse lock (on_burn dispatches with

@@ -74,6 +74,42 @@ def test_wire_torn_frame_is_loud():
         b.close()
 
 
+def test_wire_torn_frame_at_exact_header_boundary_both_sides():
+    """The 8-byte length header is the recovery pivot: a peer dying
+    ONE byte short of it and a peer dying EXACTLY on it (header
+    delivered, zero payload bytes) must both be loud, and the raw-
+    framing path must be as loud as the json path."""
+    # One byte short of the boundary: the header read itself tears.
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire._HEADER.pack(64)[:7])
+        a.close()
+        with pytest.raises(wire.WireError, match=r"mid-frame \(7/8"):
+            wire.recv_msg(b)
+    finally:
+        b.close()
+    # Exactly on the boundary: full header, then the payload tears
+    # at 0 of the promised 64 bytes.
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire._HEADER.pack(64))
+        a.close()
+        with pytest.raises(wire.WireError, match=r"mid-frame \(0/64"):
+            wire.recv_msg(b)
+    finally:
+        b.close()
+    # Same boundary on the raw-framing side (RAW_FLAG set): the json
+    # sub-header read is the first casualty.
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire._HEADER.pack(64 | wire.RAW_FLAG))
+        a.close()
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            wire.recv_msg(b)
+    finally:
+        b.close()
+
+
 def test_wire_oversized_header_is_refused():
     a, b = socket.socketpair()
     try:
@@ -127,6 +163,29 @@ def test_pack_tenants_first_fit_decreasing_with_explicit_unplaced():
     assert (a2, u2) == (assignment, unplaced)
 
 
+def test_pack_tenants_edge_cases():
+    # A zero-capacity worker is never assigned anything…
+    a, u = pack_tenants({"t": 1}, {"w0": 0, "w1": 10})
+    assert a == {"t": "w1"} and u == []
+    # …and when it is the ONLY worker, the tenant is explicitly
+    # unplaced, not silently admitted.
+    a, u = pack_tenants({"big": 5}, {"w0": 0})
+    assert a == {} and u == ["big"]
+    # A tenant larger than EVERY bin is unplaced without poisoning
+    # the placement of tenants that do fit.
+    a, u = pack_tenants({"huge": 1000, "ok": 10},
+                        {"w0": 64, "w1": 32})
+    assert a == {"ok": "w0"} and u == ["huge"]
+    # Equal-size ties break on tenant name, deterministically under
+    # dict-order permutation of BOTH inputs.
+    a1, u1 = pack_tenants({"b": 10, "a": 10, "c": 10},
+                          {"w0": 20, "w1": 10})
+    a2, u2 = pack_tenants({"c": 10, "a": 10, "b": 10},
+                          {"w1": 10, "w0": 20})
+    assert a1 == a2 == {"a": "w0", "b": "w0", "c": "w1"}
+    assert u1 == u2 == []
+
+
 # ---------------------------------------------------------------------------
 # Health: streak-gated death verdict, deterministic per-worker backoff
 # ---------------------------------------------------------------------------
@@ -166,6 +225,35 @@ def test_health_probe_backoff_is_per_worker_deterministic(monkeypatch):
     assert s0 == ladder("worker-0")       # reproducible per worker
     assert s0 != ladder("worker-1")       # but not herd-synchronized
     assert len(s0) == 2                   # sleeps BETWEEN 3 attempts
+
+
+def test_health_readmit_is_the_only_way_back():
+    """Death is sticky (record_ok never resurrects); readmit() is the
+    one explicit way back, resets the streak, and counts the
+    readmission so the fleet report shows a worker that died and came
+    back as exactly that."""
+    clock = [0.0]
+    hm = HealthMonitor(max_failures=2, clock=lambda: clock[0],
+                       sleep=lambda s: None)
+    hm.record_failure("w0", "down")
+    hm.record_failure("w0", "down")
+    assert hm.dead_workers() == ["w0"]
+    hm.record_ok("w0")                    # sticky: no resurrection
+    assert hm.dead_workers() == ["w0"]
+    clock[0] = 11.0
+    h = hm.readmit("w0")
+    assert h.alive and h.consecutive_failures == 0
+    assert h.last_error is None and h.declared_dead_s is None
+    assert h.readmissions == 1 and h.readmitted_s == 11.0
+    assert hm.alive_workers() == ["w0"]
+    assert hm.snapshot()["w0"]["readmissions"] == 1
+    # A readmitted worker needs a FRESH full streak to die again —
+    # and a second death + readmission counts separately.
+    hm.record_failure("w0", "blip")
+    assert hm.alive_workers() == ["w0"]
+    hm.record_failure("w0", "blip")
+    assert hm.dead_workers() == ["w0"]
+    assert hm.readmit("w0").readmissions == 2
 
 
 # ---------------------------------------------------------------------------

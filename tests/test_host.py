@@ -1,0 +1,368 @@
+"""graft-host unit + integration tests: host fault-domain slicing
+(contiguous blocks, per-rank jax.distributed env plans), the
+inter-host byte slice of a collective contract (priced + checked),
+the zero-copy shm data plane's LOUD failure modes (generation
+recycling, torn writes, leaks, pool exhaustion), and the
+shared-nothing router quorum (agreement proven, planted splits raise,
+router death fails accepted requests over to survivors with zero
+loss).  The full multi-process SIGKILL-a-host scenario lives in
+tools/fleet_gate.py (slow chaos-gate tier); the two-process
+jax.distributed mesh rehearsal here mirrors tests/test_multihost.py's
+CHILD_SKIP discipline.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu.analysis.prove import (
+    check_host_bytes,
+    fixture_contract,
+)
+from arrow_matrix_tpu.fleet import shm
+from arrow_matrix_tpu.fleet.health import HealthMonitor
+from arrow_matrix_tpu.fleet.host import (
+    QuorumDisagreement,
+    RouterQuorum,
+    host_of,
+    plan_host_mesh,
+)
+from arrow_matrix_tpu.fleet.router import FleetRouter, WorkerHandle
+from arrow_matrix_tpu.fleet.worker import FleetWorker, serve_worker
+from arrow_matrix_tpu.serve.request import Request
+
+
+# ---------------------------------------------------------------------------
+# Host fault-domain slicing
+# ---------------------------------------------------------------------------
+
+def test_host_of_contiguous_blocks():
+    # 4 ranks over 2 hosts: [0,1] -> host-0, [2,3] -> host-1.
+    assert [host_of(r, 4, 2) for r in range(4)] == \
+        ["host-0", "host-0", "host-1", "host-1"]
+    # Uneven split stays contiguous and uses every host.
+    doms = [host_of(r, 5, 2) for r in range(5)]
+    assert doms == ["host-0", "host-0", "host-0", "host-1", "host-1"]
+    # One host swallows everything; hosts == ranks is one rank each.
+    assert {host_of(r, 3, 1) for r in range(3)} == {"host-0"}
+    assert [host_of(r, 3, 3) for r in range(3)] == \
+        ["host-0", "host-1", "host-2"]
+    with pytest.raises(ValueError):
+        host_of(4, 4, 2)              # rank out of range
+    with pytest.raises(ValueError):
+        host_of(0, 2, 3)              # more hosts than ranks
+
+
+def test_plan_host_mesh_is_one_global_job_with_stamped_domains():
+    plan = plan_host_mesh(2, 2, coordinator="10.0.0.1", port=4321)
+    assert len(plan) == 4
+    for r, env in enumerate(plan):
+        assert env["AMT_FLEET_COORDINATOR"] == "10.0.0.1:4321"
+        assert env["AMT_FLEET_NUM_PROCESSES"] == "4"
+        assert env["AMT_FLEET_PROCESS_ID"] == str(r)
+    assert [env["AMT_HOST_ID"] for env in plan] == \
+        ["host-0", "host-0", "host-1", "host-1"]
+    with pytest.raises(ValueError):
+        plan_host_mesh(0, 2)
+
+
+def test_inter_host_bytes_pricing():
+    c = fixture_contract()               # step_bytes == 3072
+    # One host (or one device): nothing crosses a domain boundary.
+    assert c.inter_host_bytes(1, 8) == 0
+    # Ring: exactly the block-edge hops leave their host.
+    assert c.inter_host_bytes(2, 8) == round(3072 * 2 / 8)
+    assert c.inter_host_bytes(4, 8) == round(3072 * 4 / 8)
+    # All-to-all: 1 - (d/h - 1)/(d - 1) of the traffic is cross-host.
+    assert c.inter_host_bytes(2, 8, pattern="alltoall") == \
+        round(3072 * (1.0 - 3 / 7))
+    # Every device its own host: ALL traffic is inter-host.
+    assert c.inter_host_bytes(8, 8, pattern="alltoall") == 3072
+    with pytest.raises(ValueError):
+        c.inter_host_bytes(3, 8)         # uneven split
+    with pytest.raises(ValueError):
+        c.inter_host_bytes(2, 8, pattern="butterfly")
+
+
+def test_check_host_bytes_pass_and_fail():
+    c = fixture_contract()               # ratio_band (0.5, 2.0)
+    ideal = c.inter_host_bytes(2, 8)
+    assert check_host_bytes(c, 2, 8, ideal)["status"] == "pass"
+    assert check_host_bytes(c, 2, 8, 3 * ideal)["status"] == "fail"
+    # Zero promised: zero measured passes, anything else is loud.
+    assert check_host_bytes(c, 1, 8, 0)["status"] == "pass"
+    res = check_host_bytes(c, 1, 8, 100)
+    assert res["status"] == "fail" and "zero inter-host" in res["detail"]
+
+
+# ---------------------------------------------------------------------------
+# shm data plane: LOUD failure modes
+# ---------------------------------------------------------------------------
+
+def test_shm_roundtrip_is_bit_identical():
+    pool = shm.SegmentPool(slots=2, name="t_rt")
+    try:
+        x = (np.arange(4096, dtype=np.float32).reshape(64, 64)
+             * np.float32(0.25))
+        desc = pool.publish(x)
+        assert shm.is_descriptor(desc)
+        got = shm.read_descriptor(desc)
+        assert got.dtype == x.dtype and got.shape == x.shape
+        assert got.tobytes() == x.tobytes()
+        assert pool.release(desc)
+        assert not pool.release(desc)    # second release is a no-op
+    finally:
+        pool.close()
+
+
+def test_shm_recycled_generation_is_loud():
+    pool = shm.SegmentPool(slots=1, name="t_gen")
+    try:
+        stale = pool.publish(np.ones(8, dtype=np.float32), pin=False)
+        # pin=False: the single slot is immediately recyclable, so the
+        # next publish overwrites it with a bumped generation…
+        pool.publish(np.zeros(8, dtype=np.float32), pin=False)
+        # …and the stale descriptor must refuse, never hand over the
+        # other payload's bytes.
+        with pytest.raises(shm.ShmGenerationError, match="recycled"):
+            shm.read_descriptor(stale)
+    finally:
+        pool.close()
+
+
+def test_shm_torn_write_is_loud_on_read_and_close():
+    pool = shm.SegmentPool(slots=1, name="t_torn")
+    desc = pool.publish(np.ones(8, dtype=np.float32))
+    # Simulate a writer SIGKILLed mid-copy: the header carries the
+    # tear sentinel (publish stamps it before the payload move).
+    slot = pool._slots[0]
+    slot.seg.buf[:shm._SHM_HEADER.size] = shm._SHM_HEADER.pack(
+        shm._MAGIC, shm.TEAR_SENTINEL, 32)
+    with pytest.raises(shm.ShmGenerationError, match="torn write"):
+        shm.read_descriptor(desc)
+    # close() reports the torn segment (and the still-pinned leak).
+    problems = pool.close(strict=False)
+    assert any("torn segment" in p for p in problems)
+    assert any("leaked segment" in p for p in problems)
+    assert desc  # descriptor itself outlives the pool harmlessly
+
+
+def test_shm_leak_is_loud_under_strict_close():
+    pool = shm.SegmentPool(slots=2, name="t_leak")
+    pool.publish(np.ones(16, dtype=np.float32))   # pinned, never released
+    with pytest.raises(shm.ShmLeakError, match="leaked segment"):
+        pool.close(strict=True)
+    # close() is idempotent after the strict failure already unlinked.
+    assert pool.close(strict=True) == []
+
+
+def test_shm_pool_exhaustion_is_loud_not_silent():
+    pool = shm.SegmentPool(slots=1, name="t_full")
+    try:
+        pool.publish(np.ones(8, dtype=np.float32))    # pins the slot
+        with pytest.raises(shm.ShmError, match="exhausted"):
+            pool.publish(np.ones(8, dtype=np.float32))
+    finally:
+        pool.close(strict=False)
+
+
+def test_buffer_ring_recycles_and_grows():
+    ring = shm.BufferRing(slots=2, slot_bytes=16)
+    a = ring.take(8)
+    a[:] = b"\x01" * 8
+    b = ring.take(8)
+    assert ring.takes == 2 and ring.grown == 0
+    # Slot 0 comes back around; a frame over every slab grows one.
+    c = ring.take(64)
+    assert len(c) == 64 and ring.grown == 1
+    assert bytes(b[:1]) == b"\x00"       # distinct slab, untouched
+
+
+# ---------------------------------------------------------------------------
+# Router quorum over one in-process worker fleet
+# ---------------------------------------------------------------------------
+
+def _start_worker(worker_id, checkpoint_dir):
+    worker = FleetWorker(worker_id, vertices=64, width=16, seed=5,
+                         checkpoint_dir=checkpoint_dir,
+                         checkpoint_every=1)
+    ready = threading.Event()
+    box = {}
+
+    def announce(port):
+        box["port"] = port
+        ready.set()
+
+    th = threading.Thread(target=serve_worker, args=(worker,),
+                          kwargs={"port": 0, "announce": announce},
+                          daemon=True)
+    th.start()
+    assert ready.wait(120), f"{worker_id} never bound"
+    return worker, box["port"]
+
+
+@pytest.fixture()
+def two_router_quorum(tmp_path):
+    """Two shared-nothing routers attached to the same two in-process
+    workers (fresh WorkerHandle instances per router — routers share
+    NOTHING but the worker endpoints and the checkpoint dir)."""
+    ckpt = str(tmp_path / "ckpt")
+    workers, ports = [], {}
+    for wid in ("w0", "w1"):
+        w, port = _start_worker(wid, ckpt)
+        workers.append(w)
+        ports[wid] = port
+    routers = {
+        name: FleetRouter(
+            handles=[WorkerHandle(wid, "127.0.0.1", ports[wid])
+                     for wid in ports],
+            health=HealthMonitor(timeout_s=5.0, max_failures=3),
+            name=f"quorum-{name}")
+        for name in ("A", "B")}
+    try:
+        yield RouterQuorum(routers), routers
+    finally:
+        for r in routers.values():
+            r.shutdown()
+        for w in workers:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+
+def test_quorum_rejects_bad_membership():
+    class _Fake:
+        def __init__(self, workers):
+            self.workers = workers
+
+    with pytest.raises(ValueError, match=">= 2 routers"):
+        RouterQuorum({"A": _Fake({"w0": 1})})
+    with pytest.raises(ValueError, match="different worker sets"):
+        RouterQuorum({"A": _Fake({"w0": 1}), "B": _Fake({"w1": 1})})
+
+
+def test_quorum_agreement_and_planted_splits(two_router_quorum,
+                                             monkeypatch):
+    quorum, routers = two_router_quorum
+    tenants = [f"t{i}" for i in range(16)]
+    ks = {t: 2 for t in tenants}
+
+    doc = quorum.verify_agreement(tenants, tenant_ks=ks)
+    assert doc["agreed"] and doc["routers"] == ["A", "B"]
+    assert set(doc["placement"].values()) <= {"w0", "w1"}
+    assert doc["packing"] is not None
+
+    # Planted membership split: B loses a worker from its ring, so
+    # the two routers place SOME tenant differently — loud.
+    routers["B"].ring.remove("w0")
+    with pytest.raises(QuorumDisagreement, match="placement split"):
+        quorum.verify_agreement(tenants)
+    routers["B"].ring.add("w0")
+    quorum.verify_agreement(tenants)     # restored: agreement again
+
+    # Planted packing split: B computes a different FFD assignment.
+    real_plan = routers["A"].plan_packing(ks)
+    forged = {"assignment": dict(real_plan["assignment"]),
+              "unplaced": list(real_plan["unplaced"])}
+    if forged["assignment"]:
+        t0 = sorted(forged["assignment"])[0]
+        forged["assignment"][t0] = (
+            "w1" if forged["assignment"][t0] == "w0" else "w0")
+    monkeypatch.setattr(routers["B"], "plan_packing",
+                        lambda tenant_ks: forged)
+    with pytest.raises(QuorumDisagreement, match="packing split"):
+        quorum.verify_agreement(tenants, tenant_ks=ks)
+
+
+def test_quorum_failover_loses_nothing(two_router_quorum):
+    quorum, routers = two_router_quorum
+    n = routers["A"].n_rows
+    x = np.ones((n, 2), dtype=np.float32)
+    tickets = [quorum.submit(Request(f"q{i:02d}", f"t{i % 3}", x, 8))
+               for i in range(6)]
+    # Round-robin fan-in: both members accepted requests.
+    assert all(quorum.summary()["accepted_per_router"][m] == 3
+               for m in ("A", "B"))
+
+    moved = quorum.fail_router("B")
+    assert quorum.live_routers() == ["A"]
+    assert quorum.fail_router("B") == []      # idempotent
+    quorum.drain(timeout_s=180)
+
+    results = quorum.results()
+    assert sorted(results) == [f"q{i:02d}" for i in range(6)]
+    assert all(t.status == "completed" for t in results.values())
+    s = quorum.summary()
+    assert s["lost_requests"] == []
+    assert s["failed_routers"] == ["B"]
+    assert s["failovers"] == len(moved)
+    assert s["status_counts"] == {"completed": 6}
+    assert len(tickets) == 6
+    with pytest.raises(RuntimeError, match="last quorum member"):
+        quorum.fail_router("A")
+
+
+# ---------------------------------------------------------------------------
+# Two-process jax.distributed mesh rehearsal (CHILD_SKIP discipline of
+# tests/test_multihost.py: environments without working gloo skip).
+# ---------------------------------------------------------------------------
+
+MESH_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from arrow_matrix_tpu.utils.platform import force_cpu_devices
+force_cpu_devices(1)
+from arrow_matrix_tpu.fleet.worker import maybe_init_distributed
+try:
+    joined = maybe_init_distributed()
+except Exception as e:
+    print(f"CHILD_SKIP {{type(e).__name__}}: {{e}}", flush=True)
+    sys.exit(0)
+import jax
+print("JOINED", joined, jax.process_count(), jax.device_count(),
+      os.environ.get("AMT_HOST_ID"), flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_plan_host_mesh_two_process_rehearsal():
+    """Each rank of a 2-host x 1-proc plan joins ONE global mesh via
+    the AMT_FLEET_* env hooks and sees both hosts' devices — the
+    jax.distributed rehearsal behind FleetRouter(hosts=2)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    plan = plan_host_mesh(2, 1, port=_free_port())
+    procs = []
+    for env_extra in plan:
+        env = dict(os.environ)
+        env.update(env_extra)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", "-c",
+             MESH_CHILD.format(repo=repo)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any("CHILD_SKIP" in out for out, _ in outs):
+        pytest.skip(f"jax.distributed unavailable here: {outs}")
+    for rank, (out, err) in enumerate(outs):
+        want = f"JOINED True 2 2 host-{rank}"
+        assert want in out, (rank, out, err)

@@ -434,6 +434,55 @@ def test_fixture_planted_regression_trips():
 
 
 # ---------------------------------------------------------------------------
+# drift gate: cross-class iter_ms bands (graft-host satellite —
+# "byte-cheaper but time-slower fails loudly")
+
+
+def _xray(lg, metric, value, ts=2000.0):
+    return lg.record("xray", metric, value, unit="ms",
+                     structure_hash="s0", platform="cpu",
+                     device_kind="host", host_load=0.0,
+                     git_rev=None, ts_unix=ts, payload={})
+
+
+def test_xray_class_band_trips_on_time_slower_class(tmp_path):
+    """A traffic class that saves wire bytes must not quietly cost
+    wall time: iter_ms_<cls> beyond XRAY_CLASS_FACTOR x the exact
+    class's iter_ms in the SAME run fails the gate."""
+    lg = _mk(tmp_path)
+    baseline = _steady_baseline(lg)
+    exact = _xray(lg, "iter_ms_exact", 10.0)
+    fine = _xray(lg, "iter_ms_approx", 12.0, ts=2001.0)
+    failures, _ = gate.check_records([exact, fine], baseline)
+    assert failures == []                  # 1.2x: inside the band
+    slow = _xray(lg, "iter_ms_approx", 20.0, ts=2002.0)
+    failures, _ = gate.check_records([exact, slow], baseline)
+    assert any("class regression" in f
+               and "byte-cheaper but time-slower" in f
+               for f in failures)
+
+
+def test_xray_class_band_falls_back_to_baseline_exact(tmp_path):
+    """With no fresh exact record, the reference is the baseline's
+    iter_ms_exact median; with NO exact reference anywhere the check
+    is skipped with a note, never silently passed as judged."""
+    lg = _mk(tmp_path)
+    for i, v in enumerate([10.0, 10.1, 9.9]):
+        _xray(lg, "iter_ms_exact", v, ts=1000.0 + i)
+    baseline = gate.build_baseline(lg.read_all())
+    slow = _xray(lg, "iter_ms_approx", 30.0)
+    failures, _ = gate.check_records([slow], baseline)
+    assert any("class regression" in f for f in failures)
+    # No exact reference at all: note, not a silent pass.
+    lg2 = _mk(tmp_path, "lg2")
+    lone = _xray(lg2, "iter_ms_approx", 30.0)
+    failures, notes = gate.check_records(
+        [lone], gate.build_baseline([]))
+    assert failures == []
+    assert any("class band skipped" in n for n in notes)
+
+
+# ---------------------------------------------------------------------------
 # crash-window contract (utils/artifacts)
 
 

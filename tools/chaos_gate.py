@@ -37,6 +37,12 @@ checkpoint resume), and that the recovered run's final X is
              is exact on synthetic points, and a planted out-of-band
              calibration ratio record trips the ledger gate's lens
              band.
+  host_kill— graft-host kill-a-host rung (fast list, bounded): a
+             4-worker fleet split into two host fault domains loses
+             ALL of host-1 to one simultaneous SIGKILL mid-batch;
+             the router must bury exactly that domain, requeue its
+             in-flight work onto host-0, and lose zero accepted
+             requests.
 
 Plus the graft-serve chaos-under-load matrix (tools/serve_gate.py):
 serve_hang / serve_corrupt / serve_overflow / serve_hbm in-process
@@ -415,6 +421,92 @@ def scenario_lens(workdir):
     return problems
 
 
+def scenario_host_kill(workdir):
+    """graft-host kill-a-host rung (fast list): a bounded 2-domain
+    fleet — 4 spawned workers split into host-0/host-1 — loses ALL of
+    host-1 to one simultaneous SIGKILL mid-batch.  The router must
+    bury exactly that domain (deaths probed to a verdict through the
+    real heartbeat ladder), requeue its accepted-but-unfinished
+    requests onto host-0, and lose zero accepted requests.  Bounded
+    enough for the fast list: tiny operator, 8 requests; the
+    full-size CLI twin with bit-identity + resume-log + shm-ledger
+    checks is tools/fleet_gate.py:scenario_fleet_host_kill."""
+    import time as time_mod
+
+    import numpy as np
+
+    from arrow_matrix_tpu.fleet.router import FleetRouter
+    from arrow_matrix_tpu.serve import request as rq
+    from arrow_matrix_tpu.serve.loadgen import synthetic_trace
+
+    problems = []
+    router = FleetRouter(
+        spawn=4, hosts=2, vertices=96, width=16, seed=SEED,
+        fmt="fold",
+        checkpoint_dir=os.path.join(workdir, "host_kill_ckpt"),
+        name="hostchaos")
+    try:
+        hm = router.host_map()
+        if sorted(hm) != ["host-0", "host-1"] \
+                or hm["host-1"] != ["worker-2", "worker-3"]:
+            return [f"host_kill: 4 workers did not split into two "
+                    f"contiguous domains: {hm}"]
+        trace = synthetic_trace(router.n_rows, tenants=5, requests=8,
+                                k=2, iterations=4, seed=5)
+        tickets = [router.submit(r) for r in trace]
+        # Mid-batch: let the fleet prove it accepted work, then take
+        # the whole domain down at once and probe the victims to a
+        # verdict (the same wire ladder a dispatch failure walks).
+        deadline = time_mod.monotonic() + 120
+        while time_mod.monotonic() < deadline:
+            if any(t.status in rq.TERMINAL for t in tickets):
+                break
+            time_mod.sleep(0.02)
+        victims = router.kill_host("host-1")
+        for wid in victims:
+            router._on_worker_failure(wid, "host-1 killed (chaos)")
+        router.drain(timeout_s=240)
+        summ = router.fleet_summary()
+        if sorted(summ["dead_workers"]) != sorted(victims):
+            problems.append(
+                f"host_kill: buried {summ['dead_workers']} != the "
+                f"whole killed domain {sorted(victims)} (and only "
+                f"it)")
+        if summ.get("live_hosts") != ["host-0"]:
+            problems.append(f"host_kill: live hosts "
+                            f"{summ.get('live_hosts')} != ['host-0']")
+        lost = [t.request.request_id for t in tickets
+                if t.status not in rq.TERMINAL]
+        if lost:
+            problems.append(f"host_kill: LOST requests {lost}")
+        if summ["failed"]:
+            problems.append(f"host_kill: {summ['failed']} request(s) "
+                            f"failed instead of requeueing")
+        if summ["completed"] + summ["shed"] + summ["rejected"] \
+                != len(tickets):
+            problems.append(
+                f"host_kill: zero-loss violated — {summ['completed']}"
+                f" completed + {summ['shed'] + summ['rejected']} "
+                f"explicitly shed != {len(tickets)} accepted")
+        if summ["requeues"] < 1:
+            problems.append("host_kill: the domain died with no "
+                            "request requeued — the kill landed "
+                            "outside the in-flight window")
+        # Deterministic completions even across the requeue: every
+        # completed result is finite and the right shape (the full
+        # bit-identity bar lives in the fleet gate's CLI twin).
+        for t in tickets:
+            if t.status == rq.COMPLETED:
+                if t.result is None \
+                        or not np.all(np.isfinite(t.result)):
+                    problems.append(f"host_kill: completed request "
+                                    f"{t.request.request_id} carries "
+                                    f"a bad result")
+    finally:
+        router.shutdown()
+    return problems
+
+
 def scenario_xray_kill(workdir):
     """graft-xray under SIGKILL: the fleet_kill scenario's merged
     trace must still carry the victim's track — rebuilt from the
@@ -510,6 +602,12 @@ def run_gate(workdir, fast=False):
         # ledger-gate call.
         scenarios.append("lens")
         problems += scenario_lens(workdir)
+        # graft-host rides the fast list: the kill-a-host rung on a
+        # BOUNDED 2-domain fleet (tiny operator, 8 requests) — losing
+        # a whole fault domain at once must never lose an accepted
+        # request, fast mode or not.
+        scenarios.append("host_kill")
+        problems += scenario_host_kill(workdir)
         # The serving matrix rides the same gate (tools/serve_gate.py):
         # chaos under multi-tenant load with the same detected/
         # recovered/bit-identical contract.

@@ -20,6 +20,20 @@ worker processes through the ``graft_fleet`` CLI:
   checkpoint (the ``resumed request`` line in its log — replayed work
   is resumed, not recomputed), and every surviving result is
   bit-identical to the fault-free single-process replay.
+* **fleet_host_kill** (graft-host) — N=4 workers in TWO host fault
+  domains; mid-batch, EVERY worker of host-1 is SIGKILLed at once
+  (``--kill_host``) and probed to a verdict through the heartbeat
+  ladder.  Required outcome: exactly host-1's workers buried, zero
+  accepted-request loss, requeue + checkpoint RESUME on a host-0
+  survivor, every completed result bit-identical to the fault-free
+  single-process replay, and the same-host shm wire demonstrably
+  carried payload (``wire_shm_bytes > 0``).
+* **router_quorum** (graft-host) — two shared-nothing routers over
+  ONE spawned worker set: provably identical placement + FFD packing
+  with no tenant double-admitted (``RouterQuorum.verify_agreement``),
+  then one router dies mid-batch (``fail_router``) and its accepted
+  requests fail over to the survivor with zero loss, results
+  bit-identical to the fault-free replay.
 
 Registered in tools/chaos_gate.py's matrix (subprocess scenarios skip
 under ``--fast``, like serve_kill).  Standalone:
@@ -43,15 +57,21 @@ SEED, TRACE_SEED = 11, 5
 #: that it accepted work, early enough that the work is unfinished.
 KILL_AFTER = 6
 
+#: Iterations for the host-kill scenario: long enough that a request
+#: is mid-flight for many step+checkpoint cycles, so the router-side
+#: domain SIGKILL reliably lands between a checkpoint save and the
+#: request's completion (the resume-not-recompute window).
+HOST_KILL_ITERS = 24
+
 
 def _nearest_rank(vals, q):
     s = sorted(vals)
     return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
 
 
-def _reference_results(workdir):
+def _reference_results(workdir, k=K, iters=ITERS):
     """Fault-free single-process replay of the gate trace: the
-    bit-identity reference both scenarios compare against."""
+    bit-identity reference the scenarios compare against."""
     from arrow_matrix_tpu.serve.loadgen import (
         ba_executor_factory,
         run_trace,
@@ -62,7 +82,7 @@ def _reference_results(workdir):
     factory, n_rows = ba_executor_factory(N, WIDTH, SEED, fmt="fold")
     server = ArrowServer(factory, ExecConfig(), name="fleet-ref")
     trace = synthetic_trace(n_rows, tenants=TENANTS,
-                            requests=REQUESTS, k=K, iterations=ITERS,
+                            requests=REQUESTS, k=k, iterations=iters,
                             seed=TRACE_SEED)
     tickets = run_trace(server, trace)
     out = {}
@@ -265,6 +285,161 @@ def scenario_fleet_kill(workdir, ref):
     return problems
 
 
+def scenario_fleet_host_kill(workdir, ref):
+    """Kill-a-host survival: both host-1 workers SIGKILLed AT ONCE
+    mid-batch (graft-host acceptance).  Runs at ``K=4`` — a 96x4 f32
+    request (1536 B) clears ``shm.SHM_MIN_BYTES``, so the same-host
+    wire demonstrably carries payload via descriptors — and at
+    ``HOST_KILL_ITERS`` iterations so the domain SIGKILL lands inside
+    a checkpointed request; ``ref`` must be the matching replay."""
+    r, verdict, run_dir, npz = _run_fleet_cli(
+        workdir, "host_kill", 4,
+        ["--hosts", "2", "--kill_host", "host-1", "--measure_wire",
+         "--k", "4", "--iterations", str(HOST_KILL_ITERS)])
+    if r.returncode != 0 or verdict is None:
+        return [f"fleet_host_kill: run failed rc={r.returncode}: "
+                f"{r.stderr[-500:]}"]
+    problems = []
+    domain = sorted((verdict.get("hosts") or {}).get("host-1") or [])
+    if domain != ["worker-2", "worker-3"]:
+        problems.append(f"fleet_host_kill: host-1 domain {domain} != "
+                        f"['worker-2', 'worker-3'] (contiguous "
+                        f"2-host split of 4 workers)")
+    if sorted(verdict["dead_workers"]) != domain:
+        problems.append(
+            f"fleet_host_kill: buried {verdict['dead_workers']} != "
+            f"the whole killed domain {domain} (and only it)")
+    if "host-0" not in (verdict.get("live_hosts") or []) \
+            or "host-1" in (verdict.get("live_hosts") or []):
+        problems.append(f"fleet_host_kill: live hosts "
+                        f"{verdict.get('live_hosts')} != ['host-0']")
+    accounted = (verdict["completed"] + verdict["failed"]
+                 + verdict["shed"] + verdict["rejected"])
+    if accounted != REQUESTS:
+        problems.append(f"fleet_host_kill: {REQUESTS - accounted} "
+                        f"request(s) LOST (no terminal state)")
+    if verdict["failed"]:
+        problems.append(f"fleet_host_kill: {verdict['failed']} "
+                        f"request(s) failed instead of requeueing")
+    shed_explicit = sum((verdict.get("shed_reasons") or {}).values())
+    if verdict["completed"] + shed_explicit != REQUESTS:
+        problems.append(
+            f"fleet_host_kill: zero-loss violated — "
+            f"{verdict['completed']} completed + {shed_explicit} "
+            f"explicitly shed != {REQUESTS} accepted")
+    if verdict["requeues"] < 1:
+        problems.append("fleet_host_kill: the domain died with no "
+                        "request requeued — the kill landed outside "
+                        "the in-flight window")
+    resumed = False
+    for wid in ("worker-0", "worker-1"):
+        log = os.path.join(run_dir, wid, "worker.log")
+        try:
+            with open(log, encoding="utf-8") as fh:
+                if "resumed request" in fh.read():
+                    resumed = True
+        except OSError:
+            continue
+    if not resumed:
+        problems.append("fleet_host_kill: no host-0 survivor resumed "
+                        "a checkpointed request (requeued work was "
+                        "recomputed, not resumed)")
+    # The same-host data plane must actually have carried payload via
+    # shm descriptors, and the measured shm wire must be cheaper per
+    # MB than the base64 envelope it replaces.
+    if not verdict.get("wire_shm_bytes"):
+        problems.append("fleet_host_kill: wire_shm_bytes == 0 — the "
+                        "same-host shm data plane carried nothing")
+    wm = verdict.get("wire_measured") or {}
+    shm_ms = (wm.get("shm") or {}).get("serialize_ms_per_mb")
+    b64_ms = (wm.get("base64") or {}).get("serialize_ms_per_mb")
+    if shm_ms is None or b64_ms is None or shm_ms >= b64_ms:
+        problems.append(f"fleet_host_kill: shm serialize "
+                        f"{shm_ms} ms/MB is not cheaper than base64 "
+                        f"{b64_ms} ms/MB")
+    with open(os.path.join(run_dir, "fleet_report.json"),
+              encoding="utf-8") as fh:
+        report = json.load(fh)
+    completed_ids = sorted(t["request_id"] for t in report["tickets"]
+                           if t["status"] == "completed")
+    problems += _check_bit_identity("fleet_host_kill", npz, ref,
+                                    expect_ids=completed_ids)
+    problems += _check_exact_pooled_p99("fleet_host_kill", run_dir)
+    return problems
+
+
+def scenario_router_quorum(workdir, ref):
+    """Two shared-nothing routers over one worker set: provable
+    placement agreement, no double-admit, router-death failover with
+    zero accepted-request loss (graft-host acceptance)."""
+    import dataclasses
+
+    from arrow_matrix_tpu.fleet.host import (
+        QuorumDisagreement,
+        RouterQuorum,
+    )
+    from arrow_matrix_tpu.fleet.router import FleetRouter
+    from arrow_matrix_tpu.serve.loadgen import synthetic_trace
+
+    ckpt = os.path.join(workdir, "quorum_checkpoints")
+    problems = []
+    routerA = FleetRouter(spawn=3, hosts=1, vertices=N, width=WIDTH,
+                          seed=SEED, fmt="fold", checkpoint_dir=ckpt,
+                          name="quorumA")
+    routerB = None
+    try:
+        clones = [dataclasses.replace(h, proc=None,
+                                      meta=dict(h.meta))
+                  for h in routerA.workers.values()]
+        routerB = FleetRouter(handles=clones, vertices=N, width=WIDTH,
+                              seed=SEED, fmt="fold",
+                              checkpoint_dir=ckpt, name="quorumB")
+        quorum = RouterQuorum({"A": routerA, "B": routerB})
+        trace = synthetic_trace(routerA.n_rows, tenants=TENANTS,
+                                requests=REQUESTS, k=K,
+                                iterations=ITERS, seed=TRACE_SEED)
+        tenants = sorted({r.tenant for r in trace})
+        try:
+            doc = quorum.verify_agreement(
+                tenants, tenant_ks={t: K for t in tenants})
+        except QuorumDisagreement as e:
+            return [f"router_quorum: placement split between "
+                    f"shared-nothing routers: {e}"]
+        if not doc["agreed"] or doc["packing"] is None:
+            problems.append(f"router_quorum: agreement doc "
+                            f"incomplete: {doc}")
+        tickets = [quorum.submit(r) for r in trace]
+        moved = quorum.fail_router("B")
+        if not moved:
+            problems.append("router_quorum: router B died holding no "
+                            "unfinished request — the failover window "
+                            "was empty (retune the trace)")
+        quorum.drain(timeout_s=300)
+        summ = quorum.summary()
+        if summ["lost_requests"]:
+            problems.append(f"router_quorum: LOST requests after "
+                            f"failover: {summ['lost_requests']}")
+        if summ["status_counts"].get("completed", 0) != REQUESTS:
+            problems.append(f"router_quorum: {summ['status_counts']} "
+                            f"!= {REQUESTS} completed")
+        results = quorum.results()
+        for rid, ticket in sorted(results.items()):
+            if ticket.result is None:
+                problems.append(f"router_quorum: {rid} completed "
+                                f"with no result array")
+            elif rid in ref \
+                    and ticket.result.tobytes() != ref[rid]:
+                problems.append(
+                    f"router_quorum: {rid} is not bit-identical to "
+                    f"the fault-free single-process replay")
+        del tickets
+    finally:
+        if routerB is not None:
+            routerB.shutdown()
+        routerA.shutdown()
+    return problems
+
+
 def run_fleet_scenarios(workdir, fast=False):
     """Run the fleet matrix; returns (problems, scenarios_run).
     Subprocess scenarios (all of them — the fleet IS processes) skip
@@ -272,13 +447,17 @@ def run_fleet_scenarios(workdir, fast=False):
     if fast:
         return [], []
     ref = _reference_results(workdir)
-    if ref is None:
+    ref4 = _reference_results(workdir, k=4, iters=HOST_KILL_ITERS)
+    if ref is None or ref4 is None:
         return (["fleet reference: fault-free single-process replay "
                  "did not complete every request"], [])
     problems = []
-    scenarios = ["fleet_baseline", "fleet_kill"]
+    scenarios = ["fleet_baseline", "fleet_kill", "fleet_host_kill",
+                 "router_quorum"]
     problems += scenario_fleet_baseline(workdir, ref)
     problems += scenario_fleet_kill(workdir, ref)
+    problems += scenario_fleet_host_kill(workdir, ref4)
+    problems += scenario_router_quorum(workdir, ref)
     return problems, scenarios
 
 
