@@ -767,6 +767,8 @@ def certify_candidate_opts(kernel_opts: Optional[dict], k: int, *,
     if stream and not cc.supports_k(k):
         return (f"kcert: streaming pallas_sell needs k % "
                 f"{cc.stream_k_multiple} == 0 on chip (k={k})")
+    if feature_dtype is None:
+        feature_dtype = opts.get("feature_dtype")
     try:
         carriage, _dt = ps.resolve_carriage_dtype(feature_dtype)
     except ValueError as exc:
@@ -774,27 +776,59 @@ def certify_candidate_opts(kernel_opts: Optional[dict], k: int, *,
     if carriage not in cc.carriage_dtypes:
         return (f"kcert: carriage dtype {carriage!r} outside the "
                 f"contract ({cc.carriage_dtypes})")
-    rb = int(opts.get("row_block", ps.DEFAULT_ROW_BLOCK))
-    wave = int(opts.get("wave", ps.DEFAULT_WAVE))
-    ring = int(opts.get("ring", ps.DEFAULT_RING))
-    budget = opts.get("smem_cols_budget")
-    # Mimic the runtime's rb/wave normalization; ring and budgets are
-    # taken literally (they are what the plan will execute with).
-    rb = max(cc.granule, rb - rb % cc.granule)
-    w = min(wave, rb)
-    while w > 1 and rb % w:
-        w -= 1
-    try:
-        meta = ps.slab_call_meta(
-            m_t, ps.slab_rows(m_t, rb, budget), k, rb, True, stream,
-            w, ring, carriage=carriage, smem_cols_budget=budget)
-    except (ValueError, ZeroDivisionError) as exc:
-        return f"kcert: {exc}"
-    findings = check_meta(meta)
-    if findings:
-        f0 = findings[0]
-        return f"kcert: {f0.rule}: {f0.message}"
-    return None
+
+    def _point(rb, wave, ring, budget, pt_carriage, pt_m_t):
+        # Mimic the runtime's rb/wave normalization; ring and budgets
+        # are taken literally (they are what the plan executes with).
+        rb = max(cc.granule, int(rb) - int(rb) % cc.granule)
+        w = min(int(wave), rb)
+        while w > 1 and rb % w:
+            w -= 1
+        try:
+            meta = ps.slab_call_meta(
+                pt_m_t, ps.slab_rows(pt_m_t, rb, budget), k, rb, True,
+                stream, w, int(ring), carriage=pt_carriage,
+                smem_cols_budget=budget)
+        except (ValueError, ZeroDivisionError) as exc:
+            return f"kcert: {exc}"
+        findings = check_meta(meta)
+        if findings:
+            f0 = findings[0]
+            return f"kcert: {f0.rule}: {f0.message}"
+        return None
+
+    schedule = opts.get("schedule")
+    if schedule:
+        # graft-synth per-level schedule: certify EVERY tier's
+        # concretized point with its own knobs and realized slot width
+        # — one uncertifiable tier prunes the whole candidate.
+        try:
+            sched = ps._schedule_overrides(schedule)
+        except (ValueError, TypeError) as exc:
+            return f"kcert: {exc}"
+        for t in sorted(sched):
+            ov = sched[t]
+            pt_c = ov.get("carriage", carriage)
+            if pt_c == "int8":
+                return (f"kcert: tier {t}: per-tier int8 carriage is "
+                        f"not schedulable (whole-call quantization)")
+            if pt_c not in cc.carriage_dtypes:
+                return (f"kcert: tier {t}: carriage {pt_c!r} outside "
+                        f"the contract ({cc.carriage_dtypes})")
+            why = _point(
+                ov.get("row_block", opts.get("row_block",
+                                             ps.DEFAULT_ROW_BLOCK)),
+                ov.get("wave", opts.get("wave", ps.DEFAULT_WAVE)),
+                ov.get("ring", opts.get("ring", ps.DEFAULT_RING)),
+                ov.get("smem_cols_budget", opts.get("smem_cols_budget")),
+                pt_c, int(ov.get("m_t", m_t)) or m_t)
+            if why is not None:
+                return f"kcert: tier {t}: {why[len('kcert: '):]}"
+        return None
+    return _point(opts.get("row_block", ps.DEFAULT_ROW_BLOCK),
+                  opts.get("wave", ps.DEFAULT_WAVE),
+                  opts.get("ring", ps.DEFAULT_RING),
+                  opts.get("smem_cols_budget"), carriage, m_t)
 
 
 # ---------------------------------------------------------------------------

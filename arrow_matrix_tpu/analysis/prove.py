@@ -660,6 +660,37 @@ def _entries(n: int, width: int, k: int, n_dev: int):
         "scan": (mfi._scan_steps_donated, args, {"n": 2}),
     })
 
+    # -- graft-synth generated program (H1-H7 over a synthesized ------
+    # per-level schedule): the fold executor running the degree-ladder-
+    # derived schedule through the fused kernel.  Zero-comm contract —
+    # a generated schedule repartitions slabs, it must introduce no new
+    # collective kinds and hold the fold's copy discipline: the
+    # contract budget grows by one declared 8-copy loop-state set per
+    # scheduled tier (scalar/index-sized carried state of each tier's
+    # streaming loop under interpret lowering), and H6 still forbids
+    # any (rows, k) slab-sized copy or transpose in the hot loop.
+    from arrow_matrix_tpu.tune.fingerprint import structure_fingerprint
+    from arrow_matrix_tpu.tune.synth import synthesize_schedule
+
+    sched = synthesize_schedule(
+        structure_fingerprint(levels, width, np.float32))
+    if sched:
+        mfs = MultiLevelArrow(levels, width, mesh=None, fmt="fold",
+                              kernel="pallas_sell",
+                              kernel_opts={"interpret": True,
+                                           "schedule": sched})
+        xfs = mfs.set_features(x_host[:ba.shape[0]])
+        args = (xfs,) + mfs.step_operands()
+        yield ("multi_level_fold[c=1,S=1,synth]",
+               mfs.collective_contract(k), {
+                   "step": (mfs._step, args, {}),
+                   "scan": (mfs._scan_steps_donated, args, {"n": 2}),
+               })
+    else:
+        yield ("multi_level_fold[c=1,S=1,synth]", None,
+               "the prove-scale structure synthesized an empty "
+               "schedule (no non-zero ladder tiers)")
+
     # -- graft-reshard staged redistribution (H7) ----------------------
     # Two (src, dst) layout pairs, including a repl c change: the plan
     # compiler's bounded-scratch promise, proved from each stage's

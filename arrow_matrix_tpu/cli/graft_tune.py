@@ -77,6 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="re-search even on a cache hit")
     s.add_argument("--allow-int8", action="store_true",
                    help="include the opt-in int8 carriage candidate")
+    s.add_argument("--synth", action="store_true",
+                   help="graft-synth: derive per-level schedules from "
+                        "the degree ladder and race them alongside "
+                        "the fixed menu")
     s.add_argument("--traffic-class", choices=("exact", "approx"),
                    default="exact",
                    help="winner gate: exact = f32 bit-identity "
@@ -123,6 +127,7 @@ def _cmd_search(args) -> int:
                               allow_int8=args.allow_int8,
                               restrict=args.restrict,
                               traffic_class=args.traffic_class,
+                              synth=args.synth,
                               quiet=args.quiet)
         reports.append(report)
         if plan is None:

@@ -183,16 +183,18 @@ def compose_round(ledger: store.Ledger, round_n: int,
     def view(kind: str) -> List[Dict[str, Any]]:
         return [r for r in records if r.get("kind") == kind]
 
-    bench = _newest(view("bench"))
+    # Anchor on the newest bench record that carries a parsed payload:
+    # bench-kind records are also used for raw measurements (e.g. the
+    # reshard peak-HBM probes), and those cannot seed a legacy round's
+    # parsed section.
+    bench = _newest([r for r in view("bench")
+                     if r.get("payload", {}).get("parsed")])
     if bench is None:
-        raise ValueError("export needs at least one bench record in "
-                         "the ledger (run `graft_ledger ingest` or a "
-                         "bench round first)")
+        raise ValueError("export needs at least one bench record with "
+                         "a parsed payload in the ledger (run "
+                         "`graft_ledger ingest` or a bench round "
+                         "first)")
     parsed = dict(bench.get("payload", {}).get("parsed") or {})
-    if not parsed:
-        raise ValueError(f"newest bench record "
-                         f"{bench.get('record_id')} carries no parsed "
-                         f"payload")
 
     tuned: List[Dict[str, Any]] = []
     for rec in view("tune"):

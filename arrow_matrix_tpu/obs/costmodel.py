@@ -173,31 +173,68 @@ def tier_stream_bytes(slot_width: int, rows: int, k: int, *,
     return slot_width * rows_pad * granule * k * itemsize
 
 
+def schedule_family(kernel: str, slot_width: int,
+                    row_block: int) -> str:
+    """Family key of one SCHEDULED tier (graft-synth): the width
+    family refined by the synthesized row block — a tail tier tiled at
+    rb=64 prices differently from the same tier at the default rb=256,
+    so per-level schedules get their own coefficient keys
+    (``pallas:tail@rb64``).  :meth:`CostModel.predict_point` falls
+    back ``@rb``-suffix → base family → kernel-prefix pool, so an
+    unrefit model still prices a scheduled candidate."""
+    return f"{kernel}:{tier_family(int(slot_width))}@rb{int(row_block)}"
+
+
 def tier_counters(fp: Dict[str, Any], k: int, *,
                   kernel: str = "xla",
-                  feature_dtype: Optional[str] = None
+                  feature_dtype: Optional[str] = None,
+                  schedule: Optional[List[Dict[str, Any]]] = None
                   ) -> List[Dict[str, Any]]:
     """Static per-tier counter set for one (fingerprint, k, kernel,
     carriage) point — the regressor rows the cost model is fit on and
-    predicts from.  ``kernel`` is "xla" or "pallas"."""
-    itemsize = ITEMSIZE.get(feature_dtype, 4)
+    predicts from.  ``kernel`` is "xla" or "pallas".
+
+    ``schedule`` (a graft-synth per-tier override list) refines the
+    counters tier by tier: the family key carries the scheduled row
+    block (:func:`schedule_family`), the streamed bytes price the
+    tier's own carriage dtype, and the entry records the scheduled
+    ring depth for the DMA-wait term.
+    """
     granule = GRANULE if kernel == "pallas" else 1
+    sched: Dict[int, Dict[str, Any]] = {}
+    for e in (schedule or []):
+        try:
+            sched[int(e["tier"])] = e
+        except (KeyError, TypeError, ValueError):
+            continue
     ladder = fp["ladder"]
     out = []
     for t, (rows, nnz, slots, w) in enumerate(zip(
             ladder["rows"], ladder["nnz"], ladder["slots"],
             ladder["slot_width"])):
+        ov = sched.get(t)
+        fd_t = feature_dtype
+        if ov is None:
+            family = f"{kernel}:{tier_family(int(w))}"
+            ring_t = None
+        else:
+            family = schedule_family(kernel, int(w),
+                                     int(ov.get("row_block", 256)))
+            fd_t = ov.get("carriage", feature_dtype)
+            ring_t = (int(ov["ring"]) if ov.get("ring") is not None
+                      else None)
         out.append({
             "tier": t,
-            "family": f"{kernel}:{tier_family(int(w))}",
+            "family": family,
             "rows": int(rows),
             "nnz": int(nnz),
             "slots": int(slots),
             "slot_width": int(w),
             "padded_slots": int(slots) - int(nnz),
+            "ring": ring_t,
             "streamed_bytes": tier_stream_bytes(
-                int(w), int(rows), k, itemsize=itemsize,
-                granule=granule),
+                int(w), int(rows), k,
+                itemsize=ITEMSIZE.get(fd_t, 4), granule=granule),
         })
     return out
 
@@ -236,6 +273,10 @@ class CostModel:
         the same-kernel families' mean coefficients (never raises —
         the screen must price every candidate it sees)."""
         c = self.coeffs.get(family)
+        if c is None and "@" in family:
+            # Scheduled family (graft-synth ``kernel:fam@rbN``) the
+            # fit has not seen yet: price at the base width family.
+            c = self.coeffs.get(family.split("@", 1)[0])
         if c is None:
             prefix = family.split(":", 1)[0] + ":"
             pool = [v for f, v in self.coeffs.items()
@@ -326,19 +367,27 @@ def fit_cost_model(points: List[Dict[str, Any]], *,
 def predict_iter_ms(fp: Dict[str, Any], k: int, model: CostModel, *,
                     kernel: str = "xla",
                     feature_dtype: Optional[str] = None,
-                    ring: Optional[int] = None) -> float:
+                    ring: Optional[int] = None,
+                    schedule: Optional[List[Dict[str, Any]]] = None
+                    ) -> float:
     """Predicted fold-iteration ms for one (structure, k) candidate
     point: the sum of per-tier family predictions over the static
-    counters, plus the measured per-family DMA wait for a serial-ring
-    (``ring=1``) schedule — ring 1 forfeits exactly the overlap the
-    deep ring buys."""
+    counters, plus the measured per-family DMA wait for any tier whose
+    effective (scheduled or uniform) ring depth is 1 — ring 1 forfeits
+    exactly the overlap the deep ring buys."""
     tiers = tier_counters(fp, k, kernel=kernel,
-                          feature_dtype=feature_dtype)
+                          feature_dtype=feature_dtype,
+                          schedule=schedule)
     total = model.predict_tiers(tiers)
-    if kernel == "pallas" and ring == 1:
+    if kernel == "pallas":
         for t in tiers:
-            if t["slot_width"] > 0:
-                total += float(model.dma_wait_ms.get(t["family"], 0.0))
+            ring_t = t.get("ring") if t.get("ring") is not None else ring
+            if ring_t == 1 and t["slot_width"] > 0:
+                wait = model.dma_wait_ms.get(t["family"])
+                if wait is None:
+                    wait = model.dma_wait_ms.get(
+                        t["family"].split("@", 1)[0], 0.0)
+                total += float(wait)
     return total
 
 
@@ -350,7 +399,8 @@ def predict_candidate_ms(model: CostModel, fp: Dict[str, Any], k: int,
     (the ``tune/space.py`` compute screen's entry point)."""
     kernel = ("pallas" if build.get("kernel") == "pallas_sell"
               else "xla")
-    fd = build.get("feature_dtype")
-    ring = (kernel_opts or {}).get("ring")
+    opts = kernel_opts or {}
+    fd = build.get("feature_dtype") or opts.get("feature_dtype")
     return predict_iter_ms(fp, k, model, kernel=kernel,
-                           feature_dtype=fd, ring=ring)
+                           feature_dtype=fd, ring=opts.get("ring"),
+                           schedule=opts.get("schedule"))

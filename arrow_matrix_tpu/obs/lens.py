@@ -34,6 +34,7 @@ from arrow_matrix_tpu.obs.costmodel import (
     GRANULE,
     ITEMSIZE,
     fit_cost_model,
+    schedule_family,
     tier_family,
     tier_stream_bytes,
 )
@@ -66,10 +67,15 @@ def _resolve_kernel(kernel: str, k: int, platform: str) -> str:
 
 
 def _tier_static(sell, t: int, k: int, *, kernel: str,
-                 feature_dtype: Optional[str]) -> Dict[str, Any]:
+                 feature_dtype: Optional[str],
+                 schedule=None) -> Dict[str, Any]:
     """Static counter row for one realized SELL tier — same fields
     :func:`~.costmodel.tier_counters` derives from the fingerprint, but
-    read off the concrete operator the profile actually ran."""
+    read off the concrete operator the profile actually ran.  A
+    graft-synth ``schedule`` override for tier ``t`` refines the
+    family key (``kernel:fam@rbN``) and the priced carriage, exactly
+    as ``costmodel.tier_counters`` does — so a scheduled profile fits
+    the same per-level family keys the tune screen predicts with."""
     cols = sell.cols[t]
     m_t, n_t = int(cols.shape[0]), int(cols.shape[1])
     if sell.deg is not None:
@@ -78,11 +84,22 @@ def _tier_static(sell, t: int, k: int, *, kernel: str,
         nnz = int(np.count_nonzero(np.asarray(sell.data[t])))
     else:
         nnz = m_t * n_t
+    ov = None
+    for e in (schedule or []):
+        if int(e.get("tier", -1)) == t:
+            ov = e
+            break
+    if ov is None:
+        family = f"{kernel}:{tier_family(m_t)}"
+    else:
+        family = schedule_family(kernel, m_t,
+                                 int(ov.get("row_block", 256)))
+        feature_dtype = ov.get("carriage", feature_dtype)
     itemsize = ITEMSIZE.get(feature_dtype, 4)
     granule = GRANULE if kernel == "pallas" else 1
     return {
         "tier": t,
-        "family": f"{kernel}:{tier_family(m_t)}",
+        "family": family,
         "rows": n_t,
         "nnz": nnz,
         "slots": m_t * n_t,
@@ -293,10 +310,16 @@ def profile_fold(levels, width: int, k: int, *,
         launches = list(_tier_launches(
             multi, sell, x, k, kernel=kernel,
             feature_dtype=feature_dtype, kernel_opts=kopts))
+        # The ring sweep re-times SINGLE-tier subs, whose tier index
+        # collapses to 0 — a graft-synth schedule keyed by original
+        # tier index would misalign there, so scheduled profiles skip
+        # the DMA-wait split (their ring depths are already per-tier).
+        do_ring = (ring_sweep and kernel == "pallas"
+                   and not kopts.get("schedule"))
         for t, fn, prefix, single in launches:
             samplers[f"prefix{t}"] = _chain_sampler(
                 functools.partial(fn, prefix), x, iters)
-            if ring_sweep and kernel == "pallas":
+            if do_ring:
                 from arrow_matrix_tpu.ops.pallas_sell import (
                     sell_spmm_t_pallas,
                 )
@@ -317,8 +340,10 @@ def profile_fold(levels, width: int, k: int, *,
                             call=f"lens_full_{fd}", dtype=fd)
         tiers: List[Dict[str, Any]] = []
         for t, cols in enumerate(sell.cols):
-            tiers.append(_tier_static(sell, t, k, kernel=kernel,
-                                      feature_dtype=feature_dtype))
+            tiers.append(_tier_static(
+                sell, t, k, kernel=kernel,
+                feature_dtype=feature_dtype,
+                schedule=kopts.get("schedule")))
         dma_wait: Dict[str, List[float]] = {}
         prev_ms = floor_ms
         for t, fn, prefix, single in launches:
@@ -329,7 +354,7 @@ def profile_fold(levels, width: int, k: int, *,
             if registry is not None:
                 registry.record("call_time_ms", ms,
                                 call=f"lens_tier{t}_{fd}", dtype=fd)
-            if ring_sweep and kernel == "pallas":
+            if do_ring:
                 ms1 = best[f"ring1_{t}"]
                 tiers[t]["ring1_ms"] = float(ms1)
                 wait = max(float(ms1) - float(best[f"deep{t}"]), 0.0)
@@ -520,6 +545,11 @@ def record_profile(profile: Dict[str, Any],
 
     sh = str(profile.get("structure_hash", ""))
     kern = profile.get("kernel", "?")
+    if (profile.get("kernel_opts") or {}).get("schedule"):
+        # A graft-synth scheduled profile is a distinct measurement
+        # series: same structure, different programs — its metrics
+        # must not share baselines with the uniform-knob profile.
+        kern = f"{kern}-synth"
     k = int(profile.get("k", 0))
     ids: List[str] = []
 

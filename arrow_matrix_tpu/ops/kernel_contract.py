@@ -41,7 +41,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 #: Carriage dtypes a contract may declare, with their itemsizes.  The
 #: accumulator is NOT in this table on purpose: KC4 pins it to >= f32
 #: regardless of the carriage.
-CARRIAGE_ITEMSIZE: Dict[str, int] = {"f32": 4, "bf16": 2}
+CARRIAGE_ITEMSIZE: Dict[str, int] = {"f32": 4, "bf16": 2, "int8": 1}
 
 #: Accumulator dtypes KC4 accepts.
 WIDE_ACCUM_DTYPES = ("f32", "float32", "f64", "float64")
@@ -142,9 +142,35 @@ def builtin_kernels() -> List[KernelEntry]:
     ]
 
 
+#: One-shot guard for the persisted-program load below.
+_SYNTH_LOADED = False
+
+
+def _load_persisted_programs() -> None:
+    """Re-register graft-synth programs persisted in the committed
+    store (``bench_cache/synth_programs.json``) so certification and
+    the tune race see generated kernels across processes.  Lazy and
+    best-effort: ``tune/synth.py`` is jax-free at import, a missing or
+    unreadable store simply registers nothing, and a failure here must
+    never take down a host-only ``registered_kernels()`` caller."""
+    global _SYNTH_LOADED
+    if _SYNTH_LOADED:
+        return
+    _SYNTH_LOADED = True
+    try:
+        from arrow_matrix_tpu.tune import synth
+
+        synth.register_persisted_programs()
+    except Exception:  # graft-lint: disable=R8 — a corrupt store is
+        pass           # a kernel-gate finding (tools/kernel_gate.py
+                       # re-reads it and fails loudly), not a reason
+                       # to take down a host-only registry caller
+
+
 def registered_kernels() -> List[KernelEntry]:
     """Builtins first, then registered (generated) kernels, each name
     once — a registered entry shadows a builtin of the same name."""
+    _load_persisted_programs()
     out: List[KernelEntry] = []
     seen = set(_REGISTRY)
     for e in builtin_kernels():

@@ -81,8 +81,14 @@ SMEM_COLS_BUDGET = int(os.environ.get("AMT_PALLAS_SELL_SMEM",
                                       str(DEFAULT_SMEM_COLS_BUDGET)))
 
 #: Carriage dtypes the fused kernel serves (graft-kcert KC4 contract:
-#: the carriage may narrow, the accumulator stays f32).
-CARRIAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+#: the carriage may narrow, the accumulator stays f32).  The int8
+#: carriage is the fused (q, scale) pair: the packed feature table
+#: travels as int8 granule lines, the kernel decodes to f32 in the
+#: accumulator, and the per-feature scale multiplies the f32 output
+#: OUTSIDE the kernel (SpMM is separable per feature column, so the
+#: factorization is exact given the quantized table).
+CARRIAGE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                   "int8": jnp.int8}
 
 DEFAULT_ROW_BLOCK = 256  # rows per grid program (multiple of GRANULE)
 DEFAULT_WAVE = 16        # async copies per DMA wave (streaming path)
@@ -114,6 +120,55 @@ def pack_features_t(x_t: jax.Array) -> jax.Array:
     return x.reshape(n_pad // GRANULE, GRANULE * k)
 
 
+def quantize_features_t(x_t: jax.Array):
+    """Symmetric per-feature-row int8 quantization of the feature-major
+    ``(k, n)`` block: ``q = round(x / scale)`` with
+    ``scale = max|x| / 127`` taken per feature row.  Returns
+    ``(q int8 (k, n), scale f32 (k, 1))``.  Because SpMM is separable
+    per feature column, ``scale * (A @ q)`` reconstructs ``A @ x``
+    exactly up to the rounding of ``q`` itself — the scale never enters
+    the kernel, so the int8 carriage keeps the certified f32
+    accumulator (KC4)."""
+    xf = x_t.astype(jnp.float32)
+    q_max = jnp.float32(127.0)
+    amax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)        # (k, 1)
+    scale = jnp.where(amax > 0, amax / q_max, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(xf / scale), -q_max, q_max).astype(jnp.int8)
+    return q, scale
+
+
+def _schedule_overrides(schedule) -> dict:
+    """Normalize a graft-synth per-tier schedule into
+    ``tier index -> override dict``.  Accepts the TunePlan payload
+    shape (a list of dicts each carrying a ``"tier"`` key) or a dict
+    keyed by tier (string keys survive a JSON round trip)."""
+    if not schedule:
+        return {}
+
+    def _coerce(ov: dict) -> dict:
+        # Schedule knobs are JSON/TunePlan metadata (static Python
+        # ints after a round trip as strings/floats), never traced.
+        for key in ("row_block", "wave", "ring", "smem_cols_budget"):
+            if ov.get(key) is not None:
+                ov[key] = int(ov[key])  # graft-lint: disable=R1
+        return ov
+
+    if isinstance(schedule, dict):
+        return {int(t): _coerce(dict(ov))  # graft-lint: disable=R1
+                for t, ov in schedule.items()}
+    out = {}
+    for entry in schedule:
+        ov = dict(entry)
+        try:
+            t = int(ov.pop("tier"))  # graft-lint: disable=R1
+        except KeyError:
+            raise ValueError(
+                "per-tier schedule entries need a 'tier' key; got "
+                f"{sorted(entry)}") from None
+        out[t] = _coerce(ov)
+    return out
+
+
 def _select_accumulate(lines, cols_j, w_j, r, k):
     """Shared select/accumulate math of both kernel bodies: mask each
     row's granule line down to its ``col % C`` sub-row, fold the C
@@ -143,8 +198,8 @@ def resolve_carriage_dtype(feature_dtype, default=jnp.float32):
         return "f32", jnp.float32
     try:
         if isinstance(feature_dtype, str):
-            alias = {"f32": "float32", "bf16": "bfloat16"}.get(
-                feature_dtype, feature_dtype)
+            alias = {"f32": "float32", "bf16": "bfloat16",
+                     "i8": "int8"}.get(feature_dtype, feature_dtype)
             dt = jnp.dtype(alias)
         else:
             dt = jnp.dtype(feature_dtype)
@@ -480,7 +535,8 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
                        interpret: Optional[bool] = None,
                        smem_cols_budget: Optional[int] = None,
                        ring: int = DEFAULT_RING,
-                       feature_dtype=None) -> jax.Array:
+                       feature_dtype=None,
+                       schedule=None) -> jax.Array:
     """Drop-in fused twin of ``ops.sell.sell_spmm_t``: (k, n_rows)
     feature-major output, one kernel launch stream per tier, outputs
     concatenated along the sorted row axis (tiers are contiguous runs
@@ -491,21 +547,61 @@ def sell_spmm_t_pallas(m: SellMatrix, x_t: jax.Array,
     ``row_block`` VMEM tile, not a materialized gather intermediate.
     ``feature_dtype="bf16"`` narrows the packed-feature carriage only;
     accumulation stays f32 and the output dtype follows ``x_t``.
+    ``feature_dtype="int8"`` is the fused (q, scale) carriage: the
+    table is quantized per feature row (:func:`quantize_features_t`),
+    the kernel streams int8 lines, and the f32 output is rescaled
+    outside the kernel.
+
+    ``schedule`` is the graft-synth per-tier override hook: a list of
+    dicts (or tier-keyed dict) whose entries may set ``row_block``,
+    ``wave``, ``ring``, ``smem_cols_budget`` and ``carriage`` for one
+    tier, the uniform knobs covering the rest.  Per-tier ``carriage``
+    is limited to f32/bf16 (casting from the shared f32 pack); the
+    int8 pair quantizes the whole table, so it is whole-call only.
     """
     k = x_t.shape[0]
-    x_packed = pack_features_t(x_t)
+    sched = _schedule_overrides(schedule)
+    carriage_key, _dt = resolve_carriage_dtype(feature_dtype,
+                                               default=x_t.dtype)
+    # An int8 table (pre-quantized q, scale applied by the caller)
+    # still accumulates — and must return — f32 weighted sums.
+    out_dtype = (jnp.float32 if x_t.dtype == jnp.int8 else x_t.dtype)
+    scale = None
+    if carriage_key == "int8" and x_t.dtype != jnp.int8:
+        if any("carriage" in ov for ov in sched.values()):
+            raise ValueError(
+                "int8 (q, scale) carriage quantizes the whole feature "
+                "table; per-tier schedule carriage overrides cannot "
+                "apply on top of it")
+        q, scale = quantize_features_t(x_t)
+        x_packed = pack_features_t(q)
+    else:
+        x_packed = pack_features_t(x_t)
     outs = []
     for t, cols in enumerate(m.cols):
+        ov = sched.get(t, {})
+        if ov.get("carriage") == "int8":
+            raise ValueError(
+                "per-tier carriage 'int8' is not schedulable: the "
+                "(q, scale) pair quantizes the whole feature table "
+                "(pass feature_dtype='int8' instead)")
+        fd_t = ov.get("carriage", feature_dtype)
+        budget_t = ov.get("smem_cols_budget")
         out_t = sell_tier_spmm_packed(
             cols, x_packed,
             data=None if m.data is None else m.data[t],
             deg=None if m.deg is None else m.deg[t],
-            row_block=row_block, wave=wave, stream=stream,
-            interpret=interpret, smem_cols_budget=smem_cols_budget,
-            ring=ring, feature_dtype=feature_dtype)
-        outs.append(out_t.T.astype(x_t.dtype))               # (k, n_t)
+            row_block=ov.get("row_block", row_block),
+            wave=ov.get("wave", wave), stream=stream,
+            interpret=interpret,
+            smem_cols_budget=(smem_cols_budget if budget_t is None
+                              else budget_t),
+            ring=ov.get("ring", ring), feature_dtype=fd_t)
+        if scale is not None:
+            out_t = out_t * scale.reshape(1, k)
+        outs.append(out_t.T.astype(out_dtype))               # (k, n_t)
     if not outs:
-        return jnp.zeros((k, 0), dtype=x_t.dtype)
+        return jnp.zeros((k, 0), dtype=out_dtype)
     return jnp.concatenate(outs, axis=1)
 
 
@@ -555,7 +651,7 @@ KERNEL_CONTRACT = KernelContract(
     rings=(1, 2, 3, 4),
     waves=(8, 16),
     ks=(16, 128),
-    carriage_dtypes=("f32", "bf16"),
+    carriage_dtypes=("f32", "bf16", "int8"),
     accum_dtype="f32",
     smem_cols_budget=DEFAULT_SMEM_COLS_BUDGET,
     vmem_budget_bytes=VMEM_BUDGET,
@@ -578,6 +674,7 @@ def kcert_metas():
         (64, 1, 8, 16, 5, True, "f32"),       # serial ring, small tier
         (128, 3, 8, 128, 3, True, "bf16"),    # deep ring, bf16 carriage
         (256, 4, 16, 16, 16, False, "bf16"),  # deepest ring, weighted
+        (64, 4, 8, 16, 4, False, "int8"),     # fused (q, scale) carriage
     ]
     metas = []
     for rb, ring, wave, k, m_t, binary, carriage in points:
@@ -622,7 +719,27 @@ def kcert_witness():
             want = m_t * np.asarray(x_t[:, -1], dtype=np.float32)  # graft-lint: disable=R6
             if fd == "f32" and not np.allclose(st[0], want, rtol=1e-6):
                 return False, "boundary row value off the golden"
+        # int8 carriage: an already-quantized table streams and decodes
+        # exactly — both bodies bit-identical AND equal to the integer
+        # golden (f32 holds +/-127*m_t without rounding).
+        # Witness feature table: provably tiny host fetch.
+        q = jnp.asarray(np.round(np.asarray(x_t) * 127.0)  # graft-lint: disable=R6
+                        .astype(np.int8))
+        q_packed = pack_features_t(q)
+        vec = sell_tier_spmm_packed(
+            cols, q_packed, deg=deg, stream=False, interpret=True,
+            row_block=32, wave=8, feature_dtype="int8")
+        st = sell_tier_spmm_packed(
+            cols, q_packed, deg=deg, stream=True, interpret=True,
+            row_block=32, wave=8, ring=2, feature_dtype="int8")
+        vec, st = np.asarray(vec), np.asarray(st)
+        if not np.array_equal(vec, st):
+            return False, ("stream/vectorized mismatch at the "
+                           "boundary column (int8)")
+        want_q = m_t * np.asarray(q[:, -1], dtype=np.float32)  # graft-lint: disable=R6
+        if not np.array_equal(st[0], want_q):
+            return False, "int8 boundary row decode off the golden"
     except Exception as exc:  # a raise IS the out-of-bounds evidence
         return False, f"boundary interpret run raised: {exc!r}"
     return True, ("boundary-column interpret round trip ok "
-                  "(f32+bf16, stream==vectorized, finite)")
+                  "(f32+bf16+int8, stream==vectorized, finite)")

@@ -709,6 +709,21 @@ class MultiLevelArrow:
 
         def fold_slab(xt, blocks):
             if xt.dtype == jnp.int8:
+                if kernel == "pallas_sell":
+                    # Fused (q, scale) carriage: the quantized table
+                    # streams through the kernel AS int8 granule lines
+                    # (f32 accumulate, KC4); the per-feature scale is
+                    # applied by fold_step_q outside — 4x fewer gather
+                    # bytes than widening first.
+                    from arrow_matrix_tpu.ops.pallas_sell import (
+                        sell_spmm_t_pallas,
+                    )
+
+                    opts = {kk: vv for kk, vv in kopts.items()
+                            if kk != "feature_dtype"}
+                    return sell_spmm_t_pallas(blocks[0], xt,
+                                              feature_dtype="int8",
+                                              **opts)
                 # Per-slab f32 transient: the FULL carriage stays int8
                 # in HBM; only one overlap/repl slab widens at a time.
                 xt = xt.astype(jnp.float32)
@@ -1061,8 +1076,17 @@ class MultiLevelArrow:
             donated_params=(0,),
             # One XLA loop-copy set per while body (iteration scan +
             # per-level inner scans), multiplied by the S overlap
-            # sub-steps; transposes stay forbidden.
-            hot_copy_budget=16 * self.overlap_slabs,
+            # sub-steps; transposes stay forbidden.  A graft-synth
+            # per-tier schedule runs one bounded streaming loop per
+            # scheduled tier, and the interpret lowering materializes
+            # each loop's carried state (wave counter, ring cursors,
+            # index-table slices, one (1, m_t, wave) accumulator tile)
+            # as XLA copies — scalar/index-sized, never a (rows, k)
+            # feature slab — so the budget grows by one 8-copy set per
+            # scheduled tier and stays independent of n and k.
+            hot_copy_budget=(16 + 8 * len(
+                self.kernel_opts.get("schedule") or ()))
+            * self.overlap_slabs,
             h3_exempt=("single-chip fold repl is a column-group "
                        "schedule over ZERO collectives: there is no "
                        "exchange to carry a slab and no merge to price "

@@ -86,6 +86,11 @@ class TunePlan:
     wave: int = 16
     smem_cols_budget: Optional[int] = None
     ring: int = 2
+    #: graft-synth per-level schedule (list of per-tier override
+    #: dicts, ``tune/synth.synthesize_schedule`` shape).  None = the
+    #: uniform knobs above apply to every tier; when set, the uniform
+    #: knobs are the fallback for tiers the schedule doesn't name.
+    schedule: Optional[list] = None
 
     # --- provenance ---
     candidate: str = "default"
@@ -123,12 +128,15 @@ class TunePlan:
 
     def kernel_opts(self) -> Dict[str, Any]:
         """Per-call knobs of ``ops/pallas_sell.sell_spmm_t_pallas``."""
-        return {
+        opts = {
             "row_block": self.row_block,
             "wave": self.wave,
             "smem_cols_budget": self.smem_cols_budget,
             "ring": self.ring,
         }
+        if self.schedule is not None:
+            opts["schedule"] = [dict(e) for e in self.schedule]
+        return opts
 
     def exec_config(self):
         """The serving rung this plan corresponds to — the degradation

@@ -273,6 +273,7 @@ def search(source: dict, k: int, *, iters: int = 3,
            traffic_class: str = "exact",
            extra: Optional[List[Candidate]] = None,
            lens_model=None,
+           synth: bool = False,
            quiet: bool = False) -> Tuple[Optional[TunePlan], dict]:
     """Search (or cache-hit) the tuned plan for one (structure, k).
 
@@ -299,6 +300,14 @@ def search(source: dict, k: int, *, iters: int = 3,
     JSON artifact) arms the graft-lens compute screen in
     ``enumerate_candidates``: compute-hopeless candidates are pruned
     with ``"lens: …"`` reasons before their child spawns.
+
+    ``synth=True`` arms graft-synth: per-level schedules derived from
+    the degree-ladder fingerprint (``tune/synth.synth_candidates``)
+    join the race through ``extra`` — same kcert/lens screens, same
+    f32 bit-identity win rule — and the surviving generated program is
+    persisted in the synth store so graft-kcert certifies it in every
+    later process.  A cache hit still short-circuits BEFORE synthesis:
+    purity (zero children) covers generated programs too.
     """
     from arrow_matrix_tpu.classes import tolerance_for
     from arrow_matrix_tpu.utils.platform import host_load
@@ -346,12 +355,35 @@ def search(source: dict, k: int, *, iters: int = 3,
         from arrow_matrix_tpu.obs.costmodel import CostModel
         with open(lens_model, "r", encoding="utf-8") as fh:
             lens_model = CostModel.from_dict(_json.load(fh))
+    if synth:
+        from arrow_matrix_tpu.tune import synth as _synth
+
+        generated = _synth.synth_candidates(fp,
+                                            traffic_class=traffic_class)
+        if generated:
+            _say(f"synth: {len(generated)} generated candidate(s): "
+                 + "; ".join(f"{c.name} [{_synth.schedule_summary(c.kernel_opts['schedule'])}]"
+                             for c in generated))
+            extra = list(extra or []) + generated
     cands, pruned = enumerate_candidates(
         fp, k, platform=platform, allow_int8=allow_int8,
         restrict=restrict, traffic_class=traffic_class, extra=extra,
         lens_model=lens_model)
     for name, why in pruned.items():
         _say(f"pruned {name}: {why}")
+
+    synth_program = None
+    if synth:
+        # Persist + register the generated exact program ONLY when it
+        # survived the kcert/lens screens — the committed store must
+        # hold nothing `analysis kernels --check` would flag.
+        for c in cands:
+            if c.name == "synth_ladder":
+                synth_program = _synth.persist_program(
+                    fp, h, k, c.kernel_opts["schedule"])
+                _say(f"synth: persisted generated program "
+                     f"{synth_program}")
+                break
 
     run_dir = run_dir or os.path.join("bench_cache", "tune_runs", h)
     os.makedirs(run_dir, exist_ok=True)
@@ -375,6 +407,21 @@ def search(source: dict, k: int, *, iters: int = 3,
 
     default_ms = results.get("default", {}).get("ms")
 
+    def _effective_dtype(c: Candidate) -> Optional[str]:
+        """The accuracy-class key of a candidate's carriage: build or
+        kernel_opts ``feature_dtype``, or — for a graft-synth per-level
+        schedule — the NARROWEST per-tier carriage (the whole output
+        is only as exact as its least exact tier)."""
+        fd = (c.build.get("feature_dtype")
+              or c.kernel_opts.get("feature_dtype"))
+        if fd is None and c.kernel_opts.get("schedule"):
+            carrs = {e.get("carriage", "f32")
+                     for e in c.kernel_opts["schedule"]}
+            for narrow in ("int8", "bf16"):
+                if narrow in carrs:
+                    return narrow
+        return fd
+
     def _class_ok(c: Candidate) -> bool:
         r = results[c.name]
         if (r.get("error") is not None or r.get("ms") is None):
@@ -386,7 +433,7 @@ def search(source: dict, k: int, *, iters: int = 3,
         # Approx class: a reduced-precision candidate passes the
         # screen when its single-step error is within the class
         # tolerance; the full curve still has to certify below.
-        fd = c.build.get("feature_dtype")
+        fd = _effective_dtype(c)
         rel = r.get("rel_frobenius")
         return (fd is not None and rel is not None
                 and rel <= tolerance_for(fd))
@@ -396,7 +443,7 @@ def search(source: dict, k: int, *, iters: int = 3,
     winner = None
     while eligible:
         pick = min(eligible, key=lambda c: results[c.name]["ms"])
-        fd = pick.build.get("feature_dtype")
+        fd = _effective_dtype(pick)
         if (traffic_class != "approx" or fd is None
                 or results[pick.name].get("bit_identical") is True):
             winner = pick
@@ -417,6 +464,7 @@ def search(source: dict, k: int, *, iters: int = 3,
             "structure_hash": h, "k": int(k), "cache_hit": False,
             "children_spawned": len(cands), "results": results,
             "pruned": pruned, "error": "no eligible candidate",
+            "synth_program": synth_program,
         }
     w_ms = float(results[winner.name]["ms"])
     margin = (None if not default_ms
@@ -471,6 +519,7 @@ def search(source: dict, k: int, *, iters: int = 3,
         "children_spawned": len(cands), "results": results,
         "pruned": pruned, "winner": winner.name,
         "plan": plan.to_dict(), "plan_path": path,
+        "synth_program": synth_program,
         "wall_s": round(time.perf_counter() - t0, 3),
     }
 

@@ -191,6 +191,21 @@ def enumerate_candidates(fp: dict, k: int, *,
             note=("opt-in int8 (q, scale) carriage: approx-class "
                   "candidate" if approx else
                   "opt-in int8-carriage experiment (diagnostic only)")))
+    if approx or allow_int8:
+        # The fused (q, scale) SELL variant (ROADMAP item 2's last
+        # kernel): int8 carriage lines + f32 accumulate in-kernel, the
+        # per-feature scale applied outside.  Raced for approx plans
+        # alongside pallas_sell_bf16; allow_int8 also surfaces it as
+        # an exact-class diagnostic.
+        raw.append(Candidate(
+            "pallas_sell_int8",
+            build={"kernel": "pallas_sell", "feature_dtype": "int8"},
+            eligible=approx,
+            note=("fused kernel, int8 (q, scale) carriage / f32 "
+                  "accumulate (KC1-KC5 certified); tolerance-gated "
+                  "winner" if approx else
+                  "fused kernel, int8 (q, scale) carriage diagnostic "
+                  "(never f32 bit-identical; cannot win)")))
     if extra:
         raw.extend(extra)
 

@@ -1,0 +1,60 @@
+"""Planted generated-program violation for tools/kernel_gate.py --paths.
+
+This is the tail tier of a REAL graft-synth program (synthesized from
+the ba/n=96/m=3/width=16/seed=5 degree ladder, k=16) with one knob
+corrupted: the DMA ring deepened from 4 to 4096 slabs, so the
+ring-proportional scratch (64 x 131072 f32 = 32 MiB) exceeds the
+kernel's declared 8 MiB VMEM budget.  Row-block/wave/coverage all
+hold, so exactly KC2 fires -- the same prune reason
+certify_candidate_opts gives an over-deep synthesized schedule before
+it ever races.
+"""
+
+METAS = [
+    {   'kernel': 'kc2_synth_ring_overbudget',
+        'kind': 'sell_stream',
+        'grid': [['i', 2]],
+        'out': {   'shape': [16, 128],
+                   'block': [8, 128],
+                   'index': ['i', 0],
+                   'itemsize': 4},
+        'ins': [   {   'name': 'cols_vmem',
+                       'shape': [8, 128],
+                       'block': [8, 64],
+                       'index': [0, 'i'],
+                       'space': 'vmem',
+                       'itemsize': 4},
+                   {   'name': 'weights',
+                       'shape': [1, 128],
+                       'block': [1, 64],
+                       'index': [0, 'i'],
+                       'space': 'vmem',
+                       'itemsize': 4},
+                   {   'name': 'x_packed',
+                       'shape': [12, 128],
+                       'block': None,
+                       'index': None,
+                       'space': 'any',
+                       'itemsize': 4}],
+        'smem': {   'name': 'cols_prefetch',
+                    'bytes': 4096,
+                    'budget': 8192,
+                    'single_block': False},
+        'scratch': [   {   'name': 'dma_scratch',
+                           'shape': [64, 131072],
+                           'itemsize': 4}],
+        'sems': {'shape': [4096, 8]},
+        'vmem_budget': 8388608,
+        'accum_dtype': 'f32',
+        'carriage_dtype': 'f32',
+        'revisit_axes': [],
+        'stream': {   'ring': 4096,
+                      'wave': 8,
+                      'n_waves': 8,
+                      'row_block': 64,
+                      'granule': 8,
+                      'slab': 128,
+                      'm_t': 8,
+                      'lines': 12,
+                      'table_rows': 96}},
+]

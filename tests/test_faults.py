@@ -334,7 +334,7 @@ def test_chaos_gate_fast_scenarios(tmp_path):
     problems, scenarios = gate.run_gate(str(tmp_path), fast=True)
     assert problems == []
     assert scenarios == ["nan", "hang", "corrupt", "sync", "kcert",
-                         "lens", "host_kill", "serve_hang",
+                         "lens", "synth", "host_kill", "serve_hang",
                          "serve_corrupt", "serve_overflow", "serve_hbm",
                          "slo_burn_degrade", "serve_classes",
                          "reshard_h7"]

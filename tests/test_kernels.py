@@ -82,7 +82,9 @@ def test_shipped_kernels_certify_clean_and_drift_free():
     records = kcert.certify_all()
     fresh = kcert.build_manifest(records)
     assert fresh["ok"], [r["findings"] for r in records]
-    assert fresh["counts"]["kernels"] == 2
+    # 2 built-in kernels + the persisted graft-synth program the lazy
+    # registry loads from bench_cache/synth_programs.json.
+    assert fresh["counts"]["kernels"] == 3
     with open(MANIFEST, encoding="utf-8") as fh:
         committed = json.load(fh)
     problems = kcert.manifest_drift(committed, fresh)

@@ -1,0 +1,425 @@
+"""graft-synth tests (arrow_matrix_tpu/tune/synth.py): per-level
+schedule synthesis from the degree-ladder fingerprint, KC1-KC5
+certification of generated schedules (uncertifiable ones pruned with
+``kcert:`` reasons before any child spawns), TunePlan schedule
+persistence, f32 bit-identity of the scheduled executor vs the golden
+fold path, the fused int8 (q, scale) carriage, the synth search with
+its pure-cache-hit purity, the committed program store + lazy registry
+round trip, and the planted generated-program fixture that must fail
+``tools/kernel_gate.py --paths``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from arrow_matrix_tpu.analysis import kernels as kcert
+from arrow_matrix_tpu.decomposition import arrow_decomposition
+from arrow_matrix_tpu.ops.kernel_contract import (
+    builtin_kernels,
+    registered_kernels,
+    unregister_kernel,
+)
+from arrow_matrix_tpu.tune import synth
+from arrow_matrix_tpu.tune.fingerprint import (
+    structure_fingerprint,
+    fingerprint_hash,
+)
+from arrow_matrix_tpu.tune.plan import TunePlan, load_plan, save_plans
+from arrow_matrix_tpu.tune.space import enumerate_candidates
+from arrow_matrix_tpu.utils import barabasi_albert, random_dense
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SYNTH_FIXTURE = os.path.join(REPO, "tests", "fixtures", "synth",
+                             "kc2_synth_ring_overbudget.py")
+
+#: A hand-built 4-tier fingerprint: zero-degree prefix + one tier per
+#: ladder family — the smallest structure that exercises every branch
+#: of the synthesis policy.
+LADDER_FP = {
+    "n": 96, "binary": True, "total_rows": 120,
+    "ladder": {
+        "rows": [24, 64, 24, 8],
+        "nnz": [0, 180, 300, 400],
+        "slots": [0, 256, 384, 512],
+        "slot_width": [0, 4, 16, 80],
+    },
+}
+
+
+def _levels(n=96, width=16, seed=3, m=3, max_levels=4):
+    a = barabasi_albert(n, m, seed=seed)
+    return arrow_decomposition(a, width, max_levels=max_levels,
+                               block_diagonal=True, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Synthesis policy
+# ---------------------------------------------------------------------------
+
+def test_synthesis_policy_families_and_knobs():
+    sched = synth.synthesize_schedule(LADDER_FP)
+    # The zero-width prefix launches no kernel, so no entry.
+    assert [e["tier"] for e in sched] == [1, 2, 3]
+    assert [e["family"] for e in sched] == ["tail", "mid", "head"]
+    by_fam = {e["family"]: e for e in sched}
+    # Head levels dense-ish: wide row block, shallow ring; tail levels
+    # scatter-ish: narrow row block, deep ring (ISSUE 20's tentpole
+    # policy, FAMILY_POLICY).
+    assert by_fam["head"]["row_block"] > by_fam["tail"]["row_block"]
+    assert by_fam["tail"]["ring"] > by_fam["head"]["ring"]
+    # Tail/mid slabs are budget-bounded; head rides the full default
+    # scalar-prefetch budget (no per-tier override).
+    assert "smem_cols_budget" in by_fam["tail"]
+    assert "smem_cols_budget" not in by_fam["head"]
+    # Deterministic: the store and the cache key on this.
+    assert sched == synth.synthesize_schedule(LADDER_FP)
+
+
+def test_synthesis_empty_ladder_and_bad_policy():
+    empty = {"n": 8, "binary": True, "total_rows": 8,
+             "ladder": {"rows": [8], "nnz": [0], "slots": [0],
+                        "slot_width": [0]}}
+    assert synth.synthesize_schedule(empty) == []
+    assert synth.synth_candidates(empty) == []
+    with pytest.raises(ValueError, match="carriage policy"):
+        synth.synthesize_schedule(LADDER_FP, carriage_policy="fp8")
+
+
+def test_mixed_policy_narrows_head_mid_keeps_tail_exact():
+    mixed = synth.synthesize_schedule(LADDER_FP,
+                                      carriage_policy="mixed")
+    carr = {e["family"]: e["carriage"] for e in mixed}
+    assert carr == {"tail": "f32", "mid": "bf16", "head": "bf16"}
+
+
+def test_synth_candidates_traffic_classes():
+    exact = {c.name: c for c in synth.synth_candidates(LADDER_FP)}
+    assert exact["synth_ladder"].eligible is True
+    assert all(e["carriage"] == "f32" for e in
+               exact["synth_ladder"].kernel_opts["schedule"])
+    # The mixed-carriage program can never win the f32 bit-identity
+    # race — approx class only, like pallas_sell_bf16.
+    assert exact["synth_ladder_mixed"].eligible is False
+    approx = {c.name: c for c in synth.synth_candidates(
+        LADDER_FP, traffic_class="approx")}
+    assert approx["synth_ladder_mixed"].eligible is True
+
+
+# ---------------------------------------------------------------------------
+# Certification: generated schedules through KC1-KC5
+# ---------------------------------------------------------------------------
+
+def test_synthesized_schedule_certifies():
+    sched = synth.synthesize_schedule(LADDER_FP)
+    assert kcert.certify_candidate_opts({"schedule": sched}, 16) is None
+    assert kcert.certify_candidate_opts({"schedule": sched}, 16,
+                                        interpret=True) is None
+
+
+def test_bad_schedule_pruned_with_kcert_tier_reason():
+    sched = synth.synthesize_schedule(LADDER_FP)
+    bad = [dict(sched[0], ring=0)]
+    why = kcert.certify_candidate_opts({"schedule": bad}, 16)
+    assert why is not None and why.startswith("kcert: tier 1")
+    # Per-tier int8 carriage is not schedulable (the (q, scale) pair
+    # is a whole-call transform) — pruned, not silently cast.
+    bad = [dict(sched[0], carriage="int8")]
+    why = kcert.certify_candidate_opts({"schedule": bad}, 16)
+    assert why is not None and "int8" in why
+    # A malformed entry (no tier key) is a loud kcert reason too.
+    why = kcert.certify_candidate_opts(
+        {"schedule": [{"ring": 2}]}, 16)
+    assert why is not None and why.startswith("kcert:")
+
+
+def test_enumeration_screens_generated_candidates():
+    from arrow_matrix_tpu.tune.space import Candidate
+
+    cands, pruned = enumerate_candidates(
+        LADDER_FP, 16, platform="cpu",
+        extra=synth.synth_candidates(LADDER_FP))
+    assert "synth_ladder" in {c.name for c in cands}
+    bad = Candidate("synth_bad",
+                    build={"kernel": "pallas_sell"},
+                    kernel_opts={"schedule": [dict(
+                        synth.synthesize_schedule(LADDER_FP)[0],
+                        ring=0)]})
+    cands, pruned = enumerate_candidates(LADDER_FP, 16,
+                                         platform="cpu", extra=[bad])
+    assert "synth_bad" not in {c.name for c in cands}
+    assert pruned["synth_bad"].startswith("kcert:")
+
+
+def test_planted_synth_fixture_fires_exactly_kc2():
+    fired = {f.rule for f in kcert.certify_paths([SYNTH_FIXTURE])}
+    assert fired == {"KC2"}
+
+
+def test_planted_synth_fixture_fails_kernel_gate_paths():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kernel_gate.py"),
+         "--paths", SYNTH_FIXTURE],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "KC2" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# TunePlan persistence of schedules
+# ---------------------------------------------------------------------------
+
+def test_plan_schedule_round_trip(tmp_path):
+    sched = synth.synthesize_schedule(LADDER_FP)
+    plan = TunePlan(structure_hash="h", k=16, candidate="synth_ladder",
+                    kernel="pallas_sell", schedule=sched)
+    assert plan.kernel_opts()["schedule"] == sched
+    d = str(tmp_path / "plans")
+    save_plans("h", {16: plan}, directory=d)
+    got = load_plan("h", 16, d)
+    assert got.schedule == sched
+    assert got.kernel_opts()["schedule"] == sched
+    # Uniform-knob plans keep their shape: no schedule key at all.
+    assert "schedule" not in TunePlan(structure_hash="h",
+                                      k=16).kernel_opts()
+
+
+# ---------------------------------------------------------------------------
+# Executor semantics: bit-identity + the fused int8 carriage
+# ---------------------------------------------------------------------------
+
+def _golden_fold(levels, width, x_host):
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    multi = MultiLevelArrow(levels, width, mesh=None, fmt="fold")
+    x = multi.set_features(x_host)
+    return np.asarray(multi.gather_result(multi.step(x)),
+                      dtype=np.float32)
+
+
+def test_scheduled_executor_bit_identical_to_uniform_pallas():
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    levels, width = _levels(), 16
+    fp = structure_fingerprint(levels, width, np.float32)
+    sched = synth.synthesize_schedule(fp)
+    assert sched, "live BA ladder must synthesize a schedule"
+
+    base = MultiLevelArrow(levels, width, mesh=None, fmt="fold",
+                           kernel="pallas_sell",
+                           kernel_opts={"interpret": True})
+    x_host = random_dense(base.n, 16, seed=7)
+
+    def run(m):
+        return np.asarray(m.gather_result(
+            m.step(m.set_features(x_host))), dtype=np.float32)
+
+    scheduled = MultiLevelArrow(
+        levels, width, mesh=None, fmt="fold", kernel="pallas_sell",
+        kernel_opts={"interpret": True, "schedule": sched})
+    got = run(scheduled)
+    # The all-f32 schedule's numeric claim: per-tier knobs repartition
+    # slabs, the per-row accumulation order is unchanged — BITWISE
+    # equal to the uniform-knob pallas path.  (Vs the XLA golden fold
+    # the pallas gather order differs, so on the cpu-interpret
+    # evaluator the race records the honest tolerance-close result.)
+    np.testing.assert_array_equal(got, run(base))
+    want = _golden_fold(levels, width, x_host)
+    gn = float(np.linalg.norm(want.astype(np.float64)))
+    rel = float(np.linalg.norm(got.astype(np.float64)
+                               - want.astype(np.float64))) / gn
+    assert rel < 1e-5, rel
+
+
+def test_int8_fused_carriage_accuracy_and_dtype():
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    levels, width = _levels(), 16
+    multi = MultiLevelArrow(
+        levels, width, mesh=None, fmt="fold", kernel="pallas_sell",
+        feature_dtype="int8", kernel_opts={"interpret": True})
+    x_host = random_dense(multi.n, 16, seed=7)
+    got = np.asarray(multi.gather_result(
+        multi.step(multi.set_features(x_host))), dtype=np.float32)
+    want = _golden_fold(levels, width, x_host)
+    # (q, scale) carriage with f32 accumulate: quantization noise only
+    # — never bit-identical, always within the int8 class tolerance.
+    gn = float(np.linalg.norm(want.astype(np.float64)))
+    rel = float(np.linalg.norm(got.astype(np.float64)
+                               - want.astype(np.float64))) / gn
+    assert 0.0 < rel < 0.05, rel
+
+
+def test_pallas_sell_int8_candidate_is_approx_class_only():
+    for tc, eligible in (("exact", None), ("approx", True)):
+        cands, _ = enumerate_candidates(LADDER_FP, 16, platform="tpu",
+                                        traffic_class=tc)
+        by_name = {c.name: c for c in cands}
+        if eligible is None:
+            assert "pallas_sell_int8" not in by_name
+        else:
+            assert by_name["pallas_sell_int8"].eligible is eligible
+    # allow_int8 surfaces it in the exact class as a diagnostic.
+    cands, _ = enumerate_candidates(LADDER_FP, 16, platform="tpu",
+                                    allow_int8=True)
+    by_name = {c.name: c for c in cands}
+    assert by_name["pallas_sell_int8"].eligible is False
+
+
+# ---------------------------------------------------------------------------
+# The synth search: race, persist, pure cache hit
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def synth_reports(tmp_path_factory):
+    """ONE bounded synth search (default + synth_ladder children) plus
+    an immediate second search of the unchanged structure, against a
+    tmp program store and plan cache."""
+    from arrow_matrix_tpu.tune.search import search
+
+    # Pin the lazy registry load to the COMMITTED store before the env
+    # override — the one-shot loader must not capture the tmp store.
+    registered_kernels()
+    d = str(tmp_path_factory.mktemp("synth_search"))
+    store = os.path.join(d, "synth_programs.json")
+    source = {"kind": "ba", "n": 96, "m": 3, "width": 16, "seed": 3,
+              "max_levels": 4}
+    saved = {k: os.environ.get(k)
+             for k in ("AMT_SYNTH_STORE", "AMT_FLIGHT_DIR")}
+    os.environ["AMT_SYNTH_STORE"] = store
+    os.environ["AMT_FLIGHT_DIR"] = os.path.join(d, "flight")
+    try:
+        kwargs = dict(k=16, iters=1, timeout_s=180.0,
+                      plan_dir=os.path.join(d, "tune_plans"),
+                      run_dir=os.path.join(d, "tune_runs"),
+                      ledger_dir=os.path.join(d, "ledger"),
+                      restrict=["default", "synth_ladder"],
+                      synth=True, quiet=True)
+        p1, r1 = search(source, **kwargs)
+        p2, r2 = search(source, **kwargs)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if r1.get("synth_program"):
+            unregister_kernel(r1["synth_program"])
+    return d, store, (p1, r1), (p2, r2)
+
+
+def test_synth_search_races_and_persists_program(synth_reports):
+    d, store, (p1, r1), _ = synth_reports
+    assert p1 is not None and not r1["cache_hit"]
+    assert r1["children_spawned"] == 2
+    assert "synth_ladder" in r1["results"]
+    # The generated schedule raced under the f32 bit-identity win
+    # rule.  On cpu-interpret the pallas gather order differs from the
+    # XLA golden, so the honest recorded result is tolerance-close —
+    # bit_identical is an explicit False, never an error.
+    sr = r1["results"]["synth_ladder"]
+    assert sr.get("error") is None and sr["ms"] is not None
+    assert sr["bit_identical"] in (True, False)
+    assert sr["rel_frobenius"] is not None and sr["rel_frobenius"] < 1e-5
+    # The surviving program landed in the store, named by structure
+    # hash, schedule intact.
+    name = r1["synth_program"]
+    assert name == synth.program_name(r1["structure_hash"])
+    doc = synth.load_store(store)
+    assert name in doc["programs"]
+    prog = doc["programs"][name]
+    assert prog["structure_hash"] == r1["structure_hash"]
+    assert prog["schedule"] and prog["summary"]
+    # And certifies clean straight off the stored record.
+    rec = kcert.certify_entry(synth.entry_from_program(name, prog))
+    assert rec["ok"], rec["findings"]
+
+
+def test_second_synth_search_is_pure_hit_zero_children(synth_reports):
+    _, _, (p1, r1), (p2, r2) = synth_reports
+    assert r2["cache_hit"] and r2["children_spawned"] == 0
+    assert p2.candidate == p1.candidate
+    # Purity includes synthesis: a cache hit never re-synthesizes or
+    # re-persists (the report carries no program on the hit path).
+    assert "synth_program" not in r2
+
+
+def test_synth_winner_plan_replays_bitwise(synth_reports, monkeypatch):
+    from arrow_matrix_tpu.parallel import MultiLevelArrow
+
+    d, _, (p1, r1), _ = synth_reports
+    monkeypatch.setenv("AMT_TUNE_PLAN_DIR",
+                       os.path.join(d, "tune_plans"))
+    levels = _levels()
+    tuned = MultiLevelArrow(levels, 16, plan="auto")
+    assert tuned.tune_plan is not None
+    assert tuned.tune_plan.candidate == p1.candidate
+    if p1.candidate == "synth_ladder":
+        assert tuned.tune_plan.schedule == p1.schedule
+    x_host = random_dense(tuned.n, 16, seed=11)
+    got = np.asarray(tuned.gather_result(
+        tuned.step(tuned.set_features(x_host))), dtype=np.float32)
+    np.testing.assert_array_equal(got, _golden_fold(levels, 16, x_host))
+
+
+# ---------------------------------------------------------------------------
+# Store + registry round trip
+# ---------------------------------------------------------------------------
+
+def test_store_version_skew_is_loud(tmp_path):
+    p = str(tmp_path / "store.json")
+    with open(p, "w", encoding="utf-8") as fh:
+        json.dump({"version": 999, "programs": {}}, fh)
+    with pytest.raises(ValueError, match="version skew"):
+        synth.load_store(p)
+    with open(p, "w", encoding="utf-8") as fh:
+        json.dump({"nope": 1}, fh)
+    with pytest.raises(ValueError, match="not a program store"):
+        synth.load_store(p)
+    assert synth.load_store(str(tmp_path / "absent.json")) == {
+        "version": synth.STORE_VERSION, "programs": {}}
+
+
+def test_registry_lazy_loads_store_in_fresh_process(tmp_path):
+    # persist into a tmp store WITHOUT touching this process's
+    # registry state beyond the explicit unregister below.
+    fp = LADDER_FP
+    h = fingerprint_hash(fp)
+    store = str(tmp_path / "store.json")
+    name = synth.persist_program(fp, h, 16,
+                                 synth.synthesize_schedule(fp),
+                                 path=store)
+    unregister_kernel(name)
+    code = ("import os; "
+            "from arrow_matrix_tpu.ops.kernel_contract import "
+            "builtin_kernels, registered_kernels; "
+            "names = [e.name for e in registered_kernels()]; "
+            "print('REG', len(builtin_kernels()), "
+            f"{name!r} in names)")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd=REPO,
+        env=dict(os.environ, AMT_SYNTH_STORE=store))
+    assert proc.returncode == 0, proc.stderr
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("REG")][-1]
+    # Builtins stay 2; the generated program rides the registry via
+    # the one-shot lazy store load — host-only, no jax needed.
+    assert line == "REG 2 True"
+
+
+def test_committed_store_programs_certify_clean():
+    path = synth.store_path()
+    if not os.path.isfile(path):
+        pytest.skip("no committed synth store yet")
+    doc = synth.load_store(path)
+    assert doc["programs"], "committed store must carry >= 1 program"
+    names = {e.name for e in registered_kernels()}
+    for name, prog in doc["programs"].items():
+        assert name in names
+        rec = kcert.certify_entry(synth.entry_from_program(name, prog))
+        assert rec["ok"], (name, rec["findings"])

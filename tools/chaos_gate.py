@@ -421,6 +421,76 @@ def scenario_lens(workdir):
     return problems
 
 
+def scenario_synth(workdir):
+    """graft-synth: the structure-JIT schedule synthesizer must be
+    deterministic over a hand-built 4-tier ladder, its output must
+    certify under KC1-KC5, a planted-bad schedule (ring 0) must be
+    pruned with a kcert: reason, and a persisted generated program
+    must survive the store round trip — register cleanly, certify
+    cleanly — while a corrupted record must trip the certifier."""
+    from arrow_matrix_tpu.analysis import kernels as graft_kcert
+    from arrow_matrix_tpu.ops.kernel_contract import unregister_kernel
+    from arrow_matrix_tpu.tune import synth
+
+    problems = []
+    fp = {
+        "n": 96, "binary": True, "total_rows": 120,
+        "ladder": {
+            "rows": [24, 64, 24, 8],
+            "nnz": [0, 180, 300, 400],
+            "slots": [0, 256, 384, 512],
+            "slot_width": [0, 4, 16, 80],
+        },
+    }
+    s1 = synth.synthesize_schedule(fp)
+    s2 = synth.synthesize_schedule(fp)
+    if s1 != s2:
+        problems.append("synth: synthesize_schedule is not "
+                        "deterministic over the same fingerprint")
+    fams = [e["family"] for e in s1]
+    if fams != ["tail", "mid", "head"]:
+        problems.append(f"synth: 4-tier ladder (zero/tail/mid/head) "
+                        f"synthesized families {fams}, expected "
+                        f"['tail', 'mid', 'head']")
+    why = graft_kcert.certify_candidate_opts({"schedule": s1}, 16,
+                                             interpret=True)
+    if why is not None:
+        problems.append(f"synth: freshly synthesized schedule did "
+                        f"not certify: {why}")
+    bad = [dict(s1[0], ring=0)]
+    why = graft_kcert.certify_candidate_opts({"schedule": bad}, 16,
+                                             interpret=True)
+    if why is None or not why.startswith("kcert:"):
+        problems.append(f"synth: planted ring=0 schedule was NOT "
+                        f"pruned with a kcert: reason (got {why!r})")
+    store = os.path.join(workdir, "synth_store.json")
+    name = synth.persist_program(fp, "chaos" + "0" * 11, 16, s1,
+                                 path=store)
+    try:
+        names = synth.register_persisted_programs(store)
+        if name not in names:
+            problems.append(f"synth: persisted program {name} did "
+                            f"not come back from the store "
+                            f"(got {names})")
+        progs = synth.load_store(store)["programs"]
+        rec = graft_kcert.certify_entry(
+            synth.entry_from_program(name, progs[name]))
+        if rec["findings"]:
+            problems.append(f"synth: persisted program does not "
+                            f"certify: {rec['findings']}")
+        corrupt = dict(progs[name])
+        corrupt["schedule"] = [dict(e, ring=0)
+                               for e in corrupt["schedule"]]
+        rec = graft_kcert.certify_entry(
+            synth.entry_from_program(name + "_corrupt", corrupt))
+        if not rec["findings"]:
+            problems.append("synth: corrupted store record (ring 0) "
+                            "did NOT trip the certifier")
+    finally:
+        unregister_kernel(name)
+    return problems
+
+
 def scenario_host_kill(workdir):
     """graft-host kill-a-host rung (fast list): a bounded 2-domain
     fleet — 4 spawned workers split into host-0/host-1 — loses ALL of
@@ -602,6 +672,12 @@ def run_gate(workdir, fast=False):
         # ledger-gate call.
         scenarios.append("lens")
         problems += scenario_lens(workdir)
+        # graft-synth rides the fast list: schedule synthesis and
+        # KC1-KC5 certification are host-side meta work, and the store
+        # round trip is a couple of small JSON writes plus one
+        # interpret-mode witness.
+        scenarios.append("synth")
+        problems += scenario_synth(workdir)
         # graft-host rides the fast list: the kill-a-host rung on a
         # BOUNDED 2-domain fleet (tiny operator, 8 requests) — losing
         # a whole fault domain at once must never lose an accepted

@@ -51,6 +51,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LENS_DIR = os.path.join(REPO, "bench_results", "lens")
 PROFILE_PATH = os.path.join(LENS_DIR, "ba_256_3_profile.json")
 MODEL_PATH = os.path.join(LENS_DIR, "ba_256_3_model.json")
+#: graft-synth calibration: the SAME structure profiled under its
+#: synthesized per-level schedule, fitted on the scheduled width-family
+#: keys (``pallas:fam@rbN``) — the tune screen's pricing for generated
+#: candidates.  Committed alongside the menu calibration.
+SYNTH_PROFILE_PATH = os.path.join(LENS_DIR,
+                                  "ba_256_3_synth_profile.json")
+SYNTH_MODEL_PATH = os.path.join(LENS_DIR, "ba_256_3_synth_model.json")
 FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "lens")
 
 #: The committed calibration point: the same deterministic BA 256/3
@@ -171,27 +178,47 @@ def refresh(ledger_dir=None) -> int:
     from arrow_matrix_tpu.tune.search import load_levels_from_source
     from arrow_matrix_tpu.utils.artifacts import atomic_write_json
 
+    import numpy as np
+
+    from arrow_matrix_tpu.tune import synth as synthmod
+    from arrow_matrix_tpu.tune.fingerprint import structure_fingerprint
+
     levels, width = load_levels_from_source(BA_256_3_SOURCE)
-    profile = model = problems = None
-    for attempt in range(REFRESH_ATTEMPTS):
-        profile = lens.profile_fold(
-            levels, width, REFRESH_K, kernel="auto",
-            feature_dtypes=("f32", "bf16"), iters=100)
-        model = lens.fit_from_profile(profile)
-        problems = lens.check_profile(profile, model)
-        if not problems:
-            break
-        print(f"lens gate: refresh attempt {attempt + 1} unclean: "
-              f"{problems}", file=sys.stderr)
-    if problems:
-        print("lens gate: refresh could not produce a clean profile",
-              file=sys.stderr)
-        return 1
+    fp = structure_fingerprint(levels, width, np.float32)
+    sched = synthmod.synthesize_schedule(fp)
+    jobs = [
+        ("menu", PROFILE_PATH, MODEL_PATH,
+         dict(kernel="auto", feature_dtypes=("f32", "bf16"),
+              iters=100)),
+        # The graft-synth point: the same structure run under its
+        # synthesized per-level schedule — the fit lands on the
+        # scheduled width-family keys (pallas:fam@rbN).
+        ("synth", SYNTH_PROFILE_PATH, SYNTH_MODEL_PATH,
+         dict(kernel="pallas", feature_dtypes=("f32",), iters=100,
+              kernel_opts={"schedule": sched})),
+    ]
     os.makedirs(LENS_DIR, exist_ok=True)
-    atomic_write_json(PROFILE_PATH, profile, indent=2, sort_keys=True)
-    atomic_write_json(MODEL_PATH, model.to_dict(), indent=2,
-                      sort_keys=True)
-    ids = lens.record_profile(profile, model, directory=ledger_dir)
+    ids = []
+    for label, ppath, mpath, kwargs in jobs:
+        profile = model = problems = None
+        for attempt in range(REFRESH_ATTEMPTS):
+            profile = lens.profile_fold(levels, width, REFRESH_K,
+                                        **kwargs)
+            model = lens.fit_from_profile(profile)
+            problems = lens.check_profile(profile, model)
+            if not problems:
+                break
+            print(f"lens gate: {label} refresh attempt {attempt + 1} "
+                  f"unclean: {problems}", file=sys.stderr)
+        if problems:
+            print(f"lens gate: {label} refresh could not produce a "
+                  f"clean profile", file=sys.stderr)
+            return 1
+        atomic_write_json(ppath, profile, indent=2, sort_keys=True)
+        atomic_write_json(mpath, model.to_dict(), indent=2,
+                          sort_keys=True)
+        ids += lens.record_profile(profile, model,
+                                   directory=ledger_dir)
     from arrow_matrix_tpu.ledger.gate import main as ledger_main
     rc = ledger_main(["--rebaseline"]
                      + (["--ledger-dir", ledger_dir]
@@ -239,13 +266,28 @@ def main(argv=None) -> int:
     if args.refresh:
         return refresh(ledger_dir=args.ledger_dir)
 
-    for path in (args.profile, args.model):
-        if not os.path.isfile(path):
-            print(f"lens gate: missing committed artifact {path} — "
-                  f"run `python tools/lens_gate.py --refresh`",
-                  file=sys.stderr)
+    pairs = [(args.profile, args.model, False)]
+    if args.profile == PROFILE_PATH and args.model == MODEL_PATH:
+        # Checking the committed calibration covers BOTH committed
+        # pairs: the menu point and the graft-synth scheduled point.
+        pairs.append((SYNTH_PROFILE_PATH, SYNTH_MODEL_PATH, True))
+    problems = []
+    for ppath, mpath, is_synth in pairs:
+        missing = [p for p in (ppath, mpath) if not os.path.isfile(p)]
+        if missing:
+            for path in missing:
+                print(f"lens gate: missing committed artifact {path} "
+                      f"— run `python tools/lens_gate.py --refresh`",
+                      file=sys.stderr)
             return 1
-    problems = check_pair(_load(args.profile), _load(args.model))
+        model_doc = _load(mpath)
+        problems += check_pair(_load(ppath), model_doc)
+        if is_synth and not any(
+                "@rb" in f for f in (model_doc.get("coeffs") or {})):
+            problems.append(
+                f"{os.path.basename(mpath)}: no scheduled width-family "
+                f"keys (kernel:fam@rbN) — the synth calibration does "
+                f"not price generated schedules")
     if problems:
         for p in problems:
             print(f"lens gate: {p}", file=sys.stderr)
